@@ -1,0 +1,34 @@
+"""distributed_tensorflow_tpu — a TPU-native distributed training framework.
+
+A brand-new JAX/XLA/Pallas framework providing the capabilities of the reference
+TF1 parameter-server training suite (``ijustloveses/distributed_tensorflow``):
+single-device training, synchronous data-parallel training, asynchronous
+data-parallel training, and multi-host distribution — re-designed TPU-first.
+
+Architecture stance (see SURVEY.md §7): the reference's parameter-server star
+topology (``tf.train.Server`` + ``replica_device_setter``, reference
+tfdist_between.py:17,32-35) is replaced by flat SPMD over a
+``jax.sharding.Mesh``: parameters live replicated on chips, batches are sharded
+over the ``data`` mesh axis, and gradient aggregation is an XLA all-reduce over
+ICI — there is no parameter server in the loop.
+
+Layer map (mirrors SURVEY.md §1):
+
+=====  =================================  =========================================
+Layer  Reference                          This framework
+=====  =================================  =========================================
+L0     TF 1.2.1 C++ runtime (gRPC/CUDA)   XLA:TPU via jax.jit + native C++ runtime
+                                          helpers (``runtime/``)
+L1     ClusterSpec/Server bootstrap       ``cluster.py`` → jax.distributed
+L2     replica_device_setter placement    ``parallel/mesh.py`` Mesh + PartitionSpec
+L3     graph-built MLP                    ``models/`` pure functions
+L4     (Sync)GradientDescentOptimizer     ``ops/optim.py`` + collective aggregation
+L5     tf.train.Supervisor                ``train/supervisor.py`` (+ orbax ckpt)
+L6     training loop + summaries          ``train/trainer.py`` + ``utils/summary.py``
+L7     nohup-per-task launch              ``launch.py`` / example scripts
+=====  =================================  =========================================
+"""
+
+__version__ = "0.1.0"
+
+from distributed_tensorflow_tpu import config  # noqa: F401

@@ -1,0 +1,127 @@
+"""Cluster bootstrap: the reference's L1 layer, TPU-native (C2, C3, C5).
+
+Reference behavior being reproduced (SURVEY.md §1 L1, §3.2):
+
+- ``tf.app.flags`` ``--job_name={ps,worker} --task_index=N`` select this
+  process's role and rank (reference tfdist_between.py:11-13);
+- ``tf.train.ClusterSpec({"ps": ..., "worker": ...})`` +
+  ``tf.train.Server(...)`` start a per-process gRPC server
+  (reference tfdist_between.py:9,17);
+- ps processes block forever in ``server.join()``
+  (reference tfdist_between.py:27-29).
+
+TPU-native mapping: there is no parameter server and no per-tensor RPC
+transport. ``worker_svrs`` entries become processes in a
+``jax.distributed`` coordination group (entry 0 is the coordinator), the
+global device mesh spans all processes' chips, and all communication is XLA
+collectives over ICI/DCN. The ``ps`` role is accepted for CLI compatibility
+and resolves to an explanatory no-op: a launcher script that starts
+``--job_name=ps`` tasks keeps working, the ps task simply exits cleanly
+instead of serving (its function — holding shared parameters — moved onto
+the chips).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+from typing import Sequence
+
+import jax
+
+from distributed_tensorflow_tpu.config import ClusterConfig
+
+
+def define_flags(parser: argparse.ArgumentParser | None = None) -> argparse.ArgumentParser:
+    """The reference CLI (C2): ``--job_name`` / ``--task_index``."""
+    parser = parser or argparse.ArgumentParser(
+        description="distributed_tensorflow_tpu launcher"
+    )
+    parser.add_argument(
+        "--job_name",
+        type=str,
+        default="worker",
+        choices=("ps", "worker"),
+        help="Role of this process. 'ps' is accepted for compatibility and "
+        "no-ops: parameters live on the chips (no parameter server on TPU).",
+    )
+    parser.add_argument(
+        "--task_index",
+        type=int,
+        default=0,
+        help="Rank of this process within its job (0 = chief/coordinator).",
+    )
+    return parser
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessContext:
+    """What bootstrap resolves for this process."""
+
+    job_name: str
+    task_index: int
+    num_processes: int
+    is_chief: bool
+    is_ps: bool
+
+    @property
+    def should_exit(self) -> bool:
+        return self.is_ps
+
+
+def bootstrap(
+    cluster: ClusterConfig,
+    job_name: str = "worker",
+    task_index: int = 0,
+    *,
+    initialize_distributed: bool | None = None,
+    print_fn=print,
+) -> ProcessContext:
+    """Resolve this process's role; join the multi-host group if one exists.
+
+    The reference's ``Server`` + ``ClusterSpec`` bootstrap becomes
+    ``jax.distributed.initialize(coordinator, num_processes, process_id)``
+    when ``worker_svrs`` lists more than one host (multi-host DCN group);
+    single-process runs skip initialization entirely.
+    """
+    if job_name == "ps":
+        # Reference: print("ps setting up ...") then server.join() forever
+        # (tfdist_between.py:28-29). Here the role is obsolete by design.
+        print_fn("ps setting up ...")
+        print_fn(
+            "ps role is a no-op on TPU: parameters are replicated on chips "
+            "and aggregated over ICI; exiting cleanly."
+        )
+        return ProcessContext(
+            job_name="ps",
+            task_index=task_index,
+            num_processes=cluster.num_processes,
+            is_chief=False,
+            is_ps=True,
+        )
+
+    print_fn("worker setting up ...")
+    n = cluster.num_processes
+    if initialize_distributed is None:
+        initialize_distributed = n > 1
+    if initialize_distributed and n > 1:
+        jax.distributed.initialize(
+            coordinator_address=cluster.coordinator_address,
+            num_processes=n,
+            process_id=task_index,
+        )
+    return ProcessContext(
+        job_name="worker",
+        task_index=task_index,
+        num_processes=n,
+        is_chief=cluster.is_chief(task_index),
+        is_ps=False,
+    )
+
+
+def bootstrap_from_argv(
+    cluster: ClusterConfig, argv: Sequence[str] | None = None, **kw
+) -> ProcessContext:
+    args = define_flags().parse_args(argv if argv is not None else sys.argv[1:])
+    return bootstrap(cluster, args.job_name, args.task_index, **kw)

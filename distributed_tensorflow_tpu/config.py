@@ -1,0 +1,91 @@
+"""Cluster topology + hyperparameter configuration.
+
+Reference parity (component C1, SURVEY.md §2): the reference declares its
+cluster as two host:port lists in ``settings.py:3-4``::
+
+    ps_svrs     = [...]
+    worker_svrs = [...]
+
+This module keeps that exact configuration surface — a user of the reference
+can drop in their ``settings.py`` unchanged — but resolves it TPU-natively:
+the ``ps`` list is accepted and ignored (parameters are GSPMD-replicated on
+chips; there is no parameter-server role), and the ``worker`` list defines the
+set of *processes* (hosts) in a ``jax.distributed`` coordination group, i.e.
+the process axis of the device mesh.
+
+Hyperparameters mirror the reference's module constants
+(batch_size=100, lr=0.001, epochs=100 — reference tfdist_between.py:19-21)
+but are overridable per-run, and carry TPU-specific extras (dtype, mesh shape).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Any, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterConfig:
+    """Topology of a training job.
+
+    ``ps_svrs`` is retained for drop-in compatibility with the reference's
+    ``settings.py`` but plays no runtime role: the PS star is replaced by
+    all-reduce over ICI (SURVEY.md §2a). ``worker_svrs`` host:port entries
+    map 1:1 to ``jax.distributed`` processes; entry 0 is the coordinator
+    (and the chief, matching the reference's ``is_chief=(task_index==0)``,
+    reference tfdist_between.py:78).
+    """
+
+    worker_svrs: tuple[str, ...] = ()
+    ps_svrs: tuple[str, ...] = ()  # accepted, ignored (no PS role on TPU)
+
+    @property
+    def num_processes(self) -> int:
+        return max(1, len(self.worker_svrs))
+
+    @property
+    def coordinator_address(self) -> str | None:
+        return self.worker_svrs[0] if self.worker_svrs else None
+
+    def is_chief(self, task_index: int) -> bool:
+        return task_index == 0
+
+    @classmethod
+    def from_settings_module(cls, module: Any | str = "settings") -> "ClusterConfig":
+        """Load a reference-style ``settings.py`` (C1 parity)."""
+        if isinstance(module, str):
+            module = importlib.import_module(module)
+        return cls(
+            worker_svrs=tuple(getattr(module, "worker_svrs", ())),
+            ps_svrs=tuple(getattr(module, "ps_svrs", ())),
+        )
+
+    @classmethod
+    def from_lists(
+        cls, worker_svrs: Sequence[str], ps_svrs: Sequence[str] = ()
+    ) -> "ClusterConfig":
+        return cls(worker_svrs=tuple(worker_svrs), ps_svrs=tuple(ps_svrs))
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Hyperparameters. Defaults reproduce the reference exactly
+    (reference tfsingle.py:8-10, tfdist_between.py:19-21) plus TPU knobs."""
+
+    batch_size: int = 100
+    learning_rate: float = 0.001
+    epochs: int = 100
+    log_frequency: int = 100  # print every N batches (reference `freq`, :81)
+    seed: int = 1  # reference tf.set_random_seed(1), tfsingle.py:17
+
+    # TPU-first knobs (no reference analog)
+    compute_dtype: str = "bfloat16"  # MXU-friendly activations dtype
+    param_dtype: str = "float32"
+    logs_path: str = "./logs"  # reference logs_path, tfdist_between.py:22
+    checkpoint_dir: str | None = None  # deliberate upgrade: orbax checkpointing
+    sync: bool = True  # sync DP (pmean all-reduce) vs async emulation
+    async_avg_every: int = 0  # async mode: average params every N steps (0 = never)
+
+    def replace(self, **kw) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)

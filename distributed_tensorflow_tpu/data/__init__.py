@@ -1,0 +1,5 @@
+from distributed_tensorflow_tpu.data.mnist import (  # noqa: F401
+    DataSet,
+    Datasets,
+    read_data_sets,
+)

@@ -1,0 +1,96 @@
+"""High-level launcher: config → model + strategy + trainer (L7).
+
+This is where ``TrainConfig``'s mode knobs are honored:
+
+- ``sync=True``  → :class:`SyncDataParallel` (or :class:`SingleDevice` on a
+  1-chip mesh) — the ``tfdist_between_sync.py`` path;
+- ``sync=False`` → :class:`AsyncDataParallel` with
+  ``avg_every=async_avg_every`` — the ``tfdist_between.py`` path;
+- ``compute_dtype`` → the model's MXU compute dtype;
+- ``checkpoint_dir`` → a :class:`Supervisor` wired into the trainer;
+- ``logs_path`` → the TensorBoard scalar writer (chief only, matching the
+  reference where every worker wrote summaries but only the chief's mattered).
+
+The reference's per-script wiring (build graph → Supervisor → loop,
+reference tfdist_between.py:32-113) collapses into :func:`build_trainer`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.cluster import ProcessContext
+from distributed_tensorflow_tpu.config import ClusterConfig, TrainConfig
+from distributed_tensorflow_tpu.data import read_data_sets
+from distributed_tensorflow_tpu.models import MLP
+from distributed_tensorflow_tpu.ops import optim as optim_lib
+from distributed_tensorflow_tpu.parallel import (
+    AsyncDataParallel,
+    SingleDevice,
+    SyncDataParallel,
+    make_mesh,
+)
+from distributed_tensorflow_tpu.train import Trainer
+from distributed_tensorflow_tpu.utils.summary import SummaryWriter
+
+
+def build_strategy(config: TrainConfig, *, devices=None, mesh=None):
+    devices = list(devices if devices is not None else jax.devices())
+    if mesh is None and len(devices) == 1:
+        return SingleDevice()
+    mesh = mesh or make_mesh(devices=devices)
+    if config.sync:
+        return SyncDataParallel(mesh)
+    return AsyncDataParallel(mesh, avg_every=config.async_avg_every)
+
+
+def build_trainer(
+    config: TrainConfig | None = None,
+    *,
+    context: ProcessContext | None = None,
+    model=None,
+    datasets=None,
+    strategy=None,
+    optimizer=None,
+    data_dir: str = "MNIST_data",
+    summary_writer: SummaryWriter | None = None,
+    print_fn=print,
+) -> Trainer:
+    config = config or TrainConfig()
+    is_chief = context.is_chief if context is not None else True
+    model = model or MLP(compute_dtype=jnp.dtype(config.compute_dtype))
+    datasets = datasets or read_data_sets(data_dir, one_hot=True)
+    strategy = strategy or build_strategy(config)
+    optimizer = optimizer or optim_lib.sgd(config.learning_rate)
+    if summary_writer is None and is_chief and config.logs_path:
+        summary_writer = SummaryWriter(config.logs_path)
+    return Trainer(
+        model,
+        datasets,
+        config,
+        strategy=strategy,
+        optimizer=optimizer,
+        summary_writer=summary_writer,
+        is_chief=is_chief,
+        print_fn=print_fn,
+    )
+
+
+def run(
+    cluster: ClusterConfig | None = None,
+    config: TrainConfig | None = None,
+    argv=None,
+    **kw,
+) -> dict | None:
+    """End-to-end entry: parse flags, bootstrap, train. Returns the final
+    metrics dict (or None for a ps no-op process)."""
+    from distributed_tensorflow_tpu.cluster import bootstrap_from_argv
+
+    cluster = cluster or ClusterConfig()
+    ctx = bootstrap_from_argv(cluster, argv)
+    if ctx.should_exit:
+        return None
+    trainer = build_trainer(config, context=ctx, **kw)
+    print("Ready to go")  # reference tfdist_between.py:76
+    return trainer.run()

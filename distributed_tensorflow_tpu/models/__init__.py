@@ -1,0 +1,1 @@
+from distributed_tensorflow_tpu.models.mlp import MLP, MLPParams  # noqa: F401

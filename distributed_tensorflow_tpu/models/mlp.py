@@ -1,0 +1,94 @@
+"""The reference workload: a 2-layer sigmoid/softmax MLP (component C8).
+
+Reference graph (reference tfsingle.py:23-42, identical in all four scripts)::
+
+    y = softmax( sigmoid(x @ W1 + b1) @ W2 + b2 )
+    x: [B, 784]   W1: [784, 100] ~ N(0, 1)   b1: zeros(100)
+                  W2: [100, 10]  ~ N(0, 1)   b2: zeros(10)
+    seed: tf.set_random_seed(1)              (reference tfsingle.py:17)
+
+This is a pure-function re-design, not a graph translation: parameters are an
+explicit pytree, the forward pass is a jit-able function of (params, x), and
+the TPU mapping is explicit — matmuls run on the MXU in bfloat16 with float32
+accumulation (``preferred_element_type``), and probabilities are produced in
+float32 so the reference's numerically naive ``log(softmax)`` loss
+(reference tfsingle.py:44-45) stays finite.
+
+Init parity is distributional, not bitwise (SURVEY.md §7 hard-part b): TF1's
+``random_normal`` stddev-1 draws become JAX PRNG normal draws with the same
+moments; the convergence oracle (≥0.72 test accuracy, SURVEY.md §4) validates
+equivalence.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class MLPParams(NamedTuple):
+    """Parameter pytree. NamedTuple keeps it a static-structure pytree that
+    jit/shard_map handle with zero overhead."""
+
+    w1: jax.Array  # [in_dim, hidden]
+    b1: jax.Array  # [hidden]
+    w2: jax.Array  # [hidden, out]
+    b2: jax.Array  # [out]
+
+
+class MLP:
+    """The reference's 784→100→10 MLP as pure init/apply functions."""
+
+    def __init__(
+        self,
+        in_dim: int = 784,
+        hidden_dim: int = 100,
+        out_dim: int = 10,
+        compute_dtype: jnp.dtype = jnp.bfloat16,
+    ):
+        self.in_dim = in_dim
+        self.hidden_dim = hidden_dim
+        self.out_dim = out_dim
+        self.compute_dtype = compute_dtype
+
+    def init(self, seed: int = 1) -> MLPParams:
+        """N(0,1) weights, zero biases — matching the reference's
+        ``random_normal``/zeros init (reference tfsingle.py:30-36)."""
+        k1, k2 = jax.random.split(jax.random.key(seed))
+        return MLPParams(
+            w1=jax.random.normal(k1, (self.in_dim, self.hidden_dim), jnp.float32),
+            b1=jnp.zeros((self.hidden_dim,), jnp.float32),
+            w2=jax.random.normal(k2, (self.hidden_dim, self.out_dim), jnp.float32),
+            b2=jnp.zeros((self.out_dim,), jnp.float32),
+        )
+
+    def apply(self, params: MLPParams, x: jax.Array) -> jax.Array:
+        """Forward pass → class probabilities, float32.
+
+        Matmuls are cast to ``compute_dtype`` (bf16 → MXU) and accumulate in
+        float32; the softmax itself runs in float32 for loss stability.
+        """
+        cd = self.compute_dtype
+        h = jnp.dot(
+            x.astype(cd), params.w1.astype(cd), preferred_element_type=jnp.float32
+        )
+        h = jax.nn.sigmoid(h + params.b1)
+        logits = jnp.dot(
+            h.astype(cd), params.w2.astype(cd), preferred_element_type=jnp.float32
+        )
+        logits = logits + params.b2
+        return jax.nn.softmax(logits, axis=-1)
+
+    def apply_logits(self, params: MLPParams, x: jax.Array) -> jax.Array:
+        """Forward pass returning pre-softmax logits (for stable-loss variants)."""
+        cd = self.compute_dtype
+        h = jnp.dot(
+            x.astype(cd), params.w1.astype(cd), preferred_element_type=jnp.float32
+        )
+        h = jax.nn.sigmoid(h + params.b1)
+        logits = jnp.dot(
+            h.astype(cd), params.w2.astype(cd), preferred_element_type=jnp.float32
+        )
+        return logits + params.b2

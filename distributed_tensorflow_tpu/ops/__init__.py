@@ -1,0 +1,6 @@
+from distributed_tensorflow_tpu.ops.losses import (  # noqa: F401
+    accuracy,
+    cross_entropy,
+    stable_cross_entropy,
+)
+from distributed_tensorflow_tpu.ops.optim import sgd  # noqa: F401

@@ -1,0 +1,38 @@
+"""Loss and metric ops (component C9, SURVEY.md §2).
+
+The reference's loss is the numerically naive
+``reduce_mean(-reduce_sum(y_ * log(y), axis=1))`` over softmax outputs
+(reference tfsingle.py:44-45) — no logits-based formulation. We reproduce that
+behavior (same value on the same inputs) but guard the log for TPU: softmax
+runs in float32 upstream and the log input is clamped away from zero, so bf16
+underflow can't produce NaN (SURVEY.md §7 hard-part c).
+
+Accuracy is mean(argmax(y) == argmax(y_)) (reference tfsingle.py:51-53).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_LOG_EPS = 1e-30  # clamp for the naive log; far below any float32 softmax output
+
+
+def cross_entropy(probs: jax.Array, labels_one_hot: jax.Array) -> jax.Array:
+    """The reference's naive CE over probabilities, NaN-guarded."""
+    logp = jnp.log(jnp.maximum(probs.astype(jnp.float32), _LOG_EPS))
+    return jnp.mean(-jnp.sum(labels_one_hot * logp, axis=-1))
+
+
+def stable_cross_entropy(logits: jax.Array, labels_one_hot: jax.Array) -> jax.Array:
+    """Logits-based CE (log-softmax) — the numerically sound variant offered
+    alongside reference parity; identical gradient direction, better
+    conditioning for large-scale runs."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.mean(-jnp.sum(labels_one_hot * logp, axis=-1))
+
+
+def accuracy(probs_or_logits: jax.Array, labels_one_hot: jax.Array) -> jax.Array:
+    pred = jnp.argmax(probs_or_logits, axis=-1)
+    true = jnp.argmax(labels_one_hot, axis=-1)
+    return jnp.mean((pred == true).astype(jnp.float32))

@@ -1,0 +1,40 @@
+"""Optimizers (components C10/C12, SURVEY.md §2).
+
+The reference uses plain SGD at lr=0.001
+(``tf.train.GradientDescentOptimizer(0.001).minimize(...)``, reference
+tfdist_between.py:64-66) with a shared non-trainable ``global_step`` counter
+(reference tfsingle.py:20). Here the optimizer is an optax-style pure gradient
+transformation, and ``global_step`` is part of the train state pytree — it
+lives on-device and is incremented inside the compiled step, so it is exact
+under both sync DP (one increment per aggregated apply, matching
+SyncReplicasOptimizer semantics) and async emulation (one per local apply,
+matching HOGWILD counting).
+
+The sync-aggregation machinery of ``SyncReplicasOptimizer`` (C++ conditional
+accumulators + token queues, reference tfdist_between_sync.py:66-68,86) has no
+equivalent here *by design*: gradient averaging is a compiled XLA all-reduce
+over the mesh's ``data`` axis (see ``parallel/``), not an optimizer concern.
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def sgd(learning_rate: float = 0.001) -> optax.GradientTransformation:
+    """The reference optimizer: vanilla SGD, lr=0.001."""
+    return optax.sgd(learning_rate)
+
+
+def make(name: str, learning_rate: float, **kw) -> optax.GradientTransformation:
+    """Small registry so the trainer is not MLP/SGD-specific."""
+    registry = {
+        "sgd": lambda: optax.sgd(learning_rate, **kw),
+        "momentum": lambda: optax.sgd(learning_rate, momentum=kw.pop("momentum", 0.9)),
+        "adam": lambda: optax.adam(learning_rate, **kw),
+        "adamw": lambda: optax.adamw(learning_rate, **kw),
+    }
+    try:
+        return registry[name]()
+    except KeyError:
+        raise ValueError(f"unknown optimizer {name!r}; have {sorted(registry)}")

@@ -1,0 +1,63 @@
+"""Device mesh construction (component C7's TPU-native replacement).
+
+The reference's placement layer is ``tf.train.replica_device_setter`` pinning
+variables round-robin onto ``/job:ps`` tasks and ops onto
+``/job:worker/task:N/gpu:N`` (reference tfdist_between.py:32-35). On TPU there
+are no device strings and no PS: placement is a ``jax.sharding.Mesh`` plus
+``PartitionSpec`` annotations, and XLA/GSPMD inserts the collectives.
+
+The canonical mesh here is 2-D ``('data', 'model')``:
+
+- ``data``  — batch sharding + gradient all-reduce (the reference's only
+  parallelism dimension, SURVEY.md §2b);
+- ``model`` — tensor-parallel axis for layer sharding; size 1 for reference
+  parity but first-class so TP/larger models slot in without redesign
+  (SURVEY.md §2b "leave a model axis open").
+
+On multi-host topologies ``jax.make_mesh`` lays the ``data`` axis across
+hosts so the gradient all-reduce rides ICI within a slice.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    shape: Sequence[int] | None = None,
+    axis_names: Sequence[str] = ("data", "model"),
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build the training mesh.
+
+    Default: all addressable devices on the ``data`` axis, ``model`` axis of
+    size 1 — the TPU equivalent of the reference's N-worker data-parallel
+    cluster (len(worker_svrs) → mesh size).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    if len(shape) != len(axis_names):
+        raise ValueError(f"shape {shape} does not match axis names {axis_names}")
+    return jax.make_mesh(tuple(shape), tuple(axis_names), devices=devices)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Sharding for values replicated on every chip — the role the reference
+    gave PS-hosted variables."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Sharding for per-example batch tensors, split along the data axis."""
+    return NamedSharding(mesh, P(axis))
+
+
+def stacked_per_device(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Sharding for pytrees with a leading per-device axis (async-DP parameter
+    copies): axis 0 is split across the data axis, one slice per chip."""
+    return NamedSharding(mesh, P(axis))

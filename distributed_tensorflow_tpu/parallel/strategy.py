@@ -1,0 +1,310 @@
+"""Execution strategies: the reference's four run modes, TPU-native.
+
+Reference modes → strategies here (SURVEY.md §2b):
+
+- ``tfsingle.py`` (one device)                    → :class:`SingleDevice`
+- ``tfdist_between_sync.py`` (sync DP over PS)    → :class:`SyncDataParallel`
+- ``tfdist_between.py`` (async/HOGWILD DP)        → :class:`AsyncDataParallel`
+- multi-host (settings.py host lists)             → same strategies over a
+  multi-process mesh (see ``cluster.py``)
+
+Design: a Strategy owns placement (how the train state and batches are laid
+out on the mesh) and aggregation (what collective combines gradients). The
+trainer is strategy-agnostic: it calls ``init_state`` once, then
+``train_step(state, x, y) -> (state, cost)`` in the hot loop, all compiled.
+
+Sync DP replaces ``SyncReplicasOptimizer``'s C++ accumulators + token queues
+(reference tfdist_between_sync.py:66-68,86) with a single compiled all-reduce
+over the mesh ``data`` axis — either implicitly via GSPMD (batch sharded,
+params replicated, XLA inserts the reduce) or explicitly via ``shard_map`` +
+``lax.pmean``. Both paths are provided; they compile to the same collective.
+
+Async DP cannot be literal on an SPMD machine (XLA is lockstep; SURVEY.md §7
+hard-part a). It is emulated as HOGWILD-style *local SGD*: each chip owns a
+private parameter copy advancing on its own batch stream (the reference's
+per-worker independent ``minimize``, tfdist_between.py:64-66), with two knobs
+mapping to the reference's observed semantics:
+
+- ``avg_every`` — periodic parameter exchange (mean over chips), bounding
+  staleness the way the PS bounded it by serializing applies;
+- ``update_scale`` — scales the learning rate by the replica count to match
+  async's N×-total-update-count effect on convergence (the README's
+  0.72→0.80 accuracy gain comes from 2× updates, reference README.md:66-72;
+  SURVEY.md §2b sanctions step-count/update-count matching).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.ops import losses as losses_lib
+
+
+class TrainState(NamedTuple):
+    """On-device training state. ``step`` is the reference's ``global_step``
+    (component C12): scalar under sync, per-chip vector under async."""
+
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+LossFn = Callable[[jax.Array, jax.Array], jax.Array]
+
+
+def _loss_from_model(model, loss_fn: LossFn, params, x, y) -> jax.Array:
+    return loss_fn(model.apply(params, x), y)
+
+
+class Strategy:
+    """Interface. Subclasses define placement + aggregation."""
+
+    def init_state(self, model, optimizer: optax.GradientTransformation, seed: int) -> TrainState:
+        raise NotImplementedError
+
+    def make_train_step(self, model, loss_fn: LossFn, optimizer):
+        raise NotImplementedError
+
+    def make_eval_fn(self, model):
+        """Returns fn(state, images, labels) -> accuracy (float32 scalar),
+        evaluating the state's *effective* parameters on a replicated batch."""
+        raise NotImplementedError
+
+    def prepare_batch(self, x, y):
+        """Place a host batch onto devices with this strategy's sharding."""
+        raise NotImplementedError
+
+    def global_step(self, state: TrainState) -> int:
+        return int(jnp.sum(state.step))
+
+    def cost_scalar(self, cost: jax.Array) -> float:
+        return float(jnp.mean(cost))
+
+    @property
+    def num_replicas(self) -> int:
+        return 1
+
+
+class SingleDevice(Strategy):
+    """The ``tfsingle.py`` mode: everything on one chip, ``jax.jit`` step."""
+
+    def init_state(self, model, optimizer, seed: int) -> TrainState:
+        params = model.init(seed)
+        return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+
+    def make_train_step(self, model, loss_fn, optimizer):
+        @partial(jax.jit, donate_argnums=0)
+        def step(state: TrainState, x, y):
+            cost, grads = jax.value_and_grad(
+                partial(_loss_from_model, model, loss_fn)
+            )(state.params, x, y)
+            updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            return TrainState(params, opt_state, state.step + 1), cost
+
+        return step
+
+    def make_eval_fn(self, model):
+        @jax.jit
+        def evaluate(state: TrainState, x, y):
+            return losses_lib.accuracy(model.apply(state.params, x), y)
+
+        return evaluate
+
+    def prepare_batch(self, x, y):
+        return jnp.asarray(x), jnp.asarray(y)
+
+
+class SyncDataParallel(Strategy):
+    """The ``tfdist_between_sync.py`` mode: lockstep DP with gradient
+    averaging — ``SyncReplicasOptimizer`` rebuilt as an ICI all-reduce.
+
+    ``explicit_collectives=False`` (default): GSPMD path — params replicated,
+    batch sharded on ``data``, XLA inserts the gradient reduce.
+    ``explicit_collectives=True``: ``shard_map`` + ``lax.pmean`` path — the
+    collective is visible in the program, pedagogically mirroring the
+    reference's explicit aggregation step.
+    """
+
+    def __init__(self, mesh: Mesh, *, explicit_collectives: bool = False):
+        self.mesh = mesh
+        self.explicit = explicit_collectives
+        self._repl = NamedSharding(mesh, P())
+        self._batch = NamedSharding(mesh, P("data"))
+
+    @property
+    def num_replicas(self) -> int:
+        return self.mesh.shape["data"]
+
+    def init_state(self, model, optimizer, seed: int) -> TrainState:
+        params = model.init(seed)
+        state = TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+        return jax.device_put(state, self._repl)
+
+    def make_train_step(self, model, loss_fn, optimizer):
+        if self.explicit:
+            return self._make_shard_map_step(model, loss_fn, optimizer)
+        return self._make_gspmd_step(model, loss_fn, optimizer)
+
+    def _make_gspmd_step(self, model, loss_fn, optimizer):
+        @partial(
+            jax.jit,
+            donate_argnums=0,
+            in_shardings=(self._repl, self._batch, self._batch),
+            out_shardings=(self._repl, self._repl),
+        )
+        def step(state: TrainState, x, y):
+            cost, grads = jax.value_and_grad(
+                partial(_loss_from_model, model, loss_fn)
+            )(state.params, x, y)
+            updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            return TrainState(params, opt_state, state.step + 1), cost
+
+        return step
+
+    def _make_shard_map_step(self, model, loss_fn, optimizer):
+        n = self.num_replicas
+
+        def local_step(state: TrainState, x, y):
+            cost, grads = jax.value_and_grad(
+                partial(_loss_from_model, model, loss_fn)
+            )(state.params, x, y)
+            # The reference's SyncReplicasOptimizer accumulate-and-average as
+            # one compiled collective over ICI. The cross-replica *sum* is
+            # inserted by AD itself: params are unvarying (P()) under
+            # shard_map, and the transpose of their broadcast is a psum — so
+            # `grads` already holds the summed per-replica gradients; dividing
+            # by the replica count completes the average.
+            grads = jax.tree.map(lambda g: g / n, grads)
+            cost = jax.lax.pmean(cost, "data")
+            updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            return TrainState(params, opt_state, state.step + 1), cost
+
+        mapped = jax.shard_map(
+            local_step,
+            mesh=self.mesh,
+            in_specs=(P(), P("data"), P("data")),
+            out_specs=(P(), P()),
+        )
+        return jax.jit(mapped, donate_argnums=0)
+
+    def make_eval_fn(self, model):
+        @partial(jax.jit, in_shardings=(self._repl, self._repl, self._repl))
+        def evaluate(state: TrainState, x, y):
+            return losses_lib.accuracy(model.apply(state.params, x), y)
+
+        return evaluate
+
+    def prepare_batch(self, x, y):
+        return (
+            jax.device_put(jnp.asarray(x), self._batch),
+            jax.device_put(jnp.asarray(y), self._batch),
+        )
+
+
+class AsyncDataParallel(Strategy):
+    """The ``tfdist_between.py`` mode: HOGWILD-style async DP, emulated as
+    local SGD with per-chip parameter copies (see module docstring).
+
+    State pytrees carry a leading replica axis of size ``n`` sharded across
+    the ``data`` mesh axis — chip i owns copy i, exactly one worker's view.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        *,
+        avg_every: int = 0,
+        update_scale: float | None = None,
+    ):
+        self.mesh = mesh
+        self.n = mesh.shape["data"]
+        self.avg_every = avg_every
+        # None → scale lr by replica count (async N×-update-count parity).
+        self.update_scale = float(self.n if update_scale is None else update_scale)
+        self._stacked = NamedSharding(mesh, P("data"))
+        self._batch = NamedSharding(mesh, P("data"))
+        self._repl = NamedSharding(mesh, P())
+
+    @property
+    def num_replicas(self) -> int:
+        return self.n
+
+    def init_state(self, model, optimizer, seed: int) -> TrainState:
+        # Every reference worker builds the same graph with the same seed
+        # (tf.set_random_seed(1) in each process) — so all copies start equal.
+        params = model.init(seed)
+        opt_state = optimizer.init(params)
+        stacked = jax.tree.map(
+            lambda a: jnp.broadcast_to(a[None], (self.n,) + a.shape),
+            (params, opt_state),
+        )
+        state = TrainState(stacked[0], stacked[1], jnp.zeros((self.n,), jnp.int32))
+        return jax.device_put(state, self._stacked)
+
+    def make_train_step(self, model, loss_fn, optimizer):
+        scale = self.update_scale
+
+        def local_step(state: TrainState, x, y):
+            # Each chip sees leading-axis slices of size 1: its own copy.
+            params = jax.tree.map(lambda a: a[0], state.params)
+            opt_state = jax.tree.map(lambda a: a[0], state.opt_state)
+            cost, grads = jax.value_and_grad(
+                partial(_loss_from_model, model, loss_fn)
+            )(params, x, y)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            updates = jax.tree.map(lambda u: u * scale, updates)
+            params = optax.apply_updates(params, updates)
+            new = TrainState(
+                jax.tree.map(lambda a: a[None], params),
+                jax.tree.map(lambda a: a[None], opt_state),
+                state.step + 1,
+            )
+            return new, cost[None]
+
+        mapped = jax.shard_map(
+            local_step,
+            mesh=self.mesh,
+            in_specs=(P("data"), P("data"), P("data")),
+            out_specs=(P("data"), P("data")),
+        )
+        return jax.jit(mapped, donate_argnums=0)
+
+    def make_exchange_fn(self):
+        """Periodic parameter exchange: every copy jumps to the mean — the
+        staleness-bounding analog of the PS serializing worker applies."""
+
+        @partial(jax.jit, donate_argnums=0, out_shardings=self._stacked)
+        def exchange(state: TrainState):
+            params = jax.tree.map(
+                lambda a: jnp.broadcast_to(a.mean(axis=0, keepdims=True), a.shape),
+                state.params,
+            )
+            return TrainState(params, state.opt_state, state.step)
+
+        return exchange
+
+    def make_eval_fn(self, model):
+        """Evaluates the mean of the per-chip copies — the closest analog of
+        'the parameters on the PS' that every reference worker evaluated."""
+
+        @partial(jax.jit, in_shardings=(self._stacked, self._repl, self._repl))
+        def evaluate(state: TrainState, x, y):
+            params = jax.tree.map(lambda a: a.mean(axis=0), state.params)
+            return losses_lib.accuracy(model.apply(params, x), y)
+
+        return evaluate
+
+    def prepare_batch(self, x, y):
+        return (
+            jax.device_put(jnp.asarray(x), self._batch),
+            jax.device_put(jnp.asarray(y), self._batch),
+        )

@@ -1,0 +1,2 @@
+from distributed_tensorflow_tpu.train.trainer import Trainer  # noqa: F401
+from distributed_tensorflow_tpu.train.supervisor import Supervisor  # noqa: F401

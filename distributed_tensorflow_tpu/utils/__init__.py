@@ -1,0 +1,2 @@
+from distributed_tensorflow_tpu.utils.logging import StepLogger  # noqa: F401
+from distributed_tensorflow_tpu.utils.summary import SummaryWriter  # noqa: F401

@@ -1,0 +1,61 @@
+"""stdout observability (component C14's log surface, SURVEY.md §5).
+
+Reproduces the reference's exact log lines so downstream tooling / eyeballs
+that parsed the reference's output keep working:
+
+- per-``freq``-batches: ``Step: N,  Epoch: E,  Batch: B of T,  Cost: C,
+  AvgTime: Xms`` (reference tfdist_between.py:102-106)
+- per-epoch: ``Test-Accuracy: A`` / ``Total Time: Ts`` (reference :109-110)
+- end: ``Final Cost: C`` / ``Done`` (reference :112,115)
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class StepLogger:
+    """Hot-loop logger with the reference's cadence and wording."""
+
+    def __init__(self, freq: int = 100, print_fn=print):
+        self.freq = freq
+        self._print = print_fn
+        self._begin_time = time.time()
+        self._window_start = time.time()
+        self._window_count = 0
+
+    def reset_window(self) -> None:
+        self._window_start = time.time()
+        self._window_count = 0
+
+    def is_due(self, count: int, batch_count: int) -> bool:
+        """The reference's cadence (tfdist_between.py:99). The single source
+        of truth — the trainer gates its host sync on this same predicate."""
+        return count % self.freq == 0 or count == batch_count
+
+    def maybe_log_step(
+        self, *, step: int, epoch: int, batch: int, batch_count: int, cost: float
+    ) -> None:
+        count = batch + 1
+        if self.is_due(count, batch_count):
+            elapsed = time.time() - self._window_start
+            # Average over the batches actually in this window (the final
+            # window of an epoch may be partial).
+            window = max(count - self._window_count, 1)
+            self._print(
+                "Step: %d," % step,
+                " Epoch: %2d," % (epoch + 1),
+                " Batch: %3d of %3d," % (count, batch_count),
+                " Cost: %.4f," % cost,
+                " AvgTime: %3.2fms" % float(elapsed * 1000 / window),
+            )
+            self._window_count = count
+            self._window_start = time.time()
+
+    def log_epoch(self, *, test_accuracy: float) -> None:
+        self._print("Test-Accuracy: %2.2f" % test_accuracy)
+        self._print("Total Time: %3.2fs" % float(time.time() - self._begin_time))
+
+    def log_final(self, *, cost: float) -> None:
+        self._print("Final Cost: %.4f" % cost)
+        self._print("Done")

@@ -1,0 +1,142 @@
+"""Scalar summaries / TensorBoard event files (component C15, SURVEY.md §2).
+
+The reference writes ``tf.summary.scalar("cost"/"accuracy")`` through a
+``FileWriter('./logs')`` every batch (reference tfsingle.py:55-57,69,81).
+This framework has no TensorFlow dependency, so the ``tfevents`` wire format
+is implemented directly: TFRecord framing (length + masked CRC32C) around
+hand-encoded ``Event``/``Summary`` protobuf messages. TensorBoard reads the
+resulting files natively.
+
+Only the pieces the reference uses are implemented: scalar values keyed by
+tag, plus the file-version header record.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+
+# ---------------------------------------------------------------------------
+# CRC32C (Castagnoli), table-driven — required by the TFRecord framing.
+# ---------------------------------------------------------------------------
+
+_CRC_TABLE: list[int] = []
+
+
+def _build_table() -> None:
+    poly = 0x82F63B78
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        _CRC_TABLE.append(crc)
+
+
+_build_table()
+
+
+def crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# Minimal protobuf wire-format encoders (only what Event/Summary need).
+# ---------------------------------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _field_varint(field: int, value: int) -> bytes:
+    return _varint(field << 3 | 0) + _varint(value)
+
+
+def _field_double(field: int, value: float) -> bytes:
+    return _varint(field << 3 | 1) + struct.pack("<d", value)
+
+
+def _field_float(field: int, value: float) -> bytes:
+    return _varint(field << 3 | 5) + struct.pack("<f", value)
+
+
+def _field_bytes(field: int, value: bytes) -> bytes:
+    return _varint(field << 3 | 2) + _varint(len(value)) + value
+
+
+def _encode_scalar_event(wall_time: float, step: int, tag: str, value: float) -> bytes:
+    # Summary.Value { string tag = 1; float simple_value = 2; }
+    sval = _field_bytes(1, tag.encode()) + _field_float(2, value)
+    # Summary { repeated Value value = 1; }
+    summary = _field_bytes(1, sval)
+    # Event { double wall_time = 1; int64 step = 2; Summary summary = 5; }
+    return _field_double(1, wall_time) + _field_varint(2, step) + _field_bytes(5, summary)
+
+
+def _encode_version_event(wall_time: float) -> bytes:
+    # Event { double wall_time = 1; string file_version = 3; }
+    return _field_double(1, wall_time) + _field_bytes(3, b"brain.Event:2")
+
+
+class SummaryWriter:
+    """Drop-in for the reference's ``FileWriter('./logs')`` scalar usage."""
+
+    def __init__(self, logdir: str, filename_suffix: str = ""):
+        os.makedirs(logdir, exist_ok=True)
+        fname = "events.out.tfevents.%010d.%s%s" % (
+            int(time.time()),
+            socket.gethostname(),
+            filename_suffix,
+        )
+        self._path = os.path.join(logdir, fname)
+        self._f = open(self._path, "ab")
+        self._write_record(_encode_version_event(time.time()))
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def _write_record(self, data: bytes) -> None:
+        header = struct.pack("<Q", len(data))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(data)
+        self._f.write(struct.pack("<I", _masked_crc(data)))
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        self._write_record(
+            _encode_scalar_event(time.time(), int(step), tag, float(value))
+        )
+
+    def add_scalars(self, scalars: dict[str, float], step: int) -> None:
+        for tag, value in scalars.items():
+            self.add_scalar(tag, value, step)
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.flush()
+        self._f.close()
+
+    def __enter__(self) -> "SummaryWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

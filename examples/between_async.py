@@ -1,0 +1,27 @@
+"""Async data-parallel training — the ``tfdist_between.py`` equivalent
+(SURVEY.md §3.3).
+
+Run:  ``python examples/between_async.py --job_name=worker --task_index=0``
+      ``python examples/between_async.py --job_name=ps --task_index=0``  (no-op)
+
+The reference's HOGWILD parameter-server updates become per-chip parameter
+copies with periodic exchange and update-count-scaled steps
+(see parallel/strategy.py docstring). ``settings.py``'s worker list sizes the
+multi-host process group; all local chips join the mesh.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import settings  # the reference-compatible cluster module
+
+from distributed_tensorflow_tpu.config import ClusterConfig, TrainConfig
+from distributed_tensorflow_tpu.launch import run
+
+if __name__ == "__main__":
+    run(
+        ClusterConfig.from_settings_module(settings),
+        TrainConfig(sync=False, async_avg_every=50),
+    )

@@ -1,0 +1,25 @@
+"""Sync data-parallel training — the ``tfdist_between_sync.py`` equivalent
+(SURVEY.md §3.4).
+
+Run:  ``python examples/between_sync.py --job_name=worker --task_index=0``
+
+``SyncReplicasOptimizer``'s accumulate-average-apply becomes a compiled
+gradient all-reduce over the mesh's ``data`` axis — no queues, no chief
+queue-runner, no parameter server.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import settings
+
+from distributed_tensorflow_tpu.config import ClusterConfig, TrainConfig
+from distributed_tensorflow_tpu.launch import run
+
+if __name__ == "__main__":
+    run(
+        ClusterConfig.from_settings_module(settings),
+        TrainConfig(sync=True),
+    )

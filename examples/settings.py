@@ -1,0 +1,7 @@
+"""Cluster topology, drop-in compatible with the reference's settings.py
+(reference settings.py:3-4). ``ps_svrs`` is accepted and ignored on TPU —
+parameters live on the chips (SURVEY.md §2a). Each worker entry is one host
+process in the jax.distributed group; entry 0 is the coordinator/chief."""
+
+ps_svrs = ["localhost:2222"]
+worker_svrs = ["localhost:2223"]

@@ -1,0 +1,50 @@
+"""Test harness: 8 virtual CPU devices.
+
+The reference's answer to "test a cluster without a cluster" was multiple
+processes on localhost ports (SURVEY.md §4 item 4). The TPU-native analog is
+a host-platform device mesh: XLA_FLAGS forces 8 fake CPU devices, so every
+sharding/collective path compiles and runs exactly as it would on an 8-chip
+slice. Must run before the first jax import anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The container's sitecustomize force-registers the TPU plugin and pins
+# JAX_PLATFORMS; the config update below wins over both.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def datasets():
+    from distributed_tensorflow_tpu.data import read_data_sets
+
+    return read_data_sets("MNIST_data", one_hot=True)
+
+
+@pytest.fixture(scope="session")
+def small_datasets():
+    """A reduced dataset for fast convergence smoke tests."""
+    from distributed_tensorflow_tpu.data import read_data_sets
+    from distributed_tensorflow_tpu.data.mnist import DataSet, Datasets
+
+    ds = read_data_sets("MNIST_data", one_hot=True)
+    rng = np.random.default_rng(0)
+    idx = rng.permutation(ds.train.num_examples)[:8000]
+    tidx = rng.permutation(ds.test.num_examples)[:2000]
+    return Datasets(
+        train=DataSet(ds.train.images[idx], ds.train.labels[idx], seed=1),
+        validation=ds.validation,
+        test=DataSet(ds.test.images[tidx], ds.test.labels[tidx], seed=2),
+    )

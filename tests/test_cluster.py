@@ -1,0 +1,60 @@
+"""Cluster bootstrap tests (C1/C2/C3/C5): flags, settings parity, ps no-op."""
+
+import types
+
+from distributed_tensorflow_tpu.cluster import bootstrap, define_flags
+from distributed_tensorflow_tpu.config import ClusterConfig
+
+
+def _settings(ps, workers):
+    mod = types.ModuleType("settings")
+    mod.ps_svrs = ps
+    mod.worker_svrs = workers
+    return mod
+
+
+def test_settings_module_parity():
+    # The reference's settings.py surface loads unchanged (C1).
+    cfg = ClusterConfig.from_settings_module(
+        _settings(["h1:2222"], ["h1:2223", "h2:2223"])
+    )
+    assert cfg.num_processes == 2
+    assert cfg.coordinator_address == "h1:2223"
+    assert cfg.ps_svrs == ("h1:2222",)
+    assert cfg.is_chief(0) and not cfg.is_chief(1)
+
+
+def test_flags_parse_reference_cli():
+    args = define_flags().parse_args(["--job_name=worker", "--task_index=3"])
+    assert args.job_name == "worker"
+    assert args.task_index == 3
+    # defaults
+    args = define_flags().parse_args([])
+    assert args.job_name == "worker" and args.task_index == 0
+
+
+def test_ps_role_is_clean_noop():
+    # The reference ps blocks forever (server.join, tfdist_between.py:29);
+    # ours explains itself and exits cleanly (C5's TPU-native fate).
+    lines = []
+    cfg = ClusterConfig.from_lists(["h1:2223"], ["h1:2222"])
+    ctx = bootstrap(cfg, "ps", 0, print_fn=lines.append)
+    assert ctx.is_ps and ctx.should_exit and not ctx.is_chief
+    assert lines[0] == "ps setting up ..."  # reference's exact line
+    assert any("no-op" in l for l in lines)
+
+
+def test_worker_single_process_no_distributed_init():
+    cfg = ClusterConfig.from_lists(["h1:2223"])
+    lines = []
+    ctx = bootstrap(cfg, "worker", 0, print_fn=lines.append)
+    assert not ctx.is_ps and ctx.is_chief
+    assert ctx.num_processes == 1
+    assert lines[0] == "worker setting up ..."
+
+
+def test_chief_is_task_zero_only():
+    cfg = ClusterConfig.from_lists(["h1:1", "h2:2", "h3:3"])
+    ctx = bootstrap(cfg, "worker", 2, initialize_distributed=False)
+    assert not ctx.is_chief
+    assert ctx.num_processes == 3

@@ -1,0 +1,63 @@
+"""Data pipeline tests (C6): loader API parity + batching semantics."""
+
+import numpy as np
+
+from distributed_tensorflow_tpu.data import read_data_sets
+from distributed_tensorflow_tpu.data.mnist import IMAGE_PIXELS, NUM_CLASSES, DataSet
+
+
+def test_splits_and_shapes(datasets):
+    # The tutorial loader's split: 55000 train / 5000 val / 10000 test
+    # (reference consumes int(55000/100)=550 batches/epoch, tfdist_between.py:87).
+    assert datasets.train.num_examples == 55000
+    assert datasets.validation.num_examples == 5000
+    assert datasets.test.num_examples == 10000
+    assert datasets.train.images.shape == (55000, IMAGE_PIXELS)
+    assert datasets.train.labels.shape == (55000, NUM_CLASSES)
+    assert datasets.train.images.dtype == np.float32
+
+
+def test_pixel_range_and_one_hot(datasets):
+    assert datasets.train.images.min() >= 0.0
+    assert datasets.train.images.max() <= 1.0
+    sums = datasets.train.labels.sum(axis=1)
+    np.testing.assert_allclose(sums, 1.0)
+    # All ten classes present in both splits.
+    assert set(datasets.train.labels.argmax(1)) == set(range(10))
+    assert set(datasets.test.labels.argmax(1)) == set(range(10))
+
+
+def test_next_batch_epoch_semantics():
+    x = np.arange(10, dtype=np.float32)[:, None]
+    y = np.eye(10, dtype=np.float32)
+    ds = DataSet(x, y, seed=0)
+    seen = []
+    for _ in range(5):
+        bx, _ = ds.next_batch(2)
+        seen.extend(bx[:, 0].astype(int).tolist())
+    # One full epoch = every example exactly once (shuffled traversal).
+    assert sorted(seen) == list(range(10))
+    assert ds.epochs_completed == 0
+    ds.next_batch(2)
+    assert ds.epochs_completed == 1
+
+
+def test_non_one_hot_labels():
+    ds = read_data_sets("MNIST_data", one_hot=False)
+    assert ds.train.labels.ndim == 1
+    assert ds.train.labels.max() == 9
+
+
+def test_determinism():
+    a = read_data_sets("MNIST_data", one_hot=True, synthetic=True)
+    b = read_data_sets("MNIST_data", one_hot=True, synthetic=True)
+    np.testing.assert_array_equal(a.train.images[:100], b.train.images[:100])
+    np.testing.assert_array_equal(a.test.labels[:100], b.test.labels[:100])
+
+
+def test_shard():
+    ds = read_data_sets("MNIST_data", one_hot=True)
+    s0 = ds.train.shard(4, 0)
+    s3 = ds.train.shard(4, 3)
+    assert s0.num_examples == 55000 // 4
+    assert not np.array_equal(s0.images[:10], s3.images[:10])

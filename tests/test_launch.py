@@ -1,0 +1,53 @@
+"""Launcher tests: TrainConfig knobs actually select behavior."""
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.config import TrainConfig
+from distributed_tensorflow_tpu.launch import build_strategy, build_trainer
+from distributed_tensorflow_tpu.parallel import (
+    AsyncDataParallel,
+    SingleDevice,
+    SyncDataParallel,
+)
+
+
+def test_sync_knob_selects_strategy():
+    sync = build_strategy(TrainConfig(sync=True))
+    assert isinstance(sync, SyncDataParallel)
+    as_ = build_strategy(TrainConfig(sync=False, async_avg_every=10))
+    assert isinstance(as_, AsyncDataParallel)
+    assert as_.avg_every == 10
+
+
+def test_single_device_on_one_chip():
+    strat = build_strategy(TrainConfig(), devices=jax.devices()[:1])
+    assert isinstance(strat, SingleDevice)
+
+
+def test_compute_dtype_honored(small_datasets):
+    tr = build_trainer(
+        TrainConfig(compute_dtype="float32", logs_path=""),
+        datasets=small_datasets,
+        strategy=SingleDevice(),
+        print_fn=lambda *a: None,
+    )
+    assert tr.model.compute_dtype == jnp.float32
+
+
+def test_checkpoint_dir_wires_supervisor(tmp_path, small_datasets):
+    cfg = TrainConfig(
+        epochs=1, checkpoint_dir=str(tmp_path / "ck"), logs_path=""
+    )
+    tr = build_trainer(
+        cfg, datasets=small_datasets, strategy=SingleDevice(), print_fn=lambda *a: None
+    )
+    assert tr.supervisor is not None
+    tr.run(epochs=1)
+    assert tr.supervisor.latest_step() == 80
+    # Restore: a fresh trainer resumes from the checkpointed step.
+    tr2 = build_trainer(
+        cfg, datasets=small_datasets, strategy=SingleDevice(), print_fn=lambda *a: None
+    )
+    assert tr2.start_step == 80
+    assert int(tr2.state.step) == 80

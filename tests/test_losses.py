@@ -1,0 +1,40 @@
+"""Loss/metric tests (C9) against hand-computed values."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.ops import accuracy, cross_entropy, stable_cross_entropy
+
+
+def test_cross_entropy_hand_value():
+    probs = jnp.array([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]])
+    y = jnp.array([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]])
+    want = -(np.log(0.7) + np.log(0.8)) / 2
+    np.testing.assert_allclose(float(cross_entropy(probs, y)), want, rtol=1e-5)
+
+
+def test_cross_entropy_no_nan_on_zero_prob():
+    # The reference's naive log(softmax) NaNs on exact zeros; ours must not
+    # (SURVEY.md §7 hard-part c).
+    probs = jnp.array([[1.0, 0.0, 0.0]])
+    y = jnp.array([[0.0, 1.0, 0.0]])
+    val = float(cross_entropy(probs, y))
+    assert np.isfinite(val)
+
+
+def test_stable_matches_naive_on_good_inputs():
+    logits = jnp.array([[2.0, -1.0, 0.5], [0.0, 3.0, -2.0]])
+    y = jnp.array([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]])
+    probs = jnp.exp(logits - jnp.max(logits, -1, keepdims=True))
+    probs = probs / probs.sum(-1, keepdims=True)
+    np.testing.assert_allclose(
+        float(cross_entropy(probs, y)),
+        float(stable_cross_entropy(logits, y)),
+        rtol=1e-5,
+    )
+
+
+def test_accuracy():
+    probs = jnp.array([[0.9, 0.1], [0.3, 0.7], [0.6, 0.4], [0.2, 0.8]])
+    y = jnp.array([[1.0, 0.0], [0.0, 1.0], [0.0, 1.0], [0.0, 1.0]])
+    np.testing.assert_allclose(float(accuracy(probs, y)), 0.75)

@@ -1,0 +1,131 @@
+"""Parallelism tests on the 8-virtual-device CPU mesh (SURVEY.md §4's
+localhost-ports trick, TPU-style).
+
+Key invariant: sync DP over N devices is mathematically identical to
+single-device training on the same global batch (SyncReplicasOptimizer
+semantics — average of per-replica grads == grad of the global-batch mean
+loss). The GSPMD path and the explicit shard_map/pmean path must agree with
+each other and with single-device, step for step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models import MLP
+from distributed_tensorflow_tpu.ops import cross_entropy, sgd
+from distributed_tensorflow_tpu.parallel import (
+    AsyncDataParallel,
+    SingleDevice,
+    SyncDataParallel,
+    make_mesh,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, jax.devices()
+    return make_mesh()
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.default_rng(0)
+    x = rng.random((8 * 100, 784), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 800)]
+    return x, y
+
+
+def _run_steps(strategy, batch, n_steps=5, model=None):
+    model = model or MLP(compute_dtype=jnp.float32)
+    opt = sgd(0.001)
+    state = strategy.init_state(model, opt, seed=1)
+    step = strategy.make_train_step(model, cross_entropy, opt)
+    x, y = strategy.prepare_batch(*batch)
+    costs = []
+    for _ in range(n_steps):
+        state, cost = step(state, x, y)
+        costs.append(strategy.cost_scalar(cost))
+    return state, costs
+
+
+def test_mesh_shape(mesh):
+    assert mesh.shape == {"data": 8, "model": 1}
+
+
+def test_sync_dp_matches_single_device(mesh, batch):
+    state_s, costs_s = _run_steps(SingleDevice(), batch)
+    state_d, costs_d = _run_steps(SyncDataParallel(mesh), batch)
+    np.testing.assert_allclose(costs_s, costs_d, rtol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(state_s.params.w1),
+        np.asarray(state_d.params.w1),
+        rtol=1e-4,
+        atol=1e-6,
+    )
+
+
+def test_gspmd_and_explicit_collectives_agree(mesh, batch):
+    state_g, costs_g = _run_steps(SyncDataParallel(mesh), batch)
+    state_e, costs_e = _run_steps(
+        SyncDataParallel(mesh, explicit_collectives=True), batch
+    )
+    np.testing.assert_allclose(costs_g, costs_e, rtol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(state_g.params.w2), np.asarray(state_e.params.w2), rtol=1e-5
+    )
+
+
+def test_sync_dp_step_counter(mesh, batch):
+    state, _ = _run_steps(SyncDataParallel(mesh), batch, n_steps=3)
+    # Sync DP: one global_step per aggregated apply (SyncReplicasOptimizer
+    # semantics: 2 workers → half the applies, reference README.md:148-150).
+    assert SyncDataParallel(mesh).global_step(state) == 3
+
+
+def test_async_dp_diverges_then_exchanges(mesh, batch):
+    strat = AsyncDataParallel(mesh, avg_every=0, update_scale=1.0)
+    model = MLP(compute_dtype=jnp.float32)
+    opt = sgd(0.001)
+    state = strat.init_state(model, opt, seed=1)
+    step = strat.make_train_step(model, cross_entropy, opt)
+    x, y = strat.prepare_batch(*batch)
+    state, cost = step(state, x, y)
+    # Per-chip costs differ (different local batches, HOGWILD-style).
+    costs = np.asarray(cost)
+    assert costs.shape == (8,)
+    assert len(np.unique(costs.round(6))) > 1
+    # Copies diverged after updating on different shards.
+    w1 = np.asarray(state.params.w1)
+    assert w1.shape[0] == 8
+    assert not np.allclose(w1[0], w1[7])
+    # Exchange: all copies jump to the mean.
+    state = strat.make_exchange_fn()(state)
+    w1 = np.asarray(state.params.w1)
+    np.testing.assert_allclose(w1[0], w1[7], rtol=1e-6)
+
+
+def test_async_global_step_counts_all_replicas(mesh, batch):
+    # C12 under async: every local apply counts (reference async mode applied
+    # 2× the updates with 2 workers — README.md:66-72).
+    strat = AsyncDataParallel(mesh)
+    state, _ = _run_steps(strat, batch, n_steps=4)
+    assert strat.global_step(state) == 4 * 8
+
+
+def test_async_eval_uses_mean_params(mesh, batch):
+    strat = AsyncDataParallel(mesh, update_scale=1.0)
+    model = MLP(compute_dtype=jnp.float32)
+    state, _ = _run_steps(strat, batch, n_steps=2, model=model)
+    acc = strat.make_eval_fn(model)(state, batch[0][:200], batch[1][:200])
+    assert 0.0 <= float(acc) <= 1.0
+
+
+def test_model_axis_tensor_parallel_compiles(batch):
+    # The mesh keeps a 'model' axis open (SURVEY.md §2b); a 4x2 mesh must
+    # compile and agree with single-device on the same batch.
+    mesh42 = make_mesh((4, 2))
+    state_s, costs_s = _run_steps(SingleDevice(), batch)
+    state_d, costs_d = _run_steps(SyncDataParallel(mesh42), batch)
+    np.testing.assert_allclose(costs_s, costs_d, rtol=2e-4)
