@@ -1,0 +1,99 @@
+"""Benchmark harness: MNIST MLP training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's best single-device number — 550 batches × 100
+examples in ~1.3 s/epoch on a GTX 1080 (reference README.md:13-15) ≈ 42k
+examples/sec (BASELINE.md). North star: ≥50k examples/sec/chip.
+
+Method: the scanned train path (train/scan.py) — the whole epoch staged in
+device memory, one XLA dispatch per epoch, identical update semantics to the
+reference loop (SGD lr=0.001, batch 100). Warmup dispatch first (compile),
+then the median of several timed epochs. Diagnostics go to stderr; stdout
+carries exactly the one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from distributed_tensorflow_tpu.data import read_data_sets
+from distributed_tensorflow_tpu.models import MLP
+from distributed_tensorflow_tpu.ops import cross_entropy, sgd
+from distributed_tensorflow_tpu.parallel.strategy import SingleDevice
+from distributed_tensorflow_tpu.train.scan import make_scanned_train_fn, stage_epoch
+
+BASELINE_EXAMPLES_PER_SEC = 42_000.0
+BATCH_SIZE = 100
+TIMED_EPOCHS = 5
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    dev = jax.devices()[0]
+    log(f"device: {dev}")
+    ds = read_data_sets("MNIST_data", one_hot=True)
+
+    model = MLP()  # bf16 matmuls, f32 accumulation/softmax
+    opt = sgd(0.001)
+    strategy = SingleDevice()
+    state = strategy.init_state(model, opt, seed=1)
+    run_epoch = make_scanned_train_fn(model, cross_entropy, opt)
+
+    rng = np.random.default_rng(0)
+    xs_np, ys_np = stage_epoch(ds.train.images, ds.train.labels, BATCH_SIZE, rng=rng)
+    steps, batch = xs_np.shape[0], xs_np.shape[1]
+    xs = jax.device_put(jnp.asarray(xs_np), dev)
+    ys = jax.device_put(jnp.asarray(ys_np), dev)
+    log(f"staged epoch: {steps} steps x {batch} examples")
+
+    # Warmup: compile + first run.
+    t0 = time.perf_counter()
+    state, costs = run_epoch(state, xs, ys)
+    jax.block_until_ready(costs)
+    log(f"warmup (incl compile): {time.perf_counter() - t0:.2f}s")
+
+    times = []
+    for e in range(TIMED_EPOCHS):
+        t0 = time.perf_counter()
+        state, costs = run_epoch(state, xs, ys)
+        jax.block_until_ready(costs)
+        dt = time.perf_counter() - t0
+        times.append(dt)
+        log(
+            f"epoch {e + 1}: {dt * 1000:.1f}ms  "
+            f"({steps * batch / dt:,.0f} ex/s)  cost={float(costs[-1]):.4f}"
+        )
+
+    first, last = float(costs[0]), float(costs[-1])
+    if not np.isfinite(last):
+        log("FATAL: non-finite cost")
+        raise SystemExit(1)
+
+    sec_per_epoch = float(np.median(times))
+    examples_per_sec = steps * batch / sec_per_epoch
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_mlp_train_examples_per_sec_per_chip",
+                "value": round(examples_per_sec, 1),
+                "unit": "examples/sec/chip",
+                "vs_baseline": round(examples_per_sec / BASELINE_EXAMPLES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -81,6 +81,23 @@ class MLP:
         logits = logits + params.b2
         return jax.nn.softmax(logits, axis=-1)
 
+    def partition_specs(self, model_axis: str = "model") -> MLPParams:
+        """Tensor-parallel layout over the mesh's ``model`` axis (SURVEY.md
+        §2b: the reference has no TP; the mesh keeps the axis first-class).
+
+        Megatron-style column→row split: W1 sharded on its output (hidden)
+        dim, W2 on its input (hidden) dim — the sigmoid runs on local shards
+        and XLA inserts one all-reduce after the second matmul.
+        """
+        from jax.sharding import PartitionSpec as P
+
+        return MLPParams(
+            w1=P(None, model_axis),
+            b1=P(model_axis),
+            w2=P(model_axis, None),
+            b2=P(None),
+        )
+
     def apply_logits(self, params: MLPParams, x: jax.Array) -> jax.Array:
         """Forward pass returning pre-softmax logits (for stable-loss variants)."""
         cd = self.compute_dtype

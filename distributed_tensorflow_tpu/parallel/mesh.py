@@ -23,7 +23,7 @@ from __future__ import annotations
 from typing import Sequence
 
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import AxisType, Mesh, NamedSharding, PartitionSpec as P
 
 
 def make_mesh(
@@ -36,14 +36,21 @@ def make_mesh(
 
     Default: all addressable devices on the ``data`` axis, ``model`` axis of
     size 1 — the TPU equivalent of the reference's N-worker data-parallel
-    cluster (len(worker_svrs) → mesh size).
+    cluster (len(worker_svrs) → mesh size). Axes are ``Auto`` (GSPMD
+    propagation), matching this framework's annotate-and-let-XLA-infer
+    design; ``with_sharding_constraint`` requires Auto axes.
     """
     devices = list(devices if devices is not None else jax.devices())
     if shape is None:
         shape = (len(devices),) + (1,) * (len(axis_names) - 1)
     if len(shape) != len(axis_names):
         raise ValueError(f"shape {shape} does not match axis names {axis_names}")
-    return jax.make_mesh(tuple(shape), tuple(axis_names), devices=devices)
+    return jax.make_mesh(
+        tuple(shape),
+        tuple(axis_names),
+        devices=devices,
+        axis_types=(AxisType.Auto,) * len(axis_names),
+    )
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

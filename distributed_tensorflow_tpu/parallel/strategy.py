@@ -132,20 +132,50 @@ class SyncDataParallel(Strategy):
     reference's explicit aggregation step.
     """
 
-    def __init__(self, mesh: Mesh, *, explicit_collectives: bool = False):
+    def __init__(
+        self,
+        mesh: Mesh,
+        *,
+        explicit_collectives: bool = False,
+        param_specs=None,
+    ):
+        """``param_specs``: an optional pytree of ``PartitionSpec`` matching
+        the model's params (e.g. ``MLP.partition_specs()``) enabling tensor
+        parallelism over the ``model`` axis on top of DP over ``data``.
+        Without it, params are replicated (pure DP, reference parity)."""
         self.mesh = mesh
         self.explicit = explicit_collectives
         self._repl = NamedSharding(mesh, P())
         self._batch = NamedSharding(mesh, P("data"))
+        self.param_specs = param_specs
+        self._param_shardings = (
+            jax.tree.map(lambda s: NamedSharding(mesh, s), param_specs)
+            if param_specs is not None
+            else None
+        )
+        if explicit_collectives and param_specs is not None:
+            raise ValueError("explicit_collectives path supports pure DP only")
 
     @property
     def num_replicas(self) -> int:
         return self.mesh.shape["data"]
 
     def init_state(self, model, optimizer, seed: int) -> TrainState:
-        params = model.init(seed)
-        state = TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
-        return jax.device_put(state, self._repl)
+        if self._param_shardings is None:
+            params = model.init(seed)
+            state = TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+            return jax.device_put(state, self._repl)
+
+        # TP path: build state inside jit with sharding constraints on the
+        # params; GSPMD propagates matching layouts into the optimizer state.
+        shardings = self._param_shardings
+
+        @jax.jit
+        def _init():
+            params = jax.lax.with_sharding_constraint(model.init(seed), shardings)
+            return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+
+        return _init()
 
     def make_train_step(self, model, loss_fn, optimizer):
         if self.explicit:
@@ -153,21 +183,28 @@ class SyncDataParallel(Strategy):
         return self._make_gspmd_step(model, loss_fn, optimizer)
 
     def _make_gspmd_step(self, model, loss_fn, optimizer):
-        @partial(
-            jax.jit,
-            donate_argnums=0,
-            in_shardings=(self._repl, self._batch, self._batch),
-            out_shardings=(self._repl, self._repl),
-        )
-        def step(state: TrainState, x, y):
+        shardings = self._param_shardings
+
+        def _step(state: TrainState, x, y):
             cost, grads = jax.value_and_grad(
                 partial(_loss_from_model, model, loss_fn)
             )(state.params, x, y)
+            if shardings is not None:
+                grads = jax.lax.with_sharding_constraint(grads, shardings)
             updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             return TrainState(params, opt_state, state.step + 1), cost
 
-        return step
+        if shardings is None:
+            return partial(
+                jax.jit,
+                donate_argnums=0,
+                in_shardings=(self._repl, self._batch, self._batch),
+                out_shardings=(self._repl, self._repl),
+            )(_step)
+        # TP path: computation follows the data/state shardings laid down by
+        # init_state/prepare_batch; no blanket replication constraints.
+        return partial(jax.jit, donate_argnums=0)(_step)
 
     def _make_shard_map_step(self, model, loss_fn, optimizer):
         n = self.num_replicas
@@ -197,11 +234,14 @@ class SyncDataParallel(Strategy):
         return jax.jit(mapped, donate_argnums=0)
 
     def make_eval_fn(self, model):
-        @partial(jax.jit, in_shardings=(self._repl, self._repl, self._repl))
-        def evaluate(state: TrainState, x, y):
+        def _evaluate(state: TrainState, x, y):
             return losses_lib.accuracy(model.apply(state.params, x), y)
 
-        return evaluate
+        if self._param_shardings is None:
+            return partial(
+                jax.jit, in_shardings=(self._repl, self._repl, self._repl)
+            )(_evaluate)
+        return jax.jit(_evaluate)
 
     def prepare_batch(self, x, y):
         return (
