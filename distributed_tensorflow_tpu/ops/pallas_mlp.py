@@ -1,0 +1,174 @@
+"""Pallas TPU kernel: the MLP train step fused into one kernel launch.
+
+The reference's hot loop is fwd → loss → bwd → SGD apply per batch, executed
+as a TF graph of many small CUDA kernels (reference tfsingle.py:78-80). XLA
+already fuses most of that; this module goes the rest of the way with a
+single Pallas kernel computing forward, naive-CE loss, analytic backward,
+and the in-place SGD update in one VMEM-resident program:
+
+    z1 = x·W1+b1; h = σ(z1); p = softmax(h·W2+b2)
+    dlogits = (p - y)/B                        (softmax+CE analytic grad)
+    dW2 = hᵀ·dlogits   dh = dlogits·W2ᵀ
+    dz1 = dh·h·(1-h)   dW1 = xᵀ·dz1
+    W ← W - lr·dW      b ← b - lr·db
+
+Every tensor (batch 100×784 plus both weight matrices, ~700 KB f32) fits in
+VMEM simultaneously, so HBM traffic per step is exactly one read of
+x/y/params and one write of params — the bandwidth floor. The four matmuls
+hit the MXU with f32 accumulation.
+
+Biases are carried as (1, H) 2-D rows: TPU tiling is (sublane, lane)-
+oriented and 1-D vectors would be padded awkwardly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distributed_tensorflow_tpu.models.mlp import MLPParams
+
+_LOG_EPS = 1e-30
+
+
+def _fused_train_kernel(
+    x_ref, y_ref, w1_ref, b1_ref, w2_ref, b2_ref,
+    nw1_ref, nb1_ref, nw2_ref, nb2_ref, cost_ref,
+    *, lr: float,
+):
+    x = x_ref[:]
+    y = y_ref[:]
+    w1 = w1_ref[:]
+    b1 = b1_ref[:]
+    w2 = w2_ref[:]
+    b2 = b2_ref[:]
+
+    # Forward (MXU matmuls, f32 accumulation).
+    z1 = jnp.dot(x, w1, preferred_element_type=jnp.float32) + b1
+    h = jax.nn.sigmoid(z1)
+    logits = jnp.dot(h, w2, preferred_element_type=jnp.float32) + b2
+    p = jax.nn.softmax(logits, axis=-1)
+
+    # The reference's naive CE (NaN-guarded), reference tfsingle.py:44-45.
+    # Shapes stay 2-D throughout: Mosaic's vector layouts are (sublane,
+    # lane)-tiled and 1-D intermediates trip relayout bugs.
+    inv_b = 1.0 / x.shape[0]
+    per_example = -jnp.sum(
+        y * jnp.log(jnp.maximum(p, _LOG_EPS)), axis=-1, keepdims=True
+    )
+    cost_ref[0, 0] = jnp.sum(per_example) * inv_b
+    dlogits = (p - y) * inv_b
+    dw2 = jnp.dot(h.T, dlogits, preferred_element_type=jnp.float32)
+    db2 = jnp.sum(dlogits, axis=0, keepdims=True)
+    dh = jnp.dot(dlogits, w2.T, preferred_element_type=jnp.float32)
+    dz1 = dh * h * (1.0 - h)
+    dw1 = jnp.dot(x.T, dz1, preferred_element_type=jnp.float32)
+    db1 = jnp.sum(dz1, axis=0, keepdims=True)
+
+    # Fused SGD apply (C10 semantics: plain SGD, reference tfdist_between.py:64-66).
+    nw1_ref[:] = w1 - lr * dw1
+    nb1_ref[:] = b1 - lr * db1
+    nw2_ref[:] = w2 - lr * dw2
+    nb2_ref[:] = b2 - lr * db2
+
+
+class FusedState(NamedTuple):
+    """Params with 2-D biases, the kernel's native layout."""
+
+    w1: jax.Array
+    b1: jax.Array  # [1, hidden]
+    w2: jax.Array
+    b2: jax.Array  # [1, out]
+
+
+def to_fused(params: MLPParams) -> FusedState:
+    # copy=True: the caller's buffers may be donated elsewhere (the fused
+    # step itself donates via input_output_aliases), so never alias them.
+    return FusedState(
+        jnp.array(params.w1, jnp.float32, copy=True),
+        jnp.array(params.b1.reshape(1, -1), jnp.float32, copy=True),
+        jnp.array(params.w2, jnp.float32, copy=True),
+        jnp.array(params.b2.reshape(1, -1), jnp.float32, copy=True),
+    )
+
+
+def from_fused(state: FusedState) -> MLPParams:
+    return MLPParams(state.w1, state.b1[0], state.w2, state.b2[0])
+
+
+def make_fused_train_step(
+    *,
+    batch_size: int,
+    in_dim: int = 784,
+    hidden_dim: int = 100,
+    out_dim: int = 10,
+    learning_rate: float = 0.001,
+    interpret: bool | None = None,
+):
+    """Build ``step(fused_state, x, y) -> (fused_state, cost)``, one kernel
+    launch per call. ``interpret=None`` auto-selects the Pallas interpreter
+    off-TPU (CI / CPU-mesh tests) and the Mosaic compiler on TPU."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    f32 = jnp.float32
+    call = pl.pallas_call(
+        partial(_fused_train_kernel, lr=learning_rate),
+        out_shape=(
+            jax.ShapeDtypeStruct((in_dim, hidden_dim), f32),
+            jax.ShapeDtypeStruct((1, hidden_dim), f32),
+            jax.ShapeDtypeStruct((hidden_dim, out_dim), f32),
+            jax.ShapeDtypeStruct((1, out_dim), f32),
+            jax.ShapeDtypeStruct((1, 1), f32),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 6,
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ),
+        # Params update in place: new W/b alias the incoming buffers.
+        input_output_aliases={2: 0, 3: 1, 4: 2, 5: 3},
+        interpret=interpret,
+    )
+
+    @jax.jit
+    def step(state: FusedState, x: jax.Array, y: jax.Array):
+        nw1, nb1, nw2, nb2, cost = call(
+            x.astype(f32), y.astype(f32), state.w1, state.b1, state.w2, state.b2
+        )
+        return FusedState(nw1, nb1, nw2, nb2), cost[0, 0]
+
+    return step
+
+
+def make_fused_scanned_fn(
+    *,
+    batch_size: int,
+    learning_rate: float = 0.001,
+    interpret: bool | None = None,
+    **dims,
+):
+    """Scan the fused kernel over a staged epoch: [steps, B, ...] → one
+    dispatch per epoch AND one kernel per step inside it."""
+    step = make_fused_train_step(
+        batch_size=batch_size, learning_rate=learning_rate, interpret=interpret, **dims
+    )
+
+    @partial(jax.jit, donate_argnums=0)
+    def run(state: FusedState, xs: jax.Array, ys: jax.Array):
+        def body(state, batch):
+            x, y = batch
+            state, cost = step(state, x, y)
+            return state, cost
+
+        return jax.lax.scan(body, state, (xs, ys))
+
+    return run
