@@ -64,6 +64,7 @@ class ProcessContext:
     num_processes: int
     is_chief: bool
     is_ps: bool
+    heartbeat: object | None = None  # chief: HeartbeatCoordinator; worker: HeartbeatWorker
 
     @property
     def should_exit(self) -> bool:
@@ -76,6 +77,8 @@ def bootstrap(
     task_index: int = 0,
     *,
     initialize_distributed: bool | None = None,
+    heartbeat_port: int | None = None,
+    heartbeat_timeout_ms: int = 10_000,
     print_fn=print,
 ) -> ProcessContext:
     """Resolve this process's role; join the multi-host group if one exists.
@@ -84,6 +87,12 @@ def bootstrap(
     ``jax.distributed.initialize(coordinator, num_processes, process_id)``
     when ``worker_svrs`` lists more than one host (multi-host DCN group);
     single-process runs skip initialization entirely.
+
+    ``heartbeat_port`` (optional) arms the native failure detector
+    (runtime/csrc): the chief runs a UDP heartbeat coordinator, non-chiefs a
+    sender — explicit worker-liveness tracking the reference never had
+    (SURVEY.md §5 "Failure detection"). Requires the C++ runtime; silently
+    skipped when unavailable.
     """
     if job_name == "ps":
         # Reference: print("ps setting up ...") then server.join() forever
@@ -111,12 +120,29 @@ def bootstrap(
             num_processes=n,
             process_id=task_index,
         )
+    heartbeat = None
+    if heartbeat_port is not None and n > 1:
+        try:
+            from distributed_tensorflow_tpu.runtime import native
+
+            if cluster.is_chief(task_index):
+                heartbeat = native.HeartbeatCoordinator(
+                    heartbeat_port, expected_workers=n, timeout_ms=heartbeat_timeout_ms
+                )
+            else:
+                host = cluster.coordinator_address.rsplit(":", 1)[0]
+                heartbeat = native.HeartbeatWorker(
+                    host, heartbeat_port, worker_id=task_index
+                )
+        except (ImportError, OSError) as e:  # degrade to no liveness tracking
+            print_fn(f"heartbeat disabled: {e}")
     return ProcessContext(
         job_name="worker",
         task_index=task_index,
         num_processes=n,
         is_chief=cluster.is_chief(task_index),
         is_ps=False,
+        heartbeat=heartbeat,
     )
 
 

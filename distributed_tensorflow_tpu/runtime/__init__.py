@@ -1,0 +1,13 @@
+"""Native (C++) runtime helpers: data pipeline + failure detection.
+
+See ``csrc/dtf_runtime.cc``. Loaded lazily via ctypes; everything in the
+framework that uses this package degrades gracefully to pure Python/numpy
+when the shared library is absent or the toolchain can't build it.
+"""
+
+from distributed_tensorflow_tpu.runtime.native import (  # noqa: F401
+    HeartbeatCoordinator,
+    HeartbeatWorker,
+    available,
+    load_library,
+)

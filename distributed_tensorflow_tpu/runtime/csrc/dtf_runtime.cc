@@ -1,0 +1,290 @@
+// Native runtime helpers for distributed_tensorflow_tpu.
+//
+// The reference leaned on TF 1.2.1's C++ runtime for data feeding and
+// cluster liveness (SURVEY.md §2a): the tutorial loader's numpy pipeline fed
+// sess.run, and worker liveness was implicit in gRPC channel state
+// (tf.train.Server, reference tfdist_between.py:17). This translation unit
+// provides the TPU-native framework's equivalents as a small C library:
+//
+//   1. IDX (MNIST) file parsing + normalized decode to float32 — the host
+//      side of the input pipeline, off the Python interpreter.
+//   2. Shuffled-permutation + batch-gather kernels — next_batch's hot work.
+//   3. A UDP heartbeat coordinator/worker pair — explicit failure detection
+//      for multi-host jobs (SURVEY.md §5 "Failure detection": the reference
+//      had none beyond gRPC blocking; this is the deliberate upgrade).
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this environment).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+uint32_t read_be32(const unsigned char* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+int64_t now_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// 1. IDX parsing
+// ---------------------------------------------------------------------------
+
+// Reads an IDX3 image file; writes n*rows*cols floats in [0,1] into `out`
+// (caller allocates; pass out=nullptr to query the count). Returns the
+// number of images, or -1 on open/parse failure.
+long dtf_load_idx_images(const char* path, float* out, long out_capacity) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  unsigned char header[16];
+  if (std::fread(header, 1, 16, f) != 16 || read_be32(header) != 2051) {
+    std::fclose(f);
+    return -1;
+  }
+  long n = read_be32(header + 4);
+  long rows = read_be32(header + 8);
+  long cols = read_be32(header + 12);
+  long total = n * rows * cols;
+  if (!out) {
+    std::fclose(f);
+    return n;
+  }
+  if (out_capacity < total) {
+    std::fclose(f);
+    return -1;
+  }
+  std::vector<unsigned char> buf(total);
+  if ((long)std::fread(buf.data(), 1, total, f) != total) {
+    std::fclose(f);
+    return -1;
+  }
+  std::fclose(f);
+  constexpr float kInv255 = 1.0f / 255.0f;
+  for (long i = 0; i < total; ++i) out[i] = buf[i] * kInv255;
+  return n;
+}
+
+// Reads an IDX1 label file into int64 `out`. Same conventions as above.
+long dtf_load_idx_labels(const char* path, long* out, long out_capacity) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  unsigned char header[8];
+  if (std::fread(header, 1, 8, f) != 8 || read_be32(header) != 2049) {
+    std::fclose(f);
+    return -1;
+  }
+  long n = read_be32(header + 4);
+  if (!out) {
+    std::fclose(f);
+    return n;
+  }
+  if (out_capacity < n) {
+    std::fclose(f);
+    return -1;
+  }
+  std::vector<unsigned char> buf(n);
+  if ((long)std::fread(buf.data(), 1, n, f) != n) {
+    std::fclose(f);
+    return -1;
+  }
+  std::fclose(f);
+  for (long i = 0; i < n; ++i) out[i] = buf[i];
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// 2. Shuffle + batch gather
+// ---------------------------------------------------------------------------
+
+// Fisher-Yates permutation of [0, n) using splitmix64, deterministic in seed.
+void dtf_shuffle_perm(long* perm, long n, uint64_t seed) {
+  for (long i = 0; i < n; ++i) perm[i] = i;
+  uint64_t s = seed + 0x9E3779B97F4A7C15ull;
+  auto next = [&s]() {
+    uint64_t z = (s += 0x9E3779B97F4A7C15ull);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+    return z ^ (z >> 31);
+  };
+  for (long i = n - 1; i > 0; --i) {
+    long j = (long)(next() % (uint64_t)(i + 1));
+    long t = perm[i];
+    perm[i] = perm[j];
+    perm[j] = t;
+  }
+}
+
+// Gathers rows `idx[0..batch)` of `src` (row_len floats each) into `out`.
+void dtf_gather_rows(const float* src, const long* idx, long batch,
+                     long row_len, float* out) {
+  for (long b = 0; b < batch; ++b) {
+    std::memcpy(out + b * row_len, src + idx[b] * row_len,
+                row_len * sizeof(float));
+  }
+}
+
+// ---------------------------------------------------------------------------
+// 3. Heartbeat failure detection (UDP)
+// ---------------------------------------------------------------------------
+
+struct Coordinator {
+  int fd = -1;
+  int expected = 0;
+  int timeout_ms = 0;
+  std::thread thread;
+  std::atomic<bool> stop{false};
+  std::mutex mu;
+  std::vector<int64_t> last_seen;  // 0 = never
+
+  void loop() {
+    char buf[64];
+    while (!stop.load()) {
+      struct timeval tv = {0, 100 * 1000};  // 100ms poll
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      ssize_t r = recv(fd, buf, sizeof(buf) - 1, 0);
+      if (r > 0) {
+        buf[r] = 0;
+        int id = -1;
+        if (std::sscanf(buf, "HB %d", &id) == 1 && id >= 0 && id < expected) {
+          std::lock_guard<std::mutex> lock(mu);
+          last_seen[(size_t)id] = now_ms();
+        }
+      }
+    }
+  }
+};
+
+// Starts a coordinator listening on udp://0.0.0.0:port for "HB <id>"
+// datagrams from `expected_workers` workers. A worker that has reported at
+// least once and then stays silent for `timeout_ms` counts as failed.
+void* dtf_coord_start(int port, int expected_workers, int timeout_ms) {
+  auto* c = new Coordinator();
+  c->expected = expected_workers;
+  c->timeout_ms = timeout_ms;
+  c->last_seen.assign((size_t)expected_workers, 0);
+  c->fd = socket(AF_INET, SOCK_DGRAM, 0);
+  if (c->fd < 0) {
+    delete c;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(c->fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(c->fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(c->fd);
+    delete c;
+    return nullptr;
+  }
+  c->thread = std::thread([c] { c->loop(); });
+  return c;
+}
+
+int dtf_coord_alive_count(void* h) {
+  auto* c = (Coordinator*)h;
+  int64_t now = now_ms();
+  std::lock_guard<std::mutex> lock(c->mu);
+  int alive = 0;
+  for (int64_t t : c->last_seen)
+    if (t != 0 && now - t <= c->timeout_ms) ++alive;
+  return alive;
+}
+
+int dtf_coord_failed_count(void* h) {
+  auto* c = (Coordinator*)h;
+  int64_t now = now_ms();
+  std::lock_guard<std::mutex> lock(c->mu);
+  int failed = 0;
+  for (int64_t t : c->last_seen)
+    if (t != 0 && now - t > c->timeout_ms) ++failed;
+  return failed;
+}
+
+// Milliseconds since worker `id` was last heard from; -1 if never.
+long dtf_coord_ms_since_seen(void* h, int id) {
+  auto* c = (Coordinator*)h;
+  std::lock_guard<std::mutex> lock(c->mu);
+  if (id < 0 || id >= c->expected || c->last_seen[(size_t)id] == 0) return -1;
+  return (long)(now_ms() - c->last_seen[(size_t)id]);
+}
+
+void dtf_coord_stop(void* h) {
+  auto* c = (Coordinator*)h;
+  c->stop.store(true);
+  if (c->thread.joinable()) c->thread.join();
+  close(c->fd);
+  delete c;
+}
+
+struct Worker {
+  int fd = -1;
+  sockaddr_in addr{};
+  int id = 0;
+  int interval_ms = 0;
+  std::thread thread;
+  std::atomic<bool> stop{false};
+
+  void loop() {
+    char msg[32];
+    int len = std::snprintf(msg, sizeof(msg), "HB %d", id);
+    while (!stop.load()) {
+      sendto(fd, msg, (size_t)len, 0, (sockaddr*)&addr, sizeof(addr));
+      std::this_thread::sleep_for(std::chrono::milliseconds(interval_ms));
+    }
+  }
+};
+
+// Starts a worker-side heartbeat thread sending "HB <id>" to host:port
+// every interval_ms.
+void* dtf_worker_start(const char* host, int port, int worker_id,
+                       int interval_ms) {
+  auto* w = new Worker();
+  w->id = worker_id;
+  w->interval_ms = interval_ms;
+  w->fd = socket(AF_INET, SOCK_DGRAM, 0);
+  if (w->fd < 0) {
+    delete w;
+    return nullptr;
+  }
+  w->addr.sin_family = AF_INET;
+  w->addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &w->addr.sin_addr) != 1) {
+    close(w->fd);
+    delete w;
+    return nullptr;
+  }
+  w->thread = std::thread([w] { w->loop(); });
+  return w;
+}
+
+void dtf_worker_stop(void* h) {
+  auto* w = (Worker*)h;
+  w->stop.store(true);
+  if (w->thread.joinable()) w->thread.join();
+  close(w->fd);
+  delete w;
+}
+
+}  // extern "C"

@@ -1,0 +1,223 @@
+"""ctypes bindings for the native runtime (csrc/dtf_runtime.cc).
+
+No pybind11 in this environment — the library exposes a plain C ABI and
+this module wraps it. The library is built on demand with ``make`` the
+first time it is requested (set ``DTF_NO_NATIVE=1`` to disable entirely).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libdtf_runtime.so")
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_SO)
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load_library() -> ctypes.CDLL:
+    """Load (building if needed) the native library; raises ImportError if
+    unavailable so callers can fall back to pure Python."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if os.environ.get("DTF_NO_NATIVE"):
+            raise ImportError("native runtime disabled via DTF_NO_NATIVE")
+        if not os.path.exists(_SO):
+            if _tried or not _build():
+                _tried = True
+                raise ImportError("libdtf_runtime.so unavailable (build failed)")
+        _tried = True
+        lib = ctypes.CDLL(_SO)
+
+        lib.dtf_load_idx_images.restype = ctypes.c_long
+        lib.dtf_load_idx_images.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long,
+        ]
+        lib.dtf_load_idx_labels.restype = ctypes.c_long
+        lib.dtf_load_idx_labels.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.c_long,
+        ]
+        lib.dtf_shuffle_perm.restype = None
+        lib.dtf_shuffle_perm.argtypes = [
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.c_long,
+            ctypes.c_uint64,
+        ]
+        lib.dtf_gather_rows.restype = None
+        lib.dtf_gather_rows.argtypes = [
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.dtf_coord_start.restype = ctypes.c_void_p
+        lib.dtf_coord_start.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        lib.dtf_coord_alive_count.restype = ctypes.c_int
+        lib.dtf_coord_alive_count.argtypes = [ctypes.c_void_p]
+        lib.dtf_coord_failed_count.restype = ctypes.c_int
+        lib.dtf_coord_failed_count.argtypes = [ctypes.c_void_p]
+        lib.dtf_coord_ms_since_seen.restype = ctypes.c_long
+        lib.dtf_coord_ms_since_seen.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.dtf_coord_stop.restype = None
+        lib.dtf_coord_stop.argtypes = [ctypes.c_void_p]
+        lib.dtf_worker_start.restype = ctypes.c_void_p
+        lib.dtf_worker_start.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.dtf_worker_stop.restype = None
+        lib.dtf_worker_stop.argtypes = [ctypes.c_void_p]
+
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    try:
+        load_library()
+        return True
+    except ImportError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Data pipeline bindings
+# ---------------------------------------------------------------------------
+
+
+def load_idx_images(path: str) -> np.ndarray:
+    lib = load_library()
+    n = lib.dtf_load_idx_images(path.encode(), None, 0)
+    if n < 0:
+        raise OSError(f"failed to parse IDX images: {path}")
+    # IDX MNIST rows*cols is always 784; query again with a buffer.
+    out = np.empty(n * 784, dtype=np.float32)
+    got = lib.dtf_load_idx_images(
+        path.encode(), out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), out.size
+    )
+    if got != n:
+        raise OSError(f"short read from IDX images: {path}")
+    return out.reshape(n, 784)
+
+
+def load_idx_labels(path: str) -> np.ndarray:
+    lib = load_library()
+    n = lib.dtf_load_idx_labels(path.encode(), None, 0)
+    if n < 0:
+        raise OSError(f"failed to parse IDX labels: {path}")
+    out = np.empty(n, dtype=np.int64)
+    got = lib.dtf_load_idx_labels(
+        path.encode(), out.ctypes.data_as(ctypes.POINTER(ctypes.c_long)), out.size
+    )
+    if got != n:
+        raise OSError(f"short read from IDX labels: {path}")
+    return out
+
+
+def shuffle_perm(n: int, seed: int) -> np.ndarray:
+    lib = load_library()
+    out = np.empty(n, dtype=np.int64)
+    lib.dtf_shuffle_perm(
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_long)), n, seed & (2**64 - 1)
+    )
+    return out
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    lib = load_library()
+    src = np.ascontiguousarray(src, dtype=np.float32)
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    out = np.empty((idx.shape[0], src.shape[1]), dtype=np.float32)
+    lib.dtf_gather_rows(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        idx.shape[0],
+        src.shape[1],
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Failure detection bindings (SURVEY.md §5 upgrade)
+# ---------------------------------------------------------------------------
+
+
+class HeartbeatCoordinator:
+    """Chief-side liveness tracker: workers that reported once and then went
+    silent past ``timeout_ms`` count as failed."""
+
+    def __init__(self, port: int, expected_workers: int, timeout_ms: int = 5000):
+        self._lib = load_library()
+        self._h = self._lib.dtf_coord_start(port, expected_workers, timeout_ms)
+        if not self._h:
+            raise OSError(f"failed to bind heartbeat coordinator on :{port}")
+
+    def alive_count(self) -> int:
+        return self._lib.dtf_coord_alive_count(self._h)
+
+    def failed_count(self) -> int:
+        return self._lib.dtf_coord_failed_count(self._h)
+
+    def ms_since_seen(self, worker_id: int) -> int:
+        return self._lib.dtf_coord_ms_since_seen(self._h, worker_id)
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.dtf_coord_stop(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class HeartbeatWorker:
+    """Worker-side heartbeat sender."""
+
+    def __init__(self, host: str, port: int, worker_id: int, interval_ms: int = 1000):
+        self._lib = load_library()
+        self._h = self._lib.dtf_worker_start(host.encode(), port, worker_id, interval_ms)
+        if not self._h:
+            raise OSError(f"failed to start heartbeat worker to {host}:{port}")
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.dtf_worker_stop(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

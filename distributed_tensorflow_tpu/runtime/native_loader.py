@@ -1,0 +1,31 @@
+"""Directory-level MNIST loading through the native runtime (C6's host-side
+fast path). Raises ImportError/OSError when the native library or the files
+are unavailable; ``data/mnist.py`` falls back to its numpy parser."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from distributed_tensorflow_tpu.runtime import native
+
+
+def load_idx_dir(data_dir: str):
+    """Returns (train_x, train_y, test_x, test_y); images float32 [N,784] in
+    [0,1], labels int64. Gzip-compressed files are not handled here (pure-C
+    parser) — the numpy fallback covers those."""
+    paths = {
+        "train_x": os.path.join(data_dir, "train-images-idx3-ubyte"),
+        "train_y": os.path.join(data_dir, "train-labels-idx1-ubyte"),
+        "test_x": os.path.join(data_dir, "t10k-images-idx3-ubyte"),
+        "test_y": os.path.join(data_dir, "t10k-labels-idx1-ubyte"),
+    }
+    for p in paths.values():
+        if not os.path.exists(p):
+            raise OSError(f"missing IDX file: {p}")
+    train_x = native.load_idx_images(paths["train_x"])
+    train_y = native.load_idx_labels(paths["train_y"])
+    test_x = native.load_idx_images(paths["test_x"])
+    test_y = native.load_idx_labels(paths["test_y"])
+    return train_x, train_y, np.asarray(test_x), np.asarray(test_y)

@@ -1,0 +1,127 @@
+"""Native runtime tests: IDX parsing vs the numpy parser, shuffle/gather
+determinism, and UDP heartbeat failure detection on localhost."""
+
+import os
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.runtime import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native runtime unavailable (no toolchain)"
+)
+
+
+def _write_idx(tmp_path, n=50):
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, size=(n, 28, 28), dtype=np.uint8)
+    labels = rng.integers(0, 10, size=n, dtype=np.uint8)
+    img_path = os.path.join(tmp_path, "train-images-idx3-ubyte")
+    lab_path = os.path.join(tmp_path, "train-labels-idx1-ubyte")
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(images.tobytes())
+    with open(lab_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return img_path, lab_path, images, labels
+
+
+def test_idx_images_match_numpy_parser(tmp_path):
+    img_path, lab_path, images, labels = _write_idx(str(tmp_path))
+    got = native.load_idx_images(img_path)
+    want = images.reshape(-1, 784).astype(np.float32) / 255.0
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    np.testing.assert_array_equal(native.load_idx_labels(lab_path), labels)
+
+
+def test_idx_bad_magic(tmp_path):
+    p = os.path.join(str(tmp_path), "bad")
+    with open(p, "wb") as f:
+        f.write(struct.pack(">IIII", 1234, 1, 28, 28))
+        f.write(bytes(784))
+    with pytest.raises(OSError):
+        native.load_idx_images(p)
+
+
+def test_shuffle_perm_is_permutation_and_deterministic():
+    a = native.shuffle_perm(1000, seed=42)
+    b = native.shuffle_perm(1000, seed=42)
+    c = native.shuffle_perm(1000, seed=43)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    assert sorted(a.tolist()) == list(range(1000))
+
+
+def test_gather_rows():
+    src = np.arange(40, dtype=np.float32).reshape(10, 4)
+    idx = np.array([3, 0, 7], dtype=np.int64)
+    np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+
+
+def test_read_data_sets_uses_native_idx_path(tmp_path):
+    # End-to-end: a directory of real IDX files flows through read_data_sets
+    # via the native parser (data/mnist.py tries runtime.native_loader first).
+    from distributed_tensorflow_tpu.data import read_data_sets
+
+    d = str(tmp_path)
+    _write_idx(d, n=6000)
+    # test split files
+    rng = np.random.default_rng(1)
+    timgs = rng.integers(0, 256, size=(100, 28, 28), dtype=np.uint8)
+    tlabs = rng.integers(0, 10, size=100, dtype=np.uint8)
+    with open(os.path.join(d, "t10k-images-idx3-ubyte"), "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 100, 28, 28))
+        f.write(timgs.tobytes())
+    with open(os.path.join(d, "t10k-labels-idx1-ubyte"), "wb") as f:
+        f.write(struct.pack(">II", 2049, 100))
+        f.write(tlabs.tobytes())
+
+    ds = read_data_sets(d, one_hot=True)
+    assert ds.train.num_examples == 1000  # 6000 - 5000 validation
+    assert ds.test.num_examples == 100
+    np.testing.assert_array_equal(ds.test.labels.argmax(1), tlabs)
+
+
+def test_bootstrap_with_heartbeat():
+    from distributed_tensorflow_tpu.cluster import bootstrap
+    from distributed_tensorflow_tpu.config import ClusterConfig
+
+    cfg = ClusterConfig.from_lists(["127.0.0.1:2223", "127.0.0.1:2224"])
+    chief = bootstrap(
+        cfg, "worker", 0, initialize_distributed=False, heartbeat_port=19431
+    )
+    worker = bootstrap(
+        cfg, "worker", 1, initialize_distributed=False, heartbeat_port=19431
+    )
+    try:
+        assert chief.heartbeat is not None and worker.heartbeat is not None
+        time.sleep(0.3)
+        assert chief.heartbeat.alive_count() >= 1
+    finally:
+        worker.heartbeat.stop()
+        chief.heartbeat.stop()
+
+
+def test_heartbeat_failure_detection():
+    port = 19427
+    with native.HeartbeatCoordinator(port, expected_workers=2, timeout_ms=600) as coord:
+        w0 = native.HeartbeatWorker("127.0.0.1", port, worker_id=0, interval_ms=100)
+        w1 = native.HeartbeatWorker("127.0.0.1", port, worker_id=1, interval_ms=100)
+        time.sleep(0.4)
+        assert coord.alive_count() == 2
+        assert coord.failed_count() == 0
+        assert coord.ms_since_seen(0) >= 0
+        # Kill worker 1: it must transition alive→failed after the timeout.
+        w1.stop()
+        time.sleep(1.0)
+        assert coord.alive_count() == 1
+        assert coord.failed_count() == 1
+        w0.stop()
+    # Never-seen workers are not "failed" (they may still be scheduling).
+    with native.HeartbeatCoordinator(port + 1, expected_workers=3, timeout_ms=500) as c2:
+        assert c2.failed_count() == 0
+        assert c2.ms_since_seen(2) == -1
