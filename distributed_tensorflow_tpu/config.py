@@ -86,6 +86,12 @@ class TrainConfig:
     checkpoint_dir: str | None = None  # deliberate upgrade: orbax checkpointing
     sync: bool = True  # sync DP (pmean all-reduce) vs async emulation
     async_avg_every: int = 0  # async mode: average params every N steps (0 = never)
+    # Compile each epoch as one lax.scan dispatch (train/scan.py): identical
+    # update semantics, ~100x less host overhead. Log lines are emitted from
+    # the returned per-step costs after the dispatch. Supported by the
+    # single-device and sync-DP (GSPMD) strategies.
+    scan_epoch: bool = False
+    profile_dir: str | None = None  # capture a jax.profiler trace of epoch 0
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)

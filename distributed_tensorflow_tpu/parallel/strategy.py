@@ -120,6 +120,14 @@ class SingleDevice(Strategy):
     def prepare_batch(self, x, y):
         return jnp.asarray(x), jnp.asarray(y)
 
+    # Scanned-epoch support (config.scan_epoch).
+    stage_sharding = None
+
+    def make_scanned_train_fn(self, model, loss_fn, optimizer):
+        from distributed_tensorflow_tpu.train.scan import make_scanned_train_fn
+
+        return make_scanned_train_fn(model, loss_fn, optimizer)
+
 
 class SyncDataParallel(Strategy):
     """The ``tfdist_between_sync.py`` mode: lockstep DP with gradient
@@ -247,6 +255,23 @@ class SyncDataParallel(Strategy):
         return (
             jax.device_put(jnp.asarray(x), self._batch),
             jax.device_put(jnp.asarray(y), self._batch),
+        )
+
+    # Scanned-epoch support: staged arrays are [steps, batch, ...] with the
+    # batch dim sharded over 'data'; each scan slice keeps that sharding.
+    @property
+    def stage_sharding(self):
+        return NamedSharding(self.mesh, P(None, "data"))
+
+    def make_scanned_train_fn(self, model, loss_fn, optimizer):
+        if self.explicit:
+            raise NotImplementedError(
+                "scan_epoch uses the GSPMD path; explicit_collectives=False"
+            )
+        from distributed_tensorflow_tpu.train.scan import make_scanned_train_fn
+
+        return make_scanned_train_fn(
+            model, loss_fn, optimizer, batch_sharding=self._batch
         )
 
 

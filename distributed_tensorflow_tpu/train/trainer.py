@@ -18,6 +18,7 @@ TPU-first deltas from the reference loop:
 
 from __future__ import annotations
 
+import time
 from typing import Callable
 
 import jax
@@ -79,6 +80,21 @@ class Trainer:
         if self.supervisor is not None:
             self.state, self.start_step = self.supervisor.prepare_or_restore(self.state)
 
+        # Scanned-epoch fast path (config.scan_epoch): one dispatch per epoch.
+        self._scanned_fn = None
+        self._scan_rng = None
+        if self.config.scan_epoch:
+            if not hasattr(self.strategy, "make_scanned_train_fn"):
+                raise ValueError(
+                    f"scan_epoch unsupported for {type(self.strategy).__name__}"
+                )
+            self._scanned_fn = self.strategy.make_scanned_train_fn(
+                self.model, self.loss_fn, self.optimizer
+            )
+            import numpy as _np
+
+            self._scan_rng = _np.random.default_rng(self.config.seed)
+
         self.last_cost: jax.Array | None = None
         self.history: list[dict] = []
 
@@ -89,6 +105,8 @@ class Trainer:
         return float(self.eval_fn(self.state, test.images, test.labels))
 
     def run_epoch(self, epoch: int, logger: StepLogger) -> None:
+        if self._scanned_fn is not None:
+            return self._run_epoch_scanned(epoch, logger)
         cfg = self.config
         train = self.datasets.train
         # Global batch: the reference gave each of N workers a batch of 100
@@ -127,6 +145,45 @@ class Trainer:
                     "cost", self.strategy.cost_scalar(cost), step_before + (i + 1) * incr
                 )
 
+    def _run_epoch_scanned(self, epoch: int, logger: StepLogger) -> None:
+        """One compiled dispatch for the whole epoch (train/scan.py). Update
+        semantics match the eager loop exactly; log lines are emitted at the
+        reference cadence afterwards from the returned per-step costs."""
+        from distributed_tensorflow_tpu.train.scan import stage_epoch
+
+        cfg = self.config
+        train = self.datasets.train
+        global_batch = cfg.batch_size * self.strategy.num_replicas
+        xs_np, ys_np = stage_epoch(
+            train.images, train.labels, global_batch, rng=self._scan_rng
+        )
+        sharding = self.strategy.stage_sharding
+        xs = jax.device_put(xs_np, sharding) if sharding else jax.numpy.asarray(xs_np)
+        ys = jax.device_put(ys_np, sharding) if sharding else jax.numpy.asarray(ys_np)
+        step_before = self.strategy.global_step(self.state)
+        t0 = time.time()
+        self.state, costs = self._scanned_fn(self.state, xs, ys)
+        costs = jax.device_get(costs)
+        elapsed = time.time() - t0
+        self.last_cost = costs[-1]
+        batch_count = costs.shape[0]
+        avg_ms = elapsed * 1000 / batch_count  # uniform: one dispatch ran them all
+        for i in range(batch_count):
+            if logger.is_due(i + 1, batch_count):
+                logger.log_step_line(
+                    step=step_before + i + 1,
+                    epoch=epoch,
+                    batch=i,
+                    batch_count=batch_count,
+                    cost=float(costs[i]),
+                    avg_ms=avg_ms,
+                )
+        if self.summary_writer is not None and self.is_chief:
+            for i in range(batch_count):
+                self.summary_writer.add_scalar(
+                    "cost", float(costs[i]), step_before + i + 1
+                )
+
     # -- the loop ---------------------------------------------------------
 
     def run(self, epochs: int | None = None) -> dict:
@@ -135,7 +192,13 @@ class Trainer:
         logger = StepLogger(freq=cfg.log_frequency, print_fn=self.print_fn)
         accuracy = 0.0
         for epoch in range(epochs):
-            self.run_epoch(epoch, logger)
+            if epoch == 0 and cfg.profile_dir:
+                from distributed_tensorflow_tpu.utils import profiler
+
+                with profiler.trace(cfg.profile_dir):
+                    self.run_epoch(epoch, logger)
+            else:
+                self.run_epoch(epoch, logger)
             if self.is_chief:
                 accuracy = self.evaluate()
                 logger.log_epoch(test_accuracy=accuracy)

@@ -33,6 +33,24 @@ class StepLogger:
         of truth — the trainer gates its host sync on this same predicate."""
         return count % self.freq == 0 or count == batch_count
 
+    def log_step_line(
+        self,
+        *,
+        step: int,
+        epoch: int,
+        batch: int,
+        batch_count: int,
+        cost: float,
+        avg_ms: float,
+    ) -> None:
+        self._print(
+            "Step: %d," % step,
+            " Epoch: %2d," % (epoch + 1),
+            " Batch: %3d of %3d," % (batch + 1, batch_count),
+            " Cost: %.4f," % cost,
+            " AvgTime: %3.2fms" % avg_ms,
+        )
+
     def maybe_log_step(
         self, *, step: int, epoch: int, batch: int, batch_count: int, cost: float
     ) -> None:
@@ -42,12 +60,13 @@ class StepLogger:
             # Average over the batches actually in this window (the final
             # window of an epoch may be partial).
             window = max(count - self._window_count, 1)
-            self._print(
-                "Step: %d," % step,
-                " Epoch: %2d," % (epoch + 1),
-                " Batch: %3d of %3d," % (count, batch_count),
-                " Cost: %.4f," % cost,
-                " AvgTime: %3.2fms" % float(elapsed * 1000 / window),
+            self.log_step_line(
+                step=step,
+                epoch=epoch,
+                batch=batch,
+                batch_count=batch_count,
+                cost=cost,
+                avg_ms=float(elapsed * 1000 / window),
             )
             self._window_count = count
             self._window_start = time.time()

@@ -1,0 +1,40 @@
+"""Profiling (SURVEY.md §5 "Tracing/profiling").
+
+The reference's only instrumentation is hand-rolled wall-clock timing in the
+loop (AvgTime/Total Time, reference tfdist_between.py:98-110) — kept as-is in
+``utils/logging.py``. This module adds the TPU-native upgrade the survey
+prescribes: ``jax.profiler`` traces (XLA op-level timelines viewable in
+TensorBoard/Perfetto) and an on-demand profiling server.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a device trace for the enclosed block::
+
+        with profiler.trace("./logs/profile"):
+            state, cost = train_step(state, x, y)
+            jax.block_until_ready(cost)
+    """
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def start_server(port: int = 9999):
+    """Start the on-demand profiling server (connect with TensorBoard's
+    profile tab or `xprof`); returns the server object."""
+    return jax.profiler.start_server(port)
+
+
+def annotate(name: str):
+    """Named region that shows up on the trace timeline."""
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,77 @@
+"""Trainer scan_epoch integration: same learning, same log surface, and the
+sync-DP scanned path on the 8-device mesh."""
+
+import numpy as np
+
+from distributed_tensorflow_tpu.config import TrainConfig
+from distributed_tensorflow_tpu.models import MLP
+from distributed_tensorflow_tpu.parallel import SyncDataParallel, make_mesh
+from distributed_tensorflow_tpu.train import Trainer
+
+import jax.numpy as jnp
+import pytest
+
+
+def test_scan_epoch_single_device(small_datasets):
+    lines = []
+    cfg = TrainConfig(epochs=1, scan_epoch=True, log_frequency=40)
+    tr = Trainer(
+        MLP(compute_dtype=jnp.float32),
+        small_datasets,
+        cfg,
+        print_fn=lambda *a: lines.append(" ".join(map(str, a))),
+    )
+    res = tr.run(epochs=1)
+    assert tr.strategy.global_step(tr.state) == 80
+    step_lines = [l for l in lines if l.startswith("Step:")]
+    assert len(step_lines) == 2  # batches 40 and 80
+    assert "AvgTime:" in step_lines[0]
+    assert np.isfinite(res["final_cost"])
+
+
+def test_scan_epoch_matches_eager_costs(small_datasets):
+    # Same seed → same shuffles → identical cost trajectories.
+    def run(scan):
+        cfg = TrainConfig(epochs=1, scan_epoch=scan, seed=1)
+        tr = Trainer(
+            MLP(compute_dtype=jnp.float32),
+            small_datasets,
+            cfg,
+            print_fn=lambda *a: None,
+        )
+        tr.run(epochs=1)
+        return float(np.asarray(tr.strategy.cost_scalar(tr.last_cost)))
+
+    # Not bit-identical (shuffle streams differ: next_batch RNG vs stage
+    # RNG), but both must have learned comparably from one epoch.
+    c_eager, c_scan = run(False), run(True)
+    assert abs(c_eager - c_scan) / c_eager < 0.2, (c_eager, c_scan)
+
+
+def test_scan_epoch_sync_dp(small_datasets):
+    mesh = make_mesh()
+    cfg = TrainConfig(epochs=1, scan_epoch=True)
+    tr = Trainer(
+        MLP(compute_dtype=jnp.float32),
+        small_datasets,
+        cfg,
+        strategy=SyncDataParallel(mesh),
+        print_fn=lambda *a: None,
+    )
+    tr.run(epochs=1)
+    # 8000 examples / (100 x 8) global batch = 10 aggregated steps.
+    assert tr.strategy.global_step(tr.state) == 10
+
+
+def test_scan_epoch_rejects_async(small_datasets):
+    from distributed_tensorflow_tpu.parallel import AsyncDataParallel
+
+    cfg = TrainConfig(epochs=1, scan_epoch=True)
+    with pytest.raises(ValueError):
+        Trainer(
+            MLP(),
+            small_datasets,
+            cfg,
+            strategy=AsyncDataParallel(make_mesh()),
+            print_fn=lambda *a: None,
+        )
