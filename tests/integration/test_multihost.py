@@ -1,0 +1,76 @@
+"""Multi-host smoke: two real OS processes join a jax.distributed group and
+run a sync-DP step over the combined CPU mesh — the TPU-pod launch path
+(cluster.bootstrap) exercised end to end on localhost, mirroring the
+reference's multi-process-on-one-host cluster simulation (SURVEY.md §4.4).
+
+Gated behind RUN_SLOW=1 (spawns subprocesses, ~30s).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("RUN_SLOW"), reason="multi-process smoke (set RUN_SLOW=1)"
+)
+
+_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from distributed_tensorflow_tpu.cluster import bootstrap
+from distributed_tensorflow_tpu.config import ClusterConfig
+
+task = int(sys.argv[1])
+cluster = ClusterConfig.from_lists(["127.0.0.1:29771", "127.0.0.1:29772"])
+ctx = bootstrap(cluster, "worker", task)
+assert jax.process_count() == 2, jax.process_count()
+
+from distributed_tensorflow_tpu.models import MLP
+from distributed_tensorflow_tpu.ops import cross_entropy, sgd
+from distributed_tensorflow_tpu.parallel import SyncDataParallel, make_mesh
+
+mesh = make_mesh()  # global mesh across both processes' devices
+model = MLP(compute_dtype=jax.numpy.float32)
+strat = SyncDataParallel(mesh)
+state = strat.init_state(model, sgd(0.001), seed=1)
+step = strat.make_train_step(model, cross_entropy, sgd(0.001))
+
+rng = np.random.default_rng(0)
+n = mesh.shape["data"] * 4
+# Each process feeds its addressable shard via make_array_from_process_local_data.
+from jax.sharding import NamedSharding, PartitionSpec as P
+sharding = NamedSharding(mesh, P("data"))
+x = jax.make_array_from_process_local_data(
+    sharding, rng.random((n // 2, 784), dtype=np.float32), (n, 784))
+y = jax.make_array_from_process_local_data(
+    sharding, np.eye(10, dtype=np.float32)[rng.integers(0, 10, n // 2)], (n, 10))
+state, cost = step(state, x, y)
+print("MULTIHOST_OK", task, float(jax.device_get(cost)))
+"""
+
+
+def test_two_process_sync_dp(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        env.get("PYTHONPATH", "") + os.pathsep + os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(i)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    for i, out in enumerate(outs):
+        assert procs[i].returncode == 0, f"task {i} failed:\n{out}"
+        assert f"MULTIHOST_OK {i}" in out, out

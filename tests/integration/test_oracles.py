@@ -1,0 +1,63 @@
+"""Integration tier: the reference's empirical oracles (SURVEY.md §4).
+
+Slow (minutes): gated behind RUN_SLOW=1 so the default suite stays fast.
+
+1. Convergence oracle — 100 epochs single-device reaches ≥0.72 test accuracy
+   (reference README.md:15).
+2. Async-vs-sync oracle — at equal epochs on 2 replicas, async's extra
+   update count yields higher accuracy than sync (the reference's
+   0.80-vs-0.72 finding, README.md:66-72, 143-150).
+"""
+
+import os
+
+import pytest
+
+from distributed_tensorflow_tpu.config import TrainConfig
+from distributed_tensorflow_tpu.models import MLP
+from distributed_tensorflow_tpu.parallel import (
+    AsyncDataParallel,
+    SyncDataParallel,
+    make_mesh,
+)
+from distributed_tensorflow_tpu.train import Trainer
+from distributed_tensorflow_tpu.utils.logging import StepLogger
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("RUN_SLOW"), reason="slow integration oracle (set RUN_SLOW=1)"
+)
+
+_QUIET = dict(print_fn=lambda *a: None)
+
+
+def _train_epochs(trainer, epochs):
+    logger = StepLogger(freq=10**9, print_fn=lambda *a: None)
+    for e in range(epochs):
+        trainer.run_epoch(e, logger)
+    return trainer.evaluate()
+
+
+def test_convergence_oracle_100_epochs(datasets):
+    cfg = TrainConfig(epochs=100, scan_epoch=True)
+    tr = Trainer(MLP(), datasets, cfg, **_QUIET)
+    acc = _train_epochs(tr, 100)
+    assert acc >= 0.72, acc
+
+
+def test_async_beats_sync_at_equal_epochs(datasets):
+    mesh = make_mesh((2, 1))
+    epochs = 40
+    sync = Trainer(
+        MLP(), datasets, TrainConfig(), strategy=SyncDataParallel(mesh), **_QUIET
+    )
+    sync_acc = _train_epochs(sync, epochs)
+    asyn = Trainer(
+        MLP(),
+        datasets,
+        TrainConfig(),
+        strategy=AsyncDataParallel(mesh, avg_every=50),
+        **_QUIET,
+    )
+    async_acc = _train_epochs(asyn, epochs)
+    # Reference: async 2-worker 0.80 vs sync 0.72 at 100 epochs.
+    assert async_acc > sync_acc, (async_acc, sync_acc)
