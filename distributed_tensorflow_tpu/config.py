@@ -92,6 +92,17 @@ class TrainConfig:
     # single-device and sync-DP (GSPMD) strategies.
     scan_epoch: bool = False
     profile_dir: str | None = None  # capture a jax.profiler trace of epoch 0
+    # Print each parameter's sharding at startup — the TPU analog of the
+    # reference's log_device_placement=True (C4, tfdist_between.py:15).
+    log_placement: bool = False
+    # Epoch definition. False (default): one pass over the data per epoch
+    # globally (modern convention; N replicas split the 550 batches). True:
+    # the reference's convention — EACH worker runs num_examples/batch_size
+    # steps per epoch (reference tfdist_between.py:87), so N sync replicas
+    # make 550 aggregated applies/epoch at effective batch N*100, which is
+    # what makes the reference's sync accuracy equal single-device at equal
+    # epochs (README.md:148-150).
+    per_worker_epoch: bool = False
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)

@@ -1,0 +1,30 @@
+"""The model protocol every framework model satisfies.
+
+The reference's 'model' is ~20 lines of graph construction repeated in each
+script (C8). Here a model is any object with pure ``init``/``apply``:
+
+- ``init(seed) -> params``: build the parameter pytree deterministically
+  from an integer seed (so every process computes identical initial state —
+  the property that replaces chief-initializes-then-others-wait, see
+  train/supervisor.py).
+- ``apply(params, x) -> outputs``: the jit-able forward pass.
+- optionally ``partition_specs(model_axis) -> pytree[PartitionSpec]``:
+  tensor-parallel layout over the mesh's ``model`` axis.
+
+Strategies (parallel/strategy.py) and the Trainer depend only on this
+protocol, so new model families drop in without touching the parallel or
+training layers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol, runtime_checkable
+
+import jax
+
+
+@runtime_checkable
+class Model(Protocol):
+    def init(self, seed: int) -> Any: ...
+
+    def apply(self, params: Any, x: jax.Array) -> jax.Array: ...

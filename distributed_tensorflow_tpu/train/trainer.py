@@ -88,6 +88,8 @@ class Trainer:
                 raise ValueError(
                     f"scan_epoch unsupported for {type(self.strategy).__name__}"
                 )
+            if self.config.per_worker_epoch:
+                raise ValueError("scan_epoch and per_worker_epoch are exclusive")
             self._scanned_fn = self.strategy.make_scanned_train_fn(
                 self.model, self.loss_fn, self.optimizer
             )
@@ -97,6 +99,11 @@ class Trainer:
 
         self.last_cost: jax.Array | None = None
         self.history: list[dict] = []
+
+        if self.config.log_placement and self.is_chief:
+            from distributed_tensorflow_tpu.utils import placement
+
+            placement.describe(self.state.params, print_fn=self.print_fn)
 
     # -- pieces -----------------------------------------------------------
 
@@ -112,7 +119,12 @@ class Trainer:
         # Global batch: the reference gave each of N workers a batch of 100
         # (reference tfdist_between.py:19,91), so N replicas consume N×100.
         global_batch = cfg.batch_size * self.strategy.num_replicas
-        batch_count = train.num_examples // global_batch
+        if cfg.per_worker_epoch:
+            # Reference convention: each worker passes over the full dataset
+            # per epoch; next_batch wraps across the shuffled permutations.
+            batch_count = train.num_examples // cfg.batch_size
+        else:
+            batch_count = train.num_examples // global_batch
         summaries: list[tuple[int, jax.Array]] = []
         step_before = self.strategy.global_step(self.state)
         logger.reset_window()

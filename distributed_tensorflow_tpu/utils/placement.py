@@ -1,0 +1,58 @@
+"""Placement verification (C4's ``log_device_placement`` analog).
+
+The reference verified placement by turning on
+``log_device_placement=True`` and eyeballing that ops landed on
+``/job:worker/task:N/gpu:N`` (reference tfdist_between.py:15, SURVEY.md §4.3).
+On TPU there are no device strings: placement *is* sharding. This module
+renders the sharding of every leaf in a pytree — which mesh axes each dim is
+split over and which devices hold shards — for the same eyeball check.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def describe(tree, *, print_fn=print) -> list[str]:
+    """Print (and return) one line per array leaf: path, shape, sharding
+    spec, and the number of devices holding shards."""
+    lines = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        name = jax.tree_util.keystr(path)
+        if not hasattr(leaf, "sharding"):
+            lines.append(f"{name}: (non-array) {type(leaf).__name__}")
+            continue
+        sh = leaf.sharding
+        spec = getattr(sh, "spec", sh)
+        ndev = len(getattr(sh, "device_set", [None]))
+        lines.append(
+            f"{name}: shape={tuple(leaf.shape)} dtype={leaf.dtype} "
+            f"spec={spec} devices={ndev}"
+        )
+    for line in lines:
+        print_fn(line)
+    return lines
+
+
+def assert_replicated(tree) -> None:
+    """Assert every leaf is fully replicated (pure-DP invariant)."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if hasattr(leaf, "sharding") and not leaf.sharding.is_fully_replicated:
+            raise AssertionError(
+                f"{jax.tree_util.keystr(path)} is not replicated: "
+                f"{leaf.sharding}"
+            )
+
+
+def assert_sharded_over(tree, axis: str) -> None:
+    """Assert at least one leaf is actually split over mesh axis ``axis``
+    (guards against silently-replicated 'sharded' runs)."""
+    for _, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if not hasattr(leaf, "sharding"):
+            continue
+        spec = getattr(leaf.sharding, "spec", ())
+        for entry in spec:
+            names = entry if isinstance(entry, tuple) else (entry,)
+            if axis in names:
+                return
+    raise AssertionError(f"no leaf is sharded over axis {axis!r}")

@@ -60,6 +60,24 @@ def test_log_line_format(small_datasets):
     assert lines[-1] == "Done"
 
 
+def test_per_worker_epoch_batch_count(small_datasets):
+    # Reference convention: each replica runs num_examples/batch_size steps
+    # per epoch, so an 8-replica sync epoch makes 80 aggregated applies (not
+    # 10) — what made the reference's sync accuracy track single-device.
+    from distributed_tensorflow_tpu.parallel import SyncDataParallel, make_mesh
+
+    cfg = TrainConfig(epochs=1, per_worker_epoch=True)
+    tr = Trainer(
+        MLP(),
+        small_datasets,
+        cfg,
+        strategy=SyncDataParallel(make_mesh()),
+        print_fn=lambda *a: None,
+    )
+    tr.run(epochs=1)
+    assert tr.strategy.global_step(tr.state) == 80
+
+
 def test_convergence_smoke(small_datasets):
     # The reference's N(0,1) init saturates the sigmoid layer, so learning is
     # deliberately slow (it takes the reference 100 epochs to hit 0.72 —
