@@ -35,6 +35,28 @@ from distributed_tensorflow_tpu.train import Trainer
 from distributed_tensorflow_tpu.utils.summary import SummaryWriter
 
 
+def config_from_env(base: TrainConfig | None = None) -> TrainConfig:
+    """Apply environment overrides to a TrainConfig — the knob the reference
+    lacked (its hyperparameters were module constants, SURVEY.md §5
+    "Config/flag system"). Recognized: DTF_EPOCHS, DTF_BATCH_SIZE, DTF_LR,
+    DTF_SCAN (=1 → scan_epoch), DTF_LOGS (logs path, empty disables)."""
+    import os
+
+    cfg = base or TrainConfig()
+    kw = {}
+    if "DTF_EPOCHS" in os.environ:
+        kw["epochs"] = int(os.environ["DTF_EPOCHS"])
+    if "DTF_BATCH_SIZE" in os.environ:
+        kw["batch_size"] = int(os.environ["DTF_BATCH_SIZE"])
+    if "DTF_LR" in os.environ:
+        kw["learning_rate"] = float(os.environ["DTF_LR"])
+    if "DTF_SCAN" in os.environ:
+        kw["scan_epoch"] = os.environ["DTF_SCAN"] == "1"
+    if "DTF_LOGS" in os.environ:
+        kw["logs_path"] = os.environ["DTF_LOGS"]
+    return cfg.replace(**kw) if kw else cfg
+
+
 def build_strategy(config: TrainConfig, *, devices=None, mesh=None):
     devices = list(devices if devices is not None else jax.devices())
     if mesh is None and len(devices) == 1:
@@ -91,6 +113,6 @@ def run(
     ctx = bootstrap_from_argv(cluster, argv)
     if ctx.should_exit:
         return None
-    trainer = build_trainer(config, context=ctx, **kw)
+    trainer = build_trainer(config_from_env(config), context=ctx, **kw)
     print("Ready to go")  # reference tfdist_between.py:76
     return trainer.run()

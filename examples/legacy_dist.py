@@ -1,0 +1,24 @@
+"""Legacy variant — the ``tfdist.py`` equivalent (SURVEY.md §3.5).
+
+The reference kept its pre-``settings.py`` iteration in-tree with hardcoded
+cluster IPs (reference tfdist.py:8-9) and no session config. Kept here for
+launch-surface completeness: edit the two lists below instead of a settings
+module. Superseded by ``between_async.py``.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from distributed_tensorflow_tpu.config import ClusterConfig, TrainConfig
+from distributed_tensorflow_tpu.launch import run
+
+ps_svrs = ["10.88.104.31:2223"]  # accepted, ignored (no PS on TPU)
+worker_svrs = ["10.88.104.31:2222", "10.88.102.119:2222"]
+
+if __name__ == "__main__":
+    run(
+        ClusterConfig.from_lists(worker_svrs, ps_svrs),
+        TrainConfig(sync=False, async_avg_every=50),
+    )

@@ -13,8 +13,8 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 from distributed_tensorflow_tpu.config import TrainConfig
-from distributed_tensorflow_tpu.launch import build_trainer
+from distributed_tensorflow_tpu.launch import build_trainer, config_from_env
 
 if __name__ == "__main__":
-    trainer = build_trainer(TrainConfig())
+    trainer = build_trainer(config_from_env(TrainConfig()))
     trainer.run()

@@ -1,0 +1,66 @@
+"""The example scripts — the reference's four entry points — driven as real
+OS processes (the actual user surface)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_EX = os.path.join(_REPO, "examples")
+
+
+def _run(script, *args, env_extra=None, timeout=300):
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            # Keep the examples off the (single, shared) TPU chip: an empty
+            # pool disables the axon plugin registration in sitecustomize,
+            # letting JAX_PLATFORMS=cpu actually take effect.
+            "PALLAS_AXON_POOL_IPS": "",
+            "DTF_EPOCHS": "1",
+            "DTF_SCAN": "1",
+            "DTF_LOGS": "",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        }
+    )
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, os.path.join(_EX, script), *args],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=timeout,
+        cwd=_EX,
+    )
+
+
+def test_single_example_end_to_end():
+    r = _run("single.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "Test-Accuracy:" in r.stdout
+    assert r.stdout.rstrip().endswith("Done")
+
+
+def test_between_sync_worker():
+    r = _run("between_sync.py", "--job_name=worker", "--task_index=0")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "worker setting up ..." in r.stdout
+    assert "Ready to go" in r.stdout
+    assert "Done" in r.stdout
+
+
+def test_between_async_worker():
+    r = _run("between_async.py", "--job_name=worker", "--task_index=0",
+             env_extra={"DTF_SCAN": "0"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "Done" in r.stdout
+
+
+def test_ps_role_noop():
+    r = _run("between_sync.py", "--job_name=ps", "--task_index=0")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ps setting up ..." in r.stdout
+    assert "Done" not in r.stdout  # no training happened
