@@ -33,6 +33,7 @@ from distributed_tensorflow_tpu.train.scan import make_scanned_train_fn, stage_e
 
 BASELINE_EXAMPLES_PER_SEC = 42_000.0
 BATCH_SIZE = 100
+LEARNING_RATE = 0.001
 TIMED_EPOCHS = 5
 
 
@@ -41,15 +42,31 @@ def log(*a):
 
 
 def main() -> None:
+    import os
+
     dev = jax.devices()[0]
-    log(f"device: {dev}")
+    impl = os.environ.get("BENCH_IMPL", "xla")  # xla | pallas
+    log(f"device: {dev}  impl: {impl}")
     ds = read_data_sets("MNIST_data", one_hot=True)
 
     model = MLP()  # bf16 matmuls, f32 accumulation/softmax
-    opt = sgd(0.001)
-    strategy = SingleDevice()
-    state = strategy.init_state(model, opt, seed=1)
-    run_epoch = make_scanned_train_fn(model, cross_entropy, opt)
+    if impl == "pallas":
+        # NOTE: the fused kernel computes its matmuls in f32 (not bf16), so
+        # an xla-vs-pallas delta includes that dtype difference.
+        from distributed_tensorflow_tpu.ops.pallas_mlp import (
+            make_fused_scanned_fn,
+            to_fused,
+        )
+
+        log("pallas impl runs f32 matmuls (xla impl runs bf16)")
+        state = to_fused(model.init(seed=1))
+        run_epoch = make_fused_scanned_fn(
+            batch_size=BATCH_SIZE, learning_rate=LEARNING_RATE
+        )
+    else:
+        opt = sgd(LEARNING_RATE)
+        state = SingleDevice().init_state(model, opt, seed=1)
+        run_epoch = make_scanned_train_fn(model, cross_entropy, opt)
 
     rng = np.random.default_rng(0)
     xs_np, ys_np = stage_epoch(ds.train.images, ds.train.labels, BATCH_SIZE, rng=rng)

@@ -32,3 +32,32 @@ L7     nohup-per-task launch              ``launch.py`` / example scripts
 __version__ = "0.1.0"
 
 from distributed_tensorflow_tpu import config  # noqa: F401
+from distributed_tensorflow_tpu.config import ClusterConfig, TrainConfig  # noqa: F401
+
+
+_LAZY_EXPORTS = {
+    "MLP": ("distributed_tensorflow_tpu.models", "MLP"),
+    "read_data_sets": ("distributed_tensorflow_tpu.data", "read_data_sets"),
+    "make_mesh": ("distributed_tensorflow_tpu.parallel", "make_mesh"),
+    "SingleDevice": ("distributed_tensorflow_tpu.parallel", "SingleDevice"),
+    "SyncDataParallel": ("distributed_tensorflow_tpu.parallel", "SyncDataParallel"),
+    "AsyncDataParallel": ("distributed_tensorflow_tpu.parallel", "AsyncDataParallel"),
+    "Trainer": ("distributed_tensorflow_tpu.train", "Trainer"),
+    "Supervisor": ("distributed_tensorflow_tpu.train", "Supervisor"),
+    "build_trainer": ("distributed_tensorflow_tpu.launch", "build_trainer"),
+    "bootstrap": ("distributed_tensorflow_tpu.cluster", "bootstrap"),
+}
+
+
+def __getattr__(name):
+    """Lazy top-level API (keeps `import distributed_tensorflow_tpu` cheap —
+    no jax import until something that needs it is touched)."""
+    try:
+        module, attr = _LAZY_EXPORTS[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(module), attr)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value

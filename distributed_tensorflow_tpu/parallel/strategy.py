@@ -343,9 +343,32 @@ class AsyncDataParallel(Strategy):
         )
         return jax.jit(mapped, donate_argnums=0)
 
-    def make_exchange_fn(self):
+    def make_exchange_fn(self, collective: str = "auto"):
         """Periodic parameter exchange: every copy jumps to the mean — the
-        staleness-bounding analog of the PS serializing worker applies."""
+        staleness-bounding analog of the PS serializing worker applies.
+
+        ``collective="auto"`` lets XLA lower the mean-over-copies (typically
+        an all-reduce); ``"ring"`` runs it explicitly as a ppermute ring
+        (ops/collectives.py) — N-1 single-hop neighbor exchanges over ICI.
+        """
+        if collective == "ring":
+            from distributed_tensorflow_tpu.ops.collectives import ring_all_mean
+
+            def local_exchange(state: TrainState):
+                params = jax.tree.map(
+                    lambda a: ring_all_mean(a, "data"), state.params
+                )
+                return TrainState(params, state.opt_state, state.step)
+
+            mapped = jax.shard_map(
+                local_exchange,
+                mesh=self.mesh,
+                in_specs=(P("data"),),
+                out_specs=P("data"),
+            )
+            return jax.jit(mapped, donate_argnums=0)
+        if collective != "auto":
+            raise ValueError(f"unknown collective {collective!r}; use 'auto' or 'ring'")
 
         @partial(jax.jit, donate_argnums=0, out_shardings=self._stacked)
         def exchange(state: TrainState):
