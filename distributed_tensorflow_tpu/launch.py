@@ -87,7 +87,7 @@ def build_trainer(
     optimizer = optimizer or optim_lib.sgd(config.learning_rate)
     if summary_writer is None and is_chief and config.logs_path:
         summary_writer = SummaryWriter(config.logs_path)
-    return Trainer(
+    trainer = Trainer(
         model,
         datasets,
         config,
@@ -97,6 +97,16 @@ def build_trainer(
         is_chief=is_chief,
         print_fn=print_fn,
     )
+    # Failure-reactive stop: a chief with an armed heartbeat coordinator
+    # (cluster.bootstrap(heartbeat_port=...)) stops cleanly when a worker
+    # dies instead of hanging in a collective (train/supervisor.py).
+    if context is not None and context.heartbeat is not None and is_chief:
+        if trainer.supervisor is None:
+            from distributed_tensorflow_tpu.train import Supervisor
+
+            trainer.supervisor = Supervisor(is_chief=True)
+        trainer.supervisor.attach_heartbeat(context.heartbeat)
+    return trainer
 
 
 def run(

@@ -1,0 +1,80 @@
+"""Local cluster launcher — the reference's nohup-per-task workflow, automated.
+
+The reference ran every topology by hand-launching one process per task::
+
+    nohup python tfdist_between.py --job_name=ps --task_index=0 > ps.log 2>&1 &
+    nohup python tfdist_between.py --job_name=worker --task_index=0 > w0.log ...
+
+(reference README.md:34-35, 58-60; C17 in SURVEY.md §2). This tool does the
+same thing in one command, against any script that accepts the standard
+``--job_name/--task_index`` flags::
+
+    python -m distributed_tensorflow_tpu.tools.launch_local \
+        --workers 2 --ps 1 --logdir ./task_logs -- python examples/between_sync.py
+
+One OS process per task, stdout/stderr redirected to ``<logdir>/<role><i>.log``
+exactly like the nohup recipe, non-zero exit if any worker fails. ps tasks
+are launched too (they no-op and exit, preserving launcher compatibility).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def launch(
+    command: list[str],
+    num_workers: int,
+    num_ps: int = 0,
+    logdir: str = "./task_logs",
+    env: dict | None = None,
+    wait: bool = True,
+) -> int:
+    os.makedirs(logdir, exist_ok=True)
+    procs: list[tuple[str, subprocess.Popen]] = []
+    base_env = dict(os.environ)
+    if env:
+        base_env.update(env)
+    for role, count in (("ps", num_ps), ("worker", num_workers)):
+        for i in range(count):
+            log_path = os.path.join(logdir, f"{role}{i}.log")
+            f = open(log_path, "w")
+            p = subprocess.Popen(
+                command + [f"--job_name={role}", f"--task_index={i}"],
+                stdout=f,
+                stderr=subprocess.STDOUT,
+                env=base_env,
+            )
+            procs.append((f"{role}{i}", p))
+    if not wait:
+        return 0
+    rc = 0
+    for name, p in procs:
+        code = p.wait()
+        print(f"{name}: exit {code}")
+        if code != 0 and name.startswith("worker"):
+            rc = 1
+    return rc
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--workers", type=int, required=True)
+    parser.add_argument("--ps", type=int, default=0)
+    parser.add_argument("--logdir", type=str, default="./task_logs")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="-- command to launch per task")
+    args = parser.parse_args(argv)
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        parser.error("missing command after --")
+    return launch(command, args.workers, args.ps, args.logdir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

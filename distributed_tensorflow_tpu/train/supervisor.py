@@ -39,10 +39,20 @@ class Supervisor:
         self.is_chief = is_chief
         self.checkpoint_dir = os.path.abspath(checkpoint_dir) if checkpoint_dir else None
         self._stop_requested = False
+        self._heartbeat = None
         self._ckptr = None
         if self.checkpoint_dir and _HAVE_ORBAX:
             os.makedirs(self.checkpoint_dir, exist_ok=True)
             self._ckptr = ocp.StandardCheckpointer()
+
+    def attach_heartbeat(self, heartbeat) -> None:
+        """Arm failure-reactive stopping: when the attached
+        HeartbeatCoordinator (runtime/native.py) reports a failed worker,
+        ``should_stop`` turns true — so the chief's training loop exits at
+        the next epoch boundary with checkpoints intact, instead of hanging
+        in a collective the dead worker will never join (the reference's
+        failure mode: gRPC calls blocking forever, SURVEY.md §5)."""
+        self._heartbeat = heartbeat
 
     # -- checkpoint/restore (upgrade over the reference's nothing) --------
 
@@ -88,6 +98,10 @@ class Supervisor:
 
     @property
     def should_stop(self) -> bool:
+        if self._stop_requested:
+            return True
+        if self._heartbeat is not None and self._heartbeat.failed_count() > 0:
+            self._stop_requested = True
         return self._stop_requested
 
     def stop(self) -> None:

@@ -1,0 +1,55 @@
+"""Launcher tool + failure-reactive supervisor tests."""
+
+import os
+import sys
+import time
+
+import pytest
+
+from distributed_tensorflow_tpu.tools.launch_local import launch
+from distributed_tensorflow_tpu.train.supervisor import Supervisor
+
+
+def test_launch_local_spawns_roles_and_logs(tmp_path):
+    logdir = str(tmp_path / "task_logs")
+    script = tmp_path / "echo_task.py"
+    script.write_text(
+        "import sys\n"
+        "print('ARGS', [a for a in sys.argv[1:]])\n"
+    )
+    rc = launch(
+        [sys.executable, str(script)], num_workers=2, num_ps=1, logdir=logdir
+    )
+    assert rc == 0
+    logs = sorted(os.listdir(logdir))
+    assert logs == ["ps0.log", "worker0.log", "worker1.log"]
+    w1 = open(os.path.join(logdir, "worker1.log")).read()
+    assert "--job_name=worker" in w1 and "--task_index=1" in w1
+
+
+def test_launch_local_propagates_worker_failure(tmp_path):
+    script = tmp_path / "fail_task.py"
+    script.write_text(
+        "import sys\n"
+        "sys.exit(2 if '--job_name=worker' in sys.argv else 0)\n"
+    )
+    rc = launch([sys.executable, str(script)], num_workers=1, num_ps=1,
+                logdir=str(tmp_path / "logs"))
+    assert rc == 1
+
+
+def test_supervisor_stops_on_heartbeat_failure():
+    from distributed_tensorflow_tpu.runtime import native
+
+    if not native.available():
+        pytest.skip("native runtime unavailable")
+    sup = Supervisor(is_chief=True)
+    with native.HeartbeatCoordinator(19533, expected_workers=1, timeout_ms=300) as hb:
+        sup.attach_heartbeat(hb)
+        assert not sup.should_stop
+        w = native.HeartbeatWorker("127.0.0.1", 19533, worker_id=0, interval_ms=50)
+        time.sleep(0.2)
+        assert not sup.should_stop  # alive worker: keep training
+        w.stop()
+        time.sleep(0.6)
+        assert sup.should_stop  # dead worker detected → orderly stop
