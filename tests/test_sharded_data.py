@@ -1,0 +1,26 @@
+"""Multi-host batch assembly tests (single-process degenerate path; the
+2-process path is covered by tests/integration/test_multihost.py)."""
+
+import numpy as np
+
+from distributed_tensorflow_tpu.data import read_data_sets
+from distributed_tensorflow_tpu.data.sharded import global_batch, local_shard_for_process
+from distributed_tensorflow_tpu.parallel import make_mesh
+
+
+def test_global_batch_single_process_sharded():
+    mesh = make_mesh()
+    x = np.random.default_rng(0).random((800, 784), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[np.random.default_rng(0).integers(0, 10, 800)]
+    gx, gy = global_batch(mesh, x, y)
+    assert gx.shape == (800, 784)
+    # Actually distributed over the 8 devices, 100 rows each.
+    shapes = {s.data.shape for s in gx.addressable_shards}
+    assert shapes == {(100, 784)}
+    np.testing.assert_array_equal(np.asarray(gx), x)
+    assert gy.shape == (800, 10)
+
+
+def test_local_shard_identity_single_process(datasets):
+    ds = local_shard_for_process(datasets.train)
+    assert ds is datasets.train
