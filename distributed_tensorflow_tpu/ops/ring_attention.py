@@ -1,0 +1,172 @@
+"""Ring attention: sequence-parallel attention over a mesh axis.
+
+The reference workload has no attention and no sequence dimension
+(SURVEY.md §2b "Sequence/context parallel: ABSENT — model is a fixed-784-
+feature MLP"), but long-context capability is first-class in this framework:
+the mesh design reserves a sequence axis and this module provides the
+canonical long-context primitive — blockwise attention with the KV blocks
+rotating around the device ring (one ``lax.ppermute`` hop per step), online-
+softmax accumulation, O(L_local) memory per device.
+
+Mechanics (flash-attention-style streaming):
+
+- each device holds local blocks q, k, v of shape [B, L/n, H, D] for an
+  L-token sequence sharded over the ``seq`` axis of n devices;
+- n ring steps: attend local q against the currently-held KV block while a
+  ``ppermute`` forwards the block to the ring neighbor; a running
+  (max, sum, accumulator) triple makes the streamed softmax exact;
+- causal masking uses global positions reconstructed from the ring step and
+  the device's axis index, so the sharded result equals dense causal
+  attention on the unsharded sequence.
+
+Also here: ``all_to_all_seq_to_heads`` / ``heads_to_seq`` — the
+Ulysses-style alternative that reshards sequence↔heads around attention so
+each device computes full-sequence attention for a head subset.
+
+Call these inside ``jax.shard_map`` over the sequence axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, *, scale, mask=None):
+    """One block's scores/weights: q [B,Lq,H,D] x k,v [B,Lk,H,D] →
+    (scores [B,H,Lq,Lk] pre-softmax, value-product helper)."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, _NEG_INF)
+    return scores
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+) -> jax.Array:
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    Args are local blocks [B, L_local, H, D]; returns the local output block
+    of the same shape. Equivalent to dense (optionally causal) softmax
+    attention over the full gathered sequence.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, l_loc, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.array(d, jnp.float32))
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    q32 = q.astype(jnp.float32)
+    # pvary: the zero-init carries are device-invariant but the loop body
+    # makes them device-varying; shard_map's vma typing requires the carry
+    # types to match up front.
+    pvary = partial(lax.pcast, axis_name=(axis_name,), to="varying")
+    m = pvary(jnp.full((b, h, l_loc, 1), _NEG_INF, jnp.float32))
+    s = pvary(jnp.zeros((b, h, l_loc, 1), jnp.float32))
+    o = pvary(jnp.zeros((b, h, l_loc, d), jnp.float32))
+
+    q_pos = my * l_loc + jnp.arange(l_loc)  # global positions of local q rows
+
+    def body(step, carry):
+        m, s, o, kv = carry
+        k_blk, v_blk = kv
+        # The block we hold at `step` originated `step` positions behind us.
+        src = (my - step) % n
+        mask = None
+        if causal:
+            k_pos = src * l_loc + jnp.arange(l_loc)
+            mask = q_pos[:, None] >= k_pos[None, :]  # [Lq, Lk]
+            mask = mask[None, None]  # broadcast over B, H
+        scores = _block_attend(
+            q32, k_blk.astype(jnp.float32), v_blk, scale=scale, mask=mask
+        )
+        blk_max = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, blk_max)
+        # Guard fully-masked rows (every score -inf): exp(-inf - -inf) traps.
+        m_safe = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+        corr = jnp.exp(m - m_safe)
+        p = jnp.exp(scores - m_safe)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        s_new = s * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        o_new = o * corr + pv
+        kv = jax.tree.map(lambda x: lax.ppermute(x, axis_name, perm), (k_blk, v_blk))
+        return m_new, s_new, o_new, kv
+
+    m, s, o, _ = lax.fori_loop(0, n, body, (m, s, o, (k, v)))
+    out = o / jnp.maximum(s, 1e-30)
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def dense_attention(q, k, v, *, causal: bool = False) -> jax.Array:
+    """Reference dense attention on unsharded [B, L, H, D] (for tests and
+    single-device use)."""
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.array(d, jnp.float32))
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if causal:
+        l_q, l_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.arange(l_q)[:, None] >= jnp.arange(l_k)[None, :]
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bhqd", w, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses-style alternative: all-to-all resharding seq <-> heads
+# ---------------------------------------------------------------------------
+
+
+def all_to_all_seq_to_heads(x: jax.Array, axis_name: str) -> jax.Array:
+    """[B, L/n, H, D] seq-sharded → [B, L, H/n, D] head-sharded: each device
+    trades sequence shards for a head subset (one all-to-all), after which
+    plain full-sequence attention runs locally per head group."""
+    n = lax.axis_size(axis_name)
+    b, l_loc, h, d = x.shape
+    x = x.reshape(b, l_loc, n, h // n, d)
+    x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=False)
+    # all_to_all with these axes yields [B, n, l_loc, h//n, d] → merge seq.
+    return x.reshape(b, n * l_loc, h // n, d)
+
+
+def all_to_all_heads_to_seq(x: jax.Array, axis_name: str) -> jax.Array:
+    """Inverse of :func:`all_to_all_seq_to_heads`."""
+    n = lax.axis_size(axis_name)
+    b, l, h_loc, d = x.shape
+    x = x.reshape(b, n, l // n, h_loc, d)
+    x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3, tiled=False)
+    # yields [B, l//n, h_loc, n, d] with head groups stacked → merge heads.
+    return x.reshape(b, l // n, h_loc * n, d)
+
+
+def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False):
+    """Sequence-parallel attention via all-to-all (Ulysses): reshard to
+    head-parallel, run dense attention on the full sequence locally, reshard
+    back. Requires H divisible by the axis size."""
+    q2 = all_to_all_seq_to_heads(q, axis_name)
+    k2 = all_to_all_seq_to_heads(k, axis_name)
+    v2 = all_to_all_seq_to_heads(v, axis_name)
+    out = dense_attention(q2, k2, v2, causal=causal)
+    return all_to_all_heads_to_seq(out, axis_name)
