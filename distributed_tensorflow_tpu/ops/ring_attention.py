@@ -34,12 +34,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from distributed_tensorflow_tpu.ops.collectives import _ring_perm
+
 _NEG_INF = -1e30
 
 
-def _block_attend(q, k, v, *, scale, mask=None):
-    """One block's scores/weights: q [B,Lq,H,D] x k,v [B,Lk,H,D] →
-    (scores [B,H,Lq,Lk] pre-softmax, value-product helper)."""
+def _block_scores(q, k, *, scale, mask=None):
+    """Pre-softmax scores for one block: q [B,Lq,H,D] x k [B,Lk,H,D] →
+    [B,H,Lq,Lk] (f32), with optional mask applied as -inf."""
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     scores = scores * scale
     if mask is not None:
@@ -65,7 +67,7 @@ def ring_attention(
     my = lax.axis_index(axis_name)
     b, l_loc, h, d = q.shape
     scale = 1.0 / jnp.sqrt(jnp.array(d, jnp.float32))
-    perm = [(j, (j + 1) % n) for j in range(n)]
+    perm = _ring_perm(n)
 
     q32 = q.astype(jnp.float32)
     # pvary: the zero-init carries are device-invariant but the loop body
@@ -81,32 +83,43 @@ def ring_attention(
     def body(step, carry):
         m, s, o, kv = carry
         k_blk, v_blk = kv
-        # The block we hold at `step` originated `step` positions behind us.
-        src = (my - step) % n
-        mask = None
+
+        def attend(m, s, o):
+            # The block held at `step` originated `step` positions behind us.
+            src = (my - step) % n
+            mask = None
+            if causal:
+                k_pos = src * l_loc + jnp.arange(l_loc)
+                mask = q_pos[:, None] >= k_pos[None, :]  # [Lq, Lk]
+                mask = mask[None, None]  # broadcast over B, H
+            scores = _block_scores(
+                q32, k_blk.astype(jnp.float32), scale=scale, mask=mask
+            )
+            blk_max = jnp.max(scores, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m, blk_max)
+            # Guard fully-masked rows (every score -inf): exp(-inf - -inf).
+            m_safe = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+            corr = jnp.exp(m - m_safe)
+            p = jnp.exp(scores - m_safe)
+            if causal:
+                p = jnp.where(mask, p, 0.0)
+            s_new = s * corr + jnp.sum(p, axis=-1, keepdims=True)
+            pv = jnp.einsum(
+                "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, s_new, o * corr + pv
+
         if causal:
-            k_pos = src * l_loc + jnp.arange(l_loc)
-            mask = q_pos[:, None] >= k_pos[None, :]  # [Lq, Lk]
-            mask = mask[None, None]  # broadcast over B, H
-        scores = _block_attend(
-            q32, k_blk.astype(jnp.float32), v_blk, scale=scale, mask=mask
-        )
-        blk_max = jnp.max(scores, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m, blk_max)
-        # Guard fully-masked rows (every score -inf): exp(-inf - -inf) traps.
-        m_safe = jnp.where(m_new == _NEG_INF, 0.0, m_new)
-        corr = jnp.exp(m - m_safe)
-        p = jnp.exp(scores - m_safe)
-        if causal:
-            p = jnp.where(mask, p, 0.0)
-        s_new = s * corr + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        )
-        o_new = o * corr + pv
+            # Blocks strictly ahead of every local q row are fully masked:
+            # skip their einsums entirely (devices early in the ring would
+            # otherwise burn ~half the attention FLOPs on zeroed work).
+            src = (my - step) % n
+            m, s, o = lax.cond(src > my, lambda m, s, o: (m, s, o), attend, m, s, o)
+        else:
+            m, s, o = attend(m, s, o)
         kv = jax.tree.map(lambda x: lax.ppermute(x, axis_name, perm), (k_blk, v_blk))
-        return m_new, s_new, o_new, kv
+        return m, s, o, kv
 
     m, s, o, _ = lax.fori_loop(0, n, body, (m, s, o, (k, v)))
     out = o / jnp.maximum(s, 1e-30)
@@ -157,8 +170,11 @@ def all_to_all_heads_to_seq(x: jax.Array, axis_name: str) -> jax.Array:
     b, l, h_loc, d = x.shape
     x = x.reshape(b, n, l // n, h_loc, d)
     x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3, tiled=False)
-    # yields [B, l//n, h_loc, n, d] with head groups stacked → merge heads.
-    return x.reshape(b, l // n, h_loc * n, d)
+    # yields [B, l//n, h_loc, n, d]; the received axis (3) indexes the head
+    # *group*, which is the major part of the head index — transpose it in
+    # front of h_loc before merging, or heads come back interleaved.
+    x = jnp.einsum("blhnd->blnhd", x)
+    return x.reshape(b, l // n, n * h_loc, d)
 
 
 def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False):

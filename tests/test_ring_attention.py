@@ -1,6 +1,8 @@
-"""Sequence-parallel attention tests on an 8-device 'seq' mesh: ring and
-all-to-all (Ulysses) variants must equal dense attention on the unsharded
-sequence, causal and non-causal."""
+"""Sequence-parallel attention tests: ring and all-to-all (Ulysses)
+variants must equal dense attention on the unsharded sequence, causal and
+non-causal — across mesh sizes where heads-per-device is both 1 (8-device
+mesh, H=8) and >1 (4-device mesh, h_loc=2), the case that catches
+head-order bugs in the all-to-all resharding."""
 
 import jax
 import jax.numpy as jnp
@@ -9,6 +11,8 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from distributed_tensorflow_tpu.ops.ring_attention import (
+    all_to_all_heads_to_seq,
+    all_to_all_seq_to_heads,
     dense_attention,
     ring_attention,
     ulysses_attention,
@@ -18,9 +22,8 @@ from distributed_tensorflow_tpu.parallel import make_mesh
 B, L, H, D = 2, 64, 8, 16
 
 
-@pytest.fixture(scope="module")
-def mesh():
-    return make_mesh((8,), ("seq",))
+def _mesh(n):
+    return make_mesh((n,), ("seq",), devices=jax.devices()[:n])
 
 
 @pytest.fixture(scope="module")
@@ -30,40 +33,49 @@ def qkv():
     return tuple(rng.standard_normal(shape).astype(np.float32) for _ in range(3))
 
 
-def _sharded(mesh, fn):
+def _sharded(mesh, fn, out_spec=P(None, "seq")):
     return jax.jit(
         jax.shard_map(
             fn,
             mesh=mesh,
             in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
-            out_specs=P(None, "seq"),
+            out_specs=out_spec,
         )
     )
 
 
+@pytest.mark.parametrize("n", [4, 8])
 @pytest.mark.parametrize("causal", [False, True])
-def test_ring_matches_dense(mesh, qkv, causal):
-    q, k, v = qkv
-    want = dense_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal)
-    got = _sharded(mesh, lambda q, k, v: ring_attention(q, k, v, "seq", causal=causal))(
-        q, k, v
-    )
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
-
-
-@pytest.mark.parametrize("causal", [False, True])
-def test_ulysses_matches_dense(mesh, qkv, causal):
+def test_ring_matches_dense(qkv, n, causal):
     q, k, v = qkv
     want = dense_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal)
     got = _sharded(
-        mesh, lambda q, k, v: ulysses_attention(q, k, v, "seq", causal=causal)
+        _mesh(n), lambda q, k, v: ring_attention(q, k, v, "seq", causal=causal)
     )(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
 
 
-def test_ring_long_sequence_memory_shape(mesh, qkv):
-    # The point of ring attention: each device only ever materializes
-    # [B, H, L_local, L_local] score blocks, L_local = L/8.
+@pytest.mark.parametrize("n", [4, 8])
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_dense(qkv, n, causal):
     q, k, v = qkv
-    out = _sharded(mesh, lambda q, k, v: ring_attention(q, k, v, "seq"))(q, k, v)
-    assert out.shape == (B, L, H, D)
+    want = dense_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal)
+    got = _sharded(
+        _mesh(n), lambda q, k, v: ulysses_attention(q, k, v, "seq", causal=causal)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_all_to_all_roundtrip_identity(n):
+    # seq→heads→seq must be the identity for every heads-per-device count.
+    mesh = _mesh(n)
+    x = np.arange(B * L * H * D, dtype=np.float32).reshape(B, L, H, D)
+
+    def roundtrip(x, _, __):
+        return all_to_all_heads_to_seq(
+            all_to_all_seq_to_heads(x, "seq"), "seq"
+        )
+
+    got = _sharded(mesh, roundtrip)(x, x, x)
+    np.testing.assert_array_equal(np.asarray(got), x)
