@@ -1,1 +1,5 @@
 from distributed_tensorflow_tpu.models.mlp import MLP, MLPParams  # noqa: F401
+from distributed_tensorflow_tpu.models.transformer import (  # noqa: F401
+    TransformerClassifier,
+    TransformerParams,
+)

@@ -1,0 +1,171 @@
+"""A sequence-model family: single-block transformer classifier.
+
+The reference has exactly one model (the C8 MLP). This family exists to
+prove the framework's long-context machinery end to end — same init/apply
+protocol (models/base.py), same Trainer/strategies, but the forward pass has
+a real sequence dimension whose attention can run:
+
+- dense on one device (``apply``), or
+- **ring sequence-parallel** over a ``seq`` mesh axis
+  (``apply_sequence_parallel``): activations sharded along the sequence,
+  attention via ``ops/ring_attention.ring_attention`` — identical math.
+
+The MNIST workload maps onto it by treating each image as a 28-token
+sequence of 28-pixel rows (no new data pipeline needed). Architecture:
+row-embed → +learned positions → pre-LN attention block with residual →
+pre-LN MLP block with residual → mean-pool → linear head. All matmuls in
+``compute_dtype`` (bf16 MXU) with f32 accumulation; softmax/layernorm f32.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.ops.ring_attention import dense_attention, ring_attention
+
+
+class TransformerParams(NamedTuple):
+    embed: jax.Array  # [token_dim, model_dim]
+    pos: jax.Array  # [seq_len, model_dim]
+    ln1_scale: jax.Array
+    ln1_bias: jax.Array
+    wq: jax.Array  # [model_dim, model_dim]
+    wk: jax.Array
+    wv: jax.Array
+    wo: jax.Array
+    ln2_scale: jax.Array
+    ln2_bias: jax.Array
+    w_up: jax.Array  # [model_dim, 4*model_dim]
+    b_up: jax.Array
+    w_down: jax.Array  # [4*model_dim, model_dim]
+    b_down: jax.Array
+    w_head: jax.Array  # [model_dim, classes]
+    b_head: jax.Array
+
+
+def _layernorm(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps)) * scale + bias
+
+
+class TransformerClassifier:
+    """seq_len tokens of token_dim features → num_classes probabilities."""
+
+    def __init__(
+        self,
+        seq_len: int = 28,
+        token_dim: int = 28,
+        model_dim: int = 64,
+        num_heads: int = 4,
+        num_classes: int = 10,
+        compute_dtype: jnp.dtype = jnp.bfloat16,
+    ):
+        assert model_dim % num_heads == 0
+        self.seq_len = seq_len
+        self.token_dim = token_dim
+        self.model_dim = model_dim
+        self.num_heads = num_heads
+        self.head_dim = model_dim // num_heads
+        self.num_classes = num_classes
+        self.compute_dtype = compute_dtype
+
+    def init(self, seed: int = 1) -> TransformerParams:
+        keys = jax.random.split(jax.random.key(seed), 8)
+        d = self.model_dim
+
+        def dense_init(key, shape):
+            # fan-in scaled normal (unlike the MLP's reference-parity N(0,1))
+            return jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(shape[0])
+
+        return TransformerParams(
+            embed=dense_init(keys[0], (self.token_dim, d)),
+            pos=0.02 * jax.random.normal(keys[1], (self.seq_len, d), jnp.float32),
+            ln1_scale=jnp.ones((d,), jnp.float32),
+            ln1_bias=jnp.zeros((d,), jnp.float32),
+            wq=dense_init(keys[2], (d, d)),
+            wk=dense_init(keys[3], (d, d)),
+            wv=dense_init(keys[4], (d, d)),
+            wo=dense_init(keys[5], (d, d)),
+            ln2_scale=jnp.ones((d,), jnp.float32),
+            ln2_bias=jnp.zeros((d,), jnp.float32),
+            w_up=dense_init(keys[6], (d, 4 * d)),
+            b_up=jnp.zeros((4 * d,), jnp.float32),
+            w_down=dense_init(keys[7], (4 * d, d)),
+            b_down=jnp.zeros((d,), jnp.float32),
+            w_head=jnp.zeros((d, self.num_classes), jnp.float32),
+            b_head=jnp.zeros((self.num_classes,), jnp.float32),
+        )
+
+    # -- forward pieces (shared by dense and sequence-parallel paths) ------
+
+    def _dot(self, x, w):
+        cd = self.compute_dtype
+        return jnp.dot(x.astype(cd), w.astype(cd), preferred_element_type=jnp.float32)
+
+    def _qkv(self, p: TransformerParams, h):
+        b, l, d = h.shape
+        hn = self._layernorm_tokens(h, p.ln1_scale, p.ln1_bias)
+        shape = (b, l, self.num_heads, self.head_dim)
+        q = self._dot(hn, p.wq).reshape(shape)
+        k = self._dot(hn, p.wk).reshape(shape)
+        v = self._dot(hn, p.wv).reshape(shape)
+        return q, k, v
+
+    @staticmethod
+    def _layernorm_tokens(h, scale, bias):
+        return _layernorm(h, scale, bias)
+
+    def _post_attention(self, p: TransformerParams, h, attn_out):
+        b, l, _, _ = attn_out.shape
+        h = h + self._dot(attn_out.reshape(b, l, self.model_dim), p.wo)
+        hn = self._layernorm_tokens(h, p.ln2_scale, p.ln2_bias)
+        mlp = self._dot(jax.nn.gelu(self._dot(hn, p.w_up) + p.b_up), p.w_down)
+        return h + mlp + p.b_down
+
+    def _embed(self, p: TransformerParams, x, positions=None):
+        b = x.shape[0]
+        tokens = x.reshape(b, self.seq_len, self.token_dim)
+        h = self._dot(tokens, p.embed)
+        pos = p.pos if positions is None else positions
+        return h + pos
+
+    def _head_probs(self, p: TransformerParams, h):
+        pooled = h.mean(axis=1)
+        logits = self._dot(pooled, p.w_head) + p.b_head
+        return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    # -- public forwards ---------------------------------------------------
+
+    def apply(self, params: TransformerParams, x: jax.Array) -> jax.Array:
+        """Dense single-device forward: x [B, seq_len*token_dim] → probs."""
+        h = self._embed(params, x)
+        q, k, v = self._qkv(params, h)
+        attn = dense_attention(q, k, v)
+        h = self._post_attention(params, h, attn)
+        return self._head_probs(params, h)
+
+    def apply_sequence_parallel(
+        self, params: TransformerParams, x: jax.Array, axis_name: str = "seq"
+    ) -> jax.Array:
+        """Sequence-parallel forward *body*: call inside ``jax.shard_map``
+        with x sharded [B, (seq_len/n)*token_dim] per device and params
+        replicated. Attention runs as a ppermute ring; the mean-pool is a
+        cross-device pmean. Math identical to :meth:`apply`."""
+        n = jax.lax.axis_size(axis_name)
+        my = jax.lax.axis_index(axis_name)
+        l_loc = self.seq_len // n
+        b = x.shape[0]
+        tokens = x.reshape(b, l_loc, self.token_dim)
+        pos = jax.lax.dynamic_slice_in_dim(params.pos, my * l_loc, l_loc, axis=0)
+        h = self._dot(tokens, params.embed) + pos
+        q, k, v = self._qkv(params, h)
+        attn = ring_attention(q, k, v, axis_name)
+        h = self._post_attention(params, h, attn)
+        pooled = jax.lax.pmean(h.mean(axis=1), axis_name)
+        logits = self._dot(pooled, params.w_head) + params.b_head
+        return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
