@@ -1,0 +1,78 @@
+"""Pipeline parallelism: GPipe-style microbatched execution over a
+``stage`` mesh axis.
+
+Absent from the reference (SURVEY.md §2b: no pipeline stages — one tiny
+MLP), provided as first-class machinery completing the framework's
+parallelism matrix (dp / tp / sp / ep / pp, each live-tested). One layer's
+parameters live on each device of the ``stage`` axis; activations flow
+stage-to-stage over single ``ppermute`` hops; M microbatches fill the
+pipeline so all S stages compute concurrently after the fill phase
+(M + S - 1 total ticks).
+
+Call :func:`pipeline_apply` inside ``jax.shard_map`` over the stage axis,
+with per-stage parameters sharded on their leading axis and the microbatched
+input replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x_microbatches: jax.Array,
+    axis_name: str = "stage",
+) -> jax.Array:
+    """Run ``y_mb = f_{S-1}(...f_1(f_0(x_mb)))`` for every microbatch.
+
+    - ``stage_fn(params_slice, x) -> y``: one stage's computation; input and
+      output activation shapes must match across stages (pipeline wiring).
+    - ``stage_params``: pytree whose leaves carry a leading [1, ...] local
+      slice (the full [S, ...] stack sharded over ``axis_name``).
+    - ``x_microbatches``: [M, B, ...] microbatched input, replicated.
+
+    Returns the [M, B, ...] outputs, replicated on every stage device.
+    """
+    s_count = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    m = x_microbatches.shape[0]
+    act_shape = x_microbatches.shape[1:]
+    perm = [(j, (j + 1) % s_count) for j in range(s_count)]
+
+    pvary = lambda v: lax.pcast(v, axis_name=(axis_name,), to="varying")  # noqa: E731
+    carry = pvary(jnp.zeros(act_shape, x_microbatches.dtype))
+    out = pvary(jnp.zeros((m,) + act_shape, jnp.float32))
+
+    def tick(t, state):
+        carry, out = state
+        mb = t - my  # which microbatch this stage works on at tick t
+        valid = (mb >= 0) & (mb < m)
+        x_in = x_microbatches[jnp.clip(mb, 0, m - 1)]
+        inp = jnp.where(my == 0, x_in, carry)
+        y = stage_fn(stage_params, inp).astype(jnp.float32)
+        y = jnp.where(valid, y, 0.0)
+        # Final stage banks its finished microbatch.
+        bank = (my == s_count - 1) & valid
+        update = lax.dynamic_update_slice(
+            out, y[None], (jnp.clip(mb, 0, m - 1),) + (0,) * len(act_shape)
+        )
+        out = jnp.where(bank, update, out)
+        carry = lax.ppermute(y.astype(x_microbatches.dtype), axis_name, perm)
+        return carry, out
+
+    _, out = lax.fori_loop(0, m + s_count - 1, tick, (carry, out))
+    # Only the last stage holds real outputs; share them with every stage.
+    return lax.psum(out, axis_name).astype(x_microbatches.dtype)
+
+
+def microbatch(x: jax.Array, num_microbatches: int) -> jax.Array:
+    """[B, ...] → [M, B/M, ...]."""
+    b = x.shape[0]
+    assert b % num_microbatches == 0, (b, num_microbatches)
+    return x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])

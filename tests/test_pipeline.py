@@ -1,0 +1,57 @@
+"""Pipeline parallelism tests: the staged/microbatched execution must equal
+sequential layer application, for S in {4, 8} and varying microbatch counts."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel import make_mesh
+from distributed_tensorflow_tpu.parallel.pipeline import microbatch, pipeline_apply
+
+D = 32
+
+
+def _stack_params(s, key):
+    ws = jax.random.normal(key, (s, D, D), jnp.float32) / np.sqrt(D)
+    bs = jnp.zeros((s, D), jnp.float32)
+    return ws, bs
+
+
+def _stage_fn(params, x):
+    w, b = params
+    return jax.nn.gelu(jnp.dot(x, w[0], preferred_element_type=jnp.float32) + b[0])
+
+
+def _sequential(ws, bs, x):
+    for i in range(ws.shape[0]):
+        x = jax.nn.gelu(x @ ws[i] + bs[i])
+    return x
+
+
+@pytest.mark.parametrize("s,m", [(4, 4), (4, 8), (8, 2), (8, 8)])
+def test_pipeline_matches_sequential(s, m):
+    mesh = make_mesh((s,), ("stage",), devices=jax.devices()[:s])
+    ws, bs = _stack_params(s, jax.random.key(0))
+    x = np.random.default_rng(0).standard_normal((16, D)).astype(np.float32)
+    want = np.asarray(_sequential(np.asarray(ws), np.asarray(bs), x))
+
+    xs = microbatch(jnp.asarray(x), m)
+    fn = jax.jit(
+        jax.shard_map(
+            lambda p, xs: pipeline_apply(_stage_fn, p, xs, "stage"),
+            mesh=mesh,
+            in_specs=((P("stage"), P("stage")), P()),
+            out_specs=P(),
+        )
+    )
+    got = np.asarray(fn((ws, bs), xs)).reshape(16, D)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_microbatch_shapes():
+    x = jnp.zeros((16, 3))
+    assert microbatch(x, 4).shape == (4, 4, 3)
+    with pytest.raises(AssertionError):
+        microbatch(x, 5)
