@@ -46,16 +46,15 @@ def test_checkpoint_round_trip(tmp_path, make_strategy):
     strategy = make_strategy(make_mesh((4, 2)))
     state = _trained_state(strategy)
     sup = Supervisor(is_chief=True, checkpoint_dir=str(tmp_path))
-    step_no = int(jnp.sum(state.step))
+    step_no = strategy.global_step(state)
     sup.save(state, step_no)
     assert sup.latest_step() == step_no
     restored, got_step = sup.prepare_or_restore(jax.tree.map(jnp.zeros_like, state))
     assert got_step == step_no
-    np.testing.assert_array_equal(
-        np.asarray(jax.device_get(restored.params.w1)),
-        np.asarray(jax.device_get(state.params.w1)),
-    )
-    np.testing.assert_array_equal(
-        np.asarray(restored.step), np.asarray(state.step)
-    )
+    # Every leaf restored bitwise — values AND shardings.
+    for want, got in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(want)), np.asarray(jax.device_get(got))
+        )
+        assert got.sharding == want.sharding, (want.sharding, got.sharding)
     sup.stop()

@@ -45,6 +45,38 @@ def test_sequence_parallel_matches_dense():
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
 
 
+def test_trains_sync_dp(small_datasets):
+    from distributed_tensorflow_tpu.parallel import SyncDataParallel
+
+    model = TransformerClassifier(compute_dtype=jnp.float32)
+    cfg = TrainConfig(epochs=1)
+    tr = Trainer(
+        model,
+        small_datasets,
+        cfg,
+        strategy=SyncDataParallel(make_mesh()),
+        optimizer=optim_lib.make("adam", 1e-3),
+        print_fn=lambda *a: None,
+    )
+    res = tr.run(epochs=1)
+    assert tr.strategy.global_step(tr.state) == 10
+    assert np.isfinite(res["final_cost"])
+
+
+def test_profiler_trace_writes_files(tmp_path, small_datasets):
+    # TrainConfig.profile_dir captures a jax.profiler trace of epoch 0.
+    model = TransformerClassifier(compute_dtype=jnp.float32)
+    cfg = TrainConfig(epochs=1, profile_dir=str(tmp_path / "prof"))
+    tr = Trainer(model, small_datasets, cfg, print_fn=lambda *a: None)
+    tr.run(epochs=1)
+    import os
+
+    found = []
+    for root, _, files in os.walk(tmp_path / "prof"):
+        found += files
+    assert any(f.endswith(".pb") or "trace" in f for f in found), found
+
+
 def test_trains_through_standard_trainer(small_datasets):
     model = TransformerClassifier(compute_dtype=jnp.float32)
     cfg = TrainConfig(epochs=2)
