@@ -82,6 +82,9 @@ class TrainConfig:
     # TPU-first knobs (no reference analog)
     compute_dtype: str = "bfloat16"  # MXU-friendly activations dtype
     param_dtype: str = "float32"
+    # "naive" = reference parity (CE over softmax probabilities, NaN-guarded,
+    # reference tfsingle.py:44-45); "stable" = logits-based log-softmax CE.
+    loss: str = "naive"
     logs_path: str = "./logs"  # reference logs_path, tfdist_between.py:22
     checkpoint_dir: str | None = None  # deliberate upgrade: orbax checkpointing
     sync: bool = True  # sync DP (pmean all-reduce) vs async emulation

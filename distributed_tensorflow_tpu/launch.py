@@ -67,6 +67,20 @@ def build_strategy(config: TrainConfig, *, devices=None, mesh=None):
     return AsyncDataParallel(mesh, avg_every=config.async_avg_every)
 
 
+class _LogitsAdapter:
+    """Presents ``apply_logits`` as ``apply`` so the logits-based stable
+    loss composes with the strategy stack (accuracy argmax is unchanged)."""
+
+    def __init__(self, model):
+        self._model = model
+
+    def __getattr__(self, name):
+        return getattr(self._model, name)
+
+    def apply(self, params, x):
+        return self._model.apply_logits(params, x)
+
+
 def build_trainer(
     config: TrainConfig | None = None,
     *,
@@ -75,6 +89,7 @@ def build_trainer(
     datasets=None,
     strategy=None,
     optimizer=None,
+    loss_fn=None,
     data_dir: str = "MNIST_data",
     summary_writer: SummaryWriter | None = None,
     print_fn=print,
@@ -85,6 +100,20 @@ def build_trainer(
     datasets = datasets or read_data_sets(data_dir, one_hot=True)
     strategy = strategy or build_strategy(config)
     optimizer = optimizer or optim_lib.sgd(config.learning_rate)
+    if loss_fn is None:
+        from distributed_tensorflow_tpu.ops import losses as losses_lib
+
+        if config.loss == "stable":
+            if not hasattr(model, "apply_logits"):
+                raise ValueError(
+                    f"loss='stable' needs apply_logits on {type(model).__name__}"
+                )
+            model = _LogitsAdapter(model)
+            loss_fn = losses_lib.stable_cross_entropy
+        elif config.loss == "naive":
+            loss_fn = losses_lib.cross_entropy
+        else:
+            raise ValueError(f"unknown loss {config.loss!r}; use 'naive' or 'stable'")
     if summary_writer is None and is_chief and config.logs_path:
         summary_writer = SummaryWriter(config.logs_path)
     trainer = Trainer(
@@ -93,6 +122,7 @@ def build_trainer(
         config,
         strategy=strategy,
         optimizer=optimizer,
+        loss_fn=loss_fn,
         summary_writer=summary_writer,
         is_chief=is_chief,
         print_fn=print_fn,
