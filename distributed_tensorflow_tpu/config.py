@@ -89,6 +89,11 @@ class TrainConfig:
     checkpoint_dir: str | None = None  # deliberate upgrade: orbax checkpointing
     sync: bool = True  # sync DP (pmean all-reduce) vs async emulation
     async_avg_every: int = 0  # async mode: average params every N steps (0 = never)
+    # Sync-DP parameter layout: "replicated" (params on every chip, gradient
+    # all-reduce — the reference-parity mode) or "zero" (ZeRO-3/FSDP: params
+    # and optimizer state sharded over 'data', all-gather fwd/bwd +
+    # reduce-scatter grads — parallel/fsdp.py). Identical update semantics.
+    dp_mode: str = "replicated"
     # Compile each epoch as one lax.scan dispatch (train/scan.py): identical
     # update semantics, ~100x less host overhead. Log lines are emitted from
     # the returned per-step costs after the dispatch. Supported by the

@@ -58,11 +58,21 @@ def config_from_env(base: TrainConfig | None = None) -> TrainConfig:
 
 
 def build_strategy(config: TrainConfig, *, devices=None, mesh=None):
+    if config.dp_mode not in ("replicated", "zero"):
+        raise ValueError(
+            f"unknown dp_mode {config.dp_mode!r}; use 'replicated' or 'zero'"
+        )
+    if config.dp_mode == "zero" and not config.sync:
+        raise ValueError("dp_mode='zero' requires sync=True (async keeps per-chip copies)")
     devices = list(devices if devices is not None else jax.devices())
     if mesh is None and len(devices) == 1:
         return SingleDevice()
     mesh = mesh or make_mesh(devices=devices)
     if config.sync:
+        if config.dp_mode == "zero":
+            from distributed_tensorflow_tpu.parallel import ShardedDataParallel
+
+            return ShardedDataParallel(mesh)
         return SyncDataParallel(mesh)
     return AsyncDataParallel(mesh, avg_every=config.async_avg_every)
 

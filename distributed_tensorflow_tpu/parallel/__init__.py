@@ -1,3 +1,7 @@
+from distributed_tensorflow_tpu.parallel.fsdp import (  # noqa: F401
+    ShardedDataParallel,
+    fsdp_specs,
+)
 from distributed_tensorflow_tpu.parallel.mesh import make_mesh  # noqa: F401
 from distributed_tensorflow_tpu.parallel.strategy import (  # noqa: F401
     AsyncDataParallel,

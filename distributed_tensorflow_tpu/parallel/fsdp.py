@@ -1,0 +1,193 @@
+"""ZeRO/FSDP-sharded data parallelism.
+
+The reference's only answer to "parameters don't fit one worker" is the PS
+itself: ``replica_device_setter`` round-robins *variables* across ps tasks
+(reference tfdist_between.py:32-35), so each PS holds a slice of the model and
+every worker holds a full copy transiently per step. This module is the
+TPU-native generalization of that idea, done the modern way (ZeRO-3/FSDP):
+
+- parameters AND optimizer state are sharded across the ``data`` axis — each
+  chip *owns* a 1/N slice (the PS round-robin, flattened onto the chips);
+- the forward/backward all-gathers parameters just-in-time (the worker's
+  transient full copy, now an ICI collective XLA schedules and overlaps);
+- gradients are reduce-scattered so each chip updates only the slice it owns
+  (the PS apply, now a collective).
+
+All of it is expressed as GSPMD sharding annotations on one ordinary train
+step — no wrapper modules, no hooks, no manual gather/scatter code. XLA
+inserts and fuses the collectives.
+
+Composes with tensor parallelism: pass ``base`` specs (e.g.
+``MLP.partition_specs()``) and each parameter's remaining unsharded dims are
+ZeRO-sharded over ``data`` on top of the TP layout.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.ops import losses as losses_lib
+from distributed_tensorflow_tpu.parallel.strategy import (
+    Strategy,
+    TrainState,
+    _loss_from_model,
+)
+
+
+def fsdp_specs(
+    params: Any,
+    mesh: Mesh,
+    *,
+    axis: str = "data",
+    base: Any = None,
+) -> Any:
+    """Per-parameter ``PartitionSpec``s sharding each tensor's largest
+    divisible dim over ``axis``.
+
+    Dims already taken by ``base`` (a pytree of specs, e.g. a TP layout) are
+    preserved; the largest remaining dim divisible by the axis size gets
+    ``axis``; tensors with no divisible free dim stay as ``base`` says
+    (replicated over ``axis``) — small biases aren't worth a gather.
+    """
+    n = mesh.shape[axis]
+
+    def spec_for(leaf, base_spec):
+        entries = list(base_spec) if base_spec is not None else []
+        entries += [None] * (leaf.ndim - len(entries))
+        best = None
+        for d in range(leaf.ndim):
+            if entries[d] is None and leaf.shape[d] % n == 0 and leaf.shape[d] >= n:
+                if best is None or leaf.shape[d] > leaf.shape[best]:
+                    best = d
+        if best is not None:
+            entries[best] = axis
+        while entries and entries[-1] is None:
+            entries.pop()
+        return P(*entries)
+
+    if base is None:
+        return jax.tree.map(lambda leaf: spec_for(leaf, None), params)
+    return jax.tree.map(spec_for, params, base)
+
+
+class ShardedDataParallel(Strategy):
+    """Sync DP with ZeRO-3 parameter/optimizer-state sharding (see module
+    docstring). Update semantics are identical to :class:`SyncDataParallel` —
+    same batches produce the same parameters — only the memory layout and
+    collective pattern differ (all-gather fwd/bwd + reduce-scatter grads
+    instead of replicated params + all-reduce)."""
+
+    def __init__(self, mesh: Mesh, *, axis: str = "data", param_specs=None):
+        """``param_specs``: optional TP base layout (e.g.
+        ``MLP.partition_specs()``) that ZeRO sharding is layered onto."""
+        self.mesh = mesh
+        self.axis = axis
+        self._base = param_specs
+        self._repl = NamedSharding(mesh, P())
+        self._batch = NamedSharding(mesh, P(axis))
+        self._specs = None  # resolved against params in init_state
+
+    @property
+    def num_replicas(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    def _shardings(self, params):
+        if self._specs is None:
+            self._specs = fsdp_specs(
+                params, self.mesh, axis=self.axis, base=self._base
+            )
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s), self._specs)
+
+    def _state_shardings(self, model, optimizer) -> TrainState:
+        """Shardings for the full TrainState: params per ``fsdp_specs``, each
+        optimizer slot sharded like the parameter it tracks (ZeRO-1), scalars
+        replicated. Slots are matched to their param by tree-path suffix —
+        optax slot subtrees (momentum/adam moments) mirror the param pytree,
+        so a slot leaf's path ends with its param's path; shape-only matching
+        would mislayout same-shaped params with different specs."""
+        from jax.tree_util import tree_flatten_with_path
+
+        params_shape = jax.eval_shape(model.init, 0)
+        shardings = self._shardings(params_shape)
+        param_items = [
+            (tuple(path), leaf.shape, sh)
+            for (path, leaf), sh in zip(
+                tree_flatten_with_path(params_shape)[0], jax.tree.leaves(shardings)
+            )
+        ]
+
+        def slot_sharding(path, leaf):
+            for ppath, pshape, sh in param_items:
+                if leaf.shape == pshape and tuple(path[-len(ppath):]) == ppath:
+                    return sh
+            return self._repl
+
+        opt_shape = jax.eval_shape(optimizer.init, params_shape)
+        leaves, treedef = tree_flatten_with_path(opt_shape)
+        opt_shardings = jax.tree.unflatten(
+            treedef, [slot_sharding(path, leaf) for path, leaf in leaves]
+        )
+        return TrainState(shardings, opt_shardings, self._repl)
+
+    def init_state(self, model, optimizer, seed: int) -> TrainState:
+        out = self._state_shardings(model, optimizer)
+
+        @partial(jax.jit, out_shardings=out)
+        def _init():
+            params = model.init(seed)
+            return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+
+        return _init()
+
+    def make_train_step(self, model, loss_fn, optimizer):
+        shardings = self._shardings(jax.eval_shape(model.init, 0))
+        state_out = self._state_shardings(model, optimizer)
+
+        @partial(jax.jit, donate_argnums=0, out_shardings=(state_out, None))
+        def step(state: TrainState, x, y):
+            x = jax.lax.with_sharding_constraint(x, self._batch)
+            y = jax.lax.with_sharding_constraint(y, self._batch)
+            cost, grads = jax.value_and_grad(
+                partial(_loss_from_model, model, loss_fn)
+            )(state.params, x, y)
+            # Pin gradients to the owner layout: the batch-sum over 'data'
+            # becomes a reduce-scatter, and the update math below is local to
+            # each chip's slice.
+            grads = jax.lax.with_sharding_constraint(grads, shardings)
+            updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            params = jax.lax.with_sharding_constraint(params, shardings)
+            return TrainState(params, opt_state, state.step + 1), cost
+
+        return step
+
+    def make_eval_fn(self, model):
+        @jax.jit
+        def evaluate(state: TrainState, x, y):
+            return losses_lib.accuracy(model.apply(state.params, x), y)
+
+        return evaluate
+
+    def prepare_batch(self, x, y):
+        return (
+            jax.device_put(jnp.asarray(x), self._batch),
+            jax.device_put(jnp.asarray(y), self._batch),
+        )
+
+    # Scanned-epoch support: batch dim of each scan slice sharded over 'data'.
+    @property
+    def stage_sharding(self):
+        return NamedSharding(self.mesh, P(None, self.axis))
+
+    def make_scanned_train_fn(self, model, loss_fn, optimizer):
+        from distributed_tensorflow_tpu.train.scan import make_scanned_train_fn
+
+        return make_scanned_train_fn(
+            model, loss_fn, optimizer, batch_sharding=self._batch
+        )

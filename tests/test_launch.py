@@ -51,3 +51,25 @@ def test_checkpoint_dir_wires_supervisor(tmp_path, small_datasets):
     )
     assert tr2.start_step == 80
     assert int(tr2.state.step) == 80
+
+
+def test_dp_mode_zero_selects_fsdp():
+    from distributed_tensorflow_tpu.parallel import ShardedDataParallel
+
+    strat = build_strategy(TrainConfig(dp_mode="zero"))
+    assert isinstance(strat, ShardedDataParallel)
+    import pytest
+
+    with pytest.raises(ValueError, match="dp_mode"):
+        build_strategy(TrainConfig(dp_mode="bogus"))
+
+
+def test_trainer_runs_with_zero_dp(small_datasets):
+    tr = build_trainer(
+        TrainConfig(dp_mode="zero", epochs=1, logs_path=""),
+        datasets=small_datasets,
+        print_fn=lambda *a: None,
+    )
+    metrics = tr.run(epochs=1)
+    assert 0.0 <= metrics["accuracy"] <= 1.0
+    assert metrics["final_cost"] > 0
