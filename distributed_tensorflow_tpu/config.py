@@ -82,6 +82,14 @@ class TrainConfig:
     # TPU-first knobs (no reference analog)
     compute_dtype: str = "bfloat16"  # MXU-friendly activations dtype
     param_dtype: str = "float32"
+    # Optimizer surface (ops/optim.py). Defaults reproduce the reference's
+    # constant-lr SGD exactly; everything else is framework surface.
+    optimizer: str = "sgd"  # sgd | momentum | adam | adamw
+    lr_schedule: str | None = None  # None/constant | cosine | linear | exponential
+    warmup_steps: int = 0  # linear lr ramp before the schedule
+    # Average grads over N micro-steps, apply once. Note: global_step counts
+    # micro-steps (one per train_step call), not applies, when N > 1.
+    accumulate_steps: int = 1
     # "naive" = reference parity (CE over softmax probabilities, NaN-guarded,
     # reference tfsingle.py:44-45); "stable" = logits-based log-softmax CE.
     loss: str = "naive"

@@ -109,7 +109,27 @@ def build_trainer(
     model = model or MLP(compute_dtype=jnp.dtype(config.compute_dtype))
     datasets = datasets or read_data_sets(data_dir, one_hot=True)
     strategy = strategy or build_strategy(config)
-    optimizer = optimizer or optim_lib.sgd(config.learning_rate)
+    if optimizer is None:
+        # The schedule count advances once per optimizer *apply*: trainer
+        # epochs run num_examples // (batch_size × replicas) steps (global
+        # batches; trainer.py), or // batch_size under per_worker_epoch, and
+        # accumulation applies once every accumulate_steps micro-steps.
+        denom = config.batch_size * (
+            1 if config.per_worker_epoch else strategy.num_replicas
+        )
+        applies_per_epoch = max(1, datasets.train.num_examples // denom)
+        total_applies = max(
+            1, config.epochs * applies_per_epoch // config.accumulate_steps
+        )
+        lr = optim_lib.schedule(
+            config.lr_schedule,
+            config.learning_rate,
+            total_applies,
+            warmup_steps=config.warmup_steps,
+        )
+        optimizer = optim_lib.accumulate(
+            optim_lib.make(config.optimizer, lr), config.accumulate_steps
+        )
     if loss_fn is None:
         from distributed_tensorflow_tpu.ops import losses as losses_lib
 

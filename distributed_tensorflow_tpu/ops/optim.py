@@ -26,11 +26,17 @@ def sgd(learning_rate: float = 0.001) -> optax.GradientTransformation:
     return optax.sgd(learning_rate)
 
 
-def make(name: str, learning_rate: float, **kw) -> optax.GradientTransformation:
-    """Small registry so the trainer is not MLP/SGD-specific."""
+def make(name: str, learning_rate, **kw) -> optax.GradientTransformation:
+    """Small registry so the trainer is not MLP/SGD-specific.
+
+    ``learning_rate`` may be a float or an optax schedule (see
+    :func:`schedule`) — every optimizer here accepts either.
+    """
     registry = {
         "sgd": lambda: optax.sgd(learning_rate, **kw),
-        "momentum": lambda: optax.sgd(learning_rate, momentum=kw.pop("momentum", 0.9)),
+        "momentum": lambda: optax.sgd(
+            learning_rate, momentum=kw.pop("momentum", 0.9), **kw
+        ),
         "adam": lambda: optax.adam(learning_rate, **kw),
         "adamw": lambda: optax.adamw(learning_rate, **kw),
     }
@@ -38,3 +44,62 @@ def make(name: str, learning_rate: float, **kw) -> optax.GradientTransformation:
         return registry[name]()
     except KeyError:
         raise ValueError(f"unknown optimizer {name!r}; have {sorted(registry)}")
+
+
+def schedule(
+    name: str | None,
+    learning_rate: float,
+    total_steps: int,
+    *,
+    warmup_steps: int = 0,
+):
+    """Learning-rate schedule factory (no reference analog — the reference's
+    lr is the constant 0.001 for all 55k steps; this is framework surface).
+
+    ``None``/"constant" returns the float unchanged so the reference-parity
+    path is bitwise-identical. Schedules are pure functions of the on-device
+    step count, so they compile into the train step (and into the scanned
+    epoch) with no host involvement.
+
+    ``total_steps`` must be counted in optimizer *applies* — under gradient
+    accumulation (:func:`accumulate`) the inner schedule count advances once
+    per apply, not per micro-step (the launcher does this conversion).
+    """
+    # join_schedules offsets the post-warmup schedule by the boundary, so the
+    # decay horizon is what remains after the ramp.
+    decay_steps = max(1, total_steps - warmup_steps)
+    if name in (None, "constant"):
+        base = learning_rate
+    elif name == "cosine":
+        base = optax.cosine_decay_schedule(learning_rate, decay_steps)
+    elif name == "linear":
+        base = optax.linear_schedule(learning_rate, 0.0, decay_steps)
+    elif name == "exponential":
+        # Decay to 1% of the peak by the horizon, stepwise-continuous.
+        base = optax.exponential_decay(learning_rate, decay_steps, decay_rate=0.01)
+    else:
+        raise ValueError(
+            f"unknown lr schedule {name!r}; use constant/cosine/linear/exponential"
+        )
+    if warmup_steps > 0:
+        peak = base if callable(base) else (lambda _: learning_rate)
+        ramp = optax.linear_schedule(0.0, learning_rate, warmup_steps)
+        return optax.join_schedules([ramp, peak], boundaries=[warmup_steps])
+    return base
+
+
+def accumulate(
+    optimizer: optax.GradientTransformation, every: int
+) -> optax.GradientTransformation:
+    """Gradient accumulation: average gradients over ``every`` consecutive
+    micro-steps, apply once (no reference analog — the reference's only lever
+    on effective batch size was adding sync replicas,
+    tfdist_between_sync.py:66-68; this is the in-chip equivalent).
+
+    The running mean makes ``every`` microbatches of size B exactly
+    equivalent to one step on a batch of size ``every``×B for mean-reduced
+    losses. Entirely on-device state — composes with jit/scan/sharding.
+    """
+    if every <= 1:
+        return optimizer
+    return optax.MultiSteps(optimizer, every_k_schedule=every)

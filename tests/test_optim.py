@@ -1,0 +1,125 @@
+"""Optimizer surface (ops/optim.py): registry, schedules, accumulation.
+
+The reference's optimizer story is one line — constant-lr SGD
+(tfdist_between.py:64-66). These tests pin the framework surface built
+around it: the registry, lr schedules (compiled-in functions of the
+on-device step), and gradient accumulation (micro-batch equivalence).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.config import TrainConfig
+from distributed_tensorflow_tpu.launch import build_trainer
+from distributed_tensorflow_tpu.models import MLP
+from distributed_tensorflow_tpu.ops import cross_entropy
+from distributed_tensorflow_tpu.ops.optim import accumulate, make, schedule
+from distributed_tensorflow_tpu.parallel import SingleDevice
+
+
+def test_registry_rejects_unknown():
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        make("rmsprop", 0.1)
+
+
+def test_constant_schedule_is_the_float():
+    assert schedule(None, 0.5, 100) == 0.5
+    assert schedule("constant", 0.5, 100) == 0.5
+
+
+def test_cosine_and_linear_decay_to_zero():
+    for name in ("cosine", "linear"):
+        s = schedule(name, 0.1, 1000)
+        assert float(s(0)) == pytest.approx(0.1)
+        assert float(s(1000)) == pytest.approx(0.0, abs=1e-6)
+        assert float(s(500)) < 0.1
+
+
+def test_warmup_ramps_then_decays():
+    s = schedule("cosine", 0.1, 1000, warmup_steps=100)
+    assert float(s(0)) == pytest.approx(0.0)
+    assert float(s(50)) == pytest.approx(0.05)
+    peak = float(s(100))
+    assert peak == pytest.approx(0.1, rel=1e-3)
+    assert float(s(600)) < peak
+
+
+def test_unknown_schedule_raises():
+    with pytest.raises(ValueError, match="unknown lr schedule"):
+        schedule("step", 0.1, 100)
+
+
+def test_accumulation_matches_large_batch():
+    """k microbatches with accumulate(opt, k) == one step on the k×-batch."""
+    model = MLP(hidden_dim=32, compute_dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    x = rng.random((64, 784), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 64)]
+    k = 4
+
+    def loss(params, x, y):
+        return cross_entropy(model.apply(params, x), y)
+
+    # Accumulated path: k microbatches of 16.
+    opt = accumulate(make("sgd", 0.05), k)
+    params = model.init(seed=1)
+    opt_state = opt.init(params)
+    for i in range(k):
+        sl = slice(16 * i, 16 * (i + 1))
+        grads = jax.grad(loss)(params, x[sl], y[sl])
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+
+    # Large-batch path: one step on all 64.
+    ref = model.init(seed=1)
+    grads = jax.grad(loss)(ref, x, y)
+    ref = jax.tree.map(lambda p, g: p - 0.05 * g, ref, grads)
+
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7)
+
+
+def test_accumulate_one_is_identity():
+    opt = make("sgd", 0.1)
+    assert accumulate(opt, 1) is opt
+
+
+def test_trainer_with_adam_cosine_descends(small_datasets):
+    tr = build_trainer(
+        TrainConfig(
+            optimizer="adam",
+            lr_schedule="cosine",
+            warmup_steps=10,
+            epochs=1,
+            logs_path="",
+        ),
+        datasets=small_datasets,
+        strategy=SingleDevice(),
+        print_fn=lambda *a: None,
+    )
+    metrics = tr.run(epochs=1)
+    assert np.isfinite(metrics["final_cost"])
+    # Adam at lr=0.001 moves much faster than the reference's SGD: after one
+    # epoch the naive-CE cost should be well below its ~9-10 starting range.
+    assert metrics["final_cost"] < 5.0
+
+
+def test_trainer_accumulation_runs(small_datasets):
+    tr = build_trainer(
+        TrainConfig(accumulate_steps=4, epochs=1, logs_path=""),
+        datasets=small_datasets,
+        strategy=SingleDevice(),
+        print_fn=lambda *a: None,
+    )
+    metrics = tr.run(epochs=1)
+    assert np.isfinite(metrics["final_cost"])
+
+
+def test_warmup_decay_completes_by_total_steps():
+    """The decay horizon is total_steps - warmup_steps: the schedule reaches
+    its floor at the end of training, not warmup_steps past it."""
+    for name, floor in (("linear", 0.0), ("cosine", 0.0)):
+        s = schedule(name, 0.1, 1000, warmup_steps=500)
+        assert float(s(1000)) == pytest.approx(floor, abs=1e-6)
