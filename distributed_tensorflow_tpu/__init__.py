@@ -37,6 +37,9 @@ from distributed_tensorflow_tpu.config import ClusterConfig, TrainConfig  # noqa
 
 _LAZY_EXPORTS = {
     "MLP": ("distributed_tensorflow_tpu.models", "MLP"),
+    "CNN": ("distributed_tensorflow_tpu.models", "CNN"),
+    "build_model": ("distributed_tensorflow_tpu.models", "build_model"),
+    "Predictor": ("distributed_tensorflow_tpu.inference", "Predictor"),
     "read_data_sets": ("distributed_tensorflow_tpu.data", "read_data_sets"),
     "make_mesh": ("distributed_tensorflow_tpu.parallel", "make_mesh"),
     "SingleDevice": ("distributed_tensorflow_tpu.parallel", "SingleDevice"),

@@ -107,6 +107,10 @@ class TrainConfig:
     # the returned per-step costs after the dispatch. Supported by the
     # single-device and sync-DP (GSPMD) strategies.
     scan_epoch: bool = False
+    # Keep N device-placed batches in flight in the eager per-batch loop
+    # (data/prefetch.py): batch i+1's host→device transfer overlaps step i's
+    # compute. 0 disables (reference-parity synchronous feed).
+    prefetch: int = 0
     profile_dir: str | None = None  # capture a jax.profiler trace of epoch 0
     # Print each parameter's sharding at startup — the TPU analog of the
     # reference's log_device_placement=True (C4, tfdist_between.py:15).

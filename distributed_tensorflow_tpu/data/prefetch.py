@@ -1,0 +1,49 @@
+"""Host→device batch prefetching (double buffering).
+
+The reference's hot loop fed each batch synchronously: ``sess.run(...,
+feed_dict={x: batch_xs, ...})`` blocks on the host→device copy before the
+step can start (reference tfdist_between.py:91-94) — the README's measured
+gRPC/feed overhead is exactly this boundary (reference README.md:38-40). On
+TPU the same hazard is the PCIe/host transfer of the next batch.
+
+``jax.device_put`` is asynchronous: it returns a placeholder array while the
+transfer proceeds in the background. Prefetching therefore needs no threads —
+keeping ``depth`` batches in flight means batch ``i+1``'s transfer overlaps
+step ``i``'s compute, and the dispatch-ahead queue never stalls on the host.
+
+(The ``scan_epoch`` path stages the whole epoch in device memory up front and
+doesn't need this; prefetching serves the eager per-batch loop — the mode
+whose loop contract matches the reference's — and any strategy, since
+placement is delegated to ``strategy.prepare_batch``.)
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Iterator
+
+
+def prefetch_batches(
+    next_batch: Callable[[int], tuple],
+    batch_size: int,
+    steps: int,
+    place: Callable[..., tuple],
+    depth: int = 2,
+) -> Iterator[tuple]:
+    """Yield ``steps`` device-placed batches with ``depth`` in flight.
+
+    ``next_batch(batch_size)`` produces host arrays (the tutorial iterator's
+    API, reference tfdist_between.py:91); ``place(*batch)`` device-places one
+    batch with the strategy's sharding (async). Batch order is identical to
+    the unprefetched loop — only the placement timing changes.
+    """
+    if depth < 1:
+        raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+    queue: deque[tuple] = deque()
+    for _ in range(min(depth, steps)):
+        queue.append(place(*next_batch(batch_size)))
+    for i in range(steps):
+        batch = queue.popleft()
+        if i + depth < steps:
+            queue.append(place(*next_batch(batch_size)))
+        yield batch

@@ -1,0 +1,107 @@
+"""Inference surface: compiled batched prediction from trained parameters.
+
+The reference had no inference path beyond the in-loop eval fetch — the same
+``sess.run(accuracy, feed_dict=test_set)`` graph used during training
+(reference tfsingle.py:94, tfdist_between.py:108). This module is the
+framework's serving-shaped answer: take parameters (from a live training
+state or a checkpoint), compile the forward pass ONCE at a fixed batch shape,
+and stream arbitrary-sized inputs through it.
+
+TPU-first details:
+
+- **Static shapes**: XLA compiles per input shape. Arbitrary request sizes
+  are chunked to a fixed ``batch_size`` and the tail chunk zero-padded, so
+  every dispatch hits the same compiled executable — no recompiles, no
+  dynamic-shape fallbacks.
+- **Effective params**: under async DP the training state holds per-chip
+  parameter copies; ``Strategy.effective_params`` collapses them (mean) the
+  way the reference's eval read "the" parameters off the PS.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.ops import losses as losses_lib
+
+
+class Predictor:
+    """Fixed-shape compiled prediction over a trained parameter set."""
+
+    def __init__(self, model, params, *, batch_size: int = 1024):
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        self.model = model
+        self.params = params
+        self.batch_size = batch_size
+        self._fn = jax.jit(model.apply)
+
+    # -- constructors ------------------------------------------------------
+
+    @classmethod
+    def from_state(cls, model, state, *, strategy=None, **kw) -> "Predictor":
+        """Build from a live training state. Pass the training ``strategy``
+        so async states collapse their per-chip copies correctly."""
+        params = strategy.effective_params(state) if strategy is not None else state.params
+        return cls(model, params, **kw)
+
+    @classmethod
+    def from_checkpoint(
+        cls, model, checkpoint_dir: str, *, optimizer=None, seed: int = 1, **kw
+    ) -> "Predictor":
+        """Restore the latest checkpoint in ``checkpoint_dir`` (written by
+        train/supervisor.py) and serve its parameters.
+
+        ``optimizer`` must match the one used in training (the checkpoint
+        holds its slots too); defaults to the reference's SGD, whose slot
+        state is empty.
+        """
+        from distributed_tensorflow_tpu.ops import optim as optim_lib
+        from distributed_tensorflow_tpu.parallel.strategy import TrainState
+        from distributed_tensorflow_tpu.train.supervisor import (
+            Supervisor,
+            latest_checkpoint_step,
+        )
+
+        # Probe before constructing a Supervisor: a read path must not mkdir
+        # a typo'd checkpoint_dir as a side effect.
+        if latest_checkpoint_step(checkpoint_dir) is None:
+            raise FileNotFoundError(f"no checkpoint under {checkpoint_dir}")
+        optimizer = optimizer or optim_lib.sgd(0.001)
+        params = model.init(seed)
+        fresh = TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+        state, _ = Supervisor(checkpoint_dir=checkpoint_dir).prepare_or_restore(fresh)
+        return cls(model, state.params, **kw)
+
+    # -- prediction --------------------------------------------------------
+
+    def predict_proba(self, images) -> np.ndarray:
+        """[N, ...] host array → [N, num_classes] float32 probabilities.
+        Chunked to ``batch_size`` with a zero-padded tail — one compiled
+        shape regardless of N."""
+        images = np.asarray(images, dtype=np.float32)
+        n = images.shape[0]
+        if n == 0:
+            raise ValueError("predict_proba called with an empty batch")
+        bs = self.batch_size
+        out = []
+        for lo in range(0, n, bs):
+            chunk = images[lo : lo + bs]
+            pad = bs - chunk.shape[0]
+            if pad:
+                chunk = np.concatenate([chunk, np.zeros((pad,) + chunk.shape[1:], chunk.dtype)])
+            probs = self._fn(self.params, jnp.asarray(chunk))
+            out.append(np.asarray(probs[: bs - pad] if pad else probs))
+        return np.concatenate(out)
+
+    def predict(self, images) -> np.ndarray:
+        """[N, ...] → [N] int64 predicted class ids."""
+        return self.predict_proba(images).argmax(axis=-1)
+
+    def accuracy(self, images, labels_one_hot) -> float:
+        """Full-split accuracy, matching the trainer's eval metric."""
+        probs = self.predict_proba(images)
+        return float(losses_lib.accuracy(jnp.asarray(probs), jnp.asarray(labels_one_hot)))

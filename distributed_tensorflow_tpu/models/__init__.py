@@ -1,5 +1,32 @@
+"""Model families. Each satisfies the init/apply protocol (models/base.py),
+so any of them drops into the strategies and Trainer unchanged.
+
+The registry gives launchers and configs a stable string surface for model
+selection — the role the reference filled by picking which script to run
+(tfsingle.py vs tfdist_between.py all hardcode the same MLP graph,
+reference tfsingle.py:23-42).
+"""
+
+from distributed_tensorflow_tpu.models.cnn import CNN, CNNParams  # noqa: F401
 from distributed_tensorflow_tpu.models.mlp import MLP, MLPParams  # noqa: F401
 from distributed_tensorflow_tpu.models.transformer import (  # noqa: F401
     TransformerClassifier,
     TransformerParams,
 )
+
+MODEL_REGISTRY = {
+    "mlp": MLP,
+    "cnn": CNN,
+    "transformer": TransformerClassifier,
+}
+
+
+def build_model(name: str, **kwargs):
+    """Construct a registered model family by name."""
+    try:
+        cls = MODEL_REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown model {name!r}; registered: {sorted(MODEL_REGISTRY)}"
+        ) from None
+    return cls(**kwargs)

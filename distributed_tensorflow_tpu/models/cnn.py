@@ -1,0 +1,175 @@
+"""Convolutional model family: a LeNet-style MNIST ConvNet, TPU-first.
+
+The reference ships exactly one model — the 784→100→10 MLP repeated in each
+script (reference tfsingle.py:23-42) — but it is an *MNIST training suite*,
+and a convolutional classifier is the canonical next model for that workload.
+This family exists to prove the framework's model protocol (models/base.py)
+generalizes beyond the parity MLP: the CNN drops into the unchanged Trainer,
+strategies, and data pipeline because it consumes the same flattened
+``[B, 784]`` batches the reference's ``feed_dict`` carried
+(reference tfdist_between.py:92-94) and produces the same float32
+class-probability output the reference's softmax graph did.
+
+TPU mapping:
+
+- Convolutions lower to the MXU: ``lax.conv_general_dilated`` with bfloat16
+  operands and float32 accumulation (``preferred_element_type``) — XLA tiles
+  NHWC convs onto the systolic array the same way it tiles matmuls.
+- Pooling is ``lax.reduce_window`` (VPU), fused by XLA into the surrounding
+  elementwise work.
+- The head is the familiar Megatron-style pair of dense layers; the softmax
+  runs in float32 so the reference's naive ``log(softmax)`` loss
+  (ops/losses.py) stays finite.
+
+Init is fan-in-scaled (He) normal rather than the reference MLP's N(0, 1):
+this family has no reference graph to mirror, so it uses the init a
+practitioner would — deterministic from an integer seed like every model
+here (the property supervisor-free chief init relies on, models/base.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class CNNParams(NamedTuple):
+    """Parameter pytree. Conv kernels are HWIO, dense kernels [in, out]."""
+
+    conv1_w: jax.Array  # [k, k, 1, c1]
+    conv1_b: jax.Array  # [c1]
+    conv2_w: jax.Array  # [k, k, c1, c2]
+    conv2_b: jax.Array  # [c2]
+    fc1_w: jax.Array  # [(H/4)*(W/4)*c2, hidden]
+    fc1_b: jax.Array  # [hidden]
+    fc2_w: jax.Array  # [hidden, out]
+    fc2_b: jax.Array  # [out]
+
+
+class CNN:
+    """conv→relu→pool ×2 → dense→relu → dense → softmax, on [B, H*W] input."""
+
+    def __init__(
+        self,
+        image_size: int = 28,
+        in_channels: int = 1,
+        channels: Sequence[int] = (32, 64),
+        kernel: int = 5,
+        hidden_dim: int = 256,
+        out_dim: int = 10,
+        compute_dtype: jnp.dtype = jnp.bfloat16,
+    ):
+        if image_size % 4 != 0:
+            raise ValueError(f"image_size {image_size} must be divisible by 4 (two 2x2 pools)")
+        if len(channels) != 2:
+            raise ValueError(f"channels must be (c1, c2), got {tuple(channels)}")
+        self.image_size = image_size
+        self.in_channels = in_channels
+        self.c1, self.c2 = channels
+        self.kernel = kernel
+        self.hidden_dim = hidden_dim
+        self.out_dim = out_dim
+        self.compute_dtype = compute_dtype
+        self.flat_dim = (image_size // 4) * (image_size // 4) * self.c2
+
+    # -- init --------------------------------------------------------------
+
+    def init(self, seed: int = 1) -> CNNParams:
+        """He-normal weights (stddev sqrt(2/fan_in)), zero biases; fully
+        deterministic from ``seed``."""
+        k = self.kernel
+        keys = jax.random.split(jax.random.key(seed), 4)
+
+        def he(key, shape, fan_in):
+            return jax.random.normal(key, shape, jnp.float32) * jnp.sqrt(2.0 / fan_in)
+
+        return CNNParams(
+            conv1_w=he(keys[0], (k, k, self.in_channels, self.c1), k * k * self.in_channels),
+            conv1_b=jnp.zeros((self.c1,), jnp.float32),
+            conv2_w=he(keys[1], (k, k, self.c1, self.c2), k * k * self.c1),
+            conv2_b=jnp.zeros((self.c2,), jnp.float32),
+            fc1_w=he(keys[2], (self.flat_dim, self.hidden_dim), self.flat_dim),
+            fc1_b=jnp.zeros((self.hidden_dim,), jnp.float32),
+            fc2_w=he(keys[3], (self.hidden_dim, self.out_dim), self.hidden_dim),
+            fc2_b=jnp.zeros((self.out_dim,), jnp.float32),
+        )
+
+    # -- forward -----------------------------------------------------------
+
+    def _conv(self, x: jax.Array, w: jax.Array) -> jax.Array:
+        """SAME conv in ``compute_dtype`` (bf16 → MXU), result upcast to f32.
+
+        The conv's output dtype matches its operands rather than using
+        ``preferred_element_type=f32``: conv's transpose (backward) rule
+        re-invokes conv between the cotangent and an operand, and a
+        mixed-dtype pair (f32 cotangent × bf16 operand) is rejected —
+        matching dtypes keep fwd and bwd on the same MXU path. The MXU
+        accumulates in f32 internally either way; only the per-window
+        result is rounded to bf16 before the upcast."""
+        cd = self.compute_dtype
+        out = lax.conv_general_dilated(
+            x.astype(cd),
+            w.astype(cd),
+            window_strides=(1, 1),
+            padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        return out.astype(jnp.float32)
+
+    @staticmethod
+    def _max_pool(x: jax.Array) -> jax.Array:
+        return lax.reduce_window(
+            x, -jnp.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+        )
+
+    def apply_logits(self, params: CNNParams, x: jax.Array) -> jax.Array:
+        """Forward pass → pre-softmax logits, float32.
+
+        Accepts the data pipeline's flattened ``[B, H*W*C]`` batches (the
+        reference's feed shape) or already-shaped ``[B, H, W, C]``.
+        """
+        cd = self.compute_dtype
+        s = self.image_size
+        if x.ndim == 2:
+            x = x.reshape(x.shape[0], s, s, self.in_channels)
+        h = jax.nn.relu(self._conv(x, params.conv1_w) + params.conv1_b)
+        h = self._max_pool(h)
+        h = jax.nn.relu(self._conv(h, params.conv2_w) + params.conv2_b)
+        h = self._max_pool(h)
+        h = h.reshape(h.shape[0], self.flat_dim)
+        h = jnp.dot(h.astype(cd), params.fc1_w.astype(cd), preferred_element_type=jnp.float32)
+        h = jax.nn.relu(h + params.fc1_b)
+        logits = jnp.dot(h.astype(cd), params.fc2_w.astype(cd), preferred_element_type=jnp.float32)
+        return logits + params.fc2_b
+
+    def apply(self, params: CNNParams, x: jax.Array) -> jax.Array:
+        """Forward pass → class probabilities, float32 (same output contract
+        as models/mlp.py, so ops/losses.cross_entropy applies unchanged)."""
+        return jax.nn.softmax(self.apply_logits(params, x), axis=-1)
+
+    # -- parallelism -------------------------------------------------------
+
+    def partition_specs(self, model_axis: str = "model") -> CNNParams:
+        """Tensor-parallel layout over the mesh's ``model`` axis.
+
+        Two Megatron-style column→row pairs: conv1 sharded on output
+        channels / conv2 on input channels, and fc1 sharded on output
+        features / fc2 on input features. GSPMD inserts the one all-reduce
+        each row-parallel member needs; the relu/pool between the members
+        runs on local shards.
+        """
+        from jax.sharding import PartitionSpec as P
+
+        return CNNParams(
+            conv1_w=P(None, None, None, model_axis),
+            conv1_b=P(model_axis),
+            conv2_w=P(None, None, model_axis, None),
+            conv2_b=P(None),
+            fc1_w=P(None, model_axis),
+            fc1_b=P(model_axis),
+            fc2_w=P(model_axis, None),
+            fc2_b=P(None),
+        )

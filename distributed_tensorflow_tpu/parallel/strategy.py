@@ -83,6 +83,12 @@ class Strategy:
     def global_step(self, state: TrainState) -> int:
         return int(jnp.sum(state.step))
 
+    def effective_params(self, state: TrainState):
+        """The single parameter set this state denotes — what the reference
+        called "the parameters on the PS". Identity for sync strategies;
+        async overrides with the mean of the per-chip copies."""
+        return state.params
+
     def cost_scalar(self, cost: jax.Array) -> float:
         return float(jnp.mean(cost))
 
@@ -380,14 +386,16 @@ class AsyncDataParallel(Strategy):
 
         return exchange
 
+    def effective_params(self, state: TrainState):
+        return jax.tree.map(lambda a: a.mean(axis=0), state.params)
+
     def make_eval_fn(self, model):
         """Evaluates the mean of the per-chip copies — the closest analog of
         'the parameters on the PS' that every reference worker evaluated."""
 
         @partial(jax.jit, in_shardings=(self._stacked, self._repl, self._repl))
         def evaluate(state: TrainState, x, y):
-            params = jax.tree.map(lambda a: a.mean(axis=0), state.params)
-            return losses_lib.accuracy(model.apply(params, x), y)
+            return losses_lib.accuracy(model.apply(self.effective_params(state), x), y)
 
         return evaluate
 

@@ -34,6 +34,19 @@ except Exception:  # pragma: no cover
 _STEP_DIR = re.compile(r"^step_(\d+)$")
 
 
+def latest_checkpoint_step(checkpoint_dir: str | None) -> int | None:
+    """Newest ``step_N`` under ``checkpoint_dir``, or None. Read-only probe —
+    never creates the directory (unlike constructing a Supervisor)."""
+    if not checkpoint_dir or not os.path.isdir(checkpoint_dir):
+        return None
+    steps = [
+        int(m.group(1))
+        for d in os.listdir(checkpoint_dir)
+        if (m := _STEP_DIR.match(d))
+    ]
+    return max(steps) if steps else None
+
+
 class Supervisor:
     def __init__(self, *, is_chief: bool = True, checkpoint_dir: str | None = None):
         self.is_chief = is_chief
@@ -57,14 +70,7 @@ class Supervisor:
     # -- checkpoint/restore (upgrade over the reference's nothing) --------
 
     def latest_step(self) -> int | None:
-        if not self.checkpoint_dir or not os.path.isdir(self.checkpoint_dir):
-            return None
-        steps = [
-            int(m.group(1))
-            for d in os.listdir(self.checkpoint_dir)
-            if (m := _STEP_DIR.match(d))
-        ]
-        return max(steps) if steps else None
+        return latest_checkpoint_step(self.checkpoint_dir)
 
     def save(self, state: TrainState, step: int) -> None:
         """Chief-only checkpoint write (non-chiefs no-op, as with the

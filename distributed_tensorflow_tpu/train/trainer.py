@@ -128,9 +128,22 @@ class Trainer:
         summaries: list[tuple[int, jax.Array]] = []
         step_before = self.strategy.global_step(self.state)
         logger.reset_window()
-        for i in range(batch_count):
-            bx, by = train.next_batch(global_batch)
-            bx, by = self.strategy.prepare_batch(bx, by)
+        if cfg.prefetch:
+            from distributed_tensorflow_tpu.data.prefetch import prefetch_batches
+
+            batches = prefetch_batches(
+                train.next_batch,
+                global_batch,
+                batch_count,
+                self.strategy.prepare_batch,
+                depth=cfg.prefetch,
+            )
+        else:
+            batches = (
+                self.strategy.prepare_batch(*train.next_batch(global_batch))
+                for _ in range(batch_count)
+            )
+        for i, (bx, by) in enumerate(batches):
             self.state, cost = self.train_step(self.state, bx, by)
             self.last_cost = cost
             if self._exchange is not None and (i + 1) % self.strategy.avg_every == 0:

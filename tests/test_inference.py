@@ -1,0 +1,86 @@
+"""Predictor: chunked fixed-shape prediction, state/checkpoint constructors."""
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.inference import Predictor
+from distributed_tensorflow_tpu.models import MLP
+from distributed_tensorflow_tpu.ops import cross_entropy, sgd
+from distributed_tensorflow_tpu.parallel import AsyncDataParallel, SingleDevice, make_mesh
+
+
+@pytest.fixture(scope="module")
+def trained():
+    rng = np.random.default_rng(0)
+    x = rng.random((256, 784), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 256)]
+    model = MLP()
+    strat = SingleDevice()
+    opt = sgd(0.001)
+    state = strat.init_state(model, opt, seed=1)
+    step = strat.make_train_step(model, cross_entropy, opt)
+    for _ in range(3):
+        state, _ = step(state, *strat.prepare_batch(x, y))
+    return model, strat, state, x, y
+
+
+@pytest.mark.parametrize("n", [1, 63, 64, 65, 200])
+def test_chunked_matches_direct(trained, n):
+    model, strat, state, x, _ = trained
+    pred = Predictor.from_state(model, state, strategy=strat, batch_size=64)
+    direct = np.asarray(model.apply(state.params, x[:n]))
+    np.testing.assert_allclose(pred.predict_proba(x[:n]), direct, rtol=1e-5, atol=1e-7)
+    assert pred.predict(x[:n]).shape == (n,)
+
+
+def test_rejects_bad_batch_size(trained):
+    model, _, state, _, _ = trained
+    with pytest.raises(ValueError):
+        Predictor(model, state.params, batch_size=0)
+
+
+def test_accuracy_matches_eval_fn(trained):
+    model, strat, state, x, y = trained
+    pred = Predictor.from_state(model, state, strategy=strat, batch_size=100)
+    eval_acc = float(strat.make_eval_fn(model)(state, x, y))
+    np.testing.assert_allclose(pred.accuracy(x, y), eval_acc, atol=1e-6)
+
+
+def test_async_state_uses_mean_copies(trained):
+    model, _, _, x, y = trained
+    mesh = make_mesh((8, 1))
+    strat = AsyncDataParallel(mesh)
+    opt = sgd(0.001)
+    state = strat.init_state(model, opt, seed=1)
+    step = strat.make_train_step(model, cross_entropy, opt)
+    state, _ = step(state, *strat.prepare_batch(x[:64], y[:64]))
+    pred = Predictor.from_state(model, state, strategy=strat, batch_size=100)
+    eval_acc = float(strat.make_eval_fn(model)(state, x, y))
+    np.testing.assert_allclose(pred.accuracy(x, y), eval_acc, atol=1e-6)
+
+
+def test_from_checkpoint_roundtrip(trained, tmp_path):
+    model, strat, state, x, _ = trained
+    from distributed_tensorflow_tpu.train.supervisor import Supervisor
+
+    sup = Supervisor(checkpoint_dir=str(tmp_path / "ckpt"))
+    if sup.latest_step() is None:
+        sup.save(state, step=3)
+    pred = Predictor.from_checkpoint(model, str(tmp_path / "ckpt"), batch_size=64)
+    direct = np.asarray(model.apply(state.params, x))
+    np.testing.assert_allclose(pred.predict_proba(x), direct, rtol=1e-5, atol=1e-7)
+
+
+def test_from_checkpoint_missing_raises(tmp_path):
+    missing = tmp_path / "nope"
+    with pytest.raises(FileNotFoundError):
+        Predictor.from_checkpoint(MLP(), str(missing))
+    # The read path must not have mkdir'd the typo'd directory.
+    assert not missing.exists()
+
+
+def test_empty_batch_raises(trained):
+    model, strat, state, _, _ = trained
+    pred = Predictor.from_state(model, state, strategy=strat)
+    with pytest.raises(ValueError):
+        pred.predict_proba(np.zeros((0, 784), np.float32))

@@ -1,0 +1,69 @@
+"""Crash/resume equivalence: an interrupted-and-resumed run reaches the same
+state as an uninterrupted one.
+
+The reference's only recovery story was re-attaching to live PS state via
+``prepare_or_wait_for_session`` (reference tfdist_between.py:83) — kill the
+PS and everything is lost. Here checkpoints make recovery real; this test is
+the end-to-end proof that restore-or-init (train/supervisor.py) resumes the
+optimization trajectory, not just the parameters.
+"""
+
+import numpy as np
+
+from distributed_tensorflow_tpu.config import TrainConfig
+from distributed_tensorflow_tpu.data.mnist import DataSet, Datasets
+from distributed_tensorflow_tpu.models import MLP
+from distributed_tensorflow_tpu.train.trainer import Trainer
+
+
+def _datasets(small_datasets):
+    # Fresh seeded DataSets so every run sees the identical batch stream.
+    return Datasets(
+        train=DataSet(small_datasets.train.images, small_datasets.train.labels, seed=1),
+        validation=small_datasets.validation,
+        test=DataSet(small_datasets.test.images, small_datasets.test.labels, seed=2),
+    )
+
+
+def test_resume_matches_uninterrupted(small_datasets, tmp_path):
+    cfg = TrainConfig(epochs=4, log_frequency=10_000)
+
+    # Uninterrupted: 4 epochs straight.
+    t_full = Trainer(MLP(), _datasets(small_datasets), cfg, print_fn=lambda *a: None)
+    full = t_full.run()
+
+    # Interrupted: 2 epochs with checkpointing, then a brand-new Trainer
+    # (fresh process in real life) restores and finishes.
+    ckpt = str(tmp_path / "ckpt")
+    t_a = Trainer(
+        MLP(),
+        _datasets(small_datasets),
+        cfg.replace(checkpoint_dir=ckpt),
+        print_fn=lambda *a: None,
+    )
+    t_a.run(epochs=2)
+
+    t_b = Trainer(
+        MLP(),
+        _datasets(small_datasets),
+        cfg.replace(checkpoint_dir=ckpt),
+        print_fn=lambda *a: None,
+    )
+    steps_per_epoch = small_datasets.train.num_examples // cfg.batch_size
+    assert t_b.start_step == 2 * steps_per_epoch  # restored, not re-initialized
+
+    # Replay the batch stream to where the checkpoint left off (the data
+    # iterator is host state outside the checkpoint), then finish.
+    for _ in range(2 * steps_per_epoch):
+        t_b.datasets.train.next_batch(cfg.batch_size)
+    resumed = t_b.run(epochs=2)
+
+    assert resumed["global_step"] == full["global_step"]
+    np.testing.assert_allclose(resumed["final_cost"], full["final_cost"], rtol=1e-6)
+    np.testing.assert_allclose(resumed["accuracy"], full["accuracy"], rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(t_full.state.params.w1),
+        np.asarray(t_b.state.params.w1),
+        rtol=1e-6,
+        atol=1e-8,
+    )
