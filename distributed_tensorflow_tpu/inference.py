@@ -45,7 +45,18 @@ class Predictor:
     def from_state(cls, model, state, *, strategy=None, **kw) -> "Predictor":
         """Build from a live training state. Pass the training ``strategy``
         so async states collapse their per-chip copies correctly."""
-        params = strategy.effective_params(state) if strategy is not None else state.params
+        if strategy is not None:
+            params = strategy.effective_params(state)
+        else:
+            # Async states are detectable: their step counter is a per-chip
+            # vector (strategy.py AsyncDataParallel.init_state), and serving
+            # stacked per-chip params would silently yield garbage shapes.
+            if getattr(state.step, "ndim", 0):
+                raise ValueError(
+                    "state holds stacked per-chip parameter copies (async DP);"
+                    " pass strategy= so effective_params can collapse them"
+                )
+            params = state.params
         return cls(model, params, **kw)
 
     @classmethod
@@ -70,6 +81,16 @@ class Predictor:
         # a typo'd checkpoint_dir as a side effect.
         if latest_checkpoint_step(checkpoint_dir) is None:
             raise FileNotFoundError(f"no checkpoint under {checkpoint_dir}")
+        from distributed_tensorflow_tpu.train import supervisor as _sup
+
+        if not _sup._HAVE_ORBAX:
+            # Without orbax prepare_or_restore would hand back the fresh
+            # seed-init state; a checkpoint exists, so serving it silently
+            # untrained must be an error, not a fallback.
+            raise RuntimeError(
+                f"checkpoint found under {checkpoint_dir} but orbax is not"
+                " importable; cannot restore"
+            )
         optimizer = optimizer or optim_lib.sgd(0.001)
         params = model.init(seed)
         fresh = TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))

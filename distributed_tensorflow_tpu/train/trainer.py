@@ -99,6 +99,7 @@ class Trainer:
 
         self.last_cost: jax.Array | None = None
         self.history: list[dict] = []
+        self._graph_written = False
 
         if self.config.log_placement and self.is_chief:
             from distributed_tensorflow_tpu.utils import placement
@@ -209,11 +210,31 @@ class Trainer:
                     "cost", float(costs[i]), step_before + i + 1
                 )
 
+    def write_graph(self) -> None:
+        """Dump the train step's jaxpr as the TensorBoard graph — the
+        reference passed its TF graph to the FileWriter (reference
+        tfsingle.py:69, tfdist_between.py:83-84). Traced on a zeros batch so
+        the training data stream is not advanced."""
+        import numpy as np
+
+        train = self.datasets.train
+        global_batch = self.config.batch_size * self.strategy.num_replicas
+        bx, by = self.strategy.prepare_batch(
+            np.zeros((global_batch,) + train.images.shape[1:], np.float32),
+            np.zeros((global_batch,) + train.labels.shape[1:], np.float32),
+        )
+        self.summary_writer.add_graph(self.train_step, self.state, bx, by)
+
     # -- the loop ---------------------------------------------------------
 
     def run(self, epochs: int | None = None) -> dict:
         cfg = self.config
         epochs = cfg.epochs if epochs is None else epochs
+        if self.summary_writer is not None and self.is_chief and not self._graph_written:
+            # Once per trainer: TensorBoard expects at most one graph per run,
+            # and run() may be called repeatedly (resume, epoch-at-a-time).
+            self.write_graph()
+            self._graph_written = True
         logger = StepLogger(freq=cfg.log_frequency, print_fn=self.print_fn)
         accuracy = 0.0
         for epoch in range(epochs):

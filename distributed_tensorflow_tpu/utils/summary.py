@@ -8,7 +8,12 @@ hand-encoded ``Event``/``Summary`` protobuf messages. TensorBoard reads the
 resulting files natively.
 
 Only the pieces the reference uses are implemented: scalar values keyed by
-tag, plus the file-version header record.
+tag, the file-version header record, and — matching the reference's
+``FileWriter('./logs', graph=tf.get_default_graph())`` (reference
+tfsingle.py:69, tfdist_between.py:83-84) — a graph dump. There is no TF
+graph here, so the dumped graph is the *jaxpr* of the compiled train step,
+encoded as a ``GraphDef`` (one NodeDef per equation, sub-jaxprs nested via
+``/``-scoped names) that TensorBoard's Graphs tab renders natively.
 """
 
 from __future__ import annotations
@@ -94,6 +99,144 @@ def _encode_version_event(wall_time: float) -> bytes:
     return _field_double(1, wall_time) + _field_bytes(3, b"brain.Event:2")
 
 
+# ---------------------------------------------------------------------------
+# jaxpr → GraphDef (the reference's graph dump, C15).
+# ---------------------------------------------------------------------------
+
+_NAME_OK = set("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_.-/")
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if c in _NAME_OK else "_" for c in name) or "node"
+
+
+def _attr_s(key: str, value: str) -> bytes:
+    # map<string, AttrValue> entry { key = 1; AttrValue value = 2; }
+    # AttrValue { bytes s = 2; }
+    attr_value = _field_bytes(2, value.encode())
+    return _field_bytes(5, _field_bytes(1, key.encode()) + _field_bytes(2, attr_value))
+
+
+def _node_def(name: str, op: str, inputs: list[str], attrs: dict[str, str]) -> bytes:
+    # NodeDef { string name = 1; string op = 2; repeated string input = 3;
+    #           map<string, AttrValue> attr = 5; }
+    out = _field_bytes(1, name.encode()) + _field_bytes(2, op.encode())
+    for i in inputs:
+        out += _field_bytes(3, i.encode())
+    for k, v in attrs.items():
+        out += _attr_s(k, v)
+    return out
+
+
+def _aval_str(v) -> str:
+    aval = getattr(v, "aval", None)
+    if aval is None:
+        return ""
+    return "%s%s" % (getattr(aval, "dtype", "?"), list(getattr(aval, "shape", ())))
+
+
+class _GraphBuilder:
+    """Flattens a (possibly nested) jaxpr into GraphDef nodes.
+
+    Each equation becomes one node named ``<scope><primitive>_<k>``; eqn
+    params that are themselves jaxprs (pjit, scan, while, cond branches, ...)
+    are emitted under that node's name as a ``/`` scope, which TensorBoard
+    collapses into an expandable group. Sub-jaxpr inputs are wired to the
+    outer equation's input nodes positionally where lengths allow (scan
+    reorders consts/carries; edges inside a scope remain exact).
+    """
+
+    def __init__(self):
+        self.nodes: list[bytes] = []
+        self.env: dict = {}  # Var -> producing node name
+        self.counter = 0
+
+    def _fresh(self, scope: str, op: str) -> str:
+        self.counter += 1
+        return _sanitize("%s%s_%d" % (scope, op, self.counter))
+
+    def _input_name(self, v, scope: str) -> str:
+        from jax.extend import core as jex_core
+
+        if isinstance(v, jex_core.Literal):
+            name = self._fresh(scope, "Const")
+            self.nodes.append(
+                _node_def(name, "Const", [], {"value": str(v.val), "output": _aval_str(v)})
+            )
+            return name
+        if v not in self.env:
+            # Unbound within this scope (e.g. scan-reordered sub-jaxpr input).
+            name = self._fresh(scope, "capture")
+            self.nodes.append(_node_def(name, "Capture", [], {"output": _aval_str(v)}))
+            self.env[v] = name
+        return self.env[v]
+
+    def add_jaxpr(self, jaxpr, scope: str = "", input_names: list[str] | None = None):
+        from jax.extend import core as jex_core
+
+        if isinstance(jaxpr, jex_core.ClosedJaxpr):
+            jaxpr = jaxpr.jaxpr
+        for i, v in enumerate(jaxpr.invars):
+            if input_names is not None and i < len(input_names):
+                self.env[v] = input_names[i]
+            elif v not in self.env:
+                name = _sanitize("%sinput_%d" % (scope, i))
+                self.nodes.append(
+                    _node_def(name, "Placeholder", [], {"output": _aval_str(v)})
+                )
+                self.env[v] = name
+        for v in jaxpr.constvars:
+            if v not in self.env:
+                name = self._fresh(scope, "Const")
+                self.nodes.append(_node_def(name, "Const", [], {"output": _aval_str(v)}))
+                self.env[v] = name
+        for eqn in jaxpr.eqns:
+            op = eqn.primitive.name
+            inputs = [self._input_name(v, scope) for v in eqn.invars]
+            name = self._fresh(scope, op)
+            attrs = {}
+            if eqn.outvars:
+                attrs["output"] = _aval_str(eqn.outvars[0])
+            self.nodes.append(_node_def(name, op, inputs, attrs))
+            for v in eqn.outvars:
+                # DropVars are unique per site, so binding them is harmless.
+                self.env[v] = name
+            # Nest sub-jaxprs (pjit/scan/while/cond/custom_vjp ...) as a scope.
+            subs = []
+            for key, val in eqn.params.items():
+                if isinstance(val, (jex_core.Jaxpr, jex_core.ClosedJaxpr)):
+                    subs.append((key, val))
+                elif isinstance(val, (tuple, list)) and val and all(
+                    isinstance(x, (jex_core.Jaxpr, jex_core.ClosedJaxpr)) for x in val
+                ):
+                    subs.extend(("%s_%d" % (key, j), x) for j, x in enumerate(val))
+            for key, sub in subs:
+                sub_scope = "%s/%s/" % (name, key) if len(subs) > 1 else name + "/"
+                self.add_jaxpr(sub, scope=sub_scope, input_names=inputs)
+
+    def graph_def(self) -> bytes:
+        # GraphDef { repeated NodeDef node = 1; VersionDef versions = 4; }
+        # VersionDef { int32 producer = 1; }
+        out = b"".join(_field_bytes(1, n) for n in self.nodes)
+        out += _field_bytes(4, _field_varint(1, 22))
+        return out
+
+
+def graph_def_from_fn(fn, *example_args) -> bytes:
+    """Serialized GraphDef of ``jax.make_jaxpr(fn)(*example_args)``."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*example_args)
+    b = _GraphBuilder()
+    b.add_jaxpr(closed)
+    return b.graph_def()
+
+
+def _encode_graph_event(wall_time: float, graph_def: bytes) -> bytes:
+    # Event { double wall_time = 1; bytes graph_def = 4; }
+    return _field_double(1, wall_time) + _field_bytes(4, graph_def)
+
+
 class SummaryWriter:
     """Drop-in for the reference's ``FileWriter('./logs')`` scalar usage."""
 
@@ -127,6 +270,13 @@ class SummaryWriter:
     def add_scalars(self, scalars: dict[str, float], step: int) -> None:
         for tag, value in scalars.items():
             self.add_scalar(tag, value, step)
+
+    def add_graph(self, fn, *example_args) -> None:
+        """Dump ``fn``'s jaxpr as a TensorBoard graph (reference
+        tfsingle.py:69 passed the TF graph to the FileWriter)."""
+        self._write_record(
+            _encode_graph_event(time.time(), graph_def_from_fn(fn, *example_args))
+        )
 
     def flush(self) -> None:
         self._f.flush()

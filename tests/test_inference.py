@@ -84,3 +84,26 @@ def test_empty_batch_raises(trained):
     pred = Predictor.from_state(model, state, strategy=strat)
     with pytest.raises(ValueError):
         pred.predict_proba(np.zeros((0, 784), np.float32))
+
+
+def test_async_state_without_strategy_raises(trained):
+    """Stacked per-chip params must not be served as-is (review finding)."""
+    model, _, _, x, y = trained
+    mesh = make_mesh((8, 1))
+    strat = AsyncDataParallel(mesh)
+    state = strat.init_state(model, sgd(0.001), seed=1)
+    with pytest.raises(ValueError, match="per-chip"):
+        Predictor.from_state(model, state)
+
+
+def test_from_checkpoint_without_orbax_raises(trained, tmp_path, monkeypatch):
+    """A checkpoint that exists but cannot be restored must fail loudly,
+    not silently serve the fresh seed init (review finding)."""
+    from distributed_tensorflow_tpu.train import supervisor as sup
+
+    model, strat, state, _, _ = trained
+    s = sup.Supervisor(checkpoint_dir=str(tmp_path / "ckpt"))
+    s.save(state, 3)
+    monkeypatch.setattr(sup, "_HAVE_ORBAX", False)
+    with pytest.raises(RuntimeError, match="orbax"):
+        Predictor.from_checkpoint(model, str(tmp_path / "ckpt"))
