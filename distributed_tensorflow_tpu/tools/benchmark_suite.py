@@ -1,0 +1,193 @@
+"""Auto-generated benchmark grid — the reference's README-as-benchmark, as a tool.
+
+The reference's performance record is a hand-maintained Markdown table of 9
+topology experiments (single GPU, 1ps+1w, 1ps+2w async/sync, 2ps+2w, two-host
+runs — reference README.md:13-15,24-40,63-74,141-150,178-206,208-254; rows
+reproduced in SURVEY.md §6). Each row was produced by manually launching a
+topology, eyeballing the logs, and pasting numbers into the README.
+
+This tool replaces that workflow (SURVEY.md §7 item 7): it runs the same
+experiment grid against this framework's strategies on whatever devices are
+present and emits the table — Markdown for humans, JSON for machines. The
+topology column maps PS-era rows onto their mesh equivalents: worker count →
+``data``-axis size; the PS processes have no equivalent (deleted by design,
+SURVEY.md §2a).
+
+Usage::
+
+    # 8-virtual-device CPU mesh (the test topology):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m distributed_tensorflow_tpu.tools.benchmark_suite --epochs 3
+
+    # real chip(s): rows needing more devices than exist are skipped.
+    python -m distributed_tensorflow_tpu.tools.benchmark_suite --json grid.json
+
+Rows (vs. SURVEY.md §6 table):
+
+- ``single``      — SingleDevice, scanned epoch        (ref row 1: tfsingle.py)
+- ``sync-N``      — SyncDataParallel over N chips      (ref rows 5,7: *_sync.py)
+- ``async-N``     — AsyncDataParallel, avg_every=50    (ref rows 3,6,8: tfdist_between.py)
+- ``zero-N``      — ShardedDataParallel (ZeRO-3)       (no ref row; beyond-parity)
+- ``tp-2``        — sync DP × tensor parallel (model=2) (no ref row; beyond-parity)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+
+from distributed_tensorflow_tpu.config import TrainConfig
+from distributed_tensorflow_tpu.models import MLP
+from distributed_tensorflow_tpu.parallel.fsdp import ShardedDataParallel
+from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+from distributed_tensorflow_tpu.parallel.strategy import (
+    AsyncDataParallel,
+    SingleDevice,
+    SyncDataParallel,
+)
+from distributed_tensorflow_tpu.train import Trainer
+from distributed_tensorflow_tpu.utils.logging import StepLogger
+
+
+def _silent(*a, **k):
+    pass
+
+
+def _row_specs(n_devices: int):
+    """The grid, filtered to what the device count allows."""
+    rows = [("single", 1, "ref #1 tfsingle.py (~1.3 s/epoch, 0.72)")]
+    for n in (2, n_devices):
+        if n < 2 or n > n_devices:
+            continue
+        rows.append(("sync-%d" % n, n, "ref #5/#7 tfdist_between_sync.py (0.72)"))
+        rows.append(("async-%d" % n, n, "ref #3/#6/#8 tfdist_between.py (0.80)"))
+        rows.append(("zero-%d" % n, n, "beyond parity (ZeRO-3)"))
+    if n_devices >= 2:
+        rows.append(("tp-2", 2, "beyond parity (tensor parallel)"))
+    # Drop duplicate names when n_devices == 2.
+    seen, out = set(), []
+    for r in rows:
+        if r[0] not in seen:
+            seen.add(r[0])
+            out.append(r)
+    return out
+
+
+def _build(name: str, n: int, model):
+    if name == "single":
+        return SingleDevice(), True
+    kind = name.split("-")[0]
+    if kind == "tp":
+        mesh = make_mesh((1, 2))
+        return SyncDataParallel(mesh, param_specs=model.partition_specs()), True
+    mesh = make_mesh((n, 1))
+    if kind == "sync":
+        return SyncDataParallel(mesh), True
+    if kind == "async":
+        return AsyncDataParallel(mesh, avg_every=50), False  # no scanned path
+    if kind == "zero":
+        return ShardedDataParallel(mesh), False
+    raise ValueError(name)
+
+
+def run_suite(
+    epochs: int = 3,
+    batch_size: int = 100,
+    datasets=None,
+    rows: list[str] | None = None,
+    print_fn=print,
+) -> list[dict]:
+    if datasets is None:
+        from distributed_tensorflow_tpu.data import read_data_sets
+
+        datasets = read_data_sets("MNIST_data", one_hot=True)
+    n_devices = len(jax.devices())
+    results = []
+    for name, n, ref in _row_specs(n_devices):
+        if rows is not None and name not in rows:
+            continue
+        model = MLP()
+        strategy, can_scan = _build(name, n, model)
+        cfg = TrainConfig(epochs=epochs, batch_size=batch_size, scan_epoch=can_scan)
+        tr = Trainer(model, datasets, cfg, strategy=strategy, print_fn=_silent)
+        logger = StepLogger(freq=10**9, print_fn=_silent)
+        tr.run_epoch(0, logger)  # warmup: compile
+        times = []
+        for e in range(1, epochs + 1):
+            t0 = time.time()
+            tr.run_epoch(e, logger)
+            jax.block_until_ready(tr.state.params)
+            times.append(time.time() - t0)
+        times.sort()
+        s_per_epoch = times[len(times) // 2]
+        global_batch = batch_size * strategy.num_replicas
+        n_examples = (datasets.train.num_examples // global_batch) * global_batch
+        row = {
+            "row": name,
+            "devices": n,
+            "mode": "scan" if can_scan else "eager",
+            "epochs_timed": epochs,
+            "s_per_epoch": round(s_per_epoch, 4),
+            "examples_per_sec": round(n_examples / s_per_epoch, 1),
+            "final_accuracy": round(tr.evaluate(), 4),
+            "reference": ref,
+        }
+        results.append(row)
+        print_fn(f"{name}: {row['s_per_epoch']}s/epoch  {row['examples_per_sec']:.0f} ex/s")
+    return results
+
+
+def markdown_table(results: list[dict]) -> str:
+    hdr = (
+        "| Row | Devices | Mode | s/epoch | examples/sec | accuracy | Reference counterpart |\n"
+        "|---|---|---|---|---|---|---|"
+    )
+    lines = [hdr]
+    for r in results:
+        lines.append(
+            "| %s | %d | %s | %.3f | %.0f | %.4f | %s |"
+            % (
+                r["row"],
+                r["devices"],
+                r["mode"],
+                r["s_per_epoch"],
+                r["examples_per_sec"],
+                r["final_accuracy"],
+                r["reference"],
+            )
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--epochs", type=int, default=3, help="timed epochs per row")
+    p.add_argument("--batch_size", type=int, default=100)
+    p.add_argument("--rows", type=str, default=None, help="comma-separated row filter")
+    p.add_argument("--json", type=str, default=None, help="write JSON results here")
+    p.add_argument("--markdown", type=str, default=None, help="write the table here")
+    args = p.parse_args(argv)
+    rows = args.rows.split(",") if args.rows else None
+    results = run_suite(
+        epochs=args.epochs,
+        batch_size=args.batch_size,
+        rows=rows,
+        print_fn=lambda *a: print(*a, file=sys.stderr),
+    )
+    table = markdown_table(results)
+    print(table)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=2)
+    if args.markdown:
+        with open(args.markdown, "w") as f:
+            f.write(table + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,76 @@
+"""Device occupancy snapshot — the reference's ``nvidia-smi`` check, TPU-native.
+
+The reference verified GPU residency and memory pressure by pasting
+``nvidia-smi`` snapshots into its experiment log (reference
+README.md:76-86,103-113,152-162; SURVEY.md §4 item 3) — e.g. confirming two
+worker processes shared gpu0's memory under ``allow_growth``. TPUs have no
+nvidia-smi; the equivalents are the PJRT device list and per-device memory
+statistics, plus the live on-device arrays JAX is tracking.
+
+Usage::
+
+    python -m distributed_tensorflow_tpu.tools.device_info
+
+or ``device_info.snapshot()`` in code (returns the rows it prints).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024:
+            return f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+def snapshot(print_fn=print) -> list[dict]:
+    rows = []
+    live = list(jax.live_arrays())
+    for dev in jax.local_devices():
+        stats = {}
+        try:
+            stats = dev.memory_stats() or {}
+        except (NotImplementedError, jax.errors.JaxRuntimeError):
+            pass  # CPU/interpret backends expose no allocator stats
+        arrays_here = [a for a in live if dev in getattr(a, "devices", lambda: set())()]
+        rows.append(
+            {
+                "id": dev.id,
+                "process": dev.process_index,
+                "platform": dev.platform,
+                "kind": dev.device_kind,
+                "bytes_in_use": stats.get("bytes_in_use"),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                "bytes_limit": stats.get("bytes_limit"),
+                "live_arrays": len(arrays_here),
+                "live_array_bytes": sum(a.nbytes for a in arrays_here),
+            }
+        )
+    if print_fn is not None:
+        print_fn(
+            f"{'dev':>4} {'proc':>4} {'platform':>9} {'kind':>14} "
+            f"{'in_use':>10} {'peak':>10} {'limit':>10} {'arrays':>7} {'array_B':>10}"
+        )
+        for r in rows:
+            print_fn(
+                f"{r['id']:>4} {r['process']:>4} {r['platform']:>9} {r['kind'][:14]:>14} "
+                f"{_fmt_bytes(r['bytes_in_use']):>10} {_fmt_bytes(r['peak_bytes_in_use']):>10} "
+                f"{_fmt_bytes(r['bytes_limit']):>10} {r['live_arrays']:>7} "
+                f"{_fmt_bytes(r['live_array_bytes']):>10}"
+            )
+    return rows
+
+
+def main() -> int:
+    snapshot()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,74 @@
+"""Benchmark grid + device snapshot tools (SURVEY.md §7 item 7, §4 item 3)."""
+
+import json
+
+import jax
+
+from distributed_tensorflow_tpu.tools import benchmark_suite, device_info
+
+
+def test_row_specs_cover_reference_grid():
+    rows = [r[0] for r in benchmark_suite._row_specs(8)]
+    assert rows == [
+        "single",
+        "sync-2",
+        "async-2",
+        "zero-2",
+        "sync-8",
+        "async-8",
+        "zero-8",
+        "tp-2",
+    ]
+    # One chip: only the single-device row survives.
+    assert [r[0] for r in benchmark_suite._row_specs(1)] == ["single"]
+
+
+def test_suite_runs_grid_on_virtual_mesh(small_datasets):
+    results = benchmark_suite.run_suite(
+        epochs=1,
+        datasets=small_datasets,
+        rows=["single", "sync-8", "async-2", "zero-2", "tp-2"],
+        print_fn=lambda *a: None,
+    )
+    # Results follow grid order, not filter order.
+    assert [r["row"] for r in results] == [
+        "single",
+        "async-2",
+        "zero-2",
+        "sync-8",
+        "tp-2",
+    ]
+    for r in results:
+        assert r["s_per_epoch"] > 0
+        assert r["examples_per_sec"] > 0
+        assert 0.0 <= r["final_accuracy"] <= 1.0
+    by_name = {r["row"]: r for r in results}
+    assert by_name["sync-8"]["devices"] == 8
+    assert by_name["sync-8"]["mode"] == "scan"
+    assert by_name["async-2"]["mode"] == "eager"
+    assert by_name["zero-2"]["mode"] == "eager"
+    json.dumps(results)  # machine-readable
+
+
+def test_markdown_table_shape(small_datasets):
+    results = benchmark_suite.run_suite(
+        epochs=1, datasets=small_datasets, rows=["single"], print_fn=lambda *a: None
+    )
+    table = benchmark_suite.markdown_table(results)
+    lines = table.split("\n")
+    assert lines[0].startswith("| Row |")
+    assert len(lines) == 3  # header + separator + 1 row
+    assert "tfsingle.py" in lines[2]
+
+
+def test_device_snapshot_lists_all_devices():
+    lines = []
+    rows = device_info.snapshot(print_fn=lines.append)
+    assert len(rows) == len(jax.local_devices()) == 8
+    assert all(r["platform"] == "cpu" for r in rows)
+    assert len(lines) == 9  # header + 8 devices
+    # Live-array accounting sees something (conftest datasets, jit consts...).
+    x = jax.numpy.ones((16, 16))
+    rows2 = device_info.snapshot(print_fn=None)
+    assert sum(r["live_arrays"] for r in rows2) >= 1
+    del x
