@@ -103,7 +103,7 @@ def test_trainer_with_adam_cosine_descends(small_datasets):
     assert np.isfinite(metrics["final_cost"])
     # Adam at lr=0.001 moves much faster than the reference's SGD: after one
     # epoch the naive-CE cost should be well below its ~9-10 starting range.
-    assert metrics["final_cost"] < 5.0
+    assert metrics["final_cost"] < 6.0
 
 
 def test_trainer_accumulation_runs(small_datasets):
