@@ -41,6 +41,7 @@ import time
 import jax
 
 from distributed_tensorflow_tpu.config import TrainConfig
+from distributed_tensorflow_tpu.utils.sync import d2h_barrier
 from distributed_tensorflow_tpu.models import MLP
 from distributed_tensorflow_tpu.parallel.fsdp import ShardedDataParallel
 from distributed_tensorflow_tpu.parallel.mesh import make_mesh
@@ -116,11 +117,12 @@ def run_suite(
         tr = Trainer(model, datasets, cfg, strategy=strategy, print_fn=_silent)
         logger = StepLogger(freq=10**9, print_fn=_silent)
         tr.run_epoch(0, logger)  # warmup: compile
+        d2h_barrier(tr.state.params)
         times = []
         for e in range(1, epochs + 1):
             t0 = time.time()
             tr.run_epoch(e, logger)
-            jax.block_until_ready(tr.state.params)
+            d2h_barrier(tr.state.params)
             times.append(time.time() - t0)
         times.sort()
         s_per_epoch = times[len(times) // 2]

@@ -20,7 +20,7 @@ def trace(logdir: str):
 
         with profiler.trace("./logs/profile"):
             state, cost = train_step(state, x, y)
-            jax.block_until_ready(cost)
+            float(cost)  # D2H fetch: the trustworthy barrier (utils/sync.py)
     """
     jax.profiler.start_trace(logdir)
     try:

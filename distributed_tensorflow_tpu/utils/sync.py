@@ -1,0 +1,34 @@
+"""Trustworthy execution barrier for timing code.
+
+Through the tunneled TPU PJRT plugin, ``jax.block_until_ready`` returns
+optimistically — timing against it measures *enqueue*, not execution (it
+once reported "25 epochs in 1 ms"; see docs/performance.md for the full
+post-mortem). The only barrier that provably waits for the device is a
+device-to-host **value fetch** of a buffer that transitively depends on
+the work being timed.
+
+This is the one shared implementation of that rule (CLAUDE.md: "any new
+timing code must too"). The reference's timing (AvgTime/Total Time around
+blocking ``sess.run`` calls, reference tfdist_between.py:92-110) never had
+the problem because ``sess.run`` fetches values; in JAX's async-dispatch
+model the fetch must be explicit.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def d2h_barrier(tree) -> None:
+    """Block until every computation ``tree`` depends on has executed, by
+    copying one array leaf to host. Prefer fetching a value you already
+    need (as ``bench.py`` does with the final cost); use this when the
+    timed code produces nothing the caller wants on host.
+    """
+    for leaf in jax.tree_util.tree_leaves(tree):
+        # Every device leaf, not just the first: leaves may come from
+        # independent dispatches, and a host-numpy first leaf would make a
+        # single-leaf fetch a silent no-op.
+        if isinstance(leaf, jax.Array):
+            np.asarray(leaf)

@@ -72,3 +72,20 @@ def test_device_snapshot_lists_all_devices():
     rows2 = device_info.snapshot(print_fn=None)
     assert sum(r["live_arrays"] for r in rows2) >= 1
     del x
+
+
+def test_d2h_barrier_handles_mixed_and_empty_trees():
+    import numpy as np
+
+    from distributed_tensorflow_tpu.utils.sync import d2h_barrier
+
+    # Mixed tree: host numpy first (must not short-circuit the fetch),
+    # device arrays from two independent dispatches after it.
+    a = jax.jit(lambda x: x * 2)(jax.numpy.ones((4, 4)))
+    b = jax.jit(lambda x: x + 1)(jax.numpy.ones((2, 2)))
+    d2h_barrier({"host": np.zeros(3), "a": a, "b": b})
+    assert float(a[0, 0]) == 2.0 and float(b[0, 0]) == 2.0
+    # Degenerate trees are no-ops, not errors.
+    d2h_barrier({})
+    d2h_barrier(None)
+    d2h_barrier([np.ones(2)])
