@@ -82,6 +82,10 @@ class TrainConfig:
     # TPU-first knobs (no reference analog)
     compute_dtype: str = "bfloat16"  # MXU-friendly activations dtype
     param_dtype: str = "float32"
+    # Model family from the registry (models/__init__.py): mlp (reference
+    # parity) | cnn | lstm | transformer. The reference picked its model by
+    # picking which script to run; here it is one config knob.
+    model: str = "mlp"
     # Optimizer surface (ops/optim.py). Defaults reproduce the reference's
     # constant-lr SGD exactly; everything else is framework surface.
     optimizer: str = "sgd"  # sgd | momentum | adam | adamw

@@ -23,7 +23,6 @@ import jax.numpy as jnp
 from distributed_tensorflow_tpu.cluster import ProcessContext
 from distributed_tensorflow_tpu.config import ClusterConfig, TrainConfig
 from distributed_tensorflow_tpu.data import read_data_sets
-from distributed_tensorflow_tpu.models import MLP
 from distributed_tensorflow_tpu.ops import optim as optim_lib
 from distributed_tensorflow_tpu.parallel import (
     AsyncDataParallel,
@@ -39,11 +38,14 @@ def config_from_env(base: TrainConfig | None = None) -> TrainConfig:
     """Apply environment overrides to a TrainConfig — the knob the reference
     lacked (its hyperparameters were module constants, SURVEY.md §5
     "Config/flag system"). Recognized: DTF_EPOCHS, DTF_BATCH_SIZE, DTF_LR,
-    DTF_SCAN (=1 → scan_epoch), DTF_LOGS (logs path, empty disables)."""
+    DTF_SCAN (=1 → scan_epoch), DTF_LOGS (logs path, empty disables),
+    DTF_MODEL (registry name: mlp | cnn | lstm | transformer)."""
     import os
 
     cfg = base or TrainConfig()
     kw = {}
+    if "DTF_MODEL" in os.environ:
+        kw["model"] = os.environ["DTF_MODEL"]
     if "DTF_EPOCHS" in os.environ:
         kw["epochs"] = int(os.environ["DTF_EPOCHS"])
     if "DTF_BATCH_SIZE" in os.environ:
@@ -106,7 +108,12 @@ def build_trainer(
 ) -> Trainer:
     config = config or TrainConfig()
     is_chief = context.is_chief if context is not None else True
-    model = model or MLP(compute_dtype=jnp.dtype(config.compute_dtype))
+    if model is None:
+        from distributed_tensorflow_tpu.models import build_model
+
+        model = build_model(
+            config.model, compute_dtype=jnp.dtype(config.compute_dtype)
+        )
     datasets = datasets or read_data_sets(data_dir, one_hot=True)
     strategy = strategy or build_strategy(config)
     if optimizer is None:

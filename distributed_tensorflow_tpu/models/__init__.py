@@ -9,6 +9,10 @@ reference tfsingle.py:23-42).
 
 from distributed_tensorflow_tpu.models.cnn import CNN, CNNParams  # noqa: F401
 from distributed_tensorflow_tpu.models.mlp import MLP, MLPParams  # noqa: F401
+from distributed_tensorflow_tpu.models.rnn import (  # noqa: F401
+    LSTMClassifier,
+    LSTMParams,
+)
 from distributed_tensorflow_tpu.models.transformer import (  # noqa: F401
     TransformerClassifier,
     TransformerParams,
@@ -18,6 +22,7 @@ MODEL_REGISTRY = {
     "mlp": MLP,
     "cnn": CNN,
     "transformer": TransformerClassifier,
+    "lstm": LSTMClassifier,
 }
 
 

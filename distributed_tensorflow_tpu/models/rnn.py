@@ -1,0 +1,158 @@
+"""Recurrent model family: an LSTM classifier over row-sequential MNIST.
+
+The reference ships exactly one model — the 784→100→10 MLP repeated in each
+script (reference tfsingle.py:23-42). This family completes the framework's
+model-protocol proof alongside the CNN and transformer: a *stateful-
+recurrence* workload that drops into the unchanged strategies/Trainer on the
+same flattened ``[B, 784]`` batches the reference's ``feed_dict`` carried
+(reference tfdist_between.py:92-94), read as a sequence of 28 rows × 28
+features (the classic "sequential MNIST" task).
+
+TPU mapping — recurrence is where naive ports die on TPU, so the design is
+explicit about the XLA semantics:
+
+- The time loop is ``lax.scan`` — traced once, compiled once, no Python
+  per-step dispatch (the reference's per-batch ``sess.run`` pathology,
+  SURVEY.md §3.1, would reappear *per time step* in an eager loop).
+- The four gate projections are **one fused matmul** per step against a
+  stacked ``[in+hidden, 4, hidden]`` kernel: a single MXU-shaped contraction
+  in bfloat16 with float32 accumulation instead of four skinny ones.
+- Cell and hidden state stay float32 — bf16 carries across 28 recurrence
+  steps compound rounding error; matmul inputs are cast per step.
+- The head reads the final hidden state; softmax is float32 so the
+  reference's numerically naive ``log(softmax)`` loss (ops/losses.py)
+  stays finite.
+
+Tensor-parallel layout (``partition_specs``): hidden units shard over the
+mesh's ``model`` axis — the gate kernel on its hidden output dim, the head
+on its hidden input dim (Megatron column→row). Gate nonlinearities and the
+cell update are elementwise over hidden units, so they run shard-local;
+GSPMD inserts the all-gather of ``h`` feeding the next step's fused matmul
+and the all-reduce after the head.
+
+Init is fan-in-scaled normal with the standard +1 forget-gate bias (keeps
+gradient flow open through the 28 steps), deterministic from an integer
+seed like every model here (the property supervisor-free chief init relies
+on, models/base.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LSTMParams(NamedTuple):
+    """Parameter pytree. Gate order on the stacked axis: i, f, g, o."""
+
+    w: jax.Array  # [in+hidden, 4, hidden] fused gate kernel
+    b: jax.Array  # [4, hidden] gate biases (forget gate init to +1)
+    head_w: jax.Array  # [hidden, out]
+    head_b: jax.Array  # [out]
+
+
+class LSTMClassifier:
+    """scan(LSTM cell over rows) → dense head → softmax, on [B, T*F] input."""
+
+    def __init__(
+        self,
+        seq_len: int = 28,
+        feature_dim: int = 28,
+        hidden_dim: int = 128,
+        out_dim: int = 10,
+        compute_dtype: jnp.dtype = jnp.bfloat16,
+    ):
+        self.seq_len = seq_len
+        self.feature_dim = feature_dim
+        self.hidden_dim = hidden_dim
+        self.out_dim = out_dim
+        self.compute_dtype = compute_dtype
+
+    # -- init --------------------------------------------------------------
+
+    def init(self, seed: int = 1) -> LSTMParams:
+        """Fan-in-scaled normal kernels, +1 forget-gate bias, zero elsewhere;
+        fully deterministic from ``seed``."""
+        kw, kh = jax.random.split(jax.random.key(seed))
+        fan_in = self.feature_dim + self.hidden_dim
+        b = jnp.zeros((4, self.hidden_dim), jnp.float32)
+        b = b.at[1].set(1.0)  # forget gate
+        return LSTMParams(
+            w=jax.random.normal(
+                kw, (fan_in, 4, self.hidden_dim), jnp.float32
+            )
+            * jnp.sqrt(1.0 / fan_in),
+            b=b,
+            head_w=jax.random.normal(
+                kh, (self.hidden_dim, self.out_dim), jnp.float32
+            )
+            * jnp.sqrt(1.0 / self.hidden_dim),
+            head_b=jnp.zeros((self.out_dim,), jnp.float32),
+        )
+
+    # -- forward -----------------------------------------------------------
+
+    def _cell(self, params: LSTMParams, carry, x_t: jax.Array):
+        """One LSTM step: fused-gate matmul (MXU, bf16×bf16→f32) + f32 state
+        update. ``carry = (h, c)``, both [B, hidden] float32."""
+        h, c = carry
+        cd = self.compute_dtype
+        z = jnp.concatenate([x_t, h], axis=-1)
+        gates = (
+            jnp.einsum(
+                "bi,igh->bgh",
+                z.astype(cd),
+                params.w.astype(cd),
+                preferred_element_type=jnp.float32,
+            )
+            + params.b
+        )
+        i = jax.nn.sigmoid(gates[:, 0])
+        f = jax.nn.sigmoid(gates[:, 1])
+        g = jnp.tanh(gates[:, 2])
+        o = jax.nn.sigmoid(gates[:, 3])
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), None
+
+    def apply_logits(self, params: LSTMParams, x: jax.Array) -> jax.Array:
+        """Forward pass → pre-softmax logits, float32.
+
+        Accepts the data pipeline's flattened ``[B, T*F]`` batches (the
+        reference's feed shape) or already-shaped ``[B, T, F]``.
+        """
+        if x.ndim == 2:
+            x = x.reshape(x.shape[0], self.seq_len, self.feature_dim)
+        batch = x.shape[0]
+        h0 = jnp.zeros((batch, self.hidden_dim), jnp.float32)
+        carry = (h0, h0)
+        # Time-major for scan: [T, B, F].
+        xs = jnp.swapaxes(x.astype(jnp.float32), 0, 1)
+        (h, _), _ = jax.lax.scan(lambda cr, xt: self._cell(params, cr, xt), carry, xs)
+        cd = self.compute_dtype
+        logits = jnp.dot(
+            h.astype(cd),
+            params.head_w.astype(cd),
+            preferred_element_type=jnp.float32,
+        )
+        return logits + params.head_b
+
+    def apply(self, params: LSTMParams, x: jax.Array) -> jax.Array:
+        """Forward pass → class probabilities, float32."""
+        return jax.nn.softmax(self.apply_logits(params, x), axis=-1)
+
+    # -- parallelism -------------------------------------------------------
+
+    def partition_specs(self, model_axis: str = "model") -> LSTMParams:
+        """Megatron column→row split over hidden units (see module
+        docstring): gate kernel/biases sharded on hidden, head row-sharded."""
+        from jax.sharding import PartitionSpec as P
+
+        return LSTMParams(
+            w=P(None, None, model_axis),
+            b=P(None, model_axis),
+            head_w=P(model_axis, None),
+            head_b=P(None),
+        )

@@ -1,0 +1,167 @@
+"""Analytical cost/roofline report for a compiled train step.
+
+The reference reasoned about performance by wall-clock alone — hand-rolled
+``AvgTime`` per 100 batches and per-epoch totals pasted into its experiment
+log (reference tfdist_between.py:98-110, README.md:38-40,97-101), with no
+way to say *why* a configuration was slow. On TPU the compiler itself can
+answer that: XLA's analytical model reports FLOPs and bytes accessed for
+any compiled program, and comparing their ratio (arithmetic intensity)
+against the hardware's FLOPs/byte balance point classifies the program as
+compute- or bandwidth-bound and predicts its per-step floor — the
+"How to Scale Your Model" roofline recipe, as a tool.
+
+Usage::
+
+    python -m distributed_tensorflow_tpu.tools.cost_analysis --model mlp
+    python -m distributed_tensorflow_tpu.tools.cost_analysis --model lstm --batch 512
+
+or ``cost_analysis.analyze(model, batch_size=...)`` in code. Numbers come
+from ``jax.stages.Compiled.cost_analysis()`` — the same estimates the XLA
+scheduler uses; they are analytical (no execution, works on any backend),
+so use them for *shape* questions (bound class, scaling with batch) and
+the benchmark tools for measured wall clock.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.ops import cross_entropy, sgd
+from distributed_tensorflow_tpu.parallel.strategy import SingleDevice
+
+# Peak numbers for rooflining, per chip. Sources: public TPU spec sheets
+# (bf16 matmul peak / HBM bandwidth). "cpu" is a rough placeholder so the
+# tool classifies in CPU test environments.
+CHIP_PEAKS = {
+    "tpu v5 lite": {"flops": 197e12, "hbm_bytes_per_s": 819e9},
+    "tpu v4": {"flops": 275e12, "hbm_bytes_per_s": 1228e9},
+    "cpu": {"flops": 1e11, "hbm_bytes_per_s": 5e10},
+}
+
+
+def _chip_peaks(device) -> dict | None:
+    """Peaks for the device, or None when unknown — a wrong balance point
+    misclassifies every program, so refuse rather than guess."""
+    kind = device.device_kind.lower()
+    for prefix, peaks in CHIP_PEAKS.items():
+        if kind.startswith(prefix):
+            return peaks
+    return None
+
+
+def analyze(
+    model,
+    batch_size: int = 100,
+    in_dim: int = 784,
+    out_dim: int = 10,
+    learning_rate: float = 0.001,
+    device=None,
+) -> dict:
+    """Compile one SGD train step for ``model`` and report its analytical
+    cost plus the roofline classification on ``device`` (default: device 0).
+    """
+    device = device or jax.devices()[0]
+    # Analyze the *actual* program the Trainer compiles — the SingleDevice
+    # strategy's train step (parallel/strategy.py) — not a re-derivation
+    # that could drift from it.
+    strategy = SingleDevice()
+    opt = sgd(learning_rate)
+    state = strategy.init_state(model, opt, seed=1)
+    step = strategy.make_train_step(model, cross_entropy, opt)
+
+    x = jnp.zeros((batch_size, in_dim), jnp.float32)
+    y = jnp.zeros((batch_size, out_dim), jnp.float32)
+    compiled = step.lower(state, x, y).compile()
+    cost = compiled.cost_analysis() or {}
+    if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+        cost = cost[0] if cost else {}
+
+    flops = float(cost.get("flops", 0.0))
+    bytes_accessed = float(cost.get("bytes accessed", 0.0))
+    intensity = flops / bytes_accessed if bytes_accessed else float("inf")
+    n_params = sum(
+        p.size for p in jax.tree_util.tree_leaves(state.params)
+    )
+    mem = compiled.memory_analysis()
+    report = {
+        "model": type(model).__name__,
+        "batch_size": batch_size,
+        "device_kind": device.device_kind,
+        "param_count": int(n_params),
+        "flops_per_step": flops,
+        "bytes_per_step": bytes_accessed,
+        "arithmetic_intensity_flops_per_byte": round(intensity, 3),
+        "temp_bytes": getattr(mem, "temp_size_in_bytes", None),
+        "argument_bytes": getattr(mem, "argument_size_in_bytes", None),
+    }
+
+    peaks = _chip_peaks(device)
+    if peaks is None:
+        report.update(
+            chip_balance_flops_per_byte=None,
+            bound="unknown",
+            roofline_floor_us=None,
+            examples_per_sec_roofline=None,
+        )
+        return report
+    balance = peaks["flops"] / peaks["hbm_bytes_per_s"]  # FLOPs/byte
+    t_compute = flops / peaks["flops"]
+    t_memory = bytes_accessed / peaks["hbm_bytes_per_s"]
+    report.update(
+        chip_balance_flops_per_byte=round(balance, 1),
+        bound="compute" if intensity > balance else "memory",
+        roofline_floor_us=round(max(t_compute, t_memory) * 1e6, 3),
+        examples_per_sec_roofline=round(
+            batch_size / max(t_compute, t_memory, 1e-12), 1
+        ),
+    )
+    return report
+
+
+def format_report(r: dict) -> str:
+    lines = [
+        f"{r['model']} @ batch {r['batch_size']} on {r['device_kind']}",
+        f"  params:               {r['param_count']:,}",
+        f"  flops/step:           {r['flops_per_step']:,.0f}",
+        f"  bytes/step:           {r['bytes_per_step']:,.0f}",
+        f"  arithmetic intensity: {r['arithmetic_intensity_flops_per_byte']} FLOP/B",
+    ]
+    if r["bound"] == "unknown":
+        lines.append(
+            "  bound:                unknown (no peak numbers for this chip"
+            " — add them to CHIP_PEAKS)"
+        )
+    else:
+        lines += [
+            f"  chip balance:         {r['chip_balance_flops_per_byte']} FLOP/B",
+            f"  bound:                {r['bound']}",
+            f"  roofline floor:       {r['roofline_floor_us']} us/step"
+            f"  ({r['examples_per_sec_roofline']:,.0f} ex/s)",
+        ]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    from distributed_tensorflow_tpu.models import MODEL_REGISTRY, build_model
+
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--model", default="mlp", choices=sorted(MODEL_REGISTRY))
+    p.add_argument("--batch", type=int, default=100)
+    p.add_argument("--json", action="store_true", help="emit JSON instead of text")
+    args = p.parse_args(argv)
+    report = analyze(build_model(args.model), batch_size=args.batch)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(format_report(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -73,3 +73,30 @@ def test_trainer_runs_with_zero_dp(small_datasets):
     metrics = tr.run(epochs=1)
     assert 0.0 <= metrics["accuracy"] <= 1.0
     assert metrics["final_cost"] > 0
+
+
+def test_model_knob_builds_registry_family(small_datasets):
+    from distributed_tensorflow_tpu.launch import build_trainer
+    from distributed_tensorflow_tpu.models import LSTMClassifier
+
+    tr = build_trainer(
+        TrainConfig(model="lstm", logs_path=""),
+        datasets=small_datasets,
+        print_fn=lambda *a: None,
+    )
+    assert isinstance(tr.model, LSTMClassifier)
+    import pytest
+
+    with pytest.raises(ValueError):
+        build_trainer(
+            TrainConfig(model="nope", logs_path=""),
+            datasets=small_datasets,
+            print_fn=lambda *a: None,
+        )
+
+
+def test_env_override_model(monkeypatch):
+    from distributed_tensorflow_tpu.launch import config_from_env
+
+    monkeypatch.setenv("DTF_MODEL", "cnn")
+    assert config_from_env().model == "cnn"
