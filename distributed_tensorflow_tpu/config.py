@@ -111,6 +111,12 @@ class TrainConfig:
     # the returned per-step costs after the dispatch. Supported by the
     # single-device and sync-DP (GSPMD) strategies.
     scan_epoch: bool = False
+    # Compile the WHOLE run — every epoch, on-device shuffle, and per-epoch
+    # test eval — into one dispatch (train/compiled_run.py). Same observable
+    # surface as the eager loop; the shuffle moves from host numpy to the
+    # on-device PRNG (distributionally equivalent). Wins whenever dispatch
+    # latency matters. Same strategy support as scan_epoch.
+    compiled_run: bool = False
     # Keep N device-placed batches in flight in the eager per-batch loop
     # (data/prefetch.py): batch i+1's host→device transfer overlaps step i's
     # compute. 0 disables (reference-parity synchronous feed).

@@ -38,7 +38,8 @@ def config_from_env(base: TrainConfig | None = None) -> TrainConfig:
     """Apply environment overrides to a TrainConfig — the knob the reference
     lacked (its hyperparameters were module constants, SURVEY.md §5
     "Config/flag system"). Recognized: DTF_EPOCHS, DTF_BATCH_SIZE, DTF_LR,
-    DTF_SCAN (=1 → scan_epoch), DTF_LOGS (logs path, empty disables),
+    DTF_SCAN (=1 → scan_epoch), DTF_COMPILED (=1 → compiled_run: the whole
+    run as one dispatch), DTF_LOGS (logs path, empty disables),
     DTF_MODEL (registry name: mlp | cnn | lstm | transformer)."""
     import os
 
@@ -54,6 +55,8 @@ def config_from_env(base: TrainConfig | None = None) -> TrainConfig:
         kw["learning_rate"] = float(os.environ["DTF_LR"])
     if "DTF_SCAN" in os.environ:
         kw["scan_epoch"] = os.environ["DTF_SCAN"] == "1"
+    if "DTF_COMPILED" in os.environ:
+        kw["compiled_run"] = os.environ["DTF_COMPILED"] == "1"
     if "DTF_LOGS" in os.environ:
         kw["logs_path"] = os.environ["DTF_LOGS"]
     return cfg.replace(**kw) if kw else cfg
@@ -192,4 +195,4 @@ def run(
         return None
     trainer = build_trainer(config_from_env(config), context=ctx, **kw)
     print("Ready to go")  # reference tfdist_between.py:76
-    return trainer.run()
+    return trainer.run()  # honors compiled_run / scan_epoch internally

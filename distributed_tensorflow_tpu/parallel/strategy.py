@@ -128,11 +128,17 @@ class SingleDevice(Strategy):
 
     # Scanned-epoch support (config.scan_epoch).
     stage_sharding = None
+    replicated_sharding = None  # whole-run staging (train/compiled_run.py)
 
     def make_scanned_train_fn(self, model, loss_fn, optimizer):
         from distributed_tensorflow_tpu.train.scan import make_scanned_train_fn
 
         return make_scanned_train_fn(model, loss_fn, optimizer)
+
+    def make_compiled_run_fn(self, model, loss_fn, optimizer, **kw):
+        from distributed_tensorflow_tpu.train.compiled_run import make_compiled_run_fn
+
+        return make_compiled_run_fn(model, loss_fn, optimizer, **kw)
 
 
 class SyncDataParallel(Strategy):
@@ -269,6 +275,14 @@ class SyncDataParallel(Strategy):
     def stage_sharding(self):
         return NamedSharding(self.mesh, P(None, "data"))
 
+    # Whole-run staging (train/compiled_run.py): the full train/test arrays
+    # live replicated — per-step batches are random gathers, which would be
+    # cross-device traffic if the example dim were sharded. Also makes the
+    # staged arrays globally addressable in multi-process meshes.
+    @property
+    def replicated_sharding(self):
+        return self._repl
+
     def make_scanned_train_fn(self, model, loss_fn, optimizer):
         if self.explicit:
             raise NotImplementedError(
@@ -278,6 +292,17 @@ class SyncDataParallel(Strategy):
 
         return make_scanned_train_fn(
             model, loss_fn, optimizer, batch_sharding=self._batch
+        )
+
+    def make_compiled_run_fn(self, model, loss_fn, optimizer, **kw):
+        if self.explicit:
+            raise NotImplementedError(
+                "compiled run uses the GSPMD path; explicit_collectives=False"
+            )
+        from distributed_tensorflow_tpu.train.compiled_run import make_compiled_run_fn
+
+        return make_compiled_run_fn(
+            model, loss_fn, optimizer, batch_sharding=self._batch, **kw
         )
 
 

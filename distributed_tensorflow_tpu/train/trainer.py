@@ -194,10 +194,102 @@ class Trainer:
         self.last_cost = costs[-1]
         batch_count = costs.shape[0]
         avg_ms = elapsed * 1000 / batch_count  # uniform: one dispatch ran them all
+        self._emit_step_logs(costs, epoch, step_before, avg_ms, logger)
+
+    def run_compiled(self, epochs: int | None = None) -> dict:
+        """Whole-run fast path (train/compiled_run.py): every epoch, shuffle,
+        and test eval compiled into ONE dispatch. Observable surface matches
+        ``run()`` — same log lines (uniform AvgTime, as in the scanned path),
+        same summaries, same return dict — with per-epoch granularity
+        reconstructed post-hoc from the returned ``[epochs, steps]`` costs
+        and ``[epochs]`` accuracies. The epoch shuffle runs on-device
+        (distributionally equivalent to the host shuffle; see the module
+        docstring of train/compiled_run.py for the exact semantics)."""
+        cfg = self.config
+        epochs = cfg.epochs if epochs is None else epochs
+        if not hasattr(self.strategy, "make_compiled_run_fn"):
+            raise ValueError(
+                f"compiled run unsupported for {type(self.strategy).__name__}"
+            )
+        if cfg.per_worker_epoch:
+            raise ValueError("run_compiled and per_worker_epoch are exclusive")
+        train, test = self.datasets.train, self.datasets.test
+        global_batch = cfg.batch_size * self.strategy.num_replicas
+        run_fn = self.strategy.make_compiled_run_fn(
+            self.model,
+            self.loss_fn,
+            self.optimizer,
+            batch_size=global_batch,
+            epochs=epochs,
+        )
+        if self.summary_writer is not None and self.is_chief and not self._graph_written:
+            self.write_graph()
+            self._graph_written = True
+        logger = StepLogger(freq=cfg.log_frequency, print_fn=self.print_fn)
+        # Stage replicated: per-step batches are random gathers, and in a
+        # multi-process mesh the inputs must be globally addressable.
+        sharding = self.strategy.replicated_sharding
+        stage = (
+            (lambda a: jax.device_put(jax.numpy.asarray(a), sharding))
+            if sharding is not None
+            else jax.numpy.asarray
+        )
+        step_before = self.strategy.global_step(self.state)
+        t0 = time.time()
+        self.state, metrics = run_fn(
+            self.state,
+            stage(train.images),
+            stage(train.labels),
+            stage(test.images),
+            stage(test.labels),
+            jax.random.key(cfg.seed),
+        )
+        # D2H fetches double as the execution barrier (CLAUDE.md timing trap).
+        costs = jax.device_get(metrics["costs"])
+        accs = jax.device_get(metrics["accuracy"])
+        elapsed = time.time() - t0
+        batch_count = costs.shape[1]
+        if costs.size:
+            self.last_cost = costs[-1, -1]
+        avg_ms = elapsed * 1000 / max(epochs * batch_count, 1)
+        accuracy = 0.0
+        for epoch in range(epochs):
+            self._emit_step_logs(
+                costs[epoch], epoch, step_before + epoch * batch_count, avg_ms, logger
+            )
+            if self.is_chief:
+                accuracy = float(accs[epoch])
+                logger.log_epoch(test_accuracy=accuracy)
+                step_now = step_before + (epoch + 1) * batch_count
+                if self.summary_writer is not None:
+                    self.summary_writer.add_scalar("accuracy", accuracy, step_now)
+                self.history.append(
+                    {"epoch": epoch + 1, "accuracy": accuracy, "step": step_now}
+                )
+        if self.supervisor is not None:
+            self.supervisor.save(self.state, self.strategy.global_step(self.state))
+        final_cost = float(costs[-1, -1]) if costs.size else float("nan")
+        if self.is_chief:
+            logger.log_final(cost=final_cost)
+            if self.summary_writer is not None:
+                self.summary_writer.flush()
+        return {
+            "accuracy": float(accs[-1]) if accs.size else 0.0,
+            "final_cost": final_cost,
+            "global_step": self.strategy.global_step(self.state),
+        }
+
+    def _emit_step_logs(
+        self, costs, epoch: int, step_offset: int, avg_ms: float, logger: StepLogger
+    ) -> None:
+        """Post-hoc reference-cadence step lines + cost scalars from a
+        compiled dispatch's returned per-step costs (shared by the scanned
+        and whole-run fast paths)."""
+        batch_count = len(costs)
         for i in range(batch_count):
             if logger.is_due(i + 1, batch_count):
                 logger.log_step_line(
-                    step=step_before + i + 1,
+                    step=step_offset + i + 1,
                     epoch=epoch,
                     batch=i,
                     batch_count=batch_count,
@@ -207,7 +299,7 @@ class Trainer:
         if self.summary_writer is not None and self.is_chief:
             for i in range(batch_count):
                 self.summary_writer.add_scalar(
-                    "cost", float(costs[i]), step_before + i + 1
+                    "cost", float(costs[i]), step_offset + i + 1
                 )
 
     def write_graph(self) -> None:
@@ -229,6 +321,8 @@ class Trainer:
 
     def run(self, epochs: int | None = None) -> dict:
         cfg = self.config
+        if cfg.compiled_run:
+            return self.run_compiled(epochs)
         epochs = cfg.epochs if epochs is None else epochs
         if self.summary_writer is not None and self.is_chief and not self._graph_written:
             # Once per trainer: TensorBoard expects at most one graph per run,

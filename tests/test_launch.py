@@ -100,3 +100,10 @@ def test_env_override_model(monkeypatch):
 
     monkeypatch.setenv("DTF_MODEL", "cnn")
     assert config_from_env().model == "cnn"
+
+
+def test_env_override_compiled_run(monkeypatch):
+    from distributed_tensorflow_tpu.launch import config_from_env
+
+    monkeypatch.setenv("DTF_COMPILED", "1")
+    assert config_from_env().compiled_run is True
