@@ -62,6 +62,29 @@ def _loss_from_model(model, loss_fn: LossFn, params, x, y) -> jax.Array:
     return loss_fn(model.apply(params, x), y)
 
 
+def _to_varying(a: jax.Array, axis_name: str) -> jax.Array:
+    """Mark a device-invariant value (e.g. a pmean result) as varying over
+    ``axis_name`` so it can re-enter a varying scan carry under shard_map.
+    ``pcast`` is the current API; ``pvary`` its deprecated predecessor."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(a, axis_name, to="varying")
+    return jax.lax.pvary(a, axis_name)
+
+
+def _local_sgd_update(model, loss_fn, optimizer, scale, params, opt_state, x, y):
+    """One local optimizer apply — the shared update math of the async
+    eager step and the async scanned epoch (their bitwise equivalence is a
+    tested guarantee, tests/test_scan.py::test_async_scan_matches_eager_async;
+    keeping one implementation makes it structural)."""
+    cost, grads = jax.value_and_grad(partial(_loss_from_model, model, loss_fn))(
+        params, x, y
+    )
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    updates = jax.tree.map(lambda u: u * scale, updates)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, cost
+
+
 class Strategy:
     """Interface. Subclasses define placement + aggregation."""
 
@@ -353,12 +376,9 @@ class AsyncDataParallel(Strategy):
             # Each chip sees leading-axis slices of size 1: its own copy.
             params = jax.tree.map(lambda a: a[0], state.params)
             opt_state = jax.tree.map(lambda a: a[0], state.opt_state)
-            cost, grads = jax.value_and_grad(
-                partial(_loss_from_model, model, loss_fn)
-            )(params, x, y)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            updates = jax.tree.map(lambda u: u * scale, updates)
-            params = optax.apply_updates(params, updates)
+            params, opt_state, cost = _local_sgd_update(
+                model, loss_fn, optimizer, scale, params, opt_state, x, y
+            )
             new = TrainState(
                 jax.tree.map(lambda a: a[None], params),
                 jax.tree.map(lambda a: a[None], opt_state),
@@ -410,6 +430,119 @@ class AsyncDataParallel(Strategy):
             return TrainState(params, state.opt_state, state.step)
 
         return exchange
+
+    # Scanned-epoch support: staged arrays are [steps, n*batch, ...] with
+    # the batch dim sharded over 'data' (chip i's slice is worker i's batch
+    # stream), mirroring the sync layout.
+    @property
+    def stage_sharding(self):
+        return NamedSharding(self.mesh, P(None, "data"))
+
+    def make_scanned_train_fn(self, model, loss_fn, optimizer):
+        """One dispatch per epoch for the async emulation: each chip scans
+        its own local-SGD stream, and the periodic parameter exchange
+        (``avg_every``) becomes a ``pmean`` between inner scan rounds —
+        the whole HOGWILD-emulation epoch (local steps + exchanges) is a
+        single XLA program. Exchange cadence and semantics match the eager
+        path exactly: params jump to the mean every ``avg_every`` local
+        steps (including an epoch-final exchange when the count divides),
+        optimizer slots stay local, and a non-dividing remainder of steps
+        runs after the last exchange.
+        """
+        scale = self.update_scale
+        avg_every = self.avg_every
+
+        def local_epoch(state: TrainState, xs, ys):
+            # Local slices: state leading axis 1 (this chip's copy), xs/ys
+            # [steps, batch, ...] (this chip's share of each global batch).
+            params = jax.tree.map(lambda a: a[0], state.params)
+            opt_state = jax.tree.map(lambda a: a[0], state.opt_state)
+
+            def step(carry, xy):
+                params, opt_state = carry
+                x, y = xy
+                params, opt_state, cost = _local_sgd_update(
+                    model, loss_fn, optimizer, scale, params, opt_state, x, y
+                )
+                return (params, opt_state), cost
+
+            steps = xs.shape[0]
+            carry = (params, opt_state)
+            if avg_every and steps >= avg_every:
+                rounds = steps // avg_every
+                head = rounds * avg_every
+
+                def round_body(carry, xy):
+                    carry, costs = jax.lax.scan(step, carry, xy)
+                    params, opt_state = carry
+                    # pmean output is device-invariant; cast it back to the
+                    # varying-over-'data' type the scan carry requires.
+                    params = jax.tree.map(
+                        lambda a: _to_varying(jax.lax.pmean(a, "data"), "data"),
+                        params,
+                    )
+                    return (params, opt_state), costs
+
+                carry, costs = jax.lax.scan(
+                    round_body,
+                    carry,
+                    (
+                        xs[:head].reshape(rounds, avg_every, *xs.shape[1:]),
+                        ys[:head].reshape(rounds, avg_every, *ys.shape[1:]),
+                    ),
+                )
+                costs = costs.reshape(head)
+                if steps % avg_every:
+                    carry, tail = jax.lax.scan(
+                        step, carry, (xs[head:], ys[head:])
+                    )
+                    costs = jnp.concatenate([costs, tail])
+            else:
+                carry, costs = jax.lax.scan(step, carry, (xs, ys))
+
+            params, opt_state = carry
+            new = TrainState(
+                jax.tree.map(lambda a: a[None], params),
+                jax.tree.map(lambda a: a[None], opt_state),
+                state.step + steps,
+            )
+            return new, costs[:, None]  # [steps, 1] → global [steps, n]
+
+        mapped = jax.shard_map(
+            local_epoch,
+            mesh=self.mesh,
+            in_specs=(P("data"), P(None, "data"), P(None, "data")),
+            out_specs=(P("data"), P(None, "data")),
+        )
+
+        @partial(jax.jit, donate_argnums=0)
+        def run(state: TrainState, xs, ys):
+            state, costs = mapped(state, xs, ys)
+            # Mean over replicas per step — what the eager path's
+            # cost_scalar logs.
+            return state, jnp.mean(costs, axis=1)
+
+        return run
+
+    def make_divergence_fn(self):
+        """Race observability: the largest elementwise distance of any
+        parameter copy from the mean of the copies. The reference could only
+        *discuss* its async parameter race qualitatively (stale HOGWILD
+        applies, reference README.md:70-74); this measures the modeled race
+        directly — 0 right after an exchange, growing with local drift, the
+        quantitative staleness bound `avg_every` controls.
+        """
+
+        @jax.jit
+        def divergence(state: TrainState) -> jax.Array:
+            def leaf_div(a):
+                return jnp.max(jnp.abs(a - a.mean(axis=0, keepdims=True)))
+
+            return jax.tree.reduce(
+                jnp.maximum, jax.tree.map(leaf_div, state.params)
+            )
+
+        return divergence
 
     def effective_params(self, state: TrainState):
         return jax.tree.map(lambda a: a.mean(axis=0), state.params)

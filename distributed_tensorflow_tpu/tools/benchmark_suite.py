@@ -89,7 +89,7 @@ def _build(name: str, n: int, model):
     if kind == "sync":
         return SyncDataParallel(mesh), True
     if kind == "async":
-        return AsyncDataParallel(mesh, avg_every=50), False  # no scanned path
+        return AsyncDataParallel(mesh, avg_every=50), True
     if kind == "zero":
         return ShardedDataParallel(mesh), False
     raise ValueError(name)

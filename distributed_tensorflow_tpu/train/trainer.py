@@ -161,11 +161,7 @@ class Trainer:
                     cost=self.strategy.cost_scalar(cost),
                 )
         if self.summary_writer is not None and self.is_chief:
-            # global_step advances by num_replicas per batch under async,
-            # by 1 under sync — derive the per-batch increment exactly.
-            incr = (self.strategy.global_step(self.state) - step_before) // max(
-                batch_count, 1
-            )
+            incr = self._step_incr(step_before, batch_count)
             for i, cost in summaries:
                 self.summary_writer.add_scalar(
                     "cost", self.strategy.cost_scalar(cost), step_before + (i + 1) * incr
@@ -194,7 +190,14 @@ class Trainer:
         self.last_cost = costs[-1]
         batch_count = costs.shape[0]
         avg_ms = elapsed * 1000 / batch_count  # uniform: one dispatch ran them all
-        self._emit_step_logs(costs, epoch, step_before, avg_ms, logger)
+        self._emit_step_logs(
+            costs,
+            epoch,
+            step_before,
+            avg_ms,
+            logger,
+            step_incr=self._step_incr(step_before, batch_count),
+        )
 
     def run_compiled(self, epochs: int | None = None) -> dict:
         """Whole-run fast path (train/compiled_run.py): every epoch, shuffle,
@@ -279,17 +282,31 @@ class Trainer:
             "global_step": self.strategy.global_step(self.state),
         }
 
+    def _step_incr(self, step_before: int, batch_count: int) -> int:
+        """Global-step advance per batch of the epoch just run — derived
+        from the counter itself (num_replicas under async, 1 under sync)."""
+        return (self.strategy.global_step(self.state) - step_before) // max(
+            batch_count, 1
+        )
+
     def _emit_step_logs(
-        self, costs, epoch: int, step_offset: int, avg_ms: float, logger: StepLogger
+        self,
+        costs,
+        epoch: int,
+        step_offset: int,
+        avg_ms: float,
+        logger: StepLogger,
+        step_incr: int = 1,
     ) -> None:
         """Post-hoc reference-cadence step lines + cost scalars from a
         compiled dispatch's returned per-step costs (shared by the scanned
-        and whole-run fast paths)."""
+        and whole-run fast paths). ``step_incr`` is the global-step advance
+        per batch (num_replicas under async, 1 under sync)."""
         batch_count = len(costs)
         for i in range(batch_count):
             if logger.is_due(i + 1, batch_count):
                 logger.log_step_line(
-                    step=step_offset + i + 1,
+                    step=step_offset + (i + 1) * step_incr,
                     epoch=epoch,
                     batch=i,
                     batch_count=batch_count,
@@ -299,7 +316,7 @@ class Trainer:
         if self.summary_writer is not None and self.is_chief:
             for i in range(batch_count):
                 self.summary_writer.add_scalar(
-                    "cost", float(costs[i]), step_offset + i + 1
+                    "cost", float(costs[i]), step_offset + (i + 1) * step_incr
                 )
 
     def write_graph(self) -> None:

@@ -45,16 +45,23 @@ def test_convergence_oracle_100_epochs(datasets):
 
 
 def test_async_beats_sync_at_equal_epochs(datasets):
+    # scan_epoch on both arms: the oracle doubles as a convergence check of
+    # the compiled epoch paths (sync GSPMD scan; async local scans + pmean
+    # exchange rounds).
     mesh = make_mesh((2, 1))
     epochs = 40
     sync = Trainer(
-        MLP(), datasets, TrainConfig(), strategy=SyncDataParallel(mesh), **_QUIET
+        MLP(),
+        datasets,
+        TrainConfig(scan_epoch=True),
+        strategy=SyncDataParallel(mesh),
+        **_QUIET,
     )
     sync_acc = _train_epochs(sync, epochs)
     asyn = Trainer(
         MLP(),
         datasets,
-        TrainConfig(),
+        TrainConfig(scan_epoch=True),
         strategy=AsyncDataParallel(mesh, avg_every=50),
         **_QUIET,
     )

@@ -45,7 +45,7 @@ def test_suite_runs_grid_on_virtual_mesh(small_datasets):
     by_name = {r["row"]: r for r in results}
     assert by_name["sync-8"]["devices"] == 8
     assert by_name["sync-8"]["mode"] == "scan"
-    assert by_name["async-2"]["mode"] == "eager"
+    assert by_name["async-2"]["mode"] == "scan"  # async gained a scanned path
     assert by_name["zero-2"]["mode"] == "eager"
     json.dumps(results)  # machine-readable
 

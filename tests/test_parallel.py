@@ -129,3 +129,29 @@ def test_model_axis_tensor_parallel_compiles(batch):
     state_s, costs_s = _run_steps(SingleDevice(), batch)
     state_d, costs_d = _run_steps(SyncDataParallel(mesh42), batch)
     np.testing.assert_allclose(costs_s, costs_d, rtol=2e-4)
+
+
+def test_async_divergence_metric(small_datasets):
+    """Race observability: 0 at init and after exchange, >0 between."""
+    import numpy as np
+
+    from distributed_tensorflow_tpu.models import MLP
+    from distributed_tensorflow_tpu.ops import cross_entropy, sgd
+    from distributed_tensorflow_tpu.parallel import AsyncDataParallel, make_mesh
+
+    strat = AsyncDataParallel(make_mesh((4, 1)), avg_every=0)
+    model = MLP(hidden_dim=16, compute_dtype=jnp.float32)
+    state = strat.init_state(model, sgd(0.01), seed=1)
+    div = strat.make_divergence_fn()
+    assert float(div(state)) == 0.0
+
+    step = strat.make_train_step(model, cross_entropy, sgd(0.01))
+    rng = np.random.default_rng(0)
+    x = rng.random((100, 784), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 100)]
+    state, _ = step(state, *strat.prepare_batch(x, y))
+    drift = float(div(state))
+    assert drift > 0.0  # different per-chip data -> copies drifted
+
+    state = strat.make_exchange_fn()(state)
+    assert float(div(state)) < 1e-6  # exchange collapses the race
