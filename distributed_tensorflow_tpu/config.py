@@ -94,6 +94,9 @@ class TrainConfig:
     # Average grads over N micro-steps, apply once. Note: global_step counts
     # micro-steps (one per train_step call), not applies, when N > 1.
     accumulate_steps: int = 1
+    # Global-norm gradient clipping; 0 disables (reference parity — the
+    # reference's naive loss has no gradient guard and can diverge).
+    grad_clip_norm: float = 0.0
     # "naive" = reference parity (CE over softmax probabilities, NaN-guarded,
     # reference tfsingle.py:44-45); "stable" = logits-based log-softmax CE.
     loss: str = "naive"

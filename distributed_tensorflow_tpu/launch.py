@@ -138,7 +138,10 @@ def build_trainer(
             warmup_steps=config.warmup_steps,
         )
         optimizer = optim_lib.accumulate(
-            optim_lib.make(config.optimizer, lr), config.accumulate_steps
+            optim_lib.clip(
+                optim_lib.make(config.optimizer, lr), config.grad_clip_norm
+            ),
+            config.accumulate_steps,
         )
     if loss_fn is None:
         from distributed_tensorflow_tpu.ops import losses as losses_lib

@@ -103,3 +103,17 @@ def accumulate(
     if every <= 1:
         return optimizer
     return optax.MultiSteps(optimizer, every_k_schedule=every)
+
+
+def clip(
+    optimizer: optax.GradientTransformation, max_norm: float
+) -> optax.GradientTransformation:
+    """Global-norm gradient clipping ahead of ``optimizer`` (no reference
+    analog — the reference's naive ``log(softmax)`` loss can emit huge
+    gradients near saturated probabilities, reference tfsingle.py:44-45,
+    and simply diverges; this is the standard guard). ``max_norm <= 0``
+    disables, returning the optimizer unchanged so the reference-parity
+    path is untouched."""
+    if max_norm <= 0:
+        return optimizer
+    return optax.chain(optax.clip_by_global_norm(max_norm), optimizer)

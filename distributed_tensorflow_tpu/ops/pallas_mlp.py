@@ -36,18 +36,12 @@ from distributed_tensorflow_tpu.models.mlp import MLPParams
 _LOG_EPS = 1e-30
 
 
-def _fused_train_kernel(
-    x_ref, y_ref, w1_ref, b1_ref, w2_ref, b2_ref,
-    nw1_ref, nb1_ref, nw2_ref, nb2_ref, cost_ref,
-    *, lr: float,
-):
-    x = x_ref[:]
-    y = y_ref[:]
-    w1 = w1_ref[:]
-    b1 = b1_ref[:]
-    w2 = w2_ref[:]
-    b2 = b2_ref[:]
-
+def _mlp_sgd_math(x, y, w1, b1, w2, b2, lr: float):
+    """The fwd/loss/bwd/SGD math shared by both kernels (one source of
+    truth — the scan-vs-epoch equivalence test depends on it). Shapes stay
+    2-D throughout: Mosaic's vector layouts are (sublane, lane)-tiled and
+    1-D intermediates trip relayout bugs. Returns (nw1, nb1, nw2, nb2,
+    cost_scalar)."""
     # Forward (MXU matmuls, f32 accumulation).
     z1 = jnp.dot(x, w1, preferred_element_type=jnp.float32) + b1
     h = jax.nn.sigmoid(z1)
@@ -55,13 +49,11 @@ def _fused_train_kernel(
     p = jax.nn.softmax(logits, axis=-1)
 
     # The reference's naive CE (NaN-guarded), reference tfsingle.py:44-45.
-    # Shapes stay 2-D throughout: Mosaic's vector layouts are (sublane,
-    # lane)-tiled and 1-D intermediates trip relayout bugs.
     inv_b = 1.0 / x.shape[0]
     per_example = -jnp.sum(
         y * jnp.log(jnp.maximum(p, _LOG_EPS)), axis=-1, keepdims=True
     )
-    cost_ref[0, 0] = jnp.sum(per_example) * inv_b
+    cost = jnp.sum(per_example) * inv_b
     dlogits = (p - y) * inv_b
     dw2 = jnp.dot(h.T, dlogits, preferred_element_type=jnp.float32)
     db2 = jnp.sum(dlogits, axis=0, keepdims=True)
@@ -71,10 +63,22 @@ def _fused_train_kernel(
     db1 = jnp.sum(dz1, axis=0, keepdims=True)
 
     # Fused SGD apply (C10 semantics: plain SGD, reference tfdist_between.py:64-66).
-    nw1_ref[:] = w1 - lr * dw1
-    nb1_ref[:] = b1 - lr * db1
-    nw2_ref[:] = w2 - lr * dw2
-    nb2_ref[:] = b2 - lr * db2
+    return w1 - lr * dw1, b1 - lr * db1, w2 - lr * dw2, b2 - lr * db2, cost
+
+
+def _fused_train_kernel(
+    x_ref, y_ref, w1_ref, b1_ref, w2_ref, b2_ref,
+    nw1_ref, nb1_ref, nw2_ref, nb2_ref, cost_ref,
+    *, lr: float,
+):
+    nw1, nb1, nw2, nb2, cost = _mlp_sgd_math(
+        x_ref[:], y_ref[:], w1_ref[:], b1_ref[:], w2_ref[:], b2_ref[:], lr
+    )
+    cost_ref[0, 0] = cost
+    nw1_ref[:] = nw1
+    nb1_ref[:] = nb1
+    nw2_ref[:] = nw2
+    nb2_ref[:] = nb2
 
 
 class FusedState(NamedTuple):
@@ -170,5 +174,100 @@ def make_fused_scanned_fn(
             return state, cost
 
         return jax.lax.scan(body, state, (xs, ys))
+
+    return run
+
+
+def _epoch_kernel(
+    x_ref, y_ref, w1_ref, b1_ref, w2_ref, b2_ref,
+    nw1_ref, nb1_ref, nw2_ref, nb2_ref, cost_ref,
+    *, lr: float,
+):
+    """Grid step i = SGD step i of the epoch. Params live in the *output*
+    VMEM blocks (constant index map → resident across the whole grid, never
+    round-tripping HBM between steps); each step streams only its batch
+    block in. First iteration seeds the output blocks from the inputs."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _seed():
+        nw1_ref[:] = w1_ref[:]
+        nb1_ref[:] = b1_ref[:]
+        nw2_ref[:] = w2_ref[:]
+        nb2_ref[:] = b2_ref[:]
+
+    nw1, nb1, nw2, nb2, cost = _mlp_sgd_math(
+        x_ref[0], y_ref[0], nw1_ref[:], nb1_ref[:], nw2_ref[:], nb2_ref[:], lr
+    )
+    # Costs are written into (8, 128) VMEM blocks — the smallest f32 tile
+    # TPU block specs allow — grouped 8 steps per block (index map i // 8):
+    # the block stays resident across its 8 revisits, each step storing its
+    # lane-broadcast scalar into sublane i % 8. The host reads [:, 0].
+    cost_ref[pl.ds(i % 8, 1), :] = jnp.broadcast_to(
+        cost, (1, cost_ref.shape[1])
+    )
+    nw1_ref[:] = nw1
+    nb1_ref[:] = nb1
+    nw2_ref[:] = nw2
+    nb2_ref[:] = nb2
+
+
+def make_fused_epoch_fn(
+    *,
+    steps: int,
+    batch_size: int,
+    in_dim: int = 784,
+    hidden_dim: int = 100,
+    out_dim: int = 10,
+    learning_rate: float = 0.001,
+    interpret: bool | None = None,
+):
+    """Build ``run(state, xs, ys) -> (state, costs)`` where the WHOLE epoch
+    (or several concatenated epochs) is ONE kernel launch: ``grid=(steps,)``
+    walks the staged batches, parameters stay VMEM-resident across every
+    step (constant-index-map output blocks), and per-step HBM traffic is
+    exactly the batch read plus one scalar cost write — strictly less than
+    the scan-of-kernels path, which re-reads and re-writes the params each
+    step. ``xs``/``ys`` are ``[steps, batch, ...]`` f32.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    f32 = jnp.float32
+    full = lambda *shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape))
+    call = pl.pallas_call(
+        partial(_epoch_kernel, lr=learning_rate),
+        grid=(steps,),
+        in_specs=[
+            pl.BlockSpec((1, batch_size, in_dim), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, batch_size, out_dim), lambda i: (i, 0, 0)),
+            full(in_dim, hidden_dim),
+            full(1, hidden_dim),
+            full(hidden_dim, out_dim),
+            full(1, out_dim),
+        ],
+        out_specs=(
+            full(in_dim, hidden_dim),
+            full(1, hidden_dim),
+            full(hidden_dim, out_dim),
+            full(1, out_dim),
+            pl.BlockSpec((8, 128), lambda i: (i // 8, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((in_dim, hidden_dim), f32),
+            jax.ShapeDtypeStruct((1, hidden_dim), f32),
+            jax.ShapeDtypeStruct((hidden_dim, out_dim), f32),
+            jax.ShapeDtypeStruct((1, out_dim), f32),
+            jax.ShapeDtypeStruct((-(-steps // 8) * 8, 128), f32),
+        ),
+        interpret=interpret,
+    )
+
+    @partial(jax.jit, donate_argnums=0)
+    def run(state: FusedState, xs: jax.Array, ys: jax.Array):
+        nw1, nb1, nw2, nb2, costs = call(
+            xs.astype(f32), ys.astype(f32), state.w1, state.b1, state.w2, state.b2
+        )
+        return FusedState(nw1, nb1, nw2, nb2), costs[:steps, 0]
 
     return run

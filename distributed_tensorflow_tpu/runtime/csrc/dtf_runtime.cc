@@ -287,4 +287,39 @@ void dtf_worker_stop(void* h) {
   delete w;
 }
 
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli) + TFRecord masking — the checksum the TFRecord/tfevents
+// format requires (the reference's FileWriter computed it inside TF's C++
+// core). Table-driven; the Python writer (utils/summary.py) calls this and
+// falls back to its pure-Python table when the library is unavailable.
+
+struct Crc32cTable {
+  uint32_t t[256];
+  Crc32cTable() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t crc = i;
+      for (int k = 0; k < 8; ++k)
+        crc = (crc & 1) ? (crc >> 1) ^ 0x82F63B78u : crc >> 1;
+      t[i] = crc;
+    }
+  }
+};
+
+uint32_t dtf_crc32c(const uint8_t* data, size_t n) {
+  // Meyers singleton: thread-safe one-time init (ctypes calls drop the
+  // GIL, so first-use can race across threads).
+  static const Crc32cTable table;
+  const uint32_t* crc32c_table = table.t;
+  uint32_t crc = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i)
+    crc = crc32c_table[(crc ^ data[i]) & 0xFFu] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+// TFRecord "masked" crc: rotate right 15 + magic constant.
+uint32_t dtf_crc32c_masked(const uint8_t* data, size_t n) {
+  uint32_t crc = dtf_crc32c(data, n);
+  return ((crc >> 15) | (crc << 17)) + 0xA282EAD8u;
+}
+
 }  // extern "C"

@@ -95,6 +95,10 @@ def load_library() -> ctypes.CDLL:
         ]
         lib.dtf_worker_stop.restype = None
         lib.dtf_worker_stop.argtypes = [ctypes.c_void_p]
+        lib.dtf_crc32c.restype = ctypes.c_uint32
+        lib.dtf_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.dtf_crc32c_masked.restype = ctypes.c_uint32
+        lib.dtf_crc32c_masked.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
 
         _lib = lib
         return lib
@@ -221,3 +225,17 @@ class HeartbeatWorker:
 
     def __exit__(self, *exc):
         self.stop()
+
+
+# ---------------------------------------------------------------------------
+# TFRecord checksum bindings (utils/summary.py's hot path)
+# ---------------------------------------------------------------------------
+
+
+def crc32c(data: bytes) -> int:
+    return int(load_library().dtf_crc32c(data, len(data)))
+
+
+def crc32c_masked(data: bytes) -> int:
+    """TFRecord-masked CRC32C (rotate-right-15 + magic), computed natively."""
+    return int(load_library().dtf_crc32c_masked(data, len(data)))

@@ -49,9 +49,30 @@ def crc32c(data: bytes) -> int:
     return crc ^ 0xFFFFFFFF
 
 
-def _masked_crc(data: bytes) -> int:
+def _masked_crc_py(data: bytes) -> int:
     crc = crc32c(data)
     return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    """TFRecord-masked CRC32C. Uses the native runtime when available
+    (runtime/csrc/dtf_runtime.cc — the reference's FileWriter computed this
+    inside TF's C++ core); the pure-Python table otherwise. The per-record
+    checksum runs twice per batch for 55k batches, so the native path
+    matters on the eager loop."""
+    global _masked_crc_impl
+    if _masked_crc_impl is None:
+        try:
+            from distributed_tensorflow_tpu.runtime.native import crc32c_masked
+
+            crc32c_masked(b"probe")  # force library load now
+            _masked_crc_impl = crc32c_masked
+        except (ImportError, OSError):
+            _masked_crc_impl = _masked_crc_py
+    return _masked_crc_impl(data)
+
+
+_masked_crc_impl = None
 
 
 # ---------------------------------------------------------------------------

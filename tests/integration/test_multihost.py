@@ -50,6 +50,23 @@ x = jax.make_array_from_process_local_data(
 y = jax.make_array_from_process_local_data(
     sharding, np.eye(10, dtype=np.float32)[rng.integers(0, 10, n // 2)], (n, 10))
 state, cost = step(state, x, y)
+
+# Scanned-epoch dispatch across both processes: [steps, n, ...] staged with
+# the batch dim sharded over the cross-process 'data' axis, 3 steps in one
+# GSPMD program.
+scan_fn = strat.make_scanned_train_fn(model, cross_entropy, sgd(0.001))
+steps = 3
+xs = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P(None, "data")),
+    rng.random((steps, n // 2, 784), dtype=np.float32), (steps, n, 784))
+ys = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P(None, "data")),
+    np.eye(10, dtype=np.float32)[rng.integers(0, 10, steps * n // 2)].reshape(steps, n // 2, 10),
+    (steps, n, 10))
+state, costs = scan_fn(state, xs, ys)
+costs = jax.device_get(costs)
+assert costs.shape == (steps,) and np.isfinite(costs).all(), costs
+
 print("MULTIHOST_OK", task, float(jax.device_get(cost)))
 """
 

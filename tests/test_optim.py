@@ -123,3 +123,39 @@ def test_warmup_decay_completes_by_total_steps():
     for name, floor in (("linear", 0.0), ("cosine", 0.0)):
         s = schedule(name, 0.1, 1000, warmup_steps=500)
         assert float(s(1000)) == pytest.approx(floor, abs=1e-6)
+
+
+def test_clip_bounds_update_norm():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_tensorflow_tpu.ops import optim as optim_lib
+
+    opt = optim_lib.clip(optax.sgd(1.0), max_norm=1.0)
+    params = {"w": jnp.zeros(4)}
+    grads = {"w": jnp.full(4, 100.0)}  # norm 200
+    state = opt.init(params)
+    updates, _ = opt.update(grads, state, params)
+    norm = float(jnp.linalg.norm(updates["w"]))
+    assert abs(norm - 1.0) < 1e-5  # clipped to the global-norm bound
+
+    # Disabled (<=0) returns the optimizer unchanged: parity path untouched.
+    base = optax.sgd(1.0)
+    un = optim_lib.clip(base, 0.0)
+    assert un is base
+    u2, _ = un.update(grads, un.init(params), params)
+    assert float(jnp.linalg.norm(u2["w"])) > 100.0
+
+
+def test_grad_clip_knob_through_launcher(small_datasets):
+    from distributed_tensorflow_tpu.config import TrainConfig
+    from distributed_tensorflow_tpu.launch import build_trainer
+
+    tr = build_trainer(
+        TrainConfig(grad_clip_norm=0.5, logs_path="", epochs=1),
+        datasets=small_datasets,
+        print_fn=lambda *a: None,
+    )
+    res = tr.run(epochs=1)
+    assert 0.0 <= res["accuracy"] <= 1.0

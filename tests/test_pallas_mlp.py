@@ -57,3 +57,37 @@ def test_fused_round_trip_layout():
     back = from_fused(to_fused(params))
     np.testing.assert_array_equal(np.asarray(back.b1), np.asarray(params.b1))
     assert back.b1.shape == (100,)
+
+
+def test_epoch_kernel_matches_scan_of_step_kernels():
+    """One grid launch (params VMEM-resident) == scan of per-step kernels."""
+    import numpy as np
+
+    from distributed_tensorflow_tpu.models import MLP
+    from distributed_tensorflow_tpu.ops.pallas_mlp import (
+        make_fused_epoch_fn,
+        make_fused_scanned_fn,
+        to_fused,
+    )
+
+    steps, B = 6, 32
+    rng = np.random.default_rng(0)
+    xs = rng.random((steps, B, 784), dtype=np.float32)
+    ys = np.eye(10, dtype=np.float32)[rng.integers(0, 10, steps * B)].reshape(
+        steps, B, 10
+    )
+
+    s1 = to_fused(MLP().init(seed=1))
+    run_scan = make_fused_scanned_fn(batch_size=B, learning_rate=0.01)
+    s1, costs1 = run_scan(s1, jnp.asarray(xs), jnp.asarray(ys))
+
+    s2 = to_fused(MLP().init(seed=1))
+    run_epoch = make_fused_epoch_fn(steps=steps, batch_size=B, learning_rate=0.01)
+    s2, costs2 = run_epoch(s2, jnp.asarray(xs), jnp.asarray(ys))
+
+    assert costs2.shape == (steps,)
+    np.testing.assert_allclose(np.asarray(costs2), np.asarray(costs1), rtol=1e-5)
+    for a, b in zip(s2, s1):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )

@@ -125,3 +125,18 @@ def test_heartbeat_failure_detection():
     with native.HeartbeatCoordinator(port + 1, expected_workers=3, timeout_ms=500) as c2:
         assert c2.failed_count() == 0
         assert c2.ms_since_seen(2) == -1
+
+
+def test_native_crc32c_matches_python_table():
+    pytest.importorskip("distributed_tensorflow_tpu.runtime.native")
+    from distributed_tensorflow_tpu.runtime import native
+    from distributed_tensorflow_tpu.utils import summary as s
+
+    if not native.available():
+        pytest.skip("native runtime unavailable")
+    rng = np.random.default_rng(0)
+    cases = [b"", b"a", b"hello tfrecord", bytes(rng.integers(0, 256, 4096, dtype=np.uint8))]
+    cases.append(b"with\x00embedded\x00nuls")
+    for data in cases:
+        assert native.crc32c(data) == s.crc32c(data), data[:16]
+        assert native.crc32c_masked(data) == s._masked_crc_py(data), data[:16]
