@@ -26,8 +26,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import sys
-from functools import partial
 
 import jax
 import jax.numpy as jnp
