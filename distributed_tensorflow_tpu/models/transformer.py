@@ -6,9 +6,12 @@ protocol (models/base.py), same Trainer/strategies, but the forward pass has
 a real sequence dimension whose attention can run:
 
 - dense on one device (``apply``), or
-- **ring sequence-parallel** over a ``seq`` mesh axis
+- **sequence-parallel** over a ``seq`` mesh axis
   (``apply_sequence_parallel``): activations sharded along the sequence,
-  attention via ``ops/ring_attention.ring_attention`` — identical math.
+  attention selectable between the ppermute **ring**
+  (``ops/ring_attention.ring_attention``) and the all-to-all **Ulysses**
+  (``ops/ring_attention.ulysses_attention``) algorithms — identical math
+  either way.
 
 The MNIST workload maps onto it by treating each image as a 28-token
 sequence of 28-pixel rows (no new data pipeline needed). Architecture:
@@ -24,7 +27,11 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from distributed_tensorflow_tpu.ops.ring_attention import dense_attention, ring_attention
+from distributed_tensorflow_tpu.ops.ring_attention import (
+    dense_attention,
+    ring_attention,
+    ulysses_attention,
+)
 
 
 class TransformerParams(NamedTuple):
@@ -150,12 +157,22 @@ class TransformerClassifier:
         return self._head_probs(params, h)
 
     def apply_sequence_parallel(
-        self, params: TransformerParams, x: jax.Array, axis_name: str = "seq"
+        self,
+        params: TransformerParams,
+        x: jax.Array,
+        axis_name: str = "seq",
+        *,
+        attention: str = "ring",
     ) -> jax.Array:
         """Sequence-parallel forward *body*: call inside ``jax.shard_map``
         with x sharded [B, (seq_len/n)*token_dim] per device and params
-        replicated. Attention runs as a ppermute ring; the mean-pool is a
-        cross-device pmean. Math identical to :meth:`apply`."""
+        replicated. ``attention`` selects the SP algorithm — ``"ring"``
+        (ppermute KV rotation, bandwidth ∝ sequence) or ``"ulysses"``
+        (all-to-all seq↔heads reshard, needs heads divisible by the axis
+        size); the mean-pool is a cross-device pmean either way. Math
+        identical to :meth:`apply` for both."""
+        if attention not in ("ring", "ulysses"):
+            raise ValueError(f"unknown attention {attention!r}; ring|ulysses")
         n = jax.lax.axis_size(axis_name)
         my = jax.lax.axis_index(axis_name)
         l_loc = self.seq_len // n
@@ -164,7 +181,15 @@ class TransformerClassifier:
         pos = jax.lax.dynamic_slice_in_dim(params.pos, my * l_loc, l_loc, axis=0)
         h = self._dot(tokens, params.embed) + pos
         q, k, v = self._qkv(params, h)
-        attn = ring_attention(q, k, v, axis_name)
+        if attention == "ring":
+            attn = ring_attention(q, k, v, axis_name)
+        else:
+            if self.num_heads % n:
+                raise ValueError(
+                    f"ulysses needs heads ({self.num_heads}) divisible by "
+                    f"the {axis_name!r} axis size ({n})"
+                )
+            attn = ulysses_attention(q, k, v, axis_name)
         h = self._post_attention(params, h, attn)
         pooled = jax.lax.pmean(h.mean(axis=1), axis_name)
         logits = self._dot(pooled, params.w_head) + params.b_head

@@ -4,6 +4,7 @@ standard Trainer."""
 
 import jax
 import jax.numpy as jnp
+import pytest
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
@@ -25,7 +26,9 @@ def test_protocol_and_shapes():
     np.testing.assert_allclose(np.asarray(probs.sum(-1)), 1.0, rtol=1e-5)
 
 
-def test_sequence_parallel_matches_dense():
+@pytest.mark.parametrize("attention", ["ring", "ulysses"])
+def test_sequence_parallel_matches_dense(attention):
+    # 4 devices = 4 heads, so ulysses' heads-divisibility holds too.
     model = TransformerClassifier(compute_dtype=jnp.float32)
     params = model.init(seed=1)
     x = np.random.default_rng(0).random((4, 784), dtype=np.float32)
@@ -35,7 +38,9 @@ def test_sequence_parallel_matches_dense():
     # x sharded along the flattened sequence: [B, 784] → 4 x [B, 196].
     fn = jax.jit(
         jax.shard_map(
-            lambda p, x: model.apply_sequence_parallel(p, x, "seq"),
+            lambda p, x: model.apply_sequence_parallel(
+                p, x, "seq", attention=attention
+            ),
             mesh=mesh,
             in_specs=(P(), P(None, "seq")),
             out_specs=P(),
