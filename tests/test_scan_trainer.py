@@ -108,3 +108,22 @@ def test_async_scan_epoch_through_trainer(small_datasets):
     assert any(l.startswith("Step:") for l in lines)
     costs = [float(l.split("Cost:")[1].split(",")[0]) for l in lines if "Cost:" in l]
     assert np.isfinite(costs).all()
+
+
+def test_lstm_scan_epoch_through_trainer(small_datasets):
+    """The scanned-epoch path is model-agnostic: the recurrent family (its
+    own lax.scan inside the step) nests inside the epoch scan."""
+    from distributed_tensorflow_tpu.config import TrainConfig
+    from distributed_tensorflow_tpu.models import LSTMClassifier
+    from distributed_tensorflow_tpu.train.trainer import Trainer
+
+    trainer = Trainer(
+        LSTMClassifier(hidden_dim=16, compute_dtype=jnp.float32),
+        small_datasets,
+        TrainConfig(batch_size=100, learning_rate=0.5, epochs=1,
+                    log_frequency=40, scan_epoch=True),
+        print_fn=lambda *a: None,
+    )
+    result = trainer.run()
+    assert result["global_step"] == small_datasets.train.num_examples // 100
+    assert 0.0 <= result["accuracy"] <= 1.0
