@@ -97,6 +97,10 @@ class TrainConfig:
     # Global-norm gradient clipping; 0 disables (reference parity — the
     # reference's naive loss has no gradient guard and can diverge).
     grad_clip_norm: float = 0.0
+    # Rematerialization (jax.checkpoint on the model forward): recompute
+    # activations in the backward pass instead of storing them — trades MXU
+    # FLOPs for HBM activation memory. Gradients unchanged.
+    remat: bool = False
     # "naive" = reference parity (CE over softmax probabilities, NaN-guarded,
     # reference tfsingle.py:44-45); "stable" = logits-based log-softmax CE.
     loss: str = "naive"

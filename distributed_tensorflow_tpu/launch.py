@@ -82,6 +82,29 @@ def build_strategy(config: TrainConfig, *, devices=None, mesh=None):
     return AsyncDataParallel(mesh, avg_every=config.async_avg_every)
 
 
+class _RematAdapter:
+    """Applies ``jax.checkpoint`` to the model forward: activations are
+    recomputed during the backward pass instead of stored — the standard
+    TPU trade of MXU FLOPs for HBM activation memory. Gradients are
+    mathematically identical (tests/test_launch.py proves bitwise-close);
+    only peak memory and backward-pass FLOPs change. No reference analog
+    (TF1 stored everything)."""
+
+    def __init__(self, model):
+        self._model = model
+        self._apply = jax.checkpoint(model.apply)
+        if hasattr(model, "apply_logits"):
+            # Keep the stable-loss path remat'd too (loss="stable" wraps
+            # apply_logits via _LogitsAdapter after this adapter).
+            self.apply_logits = jax.checkpoint(model.apply_logits)
+
+    def __getattr__(self, name):
+        return getattr(self._model, name)
+
+    def apply(self, params, x):
+        return self._apply(params, x)
+
+
 class _LogitsAdapter:
     """Presents ``apply_logits`` as ``apply`` so the logits-based stable
     loss composes with the strategy stack (accuracy argmax is unchanged)."""
@@ -117,6 +140,8 @@ def build_trainer(
         model = build_model(
             config.model, compute_dtype=jnp.dtype(config.compute_dtype)
         )
+    if config.remat:
+        model = _RematAdapter(model)
     datasets = datasets or read_data_sets(data_dir, one_hot=True)
     strategy = strategy or build_strategy(config)
     if optimizer is None:
