@@ -38,7 +38,16 @@ from distributed_tensorflow_tpu.config import ClusterConfig, TrainConfig  # noqa
 _LAZY_EXPORTS = {
     "MLP": ("distributed_tensorflow_tpu.models", "MLP"),
     "CNN": ("distributed_tensorflow_tpu.models", "CNN"),
+    "LSTMClassifier": ("distributed_tensorflow_tpu.models", "LSTMClassifier"),
+    "TransformerClassifier": (
+        "distributed_tensorflow_tpu.models",
+        "TransformerClassifier",
+    ),
     "build_model": ("distributed_tensorflow_tpu.models", "build_model"),
+    "ShardedDataParallel": (
+        "distributed_tensorflow_tpu.parallel",
+        "ShardedDataParallel",
+    ),
     "Predictor": ("distributed_tensorflow_tpu.inference", "Predictor"),
     "read_data_sets": ("distributed_tensorflow_tpu.data", "read_data_sets"),
     "make_mesh": ("distributed_tensorflow_tpu.parallel", "make_mesh"),
