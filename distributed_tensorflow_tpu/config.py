@@ -122,7 +122,9 @@ class TrainConfig:
     # test eval — into one dispatch (train/compiled_run.py). Same observable
     # surface as the eager loop; the shuffle moves from host numpy to the
     # on-device PRNG (distributionally equivalent). Wins whenever dispatch
-    # latency matters. Same strategy support as scan_epoch.
+    # latency matters. Supported by the single-device, sync-DP (GSPMD), and
+    # async strategies (the async variant compiles every chip's local
+    # stream, the exchanges, and the mean-params evals into the program).
     compiled_run: bool = False
     # Keep N device-placed batches in flight in the eager per-batch loop
     # (data/prefetch.py): batch i+1's host→device transfer overlaps step i's

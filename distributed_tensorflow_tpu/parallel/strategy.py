@@ -71,6 +71,43 @@ def _to_varying(a: jax.Array, axis_name: str) -> jax.Array:
     return jax.lax.pvary(a, axis_name)
 
 
+def _scan_with_exchange(step, carry, xs, steps: int, avg_every: int):
+    """Scan ``step`` over the leading axis of the pytree ``xs`` (length
+    ``steps``), pmean-exchanging the params element of ``carry`` every
+    ``avg_every`` iterations — the async emulation's local-stream +
+    periodic-exchange cadence as one compiled structure. Exchange happens
+    after every full round (including an epoch-final one when the count
+    divides); a non-dividing remainder of steps runs after the last
+    exchange. Must run inside ``shard_map`` over ``'data'``."""
+    if avg_every and steps >= avg_every:
+        rounds = steps // avg_every
+        head = rounds * avg_every
+
+        def round_body(carry, xs_round):
+            carry, costs = jax.lax.scan(step, carry, xs_round)
+            params, opt_state = carry
+            # pmean output is device-invariant; cast it back to the
+            # varying-over-'data' type the scan carry requires.
+            params = jax.tree.map(
+                lambda a: _to_varying(jax.lax.pmean(a, "data"), "data"),
+                params,
+            )
+            return (params, opt_state), costs
+
+        head_xs = jax.tree.map(
+            lambda a: a[:head].reshape(rounds, avg_every, *a.shape[1:]), xs
+        )
+        carry, costs = jax.lax.scan(round_body, carry, head_xs)
+        costs = costs.reshape(head)
+        if steps % avg_every:
+            carry, tail = jax.lax.scan(
+                step, carry, jax.tree.map(lambda a: a[head:], xs)
+            )
+            costs = jnp.concatenate([costs, tail])
+        return carry, costs
+    return jax.lax.scan(step, carry, xs)
+
+
 def _local_sgd_update(model, loss_fn, optimizer, scale, params, opt_state, x, y):
     """One local optimizer apply — the shared update math of the async
     eager step and the async scanned epoch (their bitwise equivalence is a
@@ -466,41 +503,11 @@ class AsyncDataParallel(Strategy):
                 )
                 return (params, opt_state), cost
 
-            steps = xs.shape[0]
-            carry = (params, opt_state)
-            if avg_every and steps >= avg_every:
-                rounds = steps // avg_every
-                head = rounds * avg_every
-
-                def round_body(carry, xy):
-                    carry, costs = jax.lax.scan(step, carry, xy)
-                    params, opt_state = carry
-                    # pmean output is device-invariant; cast it back to the
-                    # varying-over-'data' type the scan carry requires.
-                    params = jax.tree.map(
-                        lambda a: _to_varying(jax.lax.pmean(a, "data"), "data"),
-                        params,
-                    )
-                    return (params, opt_state), costs
-
-                carry, costs = jax.lax.scan(
-                    round_body,
-                    carry,
-                    (
-                        xs[:head].reshape(rounds, avg_every, *xs.shape[1:]),
-                        ys[:head].reshape(rounds, avg_every, *ys.shape[1:]),
-                    ),
-                )
-                costs = costs.reshape(head)
-                if steps % avg_every:
-                    carry, tail = jax.lax.scan(
-                        step, carry, (xs[head:], ys[head:])
-                    )
-                    costs = jnp.concatenate([costs, tail])
-            else:
-                carry, costs = jax.lax.scan(step, carry, (xs, ys))
-
+            carry, costs = _scan_with_exchange(
+                step, (params, opt_state), (xs, ys), xs.shape[0], avg_every
+            )
             params, opt_state = carry
+            steps = xs.shape[0]
             new = TrainState(
                 jax.tree.map(lambda a: a[None], params),
                 jax.tree.map(lambda a: a[None], opt_state),
@@ -543,6 +550,103 @@ class AsyncDataParallel(Strategy):
             )
 
         return divergence
+
+    # Whole-run staging (train/compiled_run.py): full dataset replicated.
+    @property
+    def replicated_sharding(self):
+        return self._repl
+
+    def make_compiled_run_fn(
+        self,
+        model,
+        loss_fn,
+        optimizer,
+        *,
+        batch_size: int,
+        epochs: int,
+        shuffle: bool = True,
+        donate: bool = True,
+    ):
+        """The WHOLE async experiment as one dispatch: every epoch of every
+        chip's local-SGD stream, the pmean exchanges, the on-device global
+        shuffles, and a per-epoch eval on the mean of the copies (what the
+        eager path's ``make_eval_fn`` evaluates — "the parameters on the
+        PS"). Same contract as train/compiled_run.py's
+        ``make_compiled_run_fn``: ``fn(state, train_x, train_y, test_x,
+        test_y, key) -> (state, {"costs": [epochs, steps], "accuracy":
+        [epochs]})`` with ``batch_size`` the *global* batch; each chip
+        consumes its 1/n slice of every global batch, matching the eager
+        trainer's batch split."""
+        scale = self.update_scale
+        avg_every = self.avg_every
+        n = self.n
+
+        def local_run(state: TrainState, train_x, train_y, test_x, test_y, key):
+            my = jax.lax.axis_index("data")
+            b_loc = batch_size // n
+            steps = train_x.shape[0] // batch_size
+            trimmed = steps * batch_size
+            params = jax.tree.map(lambda a: a[0], state.params)
+            opt_state = jax.tree.map(lambda a: a[0], state.opt_state)
+
+            def step(carry, idx_row):
+                params, opt_state = carry
+                x = jnp.take(train_x, idx_row, axis=0)
+                y = jnp.take(train_y, idx_row, axis=0)
+                params, opt_state, cost = _local_sgd_update(
+                    model, loss_fn, optimizer, scale, params, opt_state, x, y
+                )
+                return (params, opt_state), cost
+
+            def epoch_body(carry, _):
+                params, opt_state, key = carry
+                key, sub = jax.random.split(key)
+                # Same key on every chip → same global permutation; chip i
+                # takes slice i of each global batch (the eager split).
+                perm = (
+                    jax.random.permutation(sub, trimmed)
+                    if shuffle
+                    else jnp.arange(trimmed)
+                )
+                idxs = _to_varying(
+                    perm.reshape(steps, n, b_loc), "data"
+                )[:, my]
+                (params, opt_state), costs = _scan_with_exchange(
+                    step, (params, opt_state), idxs, steps, avg_every
+                )
+                eff = jax.tree.map(
+                    lambda a: jax.lax.pmean(a, "data"), params
+                )
+                acc = losses_lib.accuracy(model.apply(eff, test_x), test_y)
+                return (params, opt_state, key), (costs, acc)
+
+            (params, opt_state, _), (costs, accs) = jax.lax.scan(
+                epoch_body, (params, opt_state, key), None, length=epochs
+            )
+            new = TrainState(
+                jax.tree.map(lambda a: a[None], params),
+                jax.tree.map(lambda a: a[None], opt_state),
+                state.step + epochs * steps,
+            )
+            # costs [epochs, steps] per chip → global [epochs, steps, n];
+            # accuracy is invariant (computed from the pmean'd params).
+            return new, costs[..., None], accs
+
+        mapped = jax.shard_map(
+            local_run,
+            mesh=self.mesh,
+            in_specs=(P("data"), P(), P(), P(), P(), P()),
+            out_specs=(P("data"), P(None, None, "data"), P()),
+        )
+
+        @partial(jax.jit, donate_argnums=0 if donate else ())
+        def run(state: TrainState, train_x, train_y, test_x, test_y, key):
+            state, costs, accs = mapped(
+                state, train_x, train_y, test_x, test_y, key
+            )
+            return state, {"costs": jnp.mean(costs, axis=-1), "accuracy": accs}
+
+        return run
 
     def effective_params(self, state: TrainState):
         return jax.tree.map(lambda a: a.mean(axis=0), state.params)

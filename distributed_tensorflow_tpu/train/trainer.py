@@ -255,15 +255,23 @@ class Trainer:
         if costs.size:
             self.last_cost = costs[-1, -1]
         avg_ms = elapsed * 1000 / max(epochs * batch_count, 1)
+        # Per-batch global-step advance (num_replicas under async, 1 under
+        # sync) — derived from the counter over the whole dispatch.
+        incr = self._step_incr(step_before, epochs * batch_count)
         accuracy = 0.0
         for epoch in range(epochs):
             self._emit_step_logs(
-                costs[epoch], epoch, step_before + epoch * batch_count, avg_ms, logger
+                costs[epoch],
+                epoch,
+                step_before + epoch * batch_count * incr,
+                avg_ms,
+                logger,
+                step_incr=incr,
             )
             if self.is_chief:
                 accuracy = float(accs[epoch])
                 logger.log_epoch(test_accuracy=accuracy)
-                step_now = step_before + (epoch + 1) * batch_count
+                step_now = step_before + (epoch + 1) * batch_count * incr
                 if self.summary_writer is not None:
                     self.summary_writer.add_scalar("accuracy", accuracy, step_now)
                 self.history.append(

@@ -166,3 +166,81 @@ def test_zero_steps_degrades_gracefully(small_datasets):
     result = trainer.run_compiled()
     assert result["global_step"] == 0
     assert np.isnan(result["final_cost"])
+
+
+def test_async_compiled_run_matches_eager_async():
+    """The async whole-run compiled path reproduces the eager async loop:
+    same local streams, same exchange cadence, same final copies and
+    mean-params eval."""
+    from distributed_tensorflow_tpu.parallel import AsyncDataParallel
+
+    data = _data(n=4 * 25 * 8, n_test=40)  # 8 global steps of 4x25
+    model = _model()
+    mesh = make_mesh((4, 1))
+    strat = AsyncDataParallel(mesh, avg_every=3)
+    opt = sgd(0.01)
+
+    # Eager: shuffle==False order, per-step dispatches + exchange every 3.
+    state_e = strat.init_state(model, opt, seed=1)
+    step = strat.make_train_step(model, cross_entropy, opt)
+    exchange = strat.make_exchange_fn()
+    eval_fn = strat.make_eval_fn(model)
+    B = 4 * 25
+    eager_costs = []
+    for i in range(8):
+        bx, by = strat.prepare_batch(
+            data[0][i * B : (i + 1) * B], data[1][i * B : (i + 1) * B]
+        )
+        state_e, c = step(state_e, bx, by)
+        eager_costs.append(float(jnp.mean(c)))
+        if (i + 1) % 3 == 0:
+            state_e = exchange(state_e)
+    want_acc = float(eval_fn(state_e, jnp.asarray(data[2]), jnp.asarray(data[3])))
+
+    # Compiled: one dispatch for the whole (1-epoch) run, unshuffled.
+    state_c = strat.init_state(model, opt, seed=1)
+    fn = strat.make_compiled_run_fn(
+        model, cross_entropy, opt, batch_size=B, epochs=1, shuffle=False
+    )
+    tx, ty, ex, ey = map(jnp.asarray, data)
+    state_c, metrics = fn(state_c, tx, ty, ex, ey, jax.random.key(0))
+
+    np.testing.assert_allclose(
+        np.asarray(metrics["costs"][0]), eager_costs, rtol=1e-5
+    )
+    np.testing.assert_allclose(float(metrics["accuracy"][0]), want_acc, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(state_c.params.w1)),
+        np.asarray(jax.device_get(state_e.params.w1)),
+        rtol=1e-5,
+        atol=1e-7,
+    )
+    assert strat.global_step(state_c) == 4 * 8
+
+
+def test_async_trainer_run_compiled(small_datasets):
+    from distributed_tensorflow_tpu.config import TrainConfig
+    from distributed_tensorflow_tpu.parallel import AsyncDataParallel
+    from distributed_tensorflow_tpu.train.trainer import Trainer
+
+    mesh = make_mesh((8, 1))
+    lines = []
+    trainer = Trainer(
+        _model(),
+        small_datasets,
+        TrainConfig(batch_size=25, learning_rate=0.05, epochs=2,
+                    log_frequency=2, compiled_run=True),
+        strategy=AsyncDataParallel(mesh, avg_every=2),
+        print_fn=lambda *a: lines.append(" ".join(map(str, a))),
+    )
+    result = trainer.run()
+    steps = small_datasets.train.num_examples // (25 * 8)
+    assert result["global_step"] == 2 * steps * 8  # 8 local applies/batch
+    assert sum("Test-Accuracy" in l for l in lines) == 2
+    # Log-line step numbering matches the eager async loop (8 per batch):
+    # the final Step line of the run must equal the returned global_step.
+    last_step = max(
+        int(l.split("Step:")[1].split(",")[0]) for l in lines if "Step:" in l
+    )
+    assert last_step == result["global_step"]
+    assert trainer.history[-1]["step"] == result["global_step"]
