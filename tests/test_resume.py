@@ -67,3 +67,31 @@ def test_resume_matches_uninterrupted(small_datasets, tmp_path):
         rtol=1e-6,
         atol=1e-8,
     )
+
+
+def test_compiled_run_checkpoints_and_resumes(small_datasets, tmp_path):
+    """run_compiled saves at dispatch end; a restarted trainer restores the
+    state and continues from the saved global step."""
+    import jax.numpy as jnp
+
+    cfg = TrainConfig(
+        epochs=2,
+        log_frequency=10_000,
+        checkpoint_dir=str(tmp_path / "ck"),
+        compute_dtype="float32",
+        logs_path="",
+    )
+    model = MLP(hidden_dim=16, compute_dtype=jnp.float32)
+    t1 = Trainer(model, _datasets(small_datasets), cfg, print_fn=lambda *a: None)
+    r1 = t1.run_compiled()
+    steps = small_datasets.train.num_examples // 100
+    assert r1["global_step"] == 2 * steps
+
+    # New process simulation: fresh trainer restores from the checkpoint.
+    t2 = Trainer(model, _datasets(small_datasets), cfg, print_fn=lambda *a: None)
+    assert t2.start_step == 2 * steps
+    np.testing.assert_allclose(
+        np.asarray(t2.state.params.w1), np.asarray(t1.state.params.w1), rtol=1e-6
+    )
+    r2 = t2.run_compiled(epochs=1)  # continues: one more epoch
+    assert r2["global_step"] == 3 * steps
