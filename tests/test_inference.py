@@ -107,3 +107,19 @@ def test_from_checkpoint_without_orbax_raises(trained, tmp_path, monkeypatch):
     monkeypatch.setattr(sup, "_HAVE_ORBAX", False)
     with pytest.raises(RuntimeError, match="orbax"):
         Predictor.from_checkpoint(model, str(tmp_path / "ckpt"))
+
+
+@pytest.mark.parametrize("name", ["mlp", "cnn", "lstm", "transformer"])
+def test_predictor_serves_every_model_family(name):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_tpu.models import build_model
+
+    model = build_model(name, compute_dtype=jnp.float32)
+    p = Predictor(model, model.init(seed=1), batch_size=16)
+    x = np.random.default_rng(0).random((20, 784), dtype=np.float32)
+    probs = p.predict_proba(x)
+    assert probs.shape == (20, 10)
+    np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-5)
+    assert p.predict(x).shape == (20,)
