@@ -100,6 +100,7 @@ class Trainer:
         self.last_cost: jax.Array | None = None
         self.history: list[dict] = []
         self._graph_written = False
+        self._compiled_run_fns: dict = {}
 
         if self.config.log_placement and self.is_chief:
             from distributed_tensorflow_tpu.utils import placement
@@ -218,13 +219,21 @@ class Trainer:
             raise ValueError("run_compiled and per_worker_epoch are exclusive")
         train, test = self.datasets.train, self.datasets.test
         global_batch = cfg.batch_size * self.strategy.num_replicas
-        run_fn = self.strategy.make_compiled_run_fn(
-            self.model,
-            self.loss_fn,
-            self.optimizer,
-            batch_size=global_batch,
-            epochs=epochs,
-        )
+        # Cache per (epochs, batch): each make_compiled_run_fn call builds a
+        # fresh jit closure, so without the cache a repeated run_compiled —
+        # resume, epoch-at-a-time, benchmark warm runs — would re-trace and
+        # recompile the whole program every call.
+        key = (epochs, global_batch)
+        run_fn = self._compiled_run_fns.get(key)
+        if run_fn is None:
+            run_fn = self.strategy.make_compiled_run_fn(
+                self.model,
+                self.loss_fn,
+                self.optimizer,
+                batch_size=global_batch,
+                epochs=epochs,
+            )
+            self._compiled_run_fns[key] = run_fn
         if self.summary_writer is not None and self.is_chief and not self._graph_written:
             self.write_graph()
             self._graph_written = True

@@ -11,6 +11,7 @@ def test_row_specs_cover_reference_grid():
     rows = [r[0] for r in benchmark_suite._row_specs(8)]
     assert rows == [
         "single",
+        "single-compiled",
         "sync-2",
         "async-2",
         "zero-2",
@@ -19,8 +20,11 @@ def test_row_specs_cover_reference_grid():
         "zero-8",
         "tp-2",
     ]
-    # One chip: only the single-device row survives.
-    assert [r[0] for r in benchmark_suite._row_specs(1)] == ["single"]
+    # One chip: only the single-device rows survive.
+    assert [r[0] for r in benchmark_suite._row_specs(1)] == [
+        "single",
+        "single-compiled",
+    ]
 
 
 def test_suite_runs_grid_on_virtual_mesh(small_datasets):
@@ -89,3 +93,17 @@ def test_d2h_barrier_handles_mixed_and_empty_trees():
     d2h_barrier({})
     d2h_barrier(None)
     d2h_barrier([np.ones(2)])
+
+
+def test_single_compiled_row_runs(small_datasets):
+    results = benchmark_suite.run_suite(
+        epochs=1,
+        datasets=small_datasets,
+        rows=["single-compiled"],
+        print_fn=lambda *a: None,
+        compiled_min_epochs=1,
+    )
+    (row,) = results
+    assert row["mode"] == "whole-run"
+    assert row["epochs_timed"] == 1
+    assert row["examples_per_sec"] > 0
