@@ -65,10 +65,23 @@ class ProcessContext:
     is_chief: bool
     is_ps: bool
     heartbeat: object | None = None  # chief: HeartbeatCoordinator; worker: HeartbeatWorker
+    # Chief only: its own loopback HeartbeatWorker (the coordinator tracks
+    # all n tasks incl. task 0, and never-seen tasks count failed after the
+    # grace period — the chief must therefore report too).
+    heartbeat_sender: object | None = None
 
     @property
     def should_exit(self) -> bool:
         return self.is_ps
+
+    def close(self) -> None:
+        """Stop the native heartbeat threads (coordinator or sender, plus
+        the chief's loopback sender). Idempotent; without this a library
+        embedding that outlives training would keep UDP threads running and
+        hold the port against a later bootstrap."""
+        for h in (self.heartbeat, self.heartbeat_sender):
+            if h is not None:
+                h.stop()
 
 
 def bootstrap(
@@ -121,6 +134,7 @@ def bootstrap(
             process_id=task_index,
         )
     heartbeat = None
+    heartbeat_sender = None
     if heartbeat_port is not None and n > 1:
         try:
             from distributed_tensorflow_tpu.runtime import native
@@ -129,6 +143,20 @@ def bootstrap(
                 heartbeat = native.HeartbeatCoordinator(
                     heartbeat_port, expected_workers=n, timeout_ms=heartbeat_timeout_ms
                 )
+                # The coordinator tracks task 0 too (a never-seen task counts
+                # failed after the grace period), so the chief reports to
+                # itself over loopback. If the sender cannot start, tear the
+                # coordinator down too — returning it alone would flag the
+                # silent chief slot as failed after the grace period and
+                # abort a healthy run.
+                try:
+                    heartbeat_sender = native.HeartbeatWorker(
+                        "127.0.0.1", heartbeat_port, worker_id=task_index
+                    )
+                except (ImportError, OSError):
+                    heartbeat.stop()
+                    heartbeat = None
+                    raise
             else:
                 host = cluster.coordinator_address.rsplit(":", 1)[0]
                 heartbeat = native.HeartbeatWorker(
@@ -143,6 +171,7 @@ def bootstrap(
         is_chief=cluster.is_chief(task_index),
         is_ps=False,
         heartbeat=heartbeat,
+        heartbeat_sender=heartbeat_sender,
     )
 
 

@@ -221,6 +221,9 @@ def run(
     ctx = bootstrap_from_argv(cluster, argv)
     if ctx.should_exit:
         return None
-    trainer = build_trainer(config_from_env(config), context=ctx, **kw)
-    print("Ready to go")  # reference tfdist_between.py:76
-    return trainer.run()  # honors compiled_run / scan_epoch internally
+    try:
+        trainer = build_trainer(config_from_env(config), context=ctx, **kw)
+        print("Ready to go")  # reference tfdist_between.py:76
+        return trainer.run()  # honors compiled_run / scan_epoch internally
+    finally:
+        ctx.close()  # stop heartbeat threads (sv.stop() analog)

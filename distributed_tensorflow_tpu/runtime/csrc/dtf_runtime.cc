@@ -151,6 +151,8 @@ struct Coordinator {
   int fd = -1;
   int expected = 0;
   int timeout_ms = 0;
+  int grace_ms = 0;     // never-seen workers count failed after this
+  int64_t start_ms = 0;  // coordinator start time (grace reference point)
   std::thread thread;
   std::atomic<bool> stop{false};
   std::mutex mu;
@@ -176,11 +178,19 @@ struct Coordinator {
 
 // Starts a coordinator listening on udp://0.0.0.0:port for "HB <id>"
 // datagrams from `expected_workers` workers. A worker that has reported at
-// least once and then stays silent for `timeout_ms` counts as failed.
-void* dtf_coord_start(int port, int expected_workers, int timeout_ms) {
+// least once and then stays silent for `timeout_ms` counts as failed; a
+// worker that NEVER reports counts as failed once `grace_ms` has elapsed
+// since coordinator start (round-1 gap: a worker dead at t=0 was never
+// "failed", so a job could wait forever with failed_count()==0 — the
+// reference analog blocked in prepare_or_wait_for_session, reference
+// tfdist_between.py:83, with no timeout either; this is the upgrade).
+void* dtf_coord_start2(int port, int expected_workers, int timeout_ms,
+                       int grace_ms) {
   auto* c = new Coordinator();
   c->expected = expected_workers;
   c->timeout_ms = timeout_ms;
+  c->grace_ms = grace_ms;
+  c->start_ms = now_ms();
   c->last_seen.assign((size_t)expected_workers, 0);
   c->fd = socket(AF_INET, SOCK_DGRAM, 0);
   if (c->fd < 0) {
@@ -202,6 +212,11 @@ void* dtf_coord_start(int port, int expected_workers, int timeout_ms) {
   return c;
 }
 
+// Back-compat entry: grace defaults to 5x the silence timeout.
+void* dtf_coord_start(int port, int expected_workers, int timeout_ms) {
+  return dtf_coord_start2(port, expected_workers, timeout_ms, 5 * timeout_ms);
+}
+
 int dtf_coord_alive_count(void* h) {
   auto* c = (Coordinator*)h;
   int64_t now = now_ms();
@@ -217,8 +232,13 @@ int dtf_coord_failed_count(void* h) {
   int64_t now = now_ms();
   std::lock_guard<std::mutex> lock(c->mu);
   int failed = 0;
-  for (int64_t t : c->last_seen)
-    if (t != 0 && now - t > c->timeout_ms) ++failed;
+  for (int64_t t : c->last_seen) {
+    if (t == 0) {
+      if (now - c->start_ms > c->grace_ms) ++failed;  // never came up
+    } else if (now - t > c->timeout_ms) {
+      ++failed;  // reported, then went silent
+    }
+  }
   return failed;
 }
 

@@ -36,7 +36,10 @@ def _build() -> bool:
 
 def load_library() -> ctypes.CDLL:
     """Load (building if needed) the native library; raises ImportError if
-    unavailable so callers can fall back to pure Python."""
+    unavailable so callers can fall back to pure Python. A stale ``.so``
+    built from older sources (missing newer symbols) is rebuilt once; if
+    symbols are still missing the failure surfaces as ImportError so the
+    pure-Python fallbacks engage rather than AttributeError escaping."""
     global _lib, _tried
     with _lock:
         if _lib is not None:
@@ -49,59 +52,91 @@ def load_library() -> ctypes.CDLL:
                 raise ImportError("libdtf_runtime.so unavailable (build failed)")
         _tried = True
         lib = ctypes.CDLL(_SO)
+        try:
+            _bind(lib)
+        except AttributeError as exc:
+            # dlopen caches by pathname: close the stale mapping or the
+            # post-rebuild CDLL call would hand back the old library.
+            import _ctypes
 
-        lib.dtf_load_idx_images.restype = ctypes.c_long
-        lib.dtf_load_idx_images.argtypes = [
-            ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_float),
-            ctypes.c_long,
-        ]
-        lib.dtf_load_idx_labels.restype = ctypes.c_long
-        lib.dtf_load_idx_labels.argtypes = [
-            ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_long),
-            ctypes.c_long,
-        ]
-        lib.dtf_shuffle_perm.restype = None
-        lib.dtf_shuffle_perm.argtypes = [
-            ctypes.POINTER(ctypes.c_long),
-            ctypes.c_long,
-            ctypes.c_uint64,
-        ]
-        lib.dtf_gather_rows.restype = None
-        lib.dtf_gather_rows.argtypes = [
-            ctypes.POINTER(ctypes.c_float),
-            ctypes.POINTER(ctypes.c_long),
-            ctypes.c_long,
-            ctypes.c_long,
-            ctypes.POINTER(ctypes.c_float),
-        ]
-        lib.dtf_coord_start.restype = ctypes.c_void_p
-        lib.dtf_coord_start.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
-        lib.dtf_coord_alive_count.restype = ctypes.c_int
-        lib.dtf_coord_alive_count.argtypes = [ctypes.c_void_p]
-        lib.dtf_coord_failed_count.restype = ctypes.c_int
-        lib.dtf_coord_failed_count.argtypes = [ctypes.c_void_p]
-        lib.dtf_coord_ms_since_seen.restype = ctypes.c_long
-        lib.dtf_coord_ms_since_seen.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.dtf_coord_stop.restype = None
-        lib.dtf_coord_stop.argtypes = [ctypes.c_void_p]
-        lib.dtf_worker_start.restype = ctypes.c_void_p
-        lib.dtf_worker_start.argtypes = [
-            ctypes.c_char_p,
-            ctypes.c_int,
-            ctypes.c_int,
-            ctypes.c_int,
-        ]
-        lib.dtf_worker_stop.restype = None
-        lib.dtf_worker_stop.argtypes = [ctypes.c_void_p]
-        lib.dtf_crc32c.restype = ctypes.c_uint32
-        lib.dtf_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
-        lib.dtf_crc32c_masked.restype = ctypes.c_uint32
-        lib.dtf_crc32c_masked.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
-
+            _ctypes.dlclose(lib._handle)
+            try:
+                os.remove(_SO)
+            except OSError:
+                pass
+            if not _build():
+                raise ImportError(
+                    f"stale libdtf_runtime.so and rebuild failed: {exc}"
+                ) from exc
+            lib = ctypes.CDLL(_SO)
+            try:
+                _bind(lib)
+            except AttributeError as exc2:
+                raise ImportError(
+                    f"libdtf_runtime.so missing symbol after rebuild: {exc2}"
+                ) from exc2
         _lib = lib
         return lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    """Declare C ABI signatures; raises AttributeError on a missing symbol."""
+    lib.dtf_load_idx_images.restype = ctypes.c_long
+    lib.dtf_load_idx_images.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_long,
+    ]
+    lib.dtf_load_idx_labels.restype = ctypes.c_long
+    lib.dtf_load_idx_labels.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_long),
+        ctypes.c_long,
+    ]
+    lib.dtf_shuffle_perm.restype = None
+    lib.dtf_shuffle_perm.argtypes = [
+        ctypes.POINTER(ctypes.c_long),
+        ctypes.c_long,
+        ctypes.c_uint64,
+    ]
+    lib.dtf_gather_rows.restype = None
+    lib.dtf_gather_rows.argtypes = [
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_long),
+        ctypes.c_long,
+        ctypes.c_long,
+        ctypes.POINTER(ctypes.c_float),
+    ]
+    lib.dtf_coord_start.restype = ctypes.c_void_p
+    lib.dtf_coord_start.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.dtf_coord_start2.restype = ctypes.c_void_p
+    lib.dtf_coord_start2.argtypes = [
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.dtf_coord_alive_count.restype = ctypes.c_int
+    lib.dtf_coord_alive_count.argtypes = [ctypes.c_void_p]
+    lib.dtf_coord_failed_count.restype = ctypes.c_int
+    lib.dtf_coord_failed_count.argtypes = [ctypes.c_void_p]
+    lib.dtf_coord_ms_since_seen.restype = ctypes.c_long
+    lib.dtf_coord_ms_since_seen.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.dtf_coord_stop.restype = None
+    lib.dtf_coord_stop.argtypes = [ctypes.c_void_p]
+    lib.dtf_worker_start.restype = ctypes.c_void_p
+    lib.dtf_worker_start.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.dtf_worker_stop.restype = None
+    lib.dtf_worker_stop.argtypes = [ctypes.c_void_p]
+    lib.dtf_crc32c.restype = ctypes.c_uint32
+    lib.dtf_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.dtf_crc32c_masked.restype = ctypes.c_uint32
+    lib.dtf_crc32c_masked.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
 
 
 def available() -> bool:
@@ -177,11 +212,25 @@ def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
 
 class HeartbeatCoordinator:
     """Chief-side liveness tracker: workers that reported once and then went
-    silent past ``timeout_ms`` count as failed."""
+    silent past ``timeout_ms`` count as failed, and workers that NEVER report
+    count as failed once ``grace_ms`` (default 5x timeout) has elapsed since
+    start — so a worker dead at t=0 is detected rather than waited on forever
+    (the reference's chief blocked indefinitely in
+    ``prepare_or_wait_for_session``, reference tfdist_between.py:83)."""
 
-    def __init__(self, port: int, expected_workers: int, timeout_ms: int = 5000):
+    def __init__(
+        self,
+        port: int,
+        expected_workers: int,
+        timeout_ms: int = 5000,
+        grace_ms: int | None = None,
+    ):
         self._lib = load_library()
-        self._h = self._lib.dtf_coord_start(port, expected_workers, timeout_ms)
+        if grace_ms is None:
+            grace_ms = 5 * timeout_ms
+        self._h = self._lib.dtf_coord_start2(
+            port, expected_workers, timeout_ms, grace_ms
+        )
         if not self._h:
             raise OSError(f"failed to bind heartbeat coordinator on :{port}")
 

@@ -1,0 +1,177 @@
+"""Fault injection: kill a live worker mid-run; the chief must end the job
+cleanly with a restorable checkpoint — not hang (RUN_SLOW tier).
+
+The reference's only failure behavior was implicit: a dead worker left the
+chief's gRPC calls blocking forever, and recovery meant a *restarted* worker
+re-attaching to still-live PS state via ``prepare_or_wait_for_session``
+(reference tfdist_between.py:83). This framework upgrades that to explicit
+liveness (C++ UDP heartbeat, runtime/csrc/dtf_runtime.cc) + a
+failure-reactive Supervisor stop + real checkpoints; this test is the
+end-to-end proof:
+
+1. chief + 1 worker bootstrap with heartbeats; chief trains epoch-at-a-time
+   with checkpointing and ``Supervisor.attach_heartbeat``;
+2. the test SIGKILLs the worker mid-run;
+3. the chief's ``should_stop`` trips at the next epoch boundary → clean exit
+   (rc 0) with a ``step_N`` checkpoint on disk;
+4. a restarted trainer restores from that checkpoint and continues — the
+   re-attach semantics, now surviving chief death too.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("RUN_SLOW"), reason="fault injection smoke (set RUN_SLOW=1)"
+)
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+_CHIEF = r"""
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from distributed_tensorflow_tpu.cluster import bootstrap
+from distributed_tensorflow_tpu.config import ClusterConfig, TrainConfig
+from distributed_tensorflow_tpu.data.mnist import DataSet, Datasets
+from distributed_tensorflow_tpu.models import MLP
+from distributed_tensorflow_tpu.train import Trainer
+from distributed_tensorflow_tpu.train.supervisor import Supervisor
+from distributed_tensorflow_tpu.utils.logging import StepLogger
+
+ckpt = sys.argv[1]
+# Heartbeat-only bootstrap (async-style independent streams: the reference's
+# async workers never synchronized in-band either).
+cluster = ClusterConfig.from_lists(["127.0.0.1:29791", "127.0.0.1:29792"])
+ctx = bootstrap(cluster, "worker", 0, initialize_distributed=False,
+                heartbeat_port=19461, heartbeat_timeout_ms=1500)
+assert ctx.heartbeat is not None
+# prepare_or_wait analog: block until the worker has reported once, so the
+# never-seen grace period can't fire while the worker is still importing.
+deadline = time.time() + 120  # generous: a loaded CI host imports jax slowly
+while ctx.heartbeat.ms_since_seen(1) < 0 and time.time() < deadline:
+    time.sleep(0.1)
+assert ctx.heartbeat.ms_since_seen(1) >= 0, "worker never came up"
+
+rng = np.random.default_rng(0)
+imgs = rng.random((2000, 784), dtype=np.float32)
+labs = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 2000)]
+ds = Datasets(train=DataSet(imgs, labs, seed=1),
+              validation=None, test=DataSet(imgs[:200], labs[:200], seed=2))
+sup = Supervisor(is_chief=True, checkpoint_dir=ckpt)
+sup.attach_heartbeat(ctx.heartbeat)
+tr = Trainer(MLP(hidden_dim=16, compute_dtype=jax.numpy.float32), ds,
+             TrainConfig(epochs=10**6, scan_epoch=True, log_frequency=10**9,
+                         logs_path="", checkpoint_dir=ckpt),
+             supervisor=sup, print_fn=lambda *a: None)
+print("CHIEF_TRAINING", flush=True)
+logger = StepLogger(freq=10**9, print_fn=lambda *a: None)
+epoch = 0
+while not sup.should_stop:
+    tr.run_epoch(epoch, logger)
+    sup.save(tr.state, tr.strategy.global_step(tr.state))
+    epoch += 1
+sup.stop()
+ctx.heartbeat.stop()
+if ctx.heartbeat_sender is not None:
+    ctx.heartbeat_sender.stop()
+print("CHIEF_STOPPED", tr.strategy.global_step(tr.state), "epochs", epoch, flush=True)
+"""
+
+_WORKER = r"""
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+from distributed_tensorflow_tpu.cluster import bootstrap
+from distributed_tensorflow_tpu.config import ClusterConfig
+
+cluster = ClusterConfig.from_lists(["127.0.0.1:29791", "127.0.0.1:29792"])
+ctx = bootstrap(cluster, "worker", 1, initialize_distributed=False,
+                heartbeat_port=19461)
+assert ctx.heartbeat is not None
+print("WORKER_UP", flush=True)
+time.sleep(600)  # "training" until killed
+"""
+
+
+def test_worker_kill_stops_chief_with_restorable_checkpoint(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = env.get("PYTHONPATH", "") + os.pathsep + _REPO
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    ckpt = str(tmp_path / "ck")
+
+    chief = subprocess.Popen(
+        [sys.executable, "-c", _CHIEF, ckpt],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        env=env,
+        text=True,
+    )
+    worker = subprocess.Popen(
+        [sys.executable, "-c", _WORKER],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        env=env,
+        text=True,
+    )
+    try:
+        # Let the job reach steady state (both heartbeats up, chief training),
+        # then kill the worker without ceremony.
+        time.sleep(12)
+        worker.send_signal(signal.SIGKILL)
+        out, _ = chief.communicate(timeout=120)
+    finally:
+        for p in (chief, worker):
+            if p.poll() is None:
+                p.kill()
+    worker.wait(timeout=10)
+
+    assert chief.returncode == 0, f"chief did not exit cleanly:\n{out}"
+    assert "CHIEF_TRAINING" in out and "CHIEF_STOPPED" in out, out
+
+    # The checkpoint the chief left must be restorable and carry progress.
+    from distributed_tensorflow_tpu.config import TrainConfig
+    from distributed_tensorflow_tpu.data.mnist import DataSet, Datasets
+    from distributed_tensorflow_tpu.models import MLP
+    from distributed_tensorflow_tpu.train import Trainer
+    from distributed_tensorflow_tpu.train.supervisor import latest_checkpoint_step
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    step = latest_checkpoint_step(ckpt)
+    assert step is not None and step > 0, f"no checkpoint written (out:\n{out})"
+
+    rng = np.random.default_rng(0)
+    imgs = rng.random((2000, 784), dtype=np.float32)
+    labs = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 2000)]
+    ds = Datasets(
+        train=DataSet(imgs, labs, seed=1),
+        validation=None,
+        test=DataSet(imgs[:200], labs[:200], seed=2),
+    )
+    tr = Trainer(
+        MLP(hidden_dim=16, compute_dtype=jnp.float32),
+        ds,
+        TrainConfig(
+            epochs=1,
+            scan_epoch=True,
+            log_frequency=10**9,
+            logs_path="",
+            checkpoint_dir=ckpt,
+        ),
+        print_fn=lambda *a: None,
+    )
+    assert tr.start_step == step  # restored, not re-initialized
+    res = tr.run(epochs=1)  # restarted worker re-attaches and continues
+    assert res["global_step"] > step

@@ -121,10 +121,60 @@ def test_heartbeat_failure_detection():
         assert coord.alive_count() == 1
         assert coord.failed_count() == 1
         w0.stop()
-    # Never-seen workers are not "failed" (they may still be scheduling).
-    with native.HeartbeatCoordinator(port + 1, expected_workers=3, timeout_ms=500) as c2:
+    # Never-seen workers are not failed inside the grace period (they may
+    # still be scheduling) but ARE flagged once it elapses — a worker dead
+    # at t=0 must not stall the job forever (round-1 finding).
+    with native.HeartbeatCoordinator(
+        port + 1, expected_workers=3, timeout_ms=500, grace_ms=400
+    ) as c2:
         assert c2.failed_count() == 0
         assert c2.ms_since_seen(2) == -1
+        w0 = native.HeartbeatWorker("127.0.0.1", port + 1, worker_id=0, interval_ms=100)
+        time.sleep(0.7)  # past grace_ms: workers 1 and 2 never reported
+        assert c2.failed_count() == 2
+        assert c2.alive_count() == 1
+        w0.stop()
+
+
+def test_stale_library_missing_symbols_raises_importerror(tmp_path, monkeypatch):
+    """A .so built from older sources (missing newer symbols) must surface as
+    ImportError — so `except (ImportError, OSError)` fallbacks engage — and a
+    successful rebuild must recover (round-1 advisor finding: AttributeError
+    escaped every fallback until a manual rebuild)."""
+    import shutil
+    import subprocess
+
+    real_so = native._SO
+    native.load_library()  # ensure the real library exists on disk
+    src = tmp_path / "stub.c"
+    src.write_text(
+        "long dtf_load_idx_images(const char* p, float* o, long n)"
+        " { (void)p; (void)o; (void)n; return -1; }\n"
+    )
+    stale = tmp_path / "libdtf_runtime.so"
+
+    def make_stub():
+        subprocess.run(
+            ["gcc", "-shared", "-fPIC", "-o", str(stale), str(src)], check=True
+        )
+
+    make_stub()
+    monkeypatch.setattr(native, "_SO", str(stale))
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", False)
+
+    # Stale symbols + failing rebuild → ImportError, never AttributeError.
+    monkeypatch.setattr(native, "_build", lambda: False)
+    with pytest.raises(ImportError):
+        native.load_library()
+
+    # Stale symbols + successful rebuild → transparent recovery.
+    make_stub()
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", False)
+    monkeypatch.setattr(native, "_build", lambda: bool(shutil.copy(real_so, stale)))
+    lib = native.load_library()
+    assert lib.dtf_crc32c(b"x", 1) != 0
 
 
 def test_native_crc32c_matches_python_table():
