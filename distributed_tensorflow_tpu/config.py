@@ -116,8 +116,11 @@ class TrainConfig:
     # Compile each epoch as one lax.scan dispatch (train/scan.py): identical
     # update semantics, ~100x less host overhead. Log lines are emitted from
     # the returned per-step costs after the dispatch. Supported by the
-    # single-device and sync-DP (GSPMD) strategies.
-    scan_epoch: bool = False
+    # single-device, sync-DP (GSPMD), and async strategies. None (default)
+    # resolves by backend: True on accelerators (where per-batch dispatches
+    # pay the device-link latency 550x per epoch), False on CPU — set an
+    # explicit bool to override.
+    scan_epoch: bool | None = None
     # Compile the WHOLE run — every epoch, on-device shuffle, and per-epoch
     # test eval — into one dispatch (train/compiled_run.py). Same observable
     # surface as the eager loop; the shuffle moves from host numpy to the
@@ -126,6 +129,13 @@ class TrainConfig:
     # async strategies (the async variant compiles every chip's local
     # stream, the exchanges, and the mean-params evals into the program).
     compiled_run: bool = False
+    # Whole-run engine for compiled_run. "xla" (default): the generic
+    # train/compiled_run.py program, any model/optimizer/strategy. "pallas":
+    # the whole-epoch Pallas grid kernel inside the epoch scan
+    # (ops/pallas_mlp.py make_fused_compiled_run_fn) — bench.py's fastest
+    # engine behind the Trainer API; requires the reference workload shape
+    # (MLP + plain sgd + naive loss + SingleDevice) and raises otherwise.
+    engine: str = "xla"
     # Keep N device-placed batches in flight in the eager per-batch loop
     # (data/prefetch.py): batch i+1's host→device transfer overlaps step i's
     # compute. 0 disables (reference-parity synchronous feed).

@@ -162,7 +162,13 @@ class SingleDevice(Strategy):
 
     def init_state(self, model, optimizer, seed: int) -> TrainState:
         params = model.init(seed)
-        return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+        state = TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+        # Commit to the default device: eagerly-built arrays are uncommitted
+        # (UnspecifiedValue sharding), so the first dispatch would compile
+        # one executable and the second — whose inputs are the committed
+        # outputs of the first — would miss the jit cache and recompile
+        # (docs/performance.md, "The round-1 73-second warmup 2").
+        return jax.device_put(state, jax.devices()[0])
 
     def make_train_step(self, model, loss_fn, optimizer):
         @partial(jax.jit, donate_argnums=0)
@@ -194,6 +200,13 @@ class SingleDevice(Strategy):
         from distributed_tensorflow_tpu.train.scan import make_scanned_train_fn
 
         return make_scanned_train_fn(model, loss_fn, optimizer)
+
+    def make_indexed_scanned_train_fn(self, model, loss_fn, optimizer):
+        from distributed_tensorflow_tpu.train.scan import (
+            make_indexed_scanned_train_fn,
+        )
+
+        return make_indexed_scanned_train_fn(model, loss_fn, optimizer)
 
     def make_compiled_run_fn(self, model, loss_fn, optimizer, **kw):
         from distributed_tensorflow_tpu.train.compiled_run import make_compiled_run_fn
@@ -351,6 +364,19 @@ class SyncDataParallel(Strategy):
         from distributed_tensorflow_tpu.train.scan import make_scanned_train_fn
 
         return make_scanned_train_fn(
+            model, loss_fn, optimizer, batch_sharding=self._batch
+        )
+
+    def make_indexed_scanned_train_fn(self, model, loss_fn, optimizer):
+        if self.explicit:
+            raise NotImplementedError(
+                "scan_epoch uses the GSPMD path; explicit_collectives=False"
+            )
+        from distributed_tensorflow_tpu.train.scan import (
+            make_indexed_scanned_train_fn,
+        )
+
+        return make_indexed_scanned_train_fn(
             model, loss_fn, optimizer, batch_sharding=self._batch
         )
 
@@ -531,6 +557,60 @@ class AsyncDataParallel(Strategy):
 
         return run
 
+    def make_indexed_scanned_train_fn(self, model, loss_fn, optimizer):
+        """Indexed variant of the scanned epoch (see train/scan.py): the full
+        train arrays stay device-resident (replicated) and each chip gathers
+        its slice of every global batch by row index — ``idxs`` is
+        ``[steps, n*b_loc]`` with chip i consuming columns
+        ``[i*b_loc, (i+1)*b_loc)``, exactly the eager trainer's batch split.
+        Update semantics identical to ``make_scanned_train_fn`` over staged
+        batches of the same permutation."""
+        scale = self.update_scale
+        avg_every = self.avg_every
+        n = self.n
+
+        def local_epoch(state: TrainState, train_x, train_y, idxs):
+            my = jax.lax.axis_index("data")
+            steps = idxs.shape[0]
+            b_loc = idxs.shape[1] // n
+            params = jax.tree.map(lambda a: a[0], state.params)
+            opt_state = jax.tree.map(lambda a: a[0], state.opt_state)
+            my_idxs = _to_varying(idxs.reshape(steps, n, b_loc), "data")[:, my]
+
+            def step(carry, idx_row):
+                params, opt_state = carry
+                x = jnp.take(train_x, idx_row, axis=0)
+                y = jnp.take(train_y, idx_row, axis=0)
+                params, opt_state, cost = _local_sgd_update(
+                    model, loss_fn, optimizer, scale, params, opt_state, x, y
+                )
+                return (params, opt_state), cost
+
+            carry, costs = _scan_with_exchange(
+                step, (params, opt_state), my_idxs, steps, avg_every
+            )
+            params, opt_state = carry
+            new = TrainState(
+                jax.tree.map(lambda a: a[None], params),
+                jax.tree.map(lambda a: a[None], opt_state),
+                state.step + steps,
+            )
+            return new, costs[:, None]
+
+        mapped = jax.shard_map(
+            local_epoch,
+            mesh=self.mesh,
+            in_specs=(P("data"), P(), P(), P()),
+            out_specs=(P("data"), P(None, "data")),
+        )
+
+        @partial(jax.jit, donate_argnums=0)
+        def run(state: TrainState, train_x, train_y, idxs):
+            state, costs = mapped(state, train_x, train_y, idxs)
+            return state, jnp.mean(costs, axis=1)
+
+        return run
+
     def make_divergence_fn(self):
         """Race observability: the largest elementwise distance of any
         parameter copy from the mean of the copies. The reference could only
@@ -566,6 +646,7 @@ class AsyncDataParallel(Strategy):
         epochs: int,
         shuffle: bool = True,
         donate: bool = True,
+        steps_per_epoch: int | None = None,
     ):
         """The WHOLE async experiment as one dispatch: every epoch of every
         chip's local-SGD stream, the pmean exchanges, the on-device global
@@ -577,6 +658,10 @@ class AsyncDataParallel(Strategy):
         [epochs]})`` with ``batch_size`` the *global* batch; each chip
         consumes its 1/n slice of every global batch, matching the eager
         trainer's batch split."""
+        from distributed_tensorflow_tpu.train.compiled_run import (
+            wrapped_epoch_perm,
+        )
+
         scale = self.update_scale
         avg_every = self.avg_every
         n = self.n
@@ -584,8 +669,18 @@ class AsyncDataParallel(Strategy):
         def local_run(state: TrainState, train_x, train_y, test_x, test_y, key):
             my = jax.lax.axis_index("data")
             b_loc = batch_size // n
-            steps = train_x.shape[0] // batch_size
-            trimmed = steps * batch_size
+            steps = (
+                train_x.shape[0] // batch_size
+                if steps_per_epoch is None
+                else steps_per_epoch
+            )
+            need = steps * batch_size
+            # Index-stream domain: trimmed for the plain convention (old
+            # behavior preserved); the full dataset, wrapping across fresh
+            # permutations, under per_worker_epoch (each worker runs
+            # num_examples/batch steps — reference tfdist_between.py:87).
+            domain = need if steps_per_epoch is None else train_x.shape[0]
+            k = (need + domain - 1) // domain if need else 1
             params = jax.tree.map(lambda a: a[0], state.params)
             opt_state = jax.tree.map(lambda a: a[0], state.opt_state)
 
@@ -603,10 +698,8 @@ class AsyncDataParallel(Strategy):
                 key, sub = jax.random.split(key)
                 # Same key on every chip → same global permutation; chip i
                 # takes slice i of each global batch (the eager split).
-                perm = (
-                    jax.random.permutation(sub, trimmed)
-                    if shuffle
-                    else jnp.arange(trimmed)
+                perm = wrapped_epoch_perm(
+                    sub, domain=domain, need=need, k=k, shuffle=shuffle
                 )
                 idxs = _to_varying(
                     perm.reshape(steps, n, b_loc), "data"

@@ -44,6 +44,23 @@ from distributed_tensorflow_tpu.ops import losses as losses_lib
 from distributed_tensorflow_tpu.parallel.strategy import TrainState, _loss_from_model
 
 
+def wrapped_epoch_perm(sub, *, domain: int, need: int, k: int, shuffle: bool):
+    """One epoch's index stream over a device-resident dataset of ``domain``
+    rows: ``need`` indices drawn from ``k`` fresh full permutations
+    concatenated (the on-device analog of ``DataSet.next_batch``'s
+    tail-carry reshuffle; ``k == 1`` is the plain single-permutation epoch),
+    or dataset order tiled when not shuffling. Shared by the generic and
+    async compiled-run builders so the wrap convention cannot diverge."""
+    if not shuffle:
+        return jnp.tile(jnp.arange(domain), k)[:need]
+    if k == 1:
+        return jax.random.permutation(sub, domain)[:need]
+    subs = jax.random.split(sub, k)
+    return jnp.concatenate(
+        [jax.random.permutation(s, domain) for s in subs]
+    )[:need]
+
+
 def make_compiled_run_fn(
     model,
     loss_fn: Callable,
@@ -54,6 +71,7 @@ def make_compiled_run_fn(
     shuffle: bool = True,
     batch_sharding=None,
     donate: bool = True,
+    steps_per_epoch: int | None = None,
 ) -> Callable:
     """Build ``fn(state, train_x, train_y, test_x, test_y, key) ->
     (state, {"costs": [epochs, steps], "accuracy": [epochs]})`` — the whole
@@ -66,12 +84,31 @@ def make_compiled_run_fn(
     ``batch_sharding`` (a NamedSharding over the ``data`` axis) each gathered
     batch is sharded across chips → sync data-parallel, GSPMD inserting the
     gradient all-reduce.
+
+    ``steps_per_epoch`` overrides the step count (the reference's
+    ``per_worker_epoch`` convention: N workers × num_examples/100 steps,
+    reference tfdist_between.py:87); the per-epoch index stream then wraps
+    across as many fresh full-dataset permutations as needed — the on-device
+    analog of ``DataSet.next_batch``'s tail-carry reshuffle.
     """
 
     @partial(jax.jit, donate_argnums=0 if donate else ())
     def run(state: TrainState, train_x, train_y, test_x, test_y, key):
-        steps = train_x.shape[0] // batch_size
-        n = steps * batch_size
+        steps = (
+            train_x.shape[0] // batch_size
+            if steps_per_epoch is None
+            else steps_per_epoch
+        )
+        need = steps * batch_size
+        # Permutation domain: the trimmed dataset for the plain convention
+        # (old behavior bit-preserved), the full dataset when wrapping.
+        domain = need if steps_per_epoch is None else train_x.shape[0]
+        k = (need + domain - 1) // domain if need else 1
+
+        def epoch_perm(sub):
+            return wrapped_epoch_perm(
+                sub, domain=domain, need=need, k=k, shuffle=shuffle
+            )
 
         def train_step(state: TrainState, idx):
             x = jnp.take(train_x, idx, axis=0)
@@ -89,11 +126,7 @@ def make_compiled_run_fn(
         def epoch_body(carry, _):
             state, key = carry
             key, sub = jax.random.split(key)
-            perm = (
-                jax.random.permutation(sub, n)
-                if shuffle
-                else jnp.arange(n)
-            )
+            perm = epoch_perm(sub)
             state, costs = jax.lax.scan(
                 train_step, state, perm.reshape(steps, batch_size)
             )

@@ -81,18 +81,47 @@ class Trainer:
             self.state, self.start_step = self.supervisor.prepare_or_restore(self.state)
 
         # Scanned-epoch fast path (config.scan_epoch): one dispatch per epoch.
+        # config.scan_epoch=None resolves by backend: on an accelerator the
+        # per-batch eager loop pays the device-link dispatch latency 550×
+        # per epoch (the round-1 gap: the documented Trainer API ran at
+        # 0.15× the reference on the tunneled chip while bench.py's scanned
+        # path ran at 240×), so non-CPU backends default to the scanned path.
         self._scanned_fn = None
+        self._indexed_fn = None
         self._scan_rng = None
-        if self.config.scan_epoch:
-            if not hasattr(self.strategy, "make_scanned_train_fn"):
+        self._stage_cache: dict = {}
+        scan_epoch = self.config.scan_epoch
+        has_indexed = hasattr(self.strategy, "make_indexed_scanned_train_fn")
+        if scan_epoch is None:
+            scan_epoch = (
+                jax.default_backend() != "cpu"
+                and (has_indexed or hasattr(self.strategy, "make_scanned_train_fn"))
+                and not (self.config.per_worker_epoch and not has_indexed)
+                and not getattr(self.strategy, "explicit", False)
+            )
+        if scan_epoch:
+            if not (has_indexed or hasattr(self.strategy, "make_scanned_train_fn")):
                 raise ValueError(
                     f"scan_epoch unsupported for {type(self.strategy).__name__}"
                 )
-            if self.config.per_worker_epoch:
-                raise ValueError("scan_epoch and per_worker_epoch are exclusive")
-            self._scanned_fn = self.strategy.make_scanned_train_fn(
-                self.model, self.loss_fn, self.optimizer
-            )
+            if self.config.per_worker_epoch and not has_indexed:
+                # The reference's epoch convention (each worker passes over
+                # the full dataset, reference tfdist_between.py:87) needs the
+                # indexed scan's wrap-around index stream.
+                raise ValueError(
+                    "per_worker_epoch scanning requires an indexed scan path"
+                )
+            # Indexed variant when available: train arrays stay device-
+            # resident across epochs; only [steps, batch] int32 indices are
+            # uploaded per epoch (train/scan.py).
+            if hasattr(self.strategy, "make_indexed_scanned_train_fn"):
+                self._indexed_fn = self.strategy.make_indexed_scanned_train_fn(
+                    self.model, self.loss_fn, self.optimizer
+                )
+            else:
+                self._scanned_fn = self.strategy.make_scanned_train_fn(
+                    self.model, self.loss_fn, self.optimizer
+                )
             import numpy as _np
 
             self._scan_rng = _np.random.default_rng(self.config.seed)
@@ -109,12 +138,35 @@ class Trainer:
 
     # -- pieces -----------------------------------------------------------
 
+    def _stage_cached(self, name: str, arr) -> jax.Array:
+        """Device-resident staging cache: full train/test arrays are placed
+        once (replicated on the mesh when the strategy defines a replicated
+        sharding) and reused across epochs and run_compiled calls. Round 1
+        re-shipped ~170 MB per epoch through the ~20-40 ms device link —
+        on the tunneled chip that transfer dwarfed the epoch's compute."""
+        # The cache value keeps the host array alive and identity-checked:
+        # keying by id() alone would go stale if a freed array's id were
+        # reused by a different dataset.
+        hit = self._stage_cache.get(name)
+        if hit is None or hit[0] is not arr:
+            sharding = getattr(self.strategy, "replicated_sharding", None)
+            a = jax.numpy.asarray(arr)
+            staged = jax.device_put(a, sharding) if sharding is not None else a
+            self._stage_cache[name] = hit = (arr, staged)
+        return hit[1]
+
     def evaluate(self) -> float:
         test = self.datasets.test
-        return float(self.eval_fn(self.state, test.images, test.labels))
+        return float(
+            self.eval_fn(
+                self.state,
+                self._stage_cached("test_x", test.images),
+                self._stage_cached("test_y", test.labels),
+            )
+        )
 
     def run_epoch(self, epoch: int, logger: StepLogger) -> None:
-        if self._scanned_fn is not None:
+        if self._scanned_fn is not None or self._indexed_fn is not None:
             return self._run_epoch_scanned(epoch, logger)
         cfg = self.config
         train = self.datasets.train
@@ -171,21 +223,59 @@ class Trainer:
     def _run_epoch_scanned(self, epoch: int, logger: StepLogger) -> None:
         """One compiled dispatch for the whole epoch (train/scan.py). Update
         semantics match the eager loop exactly; log lines are emitted at the
-        reference cadence afterwards from the returned per-step costs."""
-        from distributed_tensorflow_tpu.train.scan import stage_epoch
+        reference cadence afterwards from the returned per-step costs.
 
+        Preferred path: the indexed scan — train arrays device-resident via
+        ``_stage_cached``, per-epoch upload is only the [steps, batch] int32
+        permutation (same host-RNG draw ``stage_epoch`` makes, so the batch
+        stream is unchanged). Fallback (strategies without the indexed fn):
+        stage the shuffled epoch and ship it whole."""
         cfg = self.config
         train = self.datasets.train
         global_batch = cfg.batch_size * self.strategy.num_replicas
-        xs_np, ys_np = stage_epoch(
-            train.images, train.labels, global_batch, rng=self._scan_rng
-        )
-        sharding = self.strategy.stage_sharding
-        xs = jax.device_put(xs_np, sharding) if sharding else jax.numpy.asarray(xs_np)
-        ys = jax.device_put(ys_np, sharding) if sharding else jax.numpy.asarray(ys_np)
-        step_before = self.strategy.global_step(self.state)
-        t0 = time.time()
-        self.state, costs = self._scanned_fn(self.state, xs, ys)
+        if self._indexed_fn is not None:
+            import numpy as _np
+
+            xs = self._stage_cached("train_x", train.images)
+            ys = self._stage_cached("train_y", train.labels)
+            if cfg.per_worker_epoch:
+                # Reference convention (tfdist_between.py:87): each worker
+                # runs num_examples/batch_size steps per epoch, wrapping
+                # across reshuffles — i.e. the batch stream is successive
+                # permutations concatenated (DataSet.next_batch tail-carry).
+                steps = train.num_examples // cfg.batch_size
+            else:
+                steps = train.num_examples // global_batch
+            need = steps * global_batch
+            chunks, total = [], 0
+            while total < need:
+                p = self._scan_rng.permutation(train.num_examples)
+                chunks.append(p)
+                total += p.size
+            perm = _np.concatenate(chunks)[:need] if len(chunks) > 1 else chunks[0][:need]
+            # Replicated like xs/ys: on a multi-process mesh the jitted
+            # shard_map takes only globally-addressable inputs.
+            idxs = jax.numpy.asarray(
+                perm.reshape(steps, global_batch).astype(_np.int32)
+            )
+            sharding = getattr(self.strategy, "replicated_sharding", None)
+            if sharding is not None:
+                idxs = jax.device_put(idxs, sharding)
+            step_before = self.strategy.global_step(self.state)
+            t0 = time.time()
+            self.state, costs = self._indexed_fn(self.state, xs, ys, idxs)
+        else:
+            from distributed_tensorflow_tpu.train.scan import stage_epoch
+
+            xs_np, ys_np = stage_epoch(
+                train.images, train.labels, global_batch, rng=self._scan_rng
+            )
+            sharding = self.strategy.stage_sharding
+            xs = jax.device_put(xs_np, sharding) if sharding else jax.numpy.asarray(xs_np)
+            ys = jax.device_put(ys_np, sharding) if sharding else jax.numpy.asarray(ys_np)
+            step_before = self.strategy.global_step(self.state)
+            t0 = time.time()
+            self.state, costs = self._scanned_fn(self.state, xs, ys)
         costs = jax.device_get(costs)
         elapsed = time.time() - t0
         self.last_cost = costs[-1]
@@ -215,47 +305,90 @@ class Trainer:
             raise ValueError(
                 f"compiled run unsupported for {type(self.strategy).__name__}"
             )
-        if cfg.per_worker_epoch:
-            raise ValueError("run_compiled and per_worker_epoch are exclusive")
         train, test = self.datasets.train, self.datasets.test
         global_batch = cfg.batch_size * self.strategy.num_replicas
-        # Cache per (epochs, batch): each make_compiled_run_fn call builds a
-        # fresh jit closure, so without the cache a repeated run_compiled —
-        # resume, epoch-at-a-time, benchmark warm runs — would re-trace and
-        # recompile the whole program every call.
-        key = (epochs, global_batch)
+        # per_worker_epoch (reference convention, tfdist_between.py:87): each
+        # worker runs num_examples/batch_size steps per epoch; the compiled
+        # program wraps its index stream across fresh permutations.
+        steps_per_epoch = (
+            train.num_examples // cfg.batch_size if cfg.per_worker_epoch else None
+        )
+        use_pallas = cfg.engine == "pallas"
+        if use_pallas and not getattr(self, "_pallas_checked", False):
+            # Probe once per trainer: the check issues eager dispatches
+            # (~20-40 ms each through the tunnel) that warm repeated calls
+            # must not re-pay. Model/optimizer/loss are fixed at __init__.
+            self._check_pallas_engine()
+            self._pallas_checked = True
+        elif cfg.engine != "xla":
+            raise ValueError(f"unknown engine {cfg.engine!r} (xla|pallas)")
+        # Cache per (engine, epochs, batch, steps): each make_*_run_fn call
+        # builds a fresh jit closure, so without the cache a repeated
+        # run_compiled — resume, epoch-at-a-time, benchmark warm runs —
+        # would re-trace and recompile the whole program every call.
+        key = (cfg.engine, epochs, global_batch, steps_per_epoch)
         run_fn = self._compiled_run_fns.get(key)
         if run_fn is None:
-            run_fn = self.strategy.make_compiled_run_fn(
-                self.model,
-                self.loss_fn,
-                self.optimizer,
-                batch_size=global_batch,
-                epochs=epochs,
-            )
+            if use_pallas:
+                from distributed_tensorflow_tpu.ops.pallas_mlp import (
+                    make_fused_compiled_run_fn,
+                )
+
+                run_fn = make_fused_compiled_run_fn(
+                    batch_size=global_batch,
+                    epochs=epochs,
+                    in_dim=self.model.in_dim,
+                    hidden_dim=self.model.hidden_dim,
+                    out_dim=self.model.out_dim,
+                    learning_rate=cfg.learning_rate,
+                    steps_per_epoch=steps_per_epoch,
+                )
+            else:
+                run_fn = self.strategy.make_compiled_run_fn(
+                    self.model,
+                    self.loss_fn,
+                    self.optimizer,
+                    batch_size=global_batch,
+                    epochs=epochs,
+                    steps_per_epoch=steps_per_epoch,
+                )
             self._compiled_run_fns[key] = run_fn
         if self.summary_writer is not None and self.is_chief and not self._graph_written:
             self.write_graph()
             self._graph_written = True
         logger = StepLogger(freq=cfg.log_frequency, print_fn=self.print_fn)
-        # Stage replicated: per-step batches are random gathers, and in a
-        # multi-process mesh the inputs must be globally addressable.
-        sharding = self.strategy.replicated_sharding
-        stage = (
-            (lambda a: jax.device_put(jax.numpy.asarray(a), sharding))
-            if sharding is not None
-            else jax.numpy.asarray
-        )
+        # Stage replicated (per-step batches are random gathers, and in a
+        # multi-process mesh the inputs must be globally addressable), cached
+        # across calls: a repeated/resumed run re-dispatches without
+        # re-shipping the train+test arrays through the device link.
+        stage = self._stage_cached
         step_before = self.strategy.global_step(self.state)
+        # Fold the global step into the shuffle key so a resumed or repeated
+        # compiled run draws fresh epoch permutations instead of replaying
+        # the first run's (the eager path's host RNG advances across runs).
+        shuffle_key = jax.random.fold_in(jax.random.key(cfg.seed), step_before)
         t0 = time.time()
-        self.state, metrics = run_fn(
-            self.state,
-            stage(train.images),
-            stage(train.labels),
-            stage(test.images),
-            stage(test.labels),
-            jax.random.key(cfg.seed),
+        staged_args = (
+            stage("train_x", train.images),
+            stage("train_y", train.labels),
+            stage("test_x", test.images),
+            stage("test_y", test.labels),
+            shuffle_key,
         )
+        if use_pallas:
+            from distributed_tensorflow_tpu.ops.pallas_mlp import (
+                from_fused,
+                to_fused,
+            )
+            from distributed_tensorflow_tpu.parallel.strategy import TrainState
+
+            fused, metrics = run_fn(to_fused(self.state.params), *staged_args)
+            n_steps = int(metrics["costs"].shape[0] * metrics["costs"].shape[1])
+            self.state = TrainState(
+                from_fused(fused), self.state.opt_state, self.state.step + n_steps
+            )
+        else:
+            self.state, metrics = run_fn(self.state, *staged_args)
         # D2H fetches double as the execution barrier (CLAUDE.md timing trap).
         costs = jax.device_get(metrics["costs"])
         accs = jax.device_get(metrics["accuracy"])
@@ -298,6 +431,80 @@ class Trainer:
             "final_cost": final_cost,
             "global_step": self.strategy.global_step(self.state),
         }
+
+    def _check_pallas_engine(self) -> None:
+        """engine="pallas" runs the fused whole-epoch grid kernel, which
+        hard-codes the reference workload's math (MLP sigmoid/softmax, naive
+        CE, plain constant-lr SGD, single device). Anything else must use
+        the generic XLA engine — raise rather than silently change math."""
+        from distributed_tensorflow_tpu.models.mlp import MLP
+
+        cfg = self.config
+        problems = []
+        if not isinstance(self.model, MLP):
+            problems.append(f"model {type(self.model).__name__} (need MLP)")
+        if not isinstance(self.strategy, SingleDevice):
+            problems.append(
+                f"strategy {type(self.strategy).__name__} (need SingleDevice; "
+                "use ops.pallas_mlp.make_fused_async_epoch_fn for DP)"
+            )
+        if (
+            cfg.optimizer != "sgd"
+            or cfg.lr_schedule not in (None, "constant")  # optim.py treats both as constant
+            or cfg.warmup_steps
+        ):
+            problems.append("optimizer config (need plain constant-lr sgd)")
+        if cfg.loss != "naive":
+            problems.append("loss config (need the reference's naive CE)")
+        if cfg.accumulate_steps != 1 or cfg.grad_clip_norm:
+            problems.append("accumulation/clipping (unsupported in the kernel)")
+        # Semantic probes on top of the config strings: optimizer=/loss_fn=
+        # can be passed to Trainer directly (build_trainer always does), so
+        # the actual objects must also behave as plain sgd(lr) + naive CE.
+        # Two applies expose momentum/adam/schedules/accumulation (all of
+        # which match plain SGD on a single first step).
+        import jax.numpy as jnp
+
+        probe = jnp.asarray([[0.5, -1.5], [2.0, 0.25]])
+
+        def two_updates(opt):
+            s = opt.init(probe)
+            u1, s = opt.update(probe, s, probe)
+            u2, _ = opt.update(probe * 0.5, s, probe + u1)
+            return jnp.concatenate([u1, u2])
+
+        try:
+            opt_ok = bool(
+                jnp.allclose(
+                    two_updates(self.optimizer),
+                    two_updates(optim_lib.sgd(cfg.learning_rate)),
+                )
+            )
+        except Exception:
+            opt_ok = False
+        if not opt_ok:
+            problems.append(
+                "optimizer (need plain constant-lr sgd semantics: no "
+                "momentum/adam, schedule, warmup, clipping, or accumulation)"
+            )
+        y_probe = jnp.asarray([[1.0, 0.0], [0.0, 1.0]])
+        p_probe = jnp.asarray([[0.7, 0.3], [0.2, 0.8]])
+        try:
+            loss_ok = bool(
+                jnp.allclose(
+                    self.loss_fn(p_probe, y_probe),
+                    losses_lib.cross_entropy(p_probe, y_probe),
+                )
+            )
+        except Exception:
+            loss_ok = False
+        if not loss_ok:
+            problems.append("loss (need the reference's naive CE)")
+        if problems:
+            raise ValueError(
+                "engine='pallas' requires the reference workload shape; got "
+                + "; ".join(problems)
+            )
 
     def _step_incr(self, step_before: int, batch_count: int) -> int:
         """Global-step advance per batch of the epoch just run — derived

@@ -91,3 +91,127 @@ def test_epoch_kernel_matches_scan_of_step_kernels():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
         )
+
+
+def test_async_epoch_kernel_matches_xla_async_scan():
+    """The data-parallel composition of the whole-epoch grid kernel
+    (shard_map over 'data': per-chip grid launches + pmean exchanges between
+    rounds) reproduces AsyncDataParallel's XLA scanned path — same local
+    steps, same exchange cadence, same final copies."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_tpu.ops.pallas_mlp import (
+        make_fused_async_epoch_fn,
+        to_fused_stacked,
+    )
+    from distributed_tensorflow_tpu.parallel import AsyncDataParallel, make_mesh
+
+    mesh = make_mesh((8, 1))
+    n, b_loc, steps = 8, 25, 11  # non-dividing steps: exercises the tail
+    avg_every = 4
+    model = MLP(compute_dtype=jnp.float32)
+    opt = sgd(0.001)
+    # update_scale=1: the kernel applies plain per-chip SGD.
+    strat = AsyncDataParallel(mesh, avg_every=avg_every, update_scale=1.0)
+
+    rng = np.random.default_rng(0)
+    xs = rng.random((steps, n * b_loc, 784), dtype=np.float32)
+    ys = np.eye(10, dtype=np.float32)[
+        rng.integers(0, 10, steps * n * b_loc)
+    ].reshape(steps, n * b_loc, 10)
+
+    # XLA async scanned epoch.
+    state_x = strat.init_state(model, opt, seed=1)
+    scan_fn = strat.make_scanned_train_fn(model, cross_entropy, opt)
+    state_x, costs_x = scan_fn(
+        state_x,
+        jax.device_put(jnp.asarray(xs), strat.stage_sharding),
+        jax.device_put(jnp.asarray(ys), strat.stage_sharding),
+    )
+
+    # Pallas grid composition.
+    params = model.init(seed=1)
+    fused = to_fused_stacked(params, n, NamedSharding(mesh, P("data")))
+    run = make_fused_async_epoch_fn(
+        mesh,
+        steps=steps,
+        batch_size=b_loc,
+        learning_rate=0.001,
+        avg_every=avg_every,
+    )
+    fused, costs_p = run(
+        fused,
+        jax.device_put(jnp.asarray(xs), strat.stage_sharding),
+        jax.device_put(jnp.asarray(ys), strat.stage_sharding),
+    )
+
+    np.testing.assert_allclose(
+        np.asarray(costs_x), np.asarray(costs_p), rtol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(state_x.params.w1),
+        np.asarray(fused.w1),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(state_x.params.b2),
+        np.asarray(fused.b2[:, 0]),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
+
+def test_async_epoch_kernel_no_exchange_below_avg_every():
+    """An epoch shorter than avg_every must run with NO exchange in BOTH
+    engines (_scan_with_exchange's `steps >= avg_every` guard) — the copies
+    stay diverged and equal between engines."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_tpu.ops.pallas_mlp import (
+        make_fused_async_epoch_fn,
+        to_fused_stacked,
+    )
+    from distributed_tensorflow_tpu.parallel import AsyncDataParallel, make_mesh
+
+    mesh = make_mesh((8, 1))
+    n, b_loc, steps = 8, 16, 3
+    strat = AsyncDataParallel(mesh, avg_every=10, update_scale=1.0)
+    model = MLP(hidden_dim=16, compute_dtype=jnp.float32)
+    opt = sgd(0.01)
+    rng = np.random.default_rng(2)
+    xs = rng.random((steps, n * b_loc, 784), dtype=np.float32)
+    ys = np.eye(10, dtype=np.float32)[
+        rng.integers(0, 10, steps * n * b_loc)
+    ].reshape(steps, n * b_loc, 10)
+
+    state_x = strat.init_state(model, opt, seed=1)
+    state_x, _ = strat.make_scanned_train_fn(model, cross_entropy, opt)(
+        state_x,
+        jax.device_put(jnp.asarray(xs), strat.stage_sharding),
+        jax.device_put(jnp.asarray(ys), strat.stage_sharding),
+    )
+
+    fused = to_fused_stacked(
+        model.init(seed=1), n, NamedSharding(mesh, P("data"))
+    )
+    fused, _ = make_fused_async_epoch_fn(
+        mesh,
+        steps=steps,
+        batch_size=b_loc,
+        hidden_dim=16,
+        learning_rate=0.01,
+        avg_every=10,
+    )(
+        fused,
+        jax.device_put(jnp.asarray(xs), strat.stage_sharding),
+        jax.device_put(jnp.asarray(ys), strat.stage_sharding),
+    )
+
+    w1_x = np.asarray(state_x.params.w1)
+    # Copies must still be diverged (no exchange happened)...
+    assert not np.allclose(w1_x[0], w1_x[1])
+    # ...and the engines must agree per copy.
+    np.testing.assert_allclose(w1_x, np.asarray(fused.w1), rtol=1e-5, atol=1e-6)

@@ -121,3 +121,82 @@ def test_async_scan_no_exchange_keeps_copies_independent():
     assert costs.shape == (4,)
     # Different data per chip, no exchange -> copies must have diverged.
     assert not np.allclose(w1[0], w1[1])
+
+
+def test_indexed_scan_matches_staged_scan():
+    """The indexed path (device-resident flat arrays + on-device gather of a
+    host permutation) is bitwise the staged path over the same permutation —
+    only the staging traffic differs (round-2: per-epoch re-staging through
+    the device link replaced by a [steps, batch] int32 upload)."""
+    from distributed_tensorflow_tpu.train.scan import make_indexed_scanned_train_fn
+
+    model = MLP(compute_dtype=jnp.float32)
+    opt = sgd(0.001)
+    strat = SingleDevice()
+    rng = np.random.default_rng(3)
+    images = rng.random((1000, 784), dtype=np.float32)
+    labels = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 1000)]
+
+    perm = np.random.default_rng(11).permutation(1000)
+    xs = images[perm].reshape(10, 100, 784)
+    ys = labels[perm].reshape(10, 100, 10)
+    state_a = strat.init_state(model, opt, seed=1)
+    staged = make_scanned_train_fn(model, cross_entropy, opt)
+    state_a, costs_a = staged(state_a, jnp.asarray(xs), jnp.asarray(ys))
+
+    state_b = strat.init_state(model, opt, seed=1)
+    indexed = make_indexed_scanned_train_fn(model, cross_entropy, opt)
+    idxs = jnp.asarray(perm.reshape(10, 100).astype(np.int32))
+    state_b, costs_b = indexed(
+        state_b, jnp.asarray(images), jnp.asarray(labels), idxs
+    )
+
+    np.testing.assert_array_equal(np.asarray(costs_a), np.asarray(costs_b))
+    np.testing.assert_array_equal(
+        np.asarray(state_a.params.w1), np.asarray(state_b.params.w1)
+    )
+
+
+def test_async_indexed_scan_matches_staged_async_scan():
+    """Async indexed variant: chip i gathering columns [i*b, (i+1)*b) of each
+    global batch reproduces the staged async scan over the same permutation."""
+    import jax
+
+    from distributed_tensorflow_tpu.parallel import AsyncDataParallel, make_mesh
+
+    mesh = make_mesh((8, 1))
+    strat = AsyncDataParallel(mesh, avg_every=2)
+    model = MLP(hidden_dim=16, compute_dtype=jnp.float32)
+    opt = sgd(0.01)
+    rng = np.random.default_rng(5)
+    images = rng.random((800, 784), dtype=np.float32)
+    labels = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 800)]
+    perm = np.random.default_rng(13).permutation(800)
+    global_batch = 8 * 25  # 4 steps
+
+    xs = images[perm].reshape(-1, global_batch, 784)
+    ys = labels[perm].reshape(-1, global_batch, 10)
+    state_a = strat.init_state(model, opt, seed=1)
+    staged = strat.make_scanned_train_fn(model, cross_entropy, opt)
+    state_a, costs_a = staged(
+        state_a,
+        jax.device_put(jnp.asarray(xs), strat.stage_sharding),
+        jax.device_put(jnp.asarray(ys), strat.stage_sharding),
+    )
+
+    state_b = strat.init_state(model, opt, seed=1)
+    indexed = strat.make_indexed_scanned_train_fn(model, cross_entropy, opt)
+    idxs = jnp.asarray(perm.reshape(-1, global_batch).astype(np.int32))
+    state_b, costs_b = indexed(
+        state_b, jnp.asarray(images), jnp.asarray(labels), idxs
+    )
+
+    np.testing.assert_allclose(
+        np.asarray(costs_a), np.asarray(costs_b), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(state_a.params.w1),
+        np.asarray(state_b.params.w1),
+        rtol=1e-6,
+        atol=1e-7,
+    )

@@ -76,7 +76,7 @@ def test_scan_epoch_accepts_async(small_datasets):
         strategy=AsyncDataParallel(make_mesh(), avg_every=5),
         print_fn=lambda *a: None,
     )
-    assert tr._scanned_fn is not None
+    assert tr._indexed_fn is not None or tr._scanned_fn is not None
 
 
 def test_async_scan_epoch_through_trainer(small_datasets):
