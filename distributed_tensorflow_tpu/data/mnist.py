@@ -130,7 +130,10 @@ def _read_idx_images(path: str) -> np.ndarray:
         if magic != 2051:
             raise ValueError(f"bad IDX image magic {magic} in {path}")
         data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
-    return data.reshape(n, rows * cols).astype(np.float32) / 255.0
+    # Multiply by the f32-rounded reciprocal (not divide by 255.0): the C++
+    # parser does `buf[i] * (1.0f/255.0f)`, and the two paths must produce
+    # bit-identical arrays (tests/test_data.py parser-agreement check).
+    return data.reshape(n, rows * cols).astype(np.float32) * np.float32(1.0 / 255.0)
 
 
 def _read_idx_labels(path: str) -> np.ndarray:
