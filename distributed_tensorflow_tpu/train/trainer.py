@@ -150,10 +150,27 @@ class Trainer:
         hit = self._stage_cache.get(name)
         if hit is None or hit[0] is not arr:
             sharding = getattr(self.strategy, "replicated_sharding", None)
-            a = jax.numpy.asarray(arr)
-            staged = jax.device_put(a, sharding) if sharding is not None else a
+            staged = self._place_replicated(arr, sharding)
             self._stage_cache[name] = hit = (arr, staged)
         return hit[1]
+
+    @staticmethod
+    def _place_replicated(a, sharding) -> jax.Array:
+        """Place host data ``a`` replicated under ``sharding``. Takes the
+        host array directly — an eager ``asarray`` first would commit it to
+        the local default device and force an extra round trip through the
+        device link before re-placement. In a multi-process mesh a plain
+        device_put is not globally addressable; every process holds the
+        identical full array (deterministic loaders), so assembly goes
+        through make_array_from_process_local_data."""
+        if sharding is None:
+            return jax.numpy.asarray(a)
+        if jax.process_count() > 1:
+            import numpy as _np
+
+            a = _np.asarray(a)
+            return jax.make_array_from_process_local_data(sharding, a, a.shape)
+        return jax.device_put(a, sharding)
 
     def evaluate(self) -> float:
         test = self.datasets.test
@@ -254,13 +271,13 @@ class Trainer:
                 total += p.size
             perm = _np.concatenate(chunks)[:need] if len(chunks) > 1 else chunks[0][:need]
             # Replicated like xs/ys: on a multi-process mesh the jitted
-            # shard_map takes only globally-addressable inputs.
-            idxs = jax.numpy.asarray(
-                perm.reshape(steps, global_batch).astype(_np.int32)
+            # computation takes only globally-addressable inputs — and every
+            # process draws the identical permutation (same seed-derived
+            # _scan_rng stream), so replication is consistent.
+            idxs = self._place_replicated(
+                perm.reshape(steps, global_batch).astype(_np.int32),
+                getattr(self.strategy, "replicated_sharding", None),
             )
-            sharding = getattr(self.strategy, "replicated_sharding", None)
-            if sharding is not None:
-                idxs = jax.device_put(idxs, sharding)
             step_before = self.strategy.global_step(self.state)
             t0 = time.time()
             self.state, costs = self._indexed_fn(self.state, xs, ys, idxs)
