@@ -43,6 +43,31 @@ _TEST_LABELS = "t10k-labels-idx1-ubyte"
 _VALIDATION_SIZE = 5000  # tutorial loader's split: 55000 train / 5000 val
 
 
+_native_gather = None  # resolved on first use: fn | False
+
+
+def _gather(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """Row gather for ``next_batch`` — through the C++ runtime's memcpy
+    kernel when available (the host side of the reference's feed path,
+    C6/SURVEY.md §2a), else numpy fancy indexing. Bit-identical either way."""
+    global _native_gather
+    if _native_gather is None:
+        try:
+            from distributed_tensorflow_tpu.runtime import native
+
+            _native_gather = native.gather_rows if native.available() else False
+        except Exception:  # pragma: no cover - import breakage → numpy path
+            _native_gather = False
+    if (
+        _native_gather
+        and src.ndim == 2
+        and src.dtype == np.float32
+        and src.flags.c_contiguous
+    ):
+        return _native_gather(src, idx)
+    return src[idx]
+
+
 def _one_hot(labels: np.ndarray, num_classes: int = NUM_CLASSES) -> np.ndarray:
     out = np.zeros((labels.shape[0], num_classes), dtype=np.float32)
     out[np.arange(labels.shape[0]), labels] = 1.0
@@ -92,7 +117,7 @@ class DataSet:
         else:
             idx = self._perm[self._index : self._index + batch_size]
             self._index += batch_size
-        return self._images[idx], self._labels[idx]
+        return _gather(self._images, idx), _gather(self._labels, idx)
 
     def shard(self, num_shards: int, shard_index: int) -> "DataSet":
         """Static contiguous shard of this split — the data-parallel analog of

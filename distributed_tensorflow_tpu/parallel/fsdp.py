@@ -185,9 +185,29 @@ class ShardedDataParallel(Strategy):
     def stage_sharding(self):
         return NamedSharding(self.mesh, P(None, self.axis))
 
+    # Whole-dataset staging for the indexed scan (train/scan.py): per-step
+    # batches are random gathers, so the flat arrays live replicated.
+    @property
+    def replicated_sharding(self):
+        return self._repl
+
     def make_scanned_train_fn(self, model, loss_fn, optimizer):
         from distributed_tensorflow_tpu.train.scan import make_scanned_train_fn
 
         return make_scanned_train_fn(
+            model, loss_fn, optimizer, batch_sharding=self._batch
+        )
+
+    def make_indexed_scanned_train_fn(self, model, loss_fn, optimizer):
+        """Indexed scanned epoch (train/scan.py): train arrays device-
+        resident, per-epoch index upload only. The ZeRO layout rides the
+        carried state's shardings — GSPMD keeps params/opt-state sharded and
+        inserts the same all-gather/reduce-scatter pattern as the per-step
+        path."""
+        from distributed_tensorflow_tpu.train.scan import (
+            make_indexed_scanned_train_fn,
+        )
+
+        return make_indexed_scanned_train_fn(
             model, loss_fn, optimizer, batch_sharding=self._batch
         )

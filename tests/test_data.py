@@ -123,19 +123,46 @@ def test_fixture_cpp_and_numpy_parsers_agree(tmp_path):
         )
 
 
-def test_fixture_read_data_sets_end_to_end(tmp_path):
-    """read_data_sets over the gz fixture: the real-IDX source path wins over
-    synthetic and produces the tutorial splits (validation carved from
-    train)."""
+def test_fixture_read_data_sets_end_to_end(tmp_path, monkeypatch):
+    """read_data_sets over the gz fixture: the real-IDX source path must win
+    over synthetic and produce the tutorial splits (validation carved from
+    train). The fixture is smaller than the real 5000-example carve, so the
+    carve size is shrunk for the test — the *dispatch* (IDX detection,
+    native-or-numpy parse, split carving) is what's under test."""
     import shutil
+
+    from distributed_tensorflow_tpu.data import mnist
 
     for f in os.listdir(_FIXTURE):
         shutil.copy(os.path.join(_FIXTURE, f), tmp_path / f)
-    # Fixture is smaller than the 5000-example validation carve; check via
-    # the non-one-hot raw arrays instead of split sizes.
-    from distributed_tensorflow_tpu.data import mnist
+    monkeypatch.setattr(mnist, "_VALIDATION_SIZE", 100)
+    ds = read_data_sets(str(tmp_path), one_hot=True)
+    assert ds.train.num_examples == 200  # 300 - 100 validation
+    assert ds.validation.num_examples == 100
+    assert ds.test.num_examples == 100
+    assert ds.train.images.dtype == np.float32
+    # Content actually came from the fixture files, not the synthetic
+    # generator: compare against a direct parse.
+    train_x, train_y, _, _ = mnist._load_idx(str(tmp_path))
+    np.testing.assert_array_equal(ds.train.images, train_x[100:])
+    np.testing.assert_array_equal(ds.train.labels.argmax(1), train_y[100:])
 
-    train_x, train_y, test_x, test_y = mnist._load_idx(str(tmp_path))
-    assert train_x.shape == (300, IMAGE_PIXELS)
-    assert test_x.shape == (100, IMAGE_PIXELS)
-    assert train_y.dtype == np.int64 and test_y.dtype == np.int64
+
+def test_next_batch_native_gather_matches_numpy():
+    """next_batch's gather goes through the C++ memcpy kernel when the
+    native runtime is available; either path must equal numpy fancy
+    indexing bit-for-bit."""
+    from distributed_tensorflow_tpu.data import mnist as mnist_mod
+
+    imgs = np.arange(200 * 4, dtype=np.float32).reshape(200, 4)
+    labs = np.eye(10, dtype=np.float32)[np.arange(200) % 10]
+    ds = DataSet(imgs, labs, seed=7)
+    ref = DataSet(imgs, labs, seed=7)
+    bx, by = ds.next_batch(32)
+    # Reference gather: same permutation stream, pure numpy.
+    idx = ref._perm[:32]
+    ref._index = 32
+    np.testing.assert_array_equal(bx, imgs[idx])
+    np.testing.assert_array_equal(by, labs[idx])
+    # The resolved path is recorded (False = numpy fallback, fn = native).
+    assert mnist_mod._native_gather is not None

@@ -156,3 +156,45 @@ def test_fsdp_scanned_epoch_matches_eager():
         jax.tree.leaves(scan_state.params), jax.tree.leaves(eager_state.params)
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_fsdp_indexed_scan_matches_staged_scan():
+    """The indexed scanned epoch (device-resident flat arrays + on-device
+    gather) keeps the ZeRO layout and reproduces the staged scan bitwise
+    over the same permutation."""
+    mesh = make_mesh((8, 1))
+    model = _model()
+    rng = np.random.default_rng(2)
+    images = rng.random((6 * 64, 784), dtype=np.float32)
+    labels = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 6 * 64)]
+    perm = np.random.default_rng(9).permutation(6 * 64)
+    xs = images[perm].reshape(6, 64, 784)
+    ys = labels[perm].reshape(6, 64, 10)
+
+    opt = sgd(0.01)
+    strategy = ShardedDataParallel(mesh)
+    state_a = strategy.init_state(model, opt, seed=1)
+    staged = strategy.make_scanned_train_fn(model, cross_entropy, opt)
+    state_a, costs_a = staged(
+        state_a,
+        jax.device_put(jnp.asarray(xs), strategy.stage_sharding),
+        jax.device_put(jnp.asarray(ys), strategy.stage_sharding),
+    )
+
+    state_b = strategy.init_state(model, opt, seed=1)
+    indexed = strategy.make_indexed_scanned_train_fn(model, cross_entropy, opt)
+    state_b, costs_b = indexed(
+        state_b,
+        jax.device_put(jnp.asarray(images), strategy.replicated_sharding),
+        jax.device_put(jnp.asarray(labels), strategy.replicated_sharding),
+        jnp.asarray(perm.reshape(6, 64).astype(np.int32)),
+    )
+
+    np.testing.assert_allclose(np.asarray(costs_a), np.asarray(costs_b), rtol=1e-6)
+    # Params still ZeRO-sharded after the indexed scan.
+    w1 = state_b.params.w1
+    assert w1.addressable_shards[0].data.size < w1.size
+    for a, b in zip(
+        jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
