@@ -122,6 +122,13 @@ def run_suite(
     for name, n, ref in _row_specs(n_devices):
         if rows is not None and name not in rows:
             continue
+        if name == "single-compiled-pallas" and jax.default_backend() != "tpu":
+            # Off-TPU the Pallas kernels run in the interpreter — a
+            # correctness device, catastrophically slow as a benchmark
+            # (tens of minutes for the 50-epoch leg). Explicit --rows
+            # selection overrides.
+            if rows is None:
+                continue
         model = MLP()
         if name.startswith("single-compiled"):
             # Whole-run path: the first call compiles (the Trainer caches
