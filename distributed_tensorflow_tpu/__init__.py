@@ -54,6 +54,10 @@ _LAZY_EXPORTS = {
     "SingleDevice": ("distributed_tensorflow_tpu.parallel", "SingleDevice"),
     "SyncDataParallel": ("distributed_tensorflow_tpu.parallel", "SyncDataParallel"),
     "AsyncDataParallel": ("distributed_tensorflow_tpu.parallel", "AsyncDataParallel"),
+    "flash_attention": (
+        "distributed_tensorflow_tpu.ops.pallas_attention",
+        "flash_attention",
+    ),
     "Trainer": ("distributed_tensorflow_tpu.train", "Trainer"),
     "Supervisor": ("distributed_tensorflow_tpu.train", "Supervisor"),
     "build_trainer": ("distributed_tensorflow_tpu.launch", "build_trainer"),

@@ -5,7 +5,9 @@ prove the framework's long-context machinery end to end — same init/apply
 protocol (models/base.py), same Trainer/strategies, but the forward pass has
 a real sequence dimension whose attention can run:
 
-- dense on one device (``apply``), or
+- dense on one device (``apply``; ``attention_impl="flash"`` swaps in the
+  Pallas blockwise kernel from ``ops/pallas_attention`` — same math, no
+  [L, L] score matrix in HBM), or
 - **sequence-parallel** over a ``seq`` mesh axis
   (``apply_sequence_parallel``): activations sharded along the sequence,
   attention selectable between the ppermute **ring**
@@ -71,8 +73,13 @@ class TransformerClassifier:
         num_heads: int = 4,
         num_classes: int = 10,
         compute_dtype: jnp.dtype = jnp.bfloat16,
+        attention_impl: str = "xla",
     ):
         assert model_dim % num_heads == 0
+        if attention_impl not in ("xla", "flash"):
+            raise ValueError(
+                f"unknown attention_impl {attention_impl!r}; xla|flash"
+            )
         self.seq_len = seq_len
         self.token_dim = token_dim
         self.model_dim = model_dim
@@ -80,6 +87,7 @@ class TransformerClassifier:
         self.head_dim = model_dim // num_heads
         self.num_classes = num_classes
         self.compute_dtype = compute_dtype
+        self.attention_impl = attention_impl
 
     def init(self, seed: int = 1) -> TransformerParams:
         keys = jax.random.split(jax.random.key(seed), 8)
@@ -152,7 +160,14 @@ class TransformerClassifier:
         """Dense single-device forward: x [B, seq_len*token_dim] → probs."""
         h = self._embed(params, x)
         q, k, v = self._qkv(params, h)
-        attn = dense_attention(q, k, v)
+        if self.attention_impl == "flash":
+            from distributed_tensorflow_tpu.ops.pallas_attention import (
+                flash_attention,
+            )
+
+            attn = flash_attention(q, k, v)
+        else:
+            attn = dense_attention(q, k, v)
         h = self._post_attention(params, h, attn)
         return self._head_probs(params, h)
 

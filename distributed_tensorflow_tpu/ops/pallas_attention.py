@@ -1,0 +1,366 @@
+"""Pallas TPU kernel: blockwise fused (flash) attention, forward + backward.
+
+The reference has no attention at all (SURVEY.md §2b: the model is a fixed
+784-feature MLP) — long-context support is one of this framework's
+first-class upgrades. ``ops/ring_attention.py`` supplies the cross-device
+algorithms (ring / Ulysses); this module supplies the *within-device* hot
+op: exact softmax attention computed block-by-block in VMEM so the [L, L]
+score matrix is never materialized in HBM.
+
+Forward (online softmax, one grid step per (batch·head, q-block, k-block)):
+
+    s    = q·kᵀ·scale                     (bq, bk) f32 on the MXU
+    m'   = max(m, rowmax(s)); corr = exp(m - m')
+    p    = exp(s - m')
+    l    = l·corr + rowsum(p)
+    acc  = acc·corr + p·v
+    out  = acc / l;  lse = m + log(l)     (written at the last k-block)
+
+Backward is the standard two-kernel split, re-deriving p from the saved
+row-wise log-sum-exp instead of storing it:
+
+    p  = exp(s - lse)                      (exact, no second softmax pass)
+    dv += pᵀ·do
+    ds = p·(do·vᵀ - delta)·scale           delta = rowsum(do·out)
+    dk += dsᵀ·q                            (k-major kernel, q innermost)
+    dq += ds·k                             (q-major kernel, k innermost)
+
+Accumulators live in VMEM scratch that persists across the innermost grid
+dimension (TPU grids run sequentially, minor-most fastest); causal masking
+skips fully-masked blocks entirely via ``pl.when`` — past-diagonal work is
+never issued, so causal runs ~2× faster than masked-dense. All per-row
+statistics (m, l, lse, delta) are carried as [rows, 1] 2-D columns — 1-D
+vectors trip Mosaic relayout bugs (CLAUDE.md).
+
+Layout: public API takes [B, L, H, D] (matching ``dense_attention`` /
+``ring_attention``); kernels run on [B·H, L, D] with f32 math regardless of
+input dtype. ``interpret=None`` auto-selects the Pallas interpreter
+off-TPU, the Mosaic compiler on TPU (same convention as ops/pallas_mlp.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _pick_block(l: int, requested: int | None) -> int:
+    """Largest MXU-friendly block that divides ``l`` (≤128), or ``l`` itself
+    for short/odd sequences (Mosaic pads non-tile-multiple shapes). A long
+    sequence with no small divisor would silently degenerate to one
+    whole-sequence block — an O(L²) VMEM score tile, exactly what this
+    kernel exists to avoid — so that case is an error, not a fallback."""
+    if requested is not None:
+        if l % requested:
+            raise ValueError(f"block {requested} must divide sequence {l}")
+        return requested
+    for cand in (128, 64, 32, 16, 8):
+        if l % cand == 0:
+            return cand
+    if l > 512:
+        raise ValueError(
+            f"sequence length {l} has no block-size divisor ≤128; pad the "
+            f"sequence or pass an explicit block_q/block_k that divides it"
+        )
+    return l
+
+
+def _causal_mask(iq, ik, bq, bk):
+    """[bq, bk] bool: global q position >= global k position. 2-D
+    broadcasted_iota — plain ``jnp.arange`` is 1-D and TPU rejects it."""
+    q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = ik * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return q_pos >= k_pos
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, scale: float, causal: bool, nk: int,
+):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _accumulate():
+        # Matmuls run in the input dtype with f32 accumulation — one MXU
+        # pass for bf16 inputs, matching XLA's DEFAULT precision. Softmax
+        # statistics stay f32 regardless.
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = jnp.where(_causal_mask(iq, ik, bq, bk), s, _NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # A still-empty row (everything masked so far) has m_new == -inf;
+        # exp(s - -inf) would be exp(+inf). Causal rows always include the
+        # diagonal eventually, but guard the not-yet-reached iterations.
+        m_safe = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+        corr = jnp.exp(m_prev - m_safe)
+        p = jnp.exp(s - m_safe)
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jnp.dot(
+            p.astype(v_ref.dtype), v_ref[0], preferred_element_type=jnp.float32
+        )
+        m_scr[:] = m_new
+
+    if causal:
+        # Skip blocks strictly above the diagonal: their every score is
+        # masked (max q position < min k position).
+        pl.when((iq + 1) * bq - 1 >= ik * bk)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(l)
+
+
+def _fwd_call(q, k, v, *, causal, bq, bk, scale, interpret):
+    """[BH, L, D] → (out [BH, L, D], lse [BH, L, 1])."""
+    bh, l, d = q.shape
+    nq, nk = l // bq, l // bk
+    return pl.pallas_call(
+        partial(_fwd_kernel, scale=scale, causal=causal, nk=nk),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, iq, ik: (b, ik, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, iq, ik: (b, ik, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, iq, ik: (b, iq, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, l, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, l, 1), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, scale: float, causal: bool, nk: int,
+):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _accumulate():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = jnp.where(_causal_mask(iq, ik, bq, bk), s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0])  # masked scores underflow to exactly 0
+        dp = jnp.dot(do_ref[0], v_ref[0].T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale
+        dq_scr[:] += jnp.dot(
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        pl.when((iq + 1) * bq - 1 >= ik * bk)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr,
+    *, scale: float, causal: bool, nq: int,
+):
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _accumulate():
+        q = q_ref[0]
+        k = k_ref[0]
+        do = do_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = jnp.where(_causal_mask(iq, ik, bq, bk), s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0])
+        dv_scr[:] += jnp.dot(
+            p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
+        )
+        dp = jnp.dot(do, v_ref[0].T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale
+        dk_scr[:] += jnp.dot(
+            ds.astype(q.dtype).T, q, preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        pl.when((iq + 1) * bq - 1 >= ik * bk)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_call(q, k, v, o, lse, do, *, causal, bq, bk, scale, interpret):
+    bh, l, d = q.shape
+    nq, nk = l // bq, l // bk
+    # delta_i = rowsum(do ⊙ out): tiny elementwise reduce, XLA fuses it into
+    # the surrounding graph — not worth a kernel.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )
+    qspec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
+    rowspec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
+
+    dq = pl.pallas_call(
+        partial(_dq_kernel, scale=scale, causal=causal, nk=nk),
+        grid=(bh, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, l, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # k-major: q/do/lse/delta blocks walk the innermost dim.
+    qspec2 = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0))
+    rowspec2 = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, j, 0))
+    kspec2 = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        partial(_dkv_kernel, scale=scale, causal=causal, nq=nq),
+        grid=(bh, nk, nq),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        out_specs=(kspec2, kspec2),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, l, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, l, d), v.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper and public API
+# ---------------------------------------------------------------------------
+
+
+def _to_bh(x):
+    """[B, L, H, D] → [B·H, L, D]."""
+    b, l, h, d = x.shape
+    return jnp.einsum("blhd->bhld", x).reshape(b * h, l, d)
+
+
+def _from_bh(x, b, h):
+    bh, l, d = x.shape
+    return jnp.einsum("bhld->blhd", x.reshape(b, h, l, d))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _flash(causal, bq, bk, interpret, q, k, v):
+    out, _ = _flash_fwd(causal, bq, bk, interpret, q, k, v)
+    return out
+
+
+def _flash_fwd(causal, bq, bk, interpret, q, k, v):
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    o, lse = _fwd_call(
+        q, k, v, causal=causal, bq=bq, bk=bk, scale=scale, interpret=interpret
+    )
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, bq, bk, interpret, res, g):
+    q, k, v, o, lse = res
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _bwd_call(
+        q, k, v, o, lse, g,
+        causal=causal, bq=bq, bk=bk, scale=scale, interpret=interpret,
+    )
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Exact attention on [B, L, H, D] without materializing [L, L] scores.
+
+    Drop-in for :func:`ops.ring_attention.dense_attention` (same signature,
+    same math, differentiable via fused Pallas backward kernels); use it as
+    the within-device attention whenever L is long enough that the score
+    matrix dominates memory (the crossover on v5e is roughly L ≥ 512).
+    """
+    if q.shape != k.shape or q.shape != v.shape:
+        raise ValueError(f"q/k/v shapes must match: {q.shape} {k.shape} {v.shape}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, l, h, d = q.shape
+    bq = _pick_block(l, block_q)
+    bk = _pick_block(l, block_k)
+    out = _flash(
+        causal, bq, bk, interpret, _to_bh(q), _to_bh(k), _to_bh(v)
+    )
+    return _from_bh(out, b, h)
