@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from distributed_tensorflow_tpu.ops.ring_attention import (
     dense_attention,
     ring_attention,
+    ring_flash_attention,
     ulysses_attention,
 )
 
@@ -177,17 +178,29 @@ class TransformerClassifier:
         x: jax.Array,
         axis_name: str = "seq",
         *,
-        attention: str = "ring",
+        attention: str | None = None,
     ) -> jax.Array:
         """Sequence-parallel forward *body*: call inside ``jax.shard_map``
         with x sharded [B, (seq_len/n)*token_dim] per device and params
         replicated. ``attention`` selects the SP algorithm — ``"ring"``
-        (ppermute KV rotation, bandwidth ∝ sequence) or ``"ulysses"``
+        (ppermute KV rotation, bandwidth ∝ sequence), ``"ring_flash"``
+        (same ring, per-hop local attention in the Pallas flash kernel — no
+        [L_local, L_local] scores; off-TPU the enclosing shard_map needs
+        ``check_vma=False``), or ``"ulysses"``
         (all-to-all seq↔heads reshard, needs heads divisible by the axis
         size); the mean-pool is a cross-device pmean either way. Math
-        identical to :meth:`apply` for both."""
-        if attention not in ("ring", "ulysses"):
-            raise ValueError(f"unknown attention {attention!r}; ring|ulysses")
+        identical to :meth:`apply` for all three. The default (``None``)
+        follows the constructor's ``attention_impl``: ``"flash"`` →
+        ``"ring_flash"``, else ``"ring"`` — so a model configured for flash
+        stays blockwise when it goes sequence-parallel."""
+        if attention is None:
+            attention = (
+                "ring_flash" if self.attention_impl == "flash" else "ring"
+            )
+        if attention not in ("ring", "ring_flash", "ulysses"):
+            raise ValueError(
+                f"unknown attention {attention!r}; ring|ring_flash|ulysses"
+            )
         n = jax.lax.axis_size(axis_name)
         my = jax.lax.axis_index(axis_name)
         l_loc = self.seq_len // n
@@ -198,6 +211,8 @@ class TransformerClassifier:
         q, k, v = self._qkv(params, h)
         if attention == "ring":
             attn = ring_attention(q, k, v, axis_name)
+        elif attention == "ring_flash":
+            attn = ring_flash_attention(q, k, v, axis_name)
         else:
             if self.num_heads % n:
                 raise ValueError(

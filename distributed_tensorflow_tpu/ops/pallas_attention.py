@@ -137,8 +137,11 @@ def _fwd_kernel(
         lse_ref[0] = m_scr[:] + jnp.log(l)
 
 
-def _fwd_call(q, k, v, *, causal, bq, bk, scale, interpret):
-    """[BH, L, D] → (out [BH, L, D], lse [BH, L, 1])."""
+def _fwd_call(q, k, v, *, causal, bq, bk, scale, interpret, vma):
+    """[BH, L, D] → (out [BH, L, D], lse [BH, L, 1]). ``vma`` marks the
+    outputs as varying over those mesh axes — required under a
+    ``check_vma=True`` shard_map (the ring composition)."""
+    sds = partial(jax.ShapeDtypeStruct, vma=vma) if vma else jax.ShapeDtypeStruct
     bh, l, d = q.shape
     nq, nk = l // bq, l // bk
     return pl.pallas_call(
@@ -154,8 +157,8 @@ def _fwd_call(q, k, v, *, causal, bq, bk, scale, interpret):
             pl.BlockSpec((1, bq, 1), lambda b, iq, ik: (b, iq, 0)),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, l, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, l, 1), jnp.float32),
+            sds((bh, l, d), q.dtype),
+            sds((bh, l, 1), jnp.float32),
         ),
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -250,14 +253,10 @@ def _dkv_kernel(
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd_call(q, k, v, o, lse, do, *, causal, bq, bk, scale, interpret):
+def _bwd_call(q, k, v, o, lse, do, delta, *, causal, bq, bk, scale, interpret, vma):
+    sds = partial(jax.ShapeDtypeStruct, vma=vma) if vma else jax.ShapeDtypeStruct
     bh, l, d = q.shape
     nq, nk = l // bq, l // bk
-    # delta_i = rowsum(do ⊙ out): tiny elementwise reduce, XLA fuses it into
-    # the surrounding graph — not worth a kernel.
-    delta = jnp.sum(
-        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
-    )
     qspec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
     rowspec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
     kspec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
@@ -267,7 +266,7 @@ def _bwd_call(q, k, v, o, lse, do, *, causal, bq, bk, scale, interpret):
         grid=(bh, nq, nk),
         in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
         out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((bh, l, d), q.dtype),
+        out_shape=sds((bh, l, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
@@ -282,8 +281,8 @@ def _bwd_call(q, k, v, o, lse, do, *, causal, bq, bk, scale, interpret):
         in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
         out_specs=(kspec2, kspec2),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, l, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, l, d), v.dtype),
+            sds((bh, l, d), k.dtype),
+            sds((bh, l, d), v.dtype),
         ),
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -310,26 +309,37 @@ def _from_bh(x, b, h):
     return jnp.einsum("bhld->blhd", x.reshape(b, h, l, d))
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
-def _flash(causal, bq, bk, interpret, q, k, v):
-    out, _ = _flash_fwd(causal, bq, bk, interpret, q, k, v)
-    return out
-
-
-def _flash_fwd(causal, bq, bk, interpret, q, k, v):
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _flash(causal, bq, bk, interpret, vma, q, k, v):
+    """Primal returns (out, lse) — both differentiable. The lse output is
+    what makes blockwise *composition* (ring attention) differentiable: a
+    cotangent on lse folds into the backward's delta term, since
+    ∂lse_i/∂s_ij = p_ij means ds = p·(dp − (delta − g_lse))·scale."""
     scale = 1.0 / (q.shape[-1] ** 0.5)
-    o, lse = _fwd_call(
-        q, k, v, causal=causal, bq=bq, bk=bk, scale=scale, interpret=interpret
+    return _fwd_call(
+        q, k, v,
+        causal=causal, bq=bq, bk=bk, scale=scale, interpret=interpret, vma=vma,
     )
-    return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, bq, bk, interpret, res, g):
+def _flash_fwd(causal, bq, bk, interpret, vma, q, k, v):
+    o, lse = _flash(causal, bq, bk, interpret, vma, q, k, v)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, bq, bk, interpret, vma, res, g):
     q, k, v, o, lse = res
+    do, dlse = g
     scale = 1.0 / (q.shape[-1] ** 0.5)
+    # delta_i = rowsum(do ⊙ out) − g_lse: tiny elementwise reduce, XLA fuses
+    # it into the surrounding graph — not worth a kernel. g_lse is symbolic
+    # zero (materialized as zeros) when the caller discards lse.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    ) - dlse.astype(jnp.float32)
     return _bwd_call(
-        q, k, v, o, lse, g,
-        causal=causal, bq=bq, bk=bk, scale=scale, interpret=interpret,
+        q, k, v, o, lse, do, delta,
+        causal=causal, bq=bq, bk=bk, scale=scale, interpret=interpret, vma=vma,
     )
 
 
@@ -345,6 +355,7 @@ def flash_attention(
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
+    vma: tuple[str, ...] | None = None,
 ) -> jax.Array:
     """Exact attention on [B, L, H, D] without materializing [L, L] scores.
 
@@ -353,6 +364,31 @@ def flash_attention(
     the within-device attention whenever L is long enough that the score
     matrix dominates memory (the crossover on v5e is roughly L ≥ 512).
     """
+    out, _ = flash_attention_with_lse(
+        q, k, v,
+        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+        vma=vma,
+    )
+    return out
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+    vma: tuple[str, ...] | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """:func:`flash_attention` that also returns the per-row softmax
+    log-sum-exp, shape [B, L, H] f32 — the statistic needed to *combine*
+    partial attention over disjoint KV chunks exactly (ring attention's
+    per-hop accumulation). Both outputs are differentiable. Pass
+    ``vma=(axis,...)`` when calling inside a ``shard_map`` that checks
+    varying-mesh-axes types (Pallas outputs carry no vma by default)."""
     if q.shape != k.shape or q.shape != v.shape:
         raise ValueError(f"q/k/v shapes must match: {q.shape} {k.shape} {v.shape}")
     if interpret is None:
@@ -360,7 +396,9 @@ def flash_attention(
     b, l, h, d = q.shape
     bq = _pick_block(l, block_q)
     bk = _pick_block(l, block_k)
-    out = _flash(
-        causal, bq, bk, interpret, _to_bh(q), _to_bh(k), _to_bh(v)
+    out, lse = _flash(
+        causal, bq, bk, interpret,
+        frozenset(vma) if vma else None,  # ShapeDtypeStruct wants a set
+        _to_bh(q), _to_bh(k), _to_bh(v),
     )
-    return _from_bh(out, b, h)
+    return _from_bh(out, b, h), jnp.transpose(lse.reshape(b, h, l), (0, 2, 1))

@@ -21,7 +21,10 @@ Mechanics (flash-attention-style streaming):
 
 Also here: ``all_to_all_seq_to_heads`` / ``heads_to_seq`` — the
 Ulysses-style alternative that reshards sequence↔heads around attention so
-each device computes full-sequence attention for a head subset.
+each device computes full-sequence attention for a head subset — and
+``ring_flash_attention``, the same KV ring with each hop's local attend
+running the Pallas flash kernel (``ops/pallas_attention``) and hops
+combined by per-row logsumexp, making memory O(block) end to end.
 
 Call these inside ``jax.shard_map`` over the sequence axis.
 """
@@ -47,6 +50,22 @@ def _block_scores(q, k, *, scale, mask=None):
     if mask is not None:
         scores = jnp.where(mask, scores, _NEG_INF)
     return scores
+
+
+def _rotate_unless_last(kv, step, n, *, axis_name, perm):
+    """Forward the KV pair one ring hop — except on the final step, whose
+    rotated result the loop would discard (XLA cannot DCE inside a while
+    loop, so an unconditional permute would pay one dead cross-device hop
+    per attention call). The predicate is device-invariant, so all devices
+    agree on whether the collective runs."""
+    return lax.cond(
+        step < n - 1,
+        lambda kv: jax.tree.map(
+            lambda x: lax.ppermute(x, axis_name, perm), kv
+        ),
+        lambda kv: kv,
+        kv,
+    )
 
 
 def ring_attention(
@@ -118,12 +137,90 @@ def ring_attention(
             m, s, o = lax.cond(src > my, lambda m, s, o: (m, s, o), attend, m, s, o)
         else:
             m, s, o = attend(m, s, o)
-        kv = jax.tree.map(lambda x: lax.ppermute(x, axis_name, perm), (k_blk, v_blk))
+        kv = _rotate_unless_last(
+            (k_blk, v_blk), step, n, axis_name=axis_name, perm=perm
+        )
         return m, s, o, kv
 
     m, s, o, _ = lax.fori_loop(0, n, body, (m, s, o, (k, v)))
     out = o / jnp.maximum(s, 1e-30)
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    block_q: int | None = None,
+    block_k: int | None = None,
+) -> jax.Array:
+    """:func:`ring_attention` with the within-device attend replaced by the
+    Pallas flash kernel (``ops/pallas_attention``): the cross-device KV ring
+    is unchanged, but each hop's local block-pair runs blockwise in VMEM, so
+    per-device memory is O(block) end to end — no [L_local, L_local] score
+    matrix either. Exact (not approximate): each hop returns (partial out,
+    per-row logsumexp) over its KV chunk and the running result is the
+    lse-weighted combination, which telescopes to the full softmax.
+
+    Causal masking decomposes per hop: the KV block held at hop ``step``
+    originated ``step`` positions behind this device, so it is entirely in
+    the past (plain full attention), the diagonal (standard causal flash —
+    offsets coincide), or entirely in the future (skipped; its weight in the
+    combine is exactly zero via lse = -inf). Differentiation rides the flash
+    kernel's custom VJP — the lse cotangent folds into its delta term.
+    """
+    from distributed_tensorflow_tpu.ops.pallas_attention import (
+        flash_attention_with_lse,
+    )
+
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, l_loc, h, d = q.shape
+    perm = _ring_perm(n)
+    kw = dict(block_q=block_q, block_k=block_k, vma=(axis_name,))
+
+    pvary = partial(lax.pcast, axis_name=(axis_name,), to="varying")
+    o = pvary(jnp.zeros((b, l_loc, h, d), jnp.float32))
+    lse = pvary(jnp.full((b, l_loc, h), _NEG_INF, jnp.float32))
+
+    def _full(q, kb, vb):
+        return flash_attention_with_lse(q, kb, vb, causal=False, **kw)
+
+    def _diag(q, kb, vb):
+        return flash_attention_with_lse(q, kb, vb, causal=True, **kw)
+
+    def _skip(q, kb, vb):
+        # Constants, but typed varying to match the flash branches' outputs
+        # under check_vma (all lax.switch branches must agree).
+        return (
+            pvary(jnp.zeros((b, l_loc, h, d), q.dtype)),
+            pvary(jnp.full((b, l_loc, h), _NEG_INF, jnp.float32)),
+        )
+
+    def body(step, carry):
+        o, lse, (k_blk, v_blk) = carry
+        if causal:
+            src = (my - step) % n
+            idx = jnp.where(src > my, 2, jnp.where(src == my, 1, 0))
+            o_i, lse_i = lax.switch(idx, (_full, _diag, _skip), q, k_blk, v_blk)
+        else:
+            o_i, lse_i = _full(q, k_blk, v_blk)
+        new_lse = jnp.logaddexp(lse, lse_i)
+        # Weights sum to exactly 1; fully-masked rows keep lse ~ -inf and
+        # contribute 0 (exp of a huge negative), never NaN.
+        w_prev = jnp.exp(lse - new_lse)
+        w_new = jnp.exp(lse_i - new_lse)
+        o = o * w_prev[..., None] + o_i.astype(jnp.float32) * w_new[..., None]
+        kv = _rotate_unless_last(
+            (k_blk, v_blk), step, n, axis_name=axis_name, perm=perm
+        )
+        return o, new_lse, kv
+
+    o, lse, _ = lax.fori_loop(0, n, body, (o, lse, (k, v)))
+    return o.astype(q.dtype)
 
 
 def dense_attention(q, k, v, *, causal: bool = False) -> jax.Array:
