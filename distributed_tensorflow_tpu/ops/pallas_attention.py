@@ -363,6 +363,11 @@ def flash_attention(
     same math, differentiable via fused Pallas backward kernels); use it as
     the within-device attention whenever L is long enough that the score
     matrix dominates memory (the crossover on v5e is roughly L ≥ 512).
+
+    Auto-picked blocks stay ≤128 (a conservative, pipelining-friendly
+    default); for L ≥ 1k, explicitly passing ``block_q=block_k=512``
+    measured fastest on v5e at two of three tested lengths
+    (docs/performance.md) — tune per shape.
     """
     out, _ = flash_attention_with_lse(
         q, k, v,

@@ -67,12 +67,13 @@ def test_ulysses_matches_dense(qkv, n, causal):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("n", [4, 8])
 @pytest.mark.parametrize("causal", [False, True])
-def test_ring_attention_gradients_match_dense(qkv, causal):
+def test_ring_attention_gradients_match_dense(qkv, n, causal):
     # The training requirement: autodiff through the ppermute ring (fori_loop
     # carries included) must produce the same q/k/v grads as dense attention.
     q, k, v = qkv
-    mesh = _mesh(4)
+    mesh = _mesh(n)
 
     def loss_dense(q, k, v):
         return jnp.sum(dense_attention(q, k, v, causal=causal) ** 2)
@@ -119,12 +120,13 @@ def test_ring_flash_matches_dense(qkv, n, causal):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("n", [4, 8])
 @pytest.mark.parametrize("causal", [False, True])
-def test_ring_flash_gradients_match_dense(qkv, causal):
+def test_ring_flash_gradients_match_dense(qkv, n, causal):
     # Differentiates through the per-hop lse outputs — the only user of the
     # flash kernel's lse-cotangent (delta − g_lse) backward path.
     q, k, v = qkv
-    mesh = _mesh(4)
+    mesh = _mesh(n)
 
     def loss_dense(q, k, v):
         return jnp.sum(dense_attention(q, k, v, causal=causal) ** 2)
