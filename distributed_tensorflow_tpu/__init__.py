@@ -43,6 +43,7 @@ _LAZY_EXPORTS = {
         "distributed_tensorflow_tpu.models",
         "TransformerClassifier",
     ),
+    "GPTLM": ("distributed_tensorflow_tpu.models", "GPTLM"),
     "build_model": ("distributed_tensorflow_tpu.models", "build_model"),
     "ShardedDataParallel": (
         "distributed_tensorflow_tpu.parallel",

@@ -8,6 +8,12 @@ reference tfsingle.py:23-42).
 """
 
 from distributed_tensorflow_tpu.models.cnn import CNN, CNNParams  # noqa: F401
+from distributed_tensorflow_tpu.models.gpt import (  # noqa: F401
+    GPTLM,
+    GPTLMParams,
+    KVCache,
+    make_lm_train_step,
+)
 from distributed_tensorflow_tpu.models.mlp import MLP, MLPParams  # noqa: F401
 from distributed_tensorflow_tpu.models.rnn import (  # noqa: F401
     LSTMClassifier,
@@ -23,6 +29,9 @@ MODEL_REGISTRY = {
     "cnn": CNN,
     "transformer": TransformerClassifier,
     "lstm": LSTMClassifier,
+    # GPTLM is deliberately NOT here: the registry serves the Trainer's
+    # image-classification pipeline (C6/C14); the LM trains through
+    # models.gpt.make_lm_train_step on token batches instead.
 }
 
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 from typing import Any, Protocol, runtime_checkable
 
 import jax
+import jax.numpy as jnp
 
 
 @runtime_checkable
@@ -28,3 +29,12 @@ class Model(Protocol):
     def init(self, seed: int) -> Any: ...
 
     def apply(self, params: Any, x: jax.Array) -> jax.Array: ...
+
+
+def layernorm(x, scale, bias, eps=1e-5):
+    """Shared f32 layernorm over the last axis (transformer and GPT
+    families; one copy so numeric changes cannot diverge silently)."""
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps)) * scale + bias

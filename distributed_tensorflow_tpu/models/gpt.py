@@ -1,0 +1,323 @@
+"""Autoregressive LM family: GPT-style causal decoder with KV-cache decoding.
+
+The reference has no sequence models and no generative path at all (its one
+model is the fixed-feature MLP classifier, SURVEY.md §2 C8; its only
+"inference" is the in-loop accuracy fetch, reference tfsingle.py:94). This
+family completes the framework's long-context story on the *generation*
+side: the training forward is the same causal-attention machinery the
+transformer classifier proves (dense or Pallas flash), and decoding is the
+idiomatic TPU inference shape —
+
+- **static shapes everywhere**: the KV cache is allocated at ``max_len`` up
+  front and written with ``dynamic_update_slice``; the growing sequence
+  never changes a compiled shape, so one executable serves every step;
+- **layers as a scanned stack**: block parameters carry a leading
+  ``num_layers`` axis and the forward is one ``lax.scan`` over it — one
+  trace and one HLO body regardless of depth (no Python-unrolled layers);
+- **decode loop as ``lax.scan``**: greedy generation compiles into a single
+  dispatch, token round-trips never touch the host.
+
+Architecture: token embed → +learned positions → N pre-LN blocks
+(causal attention + GELU MLP, residuals) → final LN → logits through the
+tied embedding (lm_head = embedᵀ). All matmuls in ``compute_dtype`` with
+f32 accumulation; layernorm/softmax/loss f32.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_tensorflow_tpu.models.base import layernorm as _layernorm
+from distributed_tensorflow_tpu.ops.ring_attention import dense_attention
+
+
+class GPTBlockParams(NamedTuple):
+    """One decoder block; every leaf carries a leading [num_layers] axis in
+    ``GPTLMParams.blocks`` so the forward can scan over the stack."""
+
+    ln1_scale: jax.Array
+    ln1_bias: jax.Array
+    wq: jax.Array  # [d, d]
+    wk: jax.Array
+    wv: jax.Array
+    wo: jax.Array
+    ln2_scale: jax.Array
+    ln2_bias: jax.Array
+    w_up: jax.Array  # [d, 4d]
+    b_up: jax.Array
+    w_down: jax.Array  # [4d, d]
+    b_down: jax.Array
+
+
+class GPTLMParams(NamedTuple):
+    embed: jax.Array  # [vocab, d] (also the tied LM head)
+    pos: jax.Array  # [max_len, d]
+    blocks: GPTBlockParams  # leaves stacked over num_layers
+    lnf_scale: jax.Array
+    lnf_bias: jax.Array
+
+
+class KVCache(NamedTuple):
+    """Decode state: per-layer keys/values at full ``max_len`` (static
+    shape), plus the number of valid positions."""
+
+    k: jax.Array  # [num_layers, B, max_len, H, Dh]
+    v: jax.Array  # [num_layers, B, max_len, H, Dh]
+    length: jax.Array  # scalar int32
+
+
+class GPTLM:
+    """tokens [B, L] int32 → next-token logits [B, L, vocab]."""
+
+    def __init__(
+        self,
+        vocab_size: int = 256,
+        max_len: int = 128,
+        model_dim: int = 64,
+        num_heads: int = 4,
+        num_layers: int = 2,
+        compute_dtype: jnp.dtype = jnp.bfloat16,
+        attention_impl: str = "xla",
+    ):
+        assert model_dim % num_heads == 0
+        if attention_impl not in ("xla", "flash"):
+            raise ValueError(
+                f"unknown attention_impl {attention_impl!r}; xla|flash"
+            )
+        self.vocab_size = vocab_size
+        self.max_len = max_len
+        self.model_dim = model_dim
+        self.num_heads = num_heads
+        self.head_dim = model_dim // num_heads
+        self.num_layers = num_layers
+        self.compute_dtype = compute_dtype
+        self.attention_impl = attention_impl
+
+    # -- init --------------------------------------------------------------
+
+    def init(self, seed: int = 1) -> GPTLMParams:
+        d = self.model_dim
+        n = self.num_layers
+        keys = jax.random.split(jax.random.key(seed), 7)
+
+        def dense_init(key, shape):
+            # fan-in scaled; leading num_layers axis gets independent draws
+            return jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(
+                shape[-2]
+            )
+
+        return GPTLMParams(
+            embed=0.02
+            * jax.random.normal(keys[0], (self.vocab_size, d), jnp.float32),
+            pos=0.02
+            * jax.random.normal(keys[1], (self.max_len, d), jnp.float32),
+            blocks=GPTBlockParams(
+                ln1_scale=jnp.ones((n, d), jnp.float32),
+                ln1_bias=jnp.zeros((n, d), jnp.float32),
+                wq=dense_init(keys[2], (n, d, d)),
+                wk=dense_init(keys[3], (n, d, d)),
+                wv=dense_init(keys[4], (n, d, d)),
+                # residual-path projections start at zero: the depth-N stack
+                # begins as the identity, a stable start at any depth.
+                wo=jnp.zeros((n, d, d), jnp.float32),
+                ln2_scale=jnp.ones((n, d), jnp.float32),
+                ln2_bias=jnp.zeros((n, d), jnp.float32),
+                w_up=dense_init(keys[5], (n, d, 4 * d)),
+                b_up=jnp.zeros((n, 4 * d), jnp.float32),
+                w_down=jnp.zeros((n, 4 * d, d), jnp.float32),
+                b_down=jnp.zeros((n, d), jnp.float32),
+            ),
+            lnf_scale=jnp.ones((d,), jnp.float32),
+            lnf_bias=jnp.zeros((d,), jnp.float32),
+        )
+
+    # -- shared pieces -----------------------------------------------------
+
+    def _dot(self, x, w):
+        cd = self.compute_dtype
+        return jnp.dot(
+            x.astype(cd), w.astype(cd), preferred_element_type=jnp.float32
+        )
+
+    def _attend(self, q, k, v):
+        if self.attention_impl == "flash":
+            from distributed_tensorflow_tpu.ops.pallas_attention import (
+                flash_attention,
+            )
+
+            return flash_attention(q, k, v, causal=True)
+        return dense_attention(q, k, v, causal=True)
+
+    def _block(self, blk: GPTBlockParams, h):
+        """Full-sequence block forward; also returns this block's k/v for
+        cache prefill. h: [B, L, d]."""
+        b, l, d = h.shape
+        hn = _layernorm(h, blk.ln1_scale, blk.ln1_bias)
+        shape = (b, l, self.num_heads, self.head_dim)
+        q = self._dot(hn, blk.wq).reshape(shape)
+        k = self._dot(hn, blk.wk).reshape(shape)
+        v = self._dot(hn, blk.wv).reshape(shape)
+        attn = self._attend(q, k, v)
+        h = h + self._dot(attn.reshape(b, l, d), blk.wo)
+        hn2 = _layernorm(h, blk.ln2_scale, blk.ln2_bias)
+        mlp = self._dot(
+            jax.nn.gelu(self._dot(hn2, blk.w_up) + blk.b_up), blk.w_down
+        )
+        return h + mlp + blk.b_down, (k, v)
+
+    def _logits(self, p: GPTLMParams, h):
+        hf = _layernorm(h, p.lnf_scale, p.lnf_bias)
+        return self._dot(hf, p.embed.T)
+
+    # -- training forward --------------------------------------------------
+
+    def apply(self, params: GPTLMParams, tokens: jax.Array) -> jax.Array:
+        """tokens [B, L] int32 → logits [B, L, vocab], causal."""
+        l = tokens.shape[1]
+        h = params.embed[tokens] + params.pos[:l]
+
+        def body(h, blk):
+            h, _ = self._block(blk, h)
+            return h, None
+
+        h, _ = lax.scan(body, h, params.blocks)
+        return self._logits(params, h)
+
+    def loss(self, params: GPTLMParams, tokens: jax.Array) -> jax.Array:
+        """Mean next-token cross-entropy (positions 0..L-2 predict 1..L-1),
+        f32 log-softmax."""
+        logits = self.apply(params, tokens)[:, :-1]
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return -jnp.mean(picked)
+
+    # -- KV-cache decoding -------------------------------------------------
+
+    def prefill(self, params: GPTLMParams, tokens: jax.Array):
+        """Run the prompt once, returning (last-position logits [B, vocab],
+        cache holding every layer's prompt k/v)."""
+        b, l = tokens.shape
+        h = params.embed[tokens] + params.pos[:l]
+
+        def body(h, blk):
+            h, kv = self._block(blk, h)
+            return h, kv
+
+        h, (ks, vs) = lax.scan(body, h, params.blocks)
+        pad = [(0, 0), (0, 0), (0, self.max_len - l), (0, 0), (0, 0)]
+        cache = KVCache(
+            k=jnp.pad(ks.astype(self.compute_dtype), pad),
+            v=jnp.pad(vs.astype(self.compute_dtype), pad),
+            length=jnp.asarray(l, jnp.int32),
+        )
+        return self._logits(params, h)[:, -1], cache
+
+    def _decode_block(self, blk: GPTBlockParams, h, ck, cv, length):
+        """Single-token block step. h: [B, 1, d]; ck/cv: [B, max_len, H, Dh]
+        (this layer's cache). Returns (h, updated ck, updated cv)."""
+        b = h.shape[0]
+        hn = _layernorm(h, blk.ln1_scale, blk.ln1_bias)
+        shape = (b, 1, self.num_heads, self.head_dim)
+        q = self._dot(hn, blk.wq).reshape(shape)
+        k = self._dot(hn, blk.wk).reshape(shape).astype(ck.dtype)
+        v = self._dot(hn, blk.wv).reshape(shape).astype(cv.dtype)
+        ck = lax.dynamic_update_slice(ck, k, (0, length, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v, (0, length, 0, 0))
+        # Attend the one query against the whole static-length cache,
+        # masking positions past `length` (self included via <=).
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, ck, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(jnp.asarray(self.head_dim, jnp.float32))
+        valid = jnp.arange(self.max_len) <= length  # [max_len]
+        scores = jnp.where(valid[None, None, None, :], scores, -1e30)
+        w = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum(
+            "bhqk,bkhd->bqhd",
+            w.astype(cv.dtype),
+            cv,
+            preferred_element_type=jnp.float32,
+        )
+        h = h + self._dot(attn.reshape(b, 1, self.model_dim), blk.wo)
+        hn2 = _layernorm(h, blk.ln2_scale, blk.ln2_bias)
+        mlp = self._dot(
+            jax.nn.gelu(self._dot(hn2, blk.w_up) + blk.b_up), blk.w_down
+        )
+        return h + mlp + blk.b_down, ck, cv
+
+    def decode_step(self, params: GPTLMParams, token: jax.Array, cache: KVCache):
+        """Append one token [B] int32; returns (logits [B, vocab], cache).
+
+        The cache is full at ``length == max_len``; stepping past it would
+        silently clamp (``dynamic_update_slice`` semantics) and corrupt the
+        last slot, so eager calls raise instead. Under a trace the length is
+        abstract — loop drivers must bound their own trip count the way
+        :meth:`greedy_decode` does."""
+        if not isinstance(cache.length, jax.core.Tracer):
+            if int(cache.length) >= self.max_len:
+                raise ValueError(
+                    f"KV cache full: length {int(cache.length)} == max_len "
+                    f"{self.max_len}; increase max_len"
+                )
+        pos = lax.dynamic_slice_in_dim(params.pos, cache.length, 1, axis=0)
+        h = params.embed[token][:, None, :] + pos
+
+        def body(h, xs):
+            blk, ck, cv = xs
+            h, ck, cv = self._decode_block(blk, h, ck, cv, cache.length)
+            return h, (ck, cv)
+
+        h, (nk, nv) = lax.scan(body, h, (params.blocks, cache.k, cache.v))
+        new_cache = KVCache(k=nk, v=nv, length=cache.length + 1)
+        return self._logits(params, h)[:, 0], new_cache
+
+    def greedy_decode(
+        self, params: GPTLMParams, prompt: jax.Array, max_new: int
+    ) -> jax.Array:
+        """[B, L0] prompt → [B, L0 + max_new] (``max_new`` ≥ 1); the whole
+        generation loop is one ``lax.scan`` (jit it once, no host
+        round-trips per token)."""
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        if prompt.shape[1] + max_new > self.max_len:
+            raise ValueError(
+                f"prompt {prompt.shape[1]} + max_new {max_new} exceeds "
+                f"max_len {self.max_len}"
+            )
+        logits, cache = self.prefill(params, prompt)
+        first = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+
+        def body(carry, _):
+            tok, cache = carry
+            logits, cache = self.decode_step(params, tok, cache)
+            nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
+            return (nxt, cache), nxt
+
+        if max_new > 1:
+            _, rest = lax.scan(body, (first, cache), None, length=max_new - 1)
+            generated = jnp.concatenate(
+                [first[None], rest], axis=0
+            ).swapaxes(0, 1)
+        else:
+            generated = first[:, None]
+        return jnp.concatenate([prompt, generated], axis=1)
+
+
+def make_lm_train_step(model: GPTLM, optimizer):
+    """``step(params, opt_state, tokens) -> (params, opt_state, loss)``,
+    jitted, for any optax ``GradientTransformation`` (ops/optim.make)."""
+    import optax
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(model.loss)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

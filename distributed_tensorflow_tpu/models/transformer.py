@@ -29,6 +29,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from distributed_tensorflow_tpu.models.base import layernorm as _layernorm
 from distributed_tensorflow_tpu.ops.ring_attention import (
     dense_attention,
     ring_attention,
@@ -54,13 +55,6 @@ class TransformerParams(NamedTuple):
     b_down: jax.Array
     w_head: jax.Array  # [model_dim, classes]
     b_head: jax.Array
-
-
-def _layernorm(x, scale, bias, eps=1e-5):
-    x32 = x.astype(jnp.float32)
-    mu = x32.mean(-1, keepdims=True)
-    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
-    return ((x32 - mu) * jax.lax.rsqrt(var + eps)) * scale + bias
 
 
 class TransformerClassifier:

@@ -1,0 +1,190 @@
+"""GPT LM family tests: causality, KV-cache decode equivalence to the
+naive re-forward, training descent on a copy task, and the flash-attention
+variant agreeing with the XLA path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models.gpt import GPTLM, make_lm_train_step
+from distributed_tensorflow_tpu.ops import optim as optim_lib
+
+
+def _model(**kw):
+    kw.setdefault("vocab_size", 61)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("model_dim", 32)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("compute_dtype", jnp.float32)
+    return GPTLM(**kw)
+
+
+def _tokens(rng, b, l, vocab=61):
+    return jnp.asarray(rng.integers(0, vocab, size=(b, l)), jnp.int32)
+
+
+def test_shapes_and_determinism():
+    model = _model()
+    p1, p2 = model.init(seed=1), model.init(seed=1)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_array_equal(a, b)
+    toks = _tokens(np.random.default_rng(0), 2, 16)
+    logits = model.apply(p1, toks)
+    assert logits.shape == (2, 16, 61)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_causality():
+    # Perturbing token j must not change logits at any position < j.
+    model = _model()
+    params = model.init(seed=1)
+    rng = np.random.default_rng(1)
+    toks = _tokens(rng, 1, 16)
+    j = 10
+    base = np.asarray(model.apply(params, toks))
+    perturbed = toks.at[0, j].set((toks[0, j] + 7) % 61)
+    got = np.asarray(model.apply(params, perturbed))
+    np.testing.assert_allclose(got[:, :j], base[:, :j], atol=1e-6)
+    assert np.abs(got[:, j:] - base[:, j:]).max() > 1e-4  # it does depend
+
+
+def test_greedy_decode_matches_naive_reforward():
+    # The KV-cache path must generate exactly what re-running the full
+    # forward on the growing sequence generates.
+    model = _model()
+    params = model.init(seed=2)
+    rng = np.random.default_rng(2)
+    prompt = _tokens(rng, 2, 5)
+    max_new = 9
+
+    got = np.asarray(
+        jax.jit(lambda p, t: model.greedy_decode(p, t, max_new))(params, prompt)
+    )
+
+    seq = prompt
+    for _ in range(max_new):
+        logits = model.apply(params, seq)[:, -1]
+        nxt = jnp.argmax(logits, axis=-1).astype(seq.dtype)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    want = np.asarray(seq)
+
+    np.testing.assert_array_equal(got, want)
+
+
+def test_decode_step_logits_match_full_forward():
+    # Beyond argmax agreement: the cached single-token logits themselves
+    # must match the last-position logits of the full forward.
+    model = _model()
+    params = model.init(seed=3)
+    rng = np.random.default_rng(3)
+    prompt = _tokens(rng, 2, 6)
+
+    logits0, cache = model.prefill(params, prompt)
+    np.testing.assert_allclose(
+        np.asarray(logits0),
+        np.asarray(model.apply(params, prompt)[:, -1]),
+        atol=1e-5,
+    )
+
+    nxt = jnp.argmax(logits0, -1).astype(prompt.dtype)
+    step_logits, cache = model.decode_step(params, nxt, cache)
+    full = model.apply(
+        params, jnp.concatenate([prompt, nxt[:, None]], axis=1)
+    )[:, -1]
+    np.testing.assert_allclose(
+        np.asarray(step_logits), np.asarray(full), atol=1e-5
+    )
+    assert int(cache.length) == 7
+
+
+def test_flash_variant_matches_xla():
+    # L=32 has small divisors, so flash runs blockwise even at toy size.
+    xla = _model()
+    flash = _model(attention_impl="flash")
+    params = xla.init(seed=4)
+    toks = _tokens(np.random.default_rng(4), 2, 32)
+    np.testing.assert_allclose(
+        np.asarray(flash.apply(params, toks)),
+        np.asarray(xla.apply(params, toks)),
+        atol=2e-4,
+    )
+
+
+def test_lm_trains_on_copy_task():
+    # Sequences of the form [x0..x7, x0..x7]: after training, loss on the
+    # repeated half must drop well below chance.
+    model = _model(num_layers=2)
+    params = model.init(seed=5)
+    opt = optim_lib.make("adam", 3e-3)
+    opt_state = opt.init(params)
+    step = make_lm_train_step(model, opt)
+    rng = np.random.default_rng(5)
+
+    def batch():
+        half = rng.integers(0, 61, size=(16, 8))
+        return jnp.asarray(np.concatenate([half, half], axis=1), jnp.int32)
+
+    for _ in range(250):
+        params, opt_state, loss = step(params, opt_state, batch())
+    last = float(loss)
+    # Chance is log(61) ≈ 4.11 on every position; a model that copies the
+    # repeated half perfectly bottoms out near (7·4.11 + 8·0)/15 ≈ 1.92
+    # (measured plateau ≈ 1.95 by step ~250). 2.3 = copy clearly learned.
+    assert last < 2.3, last
+
+
+def test_decode_rejects_overflow():
+    model = _model()
+    params = model.init(seed=6)
+    prompt = _tokens(np.random.default_rng(6), 1, 30)
+    with pytest.raises(ValueError, match="exceeds"):
+        model.greedy_decode(params, prompt, 10)
+    with pytest.raises(ValueError, match="max_new"):
+        model.greedy_decode(params, prompt, 0)
+
+
+def _noisy(params, scale=0.3, seed=7):
+    # init zeroes the residual projections (identity start), which would let
+    # a cache-path bug in the attention output slip through equality tests;
+    # noise makes every path contribute.
+    leaves, treedef = jax.tree.flatten(params)
+    keys = jax.random.split(jax.random.key(seed), len(leaves))
+    return jax.tree.unflatten(
+        treedef,
+        [
+            l + scale * jax.random.normal(k, l.shape, l.dtype)
+            for l, k in zip(leaves, keys)
+        ],
+    )
+
+
+def test_decode_step_full_cache_raises_eagerly():
+    model = _model()
+    params = model.init(seed=8)
+    prompt = _tokens(np.random.default_rng(8), 1, 32)  # fills max_len
+    _, cache = model.prefill(params, prompt)
+    with pytest.raises(ValueError, match="cache full"):
+        model.decode_step(params, jnp.zeros((1,), jnp.int32), cache)
+
+
+def test_decode_matches_reforward_at_bf16_default():
+    # The cache path casts k/v and softmax weights to compute_dtype while
+    # the full forward keeps them f32 in dense_attention — at the bf16
+    # default these are genuinely different numerics, so the agreement
+    # tolerance is bf16-sized rather than exact.
+    model = _model(compute_dtype=jnp.bfloat16)
+    params = _noisy(model.init(seed=9))
+    prompt = _tokens(np.random.default_rng(9), 2, 6)
+
+    logits0, cache = model.prefill(params, prompt)
+    nxt = jnp.argmax(logits0, -1).astype(prompt.dtype)
+    step_logits, cache = model.decode_step(params, nxt, cache)
+    full = model.apply(
+        params, jnp.concatenate([prompt, nxt[:, None]], axis=1)
+    )[:, -1]
+    scale = float(jnp.max(jnp.abs(full)))
+    np.testing.assert_allclose(
+        np.asarray(step_logits), np.asarray(full), atol=0.05 * max(scale, 1.0)
+    )
