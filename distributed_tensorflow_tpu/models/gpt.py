@@ -153,16 +153,19 @@ class GPTLM:
             return flash_attention(q, k, v, causal=True)
         return dense_attention(q, k, v, causal=True)
 
-    def _block(self, blk: GPTBlockParams, h):
-        """Full-sequence block forward; also returns this block's k/v for
-        cache prefill. h: [B, L, d]."""
+    def _block(self, blk: GPTBlockParams, h, attend=None):
+        """Block forward; also returns this block's k/v for cache prefill.
+        h: [B, L, d]. ``attend`` swaps the attention algorithm (the
+        sequence-parallel path passes the ring) without duplicating the
+        surrounding layernorm/projection/MLP math — one source of truth for
+        the block, so sp==dense stays pinned by construction."""
         b, l, d = h.shape
         hn = _layernorm(h, blk.ln1_scale, blk.ln1_bias)
         shape = (b, l, self.num_heads, self.head_dim)
         q = self._dot(hn, blk.wq).reshape(shape)
         k = self._dot(hn, blk.wk).reshape(shape)
         v = self._dot(hn, blk.wv).reshape(shape)
-        attn = self._attend(q, k, v)
+        attn = (attend or self._attend)(q, k, v)
         h = h + self._dot(attn.reshape(b, l, d), blk.wo)
         hn2 = _layernorm(h, blk.ln2_scale, blk.ln2_bias)
         mlp = self._dot(
@@ -183,6 +186,63 @@ class GPTLM:
 
         def body(h, blk):
             h, _ = self._block(blk, h)
+            return h, None
+
+        h, _ = lax.scan(body, h, params.blocks)
+        return self._logits(params, h)
+
+    def apply_sequence_parallel(
+        self,
+        params: GPTLMParams,
+        tokens: jax.Array,
+        axis_name: str = "seq",
+        *,
+        attention: str | None = None,
+    ) -> jax.Array:
+        """Sequence-parallel causal forward *body*: call inside
+        ``jax.shard_map`` with tokens sharded [B, L/n] per device and params
+        replicated; returns this device's logits shard [B, L/n, vocab] —
+        identical to the matching slice of :meth:`apply` on the gathered
+        sequence. ``attention`` is ``"ring"`` or ``"ring_flash"`` (default
+        follows ``attention_impl``, like the transformer classifier; the
+        flash variant needs ``check_vma=False`` in the enclosing shard_map
+        off-TPU). This is how the LM trains past one device's activation
+        memory: L/n tokens of activations per device, KV blocks riding the
+        ring."""
+        from distributed_tensorflow_tpu.ops.ring_attention import (
+            ring_attention,
+            ring_flash_attention,
+        )
+
+        if attention is None:
+            attention = (
+                "ring_flash" if self.attention_impl == "flash" else "ring"
+            )
+        if attention not in ("ring", "ring_flash"):
+            raise ValueError(
+                f"unknown attention {attention!r}; ring|ring_flash"
+            )
+        ring = ring_attention if attention == "ring" else ring_flash_attention
+
+        n = lax.axis_size(axis_name)
+        my = lax.axis_index(axis_name)
+        b, l_loc = tokens.shape
+        if n * l_loc > self.max_len:
+            # dynamic_slice would silently CLAMP the positional slice for
+            # the last devices (duplicating other shards' positions) where
+            # the dense path fails loudly — so fail loudly here too.
+            raise ValueError(
+                f"global sequence {n * l_loc} exceeds max_len {self.max_len}"
+            )
+        pos = lax.dynamic_slice_in_dim(
+            params.pos, my * l_loc, l_loc, axis=0
+        )
+        h = params.embed[tokens] + pos
+
+        def body(h, blk):
+            h, _ = self._block(
+                blk, h, attend=lambda q, k, v: ring(q, k, v, axis_name, causal=True)
+            )
             return h, None
 
         h, _ = lax.scan(body, h, params.blocks)
@@ -308,16 +368,48 @@ class GPTLM:
         return jnp.concatenate([prompt, generated], axis=1)
 
 
-def make_lm_train_step(model: GPTLM, optimizer):
+def make_lm_train_step(model: GPTLM, optimizer, mesh=None, axis: str = "data"):
     """``step(params, opt_state, tokens) -> (params, opt_state, loss)``,
-    jitted, for any optax ``GradientTransformation`` (ops/optim.make)."""
+    jitted, for any optax ``GradientTransformation`` (ops/optim.make).
+
+    With ``mesh`` the step runs data-parallel over its ``axis``: tokens
+    sharded on the batch dim, params/opt-state replicated, gradients
+    all-reduced — the LM analog of ``SyncDataParallel``'s compiled
+    collective (the reference's sync mode, tfdist_between_sync.py:66-68,
+    minus the parameter server). Identical math to the single-device step on
+    the same global batch. Under ``shard_map`` AD auto-inserts a psum for
+    grads of the replicated params, so the local grads are *summed* — the
+    code divides by the axis size rather than pmean-ing (CLAUDE.md)."""
     import optax
 
-    @jax.jit
-    def step(params, opt_state, tokens):
+    if mesh is None:
+
+        @jax.jit
+        def step(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(model.loss)(params, tokens)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return step
+
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+
+    def local(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(model.loss)(params, tokens)
+        # AD's auto-psum summed the per-device grads of the replicated
+        # params; the global-mean loss needs their mean.
+        grads = jax.tree.map(lambda g: g / n, grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        return params, opt_state, lax.pmean(loss, axis)
 
-    return step
+    mapped = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis)),
+        out_specs=(P(), P(), P()),
+    )
+    return jax.jit(mapped)

@@ -135,6 +135,57 @@ def test_lm_trains_on_copy_task():
     assert last < 2.3, last
 
 
+@pytest.mark.parametrize("attention", ["ring", "ring_flash"])
+def test_sequence_parallel_matches_dense(attention):
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    model = _model()
+    params = model.init(seed=12)
+    toks = _tokens(np.random.default_rng(12), 2, 32)
+    want = np.asarray(model.apply(params, toks))
+
+    mesh = make_mesh((4,), ("seq",), devices=jax.devices()[:4])
+    got = np.asarray(
+        jax.jit(
+            jax.shard_map(
+                lambda p, t: model.apply_sequence_parallel(
+                    p, t, "seq", attention=attention
+                ),
+                mesh=mesh,
+                in_specs=(P(), P(None, "seq")),
+                out_specs=P(None, "seq"),
+                check_vma=(attention != "ring_flash"),
+            )
+        )(params, toks)
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_dp_train_step_matches_single_device():
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    model = _model()
+    params = model.init(seed=13)
+    opt = optim_lib.make("adam", 1e-3)
+    opt_state = opt.init(params)
+    toks = _tokens(np.random.default_rng(13), 16, 16)
+
+    single = make_lm_train_step(model, opt)
+    p1, _, l1 = single(params, opt_state, toks)
+
+    mesh = make_mesh((8,), ("data",), devices=jax.devices()[:8])
+    dp = make_lm_train_step(model, opt, mesh=mesh)
+    p2, _, l2 = dp(params, opt_state, toks)
+
+    np.testing.assert_allclose(float(l2), float(l1), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=1e-4, atol=1e-6
+        )
+
+
 def test_decode_rejects_overflow():
     model = _model()
     params = model.init(seed=6)
