@@ -136,6 +136,42 @@ class GPTLM:
             lnf_bias=jnp.zeros((d,), jnp.float32),
         )
 
+    def partition_specs(self, model_axis: str = "model") -> GPTLMParams:
+        """Megatron-style tensor-parallel layout over ``model_axis`` (same
+        convention as ``MLP.partition_specs``; every block leaf keeps its
+        leading num_layers axis unsharded).
+
+        Attention: wq/wk/wv column-split on their output dim — the split
+        lands on whole heads as long as the axis size divides num_heads —
+        and wo row-split, so attention computes on local head groups with
+        one all-reduce after the output projection. MLP: w_up column-split,
+        w_down row-split (all-reduce after). Embeddings, positions, norms,
+        and biases on the residual stream stay replicated. Apply by placing
+        params with ``NamedSharding(mesh, spec)`` and calling the ordinary
+        jitted step — GSPMD inserts the collectives."""
+        from jax.sharding import PartitionSpec as P
+
+        return GPTLMParams(
+            embed=P(),
+            pos=P(),
+            blocks=GPTBlockParams(
+                ln1_scale=P(),
+                ln1_bias=P(),
+                wq=P(None, None, model_axis),
+                wk=P(None, None, model_axis),
+                wv=P(None, None, model_axis),
+                wo=P(None, model_axis, None),
+                ln2_scale=P(),
+                ln2_bias=P(),
+                w_up=P(None, None, model_axis),
+                b_up=P(None, model_axis),
+                w_down=P(None, model_axis, None),
+                b_down=P(),
+            ),
+            lnf_scale=P(),
+            lnf_bias=P(),
+        )
+
     # -- shared pieces -----------------------------------------------------
 
     def _dot(self, x, w):
@@ -336,12 +372,10 @@ class GPTLM:
         new_cache = KVCache(k=nk, v=nv, length=cache.length + 1)
         return self._logits(params, h)[:, 0], new_cache
 
-    def greedy_decode(
-        self, params: GPTLMParams, prompt: jax.Array, max_new: int
-    ) -> jax.Array:
-        """[B, L0] prompt → [B, L0 + max_new] (``max_new`` ≥ 1); the whole
-        generation loop is one ``lax.scan`` (jit it once, no host
-        round-trips per token)."""
+    def _decode_loop(self, params, prompt, max_new, pick, key):
+        """Shared generation scaffold: prefill, then one ``lax.scan`` of
+        decode steps, each choosing the next token via ``pick(logits, key)``
+        (greedy ignores the key). Returns [B, L0 + max_new]."""
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
         if prompt.shape[1] + max_new > self.max_len:
@@ -350,22 +384,73 @@ class GPTLM:
                 f"max_len {self.max_len}"
             )
         logits, cache = self.prefill(params, prompt)
-        first = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        key, sub = jax.random.split(key)
+        first = pick(logits, sub)
 
         def body(carry, _):
-            tok, cache = carry
+            tok, cache, key = carry
             logits, cache = self.decode_step(params, tok, cache)
-            nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
-            return (nxt, cache), nxt
+            key, sub = jax.random.split(key)
+            nxt = pick(logits, sub)
+            return (nxt, cache, key), nxt
 
         if max_new > 1:
-            _, rest = lax.scan(body, (first, cache), None, length=max_new - 1)
-            generated = jnp.concatenate(
-                [first[None], rest], axis=0
-            ).swapaxes(0, 1)
+            _, rest = lax.scan(
+                body, (first, cache, key), None, length=max_new - 1
+            )
+            generated = jnp.concatenate([first[None], rest], axis=0).swapaxes(
+                0, 1
+            )
         else:
             generated = first[:, None]
         return jnp.concatenate([prompt, generated], axis=1)
+
+    def greedy_decode(
+        self, params: GPTLMParams, prompt: jax.Array, max_new: int
+    ) -> jax.Array:
+        """[B, L0] prompt → [B, L0 + max_new] (``max_new`` ≥ 1); the whole
+        generation loop is one ``lax.scan`` (jit it once, no host
+        round-trips per token)."""
+
+        def pick(logits, _key):
+            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+
+        return self._decode_loop(
+            params, prompt, max_new, pick, jax.random.key(0)
+        )
+
+    def sample_decode(
+        self,
+        params: GPTLMParams,
+        prompt: jax.Array,
+        max_new: int,
+        key: jax.Array,
+        *,
+        temperature: float = 1.0,
+        top_k: int | None = None,
+    ) -> jax.Array:
+        """Stochastic counterpart of :meth:`greedy_decode`: categorical
+        sampling from ``logits/temperature``, optionally truncated to the
+        ``top_k`` highest-probability tokens. Same one-``lax.scan`` shape —
+        the PRNG key rides the carry, so generation stays fully on-device
+        and reproducible per key. ``top_k=1`` is exactly greedy."""
+        if temperature <= 0:
+            raise ValueError(f"temperature must be > 0, got {temperature}")
+        if top_k is not None and not 1 <= top_k <= self.vocab_size:
+            raise ValueError(
+                f"top_k must be in [1, {self.vocab_size}], got {top_k}"
+            )
+
+        def pick(logits, k):
+            logits = logits.astype(jnp.float32) / temperature
+            if top_k is not None:
+                kth = lax.top_k(logits, top_k)[0][..., -1:]
+                logits = jnp.where(logits >= kth, logits, -jnp.inf)
+            return jax.random.categorical(k, logits, axis=-1).astype(
+                prompt.dtype
+            )
+
+        return self._decode_loop(params, prompt, max_new, pick, key)
 
 
 def make_lm_train_step(model: GPTLM, optimizer, mesh=None, axis: str = "data"):

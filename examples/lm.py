@@ -1,0 +1,74 @@
+"""Language-model training + generation — the capability the reference never
+had (its one model is the MLP classifier, reference tfsingle.py:23-42).
+
+Run: ``python examples/lm.py [epochs] [max_new]``
+
+Trains a small GPT-style causal LM on a synthetic copy task (sequences of
+the form ``x · x`` — the model must learn to attend back and reproduce the
+first half), printing the reference-style Step/Cost lines, then generates
+from a held-out prompt with the static-shape KV cache: greedy and sampled.
+``DTF_LM_FLASH=1`` switches the causal attention to the Pallas flash
+kernel.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_tpu.models.gpt import GPTLM, make_lm_train_step
+from distributed_tensorflow_tpu.ops import optim as optim_lib
+
+
+def main(steps: int = 300, max_new: int = 16) -> None:
+    model = GPTLM(
+        vocab_size=61,
+        max_len=48,
+        model_dim=64,
+        num_heads=4,
+        num_layers=2,
+        compute_dtype=jnp.float32,
+        attention_impl="flash" if os.environ.get("DTF_LM_FLASH") else "xla",
+    )
+    params = model.init(seed=1)
+    opt = optim_lib.make("adam", 3e-3)
+    opt_state = opt.init(params)
+    step = make_lm_train_step(model, opt)
+    rng = np.random.default_rng(0)
+
+    def batch():
+        half = rng.integers(0, 61, size=(16, 8))
+        return jnp.asarray(np.concatenate([half, half], axis=1), jnp.int32)
+
+    t0 = time.time()
+    for i in range(1, steps + 1):
+        params, opt_state, loss = step(params, opt_state, batch())
+        if i % 50 == 0 or i == 1:
+            print(f"Step: {i},  Cost: {float(loss):.4f}")
+    print(f"Total Time: {time.time() - t0:.2f}s")
+
+    half = rng.integers(0, 61, size=(2, 8))
+    prompt = jnp.asarray(
+        np.concatenate([half, half[:, :2]], axis=1), jnp.int32
+    )  # first half + 2 copied tokens: the model should continue the copy
+    greedy = model.greedy_decode(params, prompt, max_new)
+    sampled = model.sample_decode(
+        params, prompt, max_new, jax.random.key(0), temperature=0.7, top_k=8
+    )
+    ncheck = min(6, max_new)
+    copied = np.asarray(greedy[:, 10 : 10 + ncheck])
+    want = half[:, 2 : 2 + ncheck]
+    print(f"greedy continuation:  {np.asarray(greedy)[0, 10:].tolist()}")
+    print(f"sampled continuation: {np.asarray(sampled)[0, 10:].tolist()}")
+    print(f"copy-accuracy (greedy): {(copied == want).mean():.2f}")
+    print("Done")
+
+
+if __name__ == "__main__":
+    argv = [int(a) for a in sys.argv[1:3]]
+    main(*argv)

@@ -64,3 +64,12 @@ def test_ps_role_noop():
     assert r.returncode == 0, r.stdout + r.stderr
     assert "ps setting up ..." in r.stdout
     assert "Done" not in r.stdout  # no training happened
+
+
+def test_lm_example_trains_and_generates():
+    # 120 steps is enough for the copy task to clearly beat chance (full
+    # convergence needs ~250; the example defaults to 300).
+    r = _run("lm.py", "120", "8", timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "greedy continuation:" in r.stdout
+    assert r.stdout.rstrip().endswith("Done")

@@ -186,6 +186,69 @@ def test_dp_train_step_matches_single_device():
         )
 
 
+def test_sample_decode_topk1_is_greedy():
+    model = _model()
+    params = _noisy(model.init(seed=15))
+    prompt = _tokens(np.random.default_rng(15), 2, 5)
+    greedy = np.asarray(model.greedy_decode(params, prompt, 8))
+    sampled = np.asarray(
+        model.sample_decode(
+            params, prompt, 8, jax.random.key(0), top_k=1
+        )
+    )
+    np.testing.assert_array_equal(sampled, greedy)
+
+
+def test_sample_decode_valid_and_key_dependent():
+    model = _model()
+    params = _noisy(model.init(seed=16))
+    prompt = _tokens(np.random.default_rng(16), 2, 5)
+    fn = jax.jit(
+        lambda p, t, k: model.sample_decode(p, t, 12, k, temperature=1.0)
+    )
+    a = np.asarray(fn(params, prompt, jax.random.key(1)))
+    b = np.asarray(fn(params, prompt, jax.random.key(2)))
+    assert a.shape == (2, 17)
+    assert ((a >= 0) & (a < 61)).all()
+    np.testing.assert_array_equal(a[:, :5], np.asarray(prompt))
+    # near-uniform toy model, 24 sampled positions: identical draws from
+    # two keys would be astronomically unlikely
+    assert not np.array_equal(a, b)
+
+
+def test_tensor_parallel_step_matches_single_device():
+    # GSPMD TP: params placed per partition_specs on a (data, model) mesh,
+    # the ordinary jitted step runs, XLA inserts the collectives — results
+    # must match the unsharded step exactly (same math, different layout).
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    model = _model()
+    params = model.init(seed=14)
+    opt = optim_lib.make("adam", 1e-3)
+    opt_state = opt.init(params)
+    toks = _tokens(np.random.default_rng(14), 8, 16)
+
+    step = make_lm_train_step(model, opt)
+    p1, _, l1 = step(params, opt_state, toks)
+
+    mesh = make_mesh((4, 2), ("data", "model"))
+    specs = model.partition_specs()
+    sh = lambda spec: NamedSharding(mesh, spec)
+    params_tp = jax.tree.map(
+        lambda x, s: jax.device_put(x, sh(s)), params, specs
+    )
+    toks_tp = jax.device_put(toks, sh(P("data")))
+    p2, _, l2 = step(params_tp, opt_state, toks_tp)
+
+    np.testing.assert_allclose(float(l2), float(l1), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=1e-4, atol=1e-6
+        )
+
+
 def test_decode_rejects_overflow():
     model = _model()
     params = model.init(seed=6)
