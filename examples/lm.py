@@ -1,7 +1,7 @@
 """Language-model training + generation — the capability the reference never
 had (its one model is the MLP classifier, reference tfsingle.py:23-42).
 
-Run: ``python examples/lm.py [epochs] [max_new]``
+Run: ``python examples/lm.py [steps] [max_new]``
 
 Trains a small GPT-style causal LM on a synthetic copy task (sequences of
 the form ``x · x`` — the model must learn to attend back and reproduce the
@@ -50,7 +50,8 @@ def main(steps: int = 300, max_new: int = 16) -> None:
         params, opt_state, loss = step(params, opt_state, batch())
         if i % 50 == 0 or i == 1:
             print(f"Step: {i},  Cost: {float(loss):.4f}")
-    print(f"Total Time: {time.time() - t0:.2f}s")
+    final = float(loss)  # D2H fetch: the only trustworthy barrier (CLAUDE.md)
+    print(f"Total Time: {time.time() - t0:.2f}s  Final Cost: {final:.4f}")
 
     half = rng.integers(0, 61, size=(2, 8))
     prompt = jnp.asarray(
