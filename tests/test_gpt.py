@@ -288,6 +288,42 @@ def test_zero_sharded_lm_step_matches_single_device():
         )
 
 
+def test_lm_checkpoint_resume_bitwise(tmp_path):
+    # The Supervisor's orbax checkpointing is pytree-generic, so the LM's
+    # (params, opt_state) composes unchanged: save mid-run, restore into a
+    # fresh Supervisor, continue on the same batch stream — bit-identical
+    # to the uninterrupted run (mirrors tests/test_resume.py for the
+    # Trainer, reference re-attach semantics tfdist_between.py:83).
+    from distributed_tensorflow_tpu.train import Supervisor
+
+    model = _model()
+    opt = optim_lib.make("adam", 1e-3)
+    step = make_lm_train_step(model, opt)
+    rng = np.random.default_rng(18)
+    batches = [_tokens(rng, 8, 16) for _ in range(10)]
+
+    params_a, st_a = model.init(seed=18), opt.init(model.init(seed=18))
+    for b in batches:
+        params_a, st_a, _ = step(params_a, st_a, b)
+
+    ckdir = str(tmp_path / "lm_ck")
+    params_b, st_b = model.init(seed=18), opt.init(model.init(seed=18))
+    for b in batches[:5]:
+        params_b, st_b, _ = step(params_b, st_b, b)
+    Supervisor(checkpoint_dir=ckdir).save((params_b, st_b), 5)
+
+    sup = Supervisor(checkpoint_dir=ckdir)
+    (params_c, st_c), start = sup.prepare_or_restore(
+        (model.init(seed=18), opt.init(model.init(seed=18)))
+    )
+    assert start == 5
+    for b in batches[5:]:
+        params_c, st_c, _ = step(params_c, st_c, b)
+
+    for a, c in zip(jax.tree.leaves(params_a), jax.tree.leaves(params_c)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+
 def test_decode_rejects_overflow():
     model = _model()
     params = model.init(seed=6)

@@ -50,7 +50,24 @@ y = jax.make_array_from_process_local_data(
 state, cost = step(state, x, y)
 cost = float(jax.device_get(cost))
 assert np.isfinite(cost), cost
-print("FASTMP_OK", task, cost)
+
+# One LM dp step over the same 2-process mesh (models/gpt.py): token batch
+# sharded across processes, grads all-reduced over DCN.
+import jax.numpy as jnp
+from distributed_tensorflow_tpu.models.gpt import GPTLM, make_lm_train_step
+from distributed_tensorflow_tpu.ops import optim as optim_lib
+
+lm = GPTLM(vocab_size=32, max_len=16, model_dim=16, num_heads=2,
+           num_layers=1, compute_dtype=jnp.float32)
+lp = lm.init(seed=1)
+lopt = optim_lib.make("adam", 1e-3)
+lstep = make_lm_train_step(lm, lopt, mesh=mesh)
+toks = jax.make_array_from_process_local_data(
+    sharding, rng.integers(0, 32, size=(2, 16)).astype(np.int32), (4, 16))
+lp, _, lm_loss = lstep(lp, lopt.init(lp), toks)
+lm_loss = float(jax.device_get(lm_loss))
+assert np.isfinite(lm_loss), lm_loss
+print("FASTMP_OK", task, cost, lm_loss)
 """
 
 
