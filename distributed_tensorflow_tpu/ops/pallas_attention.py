@@ -72,12 +72,55 @@ def _pick_block(l: int, requested: int | None) -> int:
     return l
 
 
-def _causal_mask(iq, ik, bq, bk):
-    """[bq, bk] bool: global q position >= global k position. 2-D
-    broadcasted_iota — plain ``jnp.arange`` is 1-D and TPU rejects it."""
+def _causal_mask(iq, ik, bq, bk, window=None):
+    """[bq, bk] bool: global q position >= global k position (and, with
+    ``window=W``, within the last W keys). 2-D broadcasted_iota — plain
+    ``jnp.arange`` is 1-D and TPU rejects it."""
     q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = ik * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return q_pos >= k_pos
+    diff = q_pos - k_pos
+    mask = diff >= 0
+    if window is not None:
+        mask &= diff < window
+    return mask
+
+
+def _block_needed(iq, ik, bq, bk, window):
+    """Whether any (q, k) pair in this block pair survives the causal(+
+    window) mask: max diff >= 0 (not fully above the diagonal) and, with a
+    window, min diff < W (not fully fallen out of it)."""
+    needed = (iq + 1) * bq - 1 >= ik * bk
+    if window is not None:
+        needed &= iq * bq - (ik + 1) * bk + 1 < window
+    return needed
+
+
+def _banded_k_index(window, bq, bk):
+    """Index-map factory clamping the k-block index into the causal window
+    band of its q block. Out-of-band grid steps re-reference an in-band
+    (already-resident) block, so they cost no DMA — their compute is skipped
+    by ``_block_needed`` anyway. Purely an index-map change: the kernels
+    never see the clamped index (they recompute the true one from
+    ``pl.program_id``)."""
+
+    def index_map(b, iq, ik):
+        lo = jnp.maximum((iq * bq - window + 1) // bk, 0)
+        hi = ((iq + 1) * bq - 1) // bk
+        return (b, jnp.clip(ik, lo, hi), 0)
+
+    return index_map
+
+
+def _banded_q_index(window, bq, bk, nq):
+    """Transposed band for the k-major (dkv) kernel: clamp the q-block
+    index into [first q attending this k, last q within the window]."""
+
+    def index_map(b, ik, iq):
+        lo = (ik * bk) // bq
+        hi = jnp.minimum(((ik + 1) * bk - 2 + window) // bq, nq - 1)
+        return (b, jnp.clip(iq, lo, hi), 0)
+
+    return index_map
 
 
 # ---------------------------------------------------------------------------
@@ -87,7 +130,7 @@ def _causal_mask(iq, ik, bq, bk):
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, scale: float, causal: bool, nk: int,
+    *, scale: float, causal: bool, window: int | None, nk: int,
 ):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
@@ -108,7 +151,7 @@ def _fwd_kernel(
         k = k_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = jnp.where(_causal_mask(iq, ik, bq, bk), s, _NEG_INF)
+            s = jnp.where(_causal_mask(iq, ik, bq, bk, window), s, _NEG_INF)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         # A still-empty row (everything masked so far) has m_new == -inf;
@@ -124,9 +167,9 @@ def _fwd_kernel(
         m_scr[:] = m_new
 
     if causal:
-        # Skip blocks strictly above the diagonal: their every score is
-        # masked (max q position < min k position).
-        pl.when((iq + 1) * bq - 1 >= ik * bk)(_accumulate)
+        # Skip blocks whose every score is masked: strictly above the
+        # diagonal, or (windowed) entirely fallen out of the window.
+        pl.when(_block_needed(iq, ik, bq, bk, window))(_accumulate)
     else:
         _accumulate()
 
@@ -137,20 +180,25 @@ def _fwd_kernel(
         lse_ref[0] = m_scr[:] + jnp.log(l)
 
 
-def _fwd_call(q, k, v, *, causal, bq, bk, scale, interpret, vma):
+def _fwd_call(q, k, v, *, causal, window, bq, bk, scale, interpret, vma):
     """[BH, L, D] → (out [BH, L, D], lse [BH, L, 1]). ``vma`` marks the
     outputs as varying over those mesh axes — required under a
     ``check_vma=True`` shard_map (the ring composition)."""
     sds = partial(jax.ShapeDtypeStruct, vma=vma) if vma else jax.ShapeDtypeStruct
     bh, l, d = q.shape
     nq, nk = l // bq, l // bk
+    kmap = (
+        _banded_k_index(window, bq, bk)
+        if window is not None
+        else (lambda b, iq, ik: (b, ik, 0))
+    )
     return pl.pallas_call(
-        partial(_fwd_kernel, scale=scale, causal=causal, nk=nk),
+        partial(_fwd_kernel, scale=scale, causal=causal, window=window, nk=nk),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, iq, ik: (b, ik, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, iq, ik: (b, ik, 0)),
+            pl.BlockSpec((1, bk, d), kmap),
+            pl.BlockSpec((1, bk, d), kmap),
         ],
         out_specs=(
             pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
@@ -176,7 +224,7 @@ def _fwd_call(q, k, v, *, causal, bq, bk, scale, interpret, vma):
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, scale: float, causal: bool, nk: int,
+    *, scale: float, causal: bool, window: int | None, nk: int,
 ):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
@@ -192,7 +240,7 @@ def _dq_kernel(
         k = k_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = jnp.where(_causal_mask(iq, ik, bq, bk), s, _NEG_INF)
+            s = jnp.where(_causal_mask(iq, ik, bq, bk, window), s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0])  # masked scores underflow to exactly 0
         dp = jnp.dot(do_ref[0], v_ref[0].T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0]) * scale
@@ -201,7 +249,7 @@ def _dq_kernel(
         )
 
     if causal:
-        pl.when((iq + 1) * bq - 1 >= ik * bk)(_accumulate)
+        pl.when(_block_needed(iq, ik, bq, bk, window))(_accumulate)
     else:
         _accumulate()
 
@@ -213,7 +261,7 @@ def _dq_kernel(
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_scr, dv_scr,
-    *, scale: float, causal: bool, nq: int,
+    *, scale: float, causal: bool, window: int | None, nq: int,
 ):
     ik = pl.program_id(1)
     iq = pl.program_id(2)
@@ -231,7 +279,7 @@ def _dkv_kernel(
         do = do_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = jnp.where(_causal_mask(iq, ik, bq, bk), s, _NEG_INF)
+            s = jnp.where(_causal_mask(iq, ik, bq, bk, window), s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0])
         dv_scr[:] += jnp.dot(
             p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
@@ -243,7 +291,7 @@ def _dkv_kernel(
         )
 
     if causal:
-        pl.when((iq + 1) * bq - 1 >= ik * bk)(_accumulate)
+        pl.when(_block_needed(iq, ik, bq, bk, window))(_accumulate)
     else:
         _accumulate()
 
@@ -253,16 +301,21 @@ def _dkv_kernel(
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd_call(q, k, v, o, lse, do, delta, *, causal, bq, bk, scale, interpret, vma):
+def _bwd_call(q, k, v, o, lse, do, delta, *, causal, window, bq, bk, scale, interpret, vma):
     sds = partial(jax.ShapeDtypeStruct, vma=vma) if vma else jax.ShapeDtypeStruct
     bh, l, d = q.shape
     nq, nk = l // bq, l // bk
     qspec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
     rowspec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
-    kspec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
+    kmap = (
+        _banded_k_index(window, bq, bk)
+        if window is not None
+        else (lambda b, i, j: (b, j, 0))
+    )
+    kspec = pl.BlockSpec((1, bk, d), kmap)
 
     dq = pl.pallas_call(
-        partial(_dq_kernel, scale=scale, causal=causal, nk=nk),
+        partial(_dq_kernel, scale=scale, causal=causal, window=window, nk=nk),
         grid=(bh, nq, nk),
         in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
         out_specs=qspec,
@@ -272,11 +325,16 @@ def _bwd_call(q, k, v, o, lse, do, delta, *, causal, bq, bk, scale, interpret, v
     )(q, k, v, do, lse, delta)
 
     # k-major: q/do/lse/delta blocks walk the innermost dim.
-    qspec2 = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0))
-    rowspec2 = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, j, 0))
+    if window is not None:
+        qmap = _banded_q_index(window, bq, bk, nq)
+        qspec2 = pl.BlockSpec((1, bq, d), qmap)
+        rowspec2 = pl.BlockSpec((1, bq, 1), qmap)
+    else:
+        qspec2 = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0))
+        rowspec2 = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, j, 0))
     kspec2 = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0))
     dk, dv = pl.pallas_call(
-        partial(_dkv_kernel, scale=scale, causal=causal, nq=nq),
+        partial(_dkv_kernel, scale=scale, causal=causal, window=window, nq=nq),
         grid=(bh, nk, nq),
         in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
         out_specs=(kspec2, kspec2),
@@ -309,8 +367,8 @@ def _from_bh(x, b, h):
     return jnp.einsum("bhld->blhd", x.reshape(b, h, l, d))
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
-def _flash(causal, bq, bk, interpret, vma, q, k, v):
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5))
+def _flash(causal, window, bq, bk, interpret, vma, q, k, v):
     """Primal returns (out, lse) — both differentiable. The lse output is
     what makes blockwise *composition* (ring attention) differentiable: a
     cotangent on lse folds into the backward's delta term, since
@@ -318,16 +376,17 @@ def _flash(causal, bq, bk, interpret, vma, q, k, v):
     scale = 1.0 / (q.shape[-1] ** 0.5)
     return _fwd_call(
         q, k, v,
-        causal=causal, bq=bq, bk=bk, scale=scale, interpret=interpret, vma=vma,
+        causal=causal, window=window, bq=bq, bk=bk, scale=scale,
+        interpret=interpret, vma=vma,
     )
 
 
-def _flash_fwd(causal, bq, bk, interpret, vma, q, k, v):
-    o, lse = _flash(causal, bq, bk, interpret, vma, q, k, v)
+def _flash_fwd(causal, window, bq, bk, interpret, vma, q, k, v):
+    o, lse = _flash(causal, window, bq, bk, interpret, vma, q, k, v)
     return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, bq, bk, interpret, vma, res, g):
+def _flash_bwd(causal, window, bq, bk, interpret, vma, res, g):
     q, k, v, o, lse = res
     do, dlse = g
     scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -339,7 +398,8 @@ def _flash_bwd(causal, bq, bk, interpret, vma, res, g):
     ) - dlse.astype(jnp.float32)
     return _bwd_call(
         q, k, v, o, lse, do, delta,
-        causal=causal, bq=bq, bk=bk, scale=scale, interpret=interpret, vma=vma,
+        causal=causal, window=window, bq=bq, bk=bk, scale=scale,
+        interpret=interpret, vma=vma,
     )
 
 
@@ -352,12 +412,17 @@ def flash_attention(
     v: jax.Array,
     *,
     causal: bool = False,
+    window: int | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
     vma: tuple[str, ...] | None = None,
 ) -> jax.Array:
     """Exact attention on [B, L, H, D] without materializing [L, L] scores.
+
+    ``window=W`` (requires ``causal``) is sliding-window attention: each
+    query sees only its last W keys (self included), and block pairs wholly
+    outside the band are skipped — compute scales O(L·W) instead of O(L²).
 
     Drop-in for :func:`ops.ring_attention.dense_attention` (same signature,
     same math, differentiable via fused Pallas backward kernels); use it as
@@ -371,8 +436,8 @@ def flash_attention(
     """
     out, _ = flash_attention_with_lse(
         q, k, v,
-        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
-        vma=vma,
+        causal=causal, window=window, block_q=block_q, block_k=block_k,
+        interpret=interpret, vma=vma,
     )
     return out
 
@@ -383,6 +448,7 @@ def flash_attention_with_lse(
     v: jax.Array,
     *,
     causal: bool = False,
+    window: int | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
@@ -396,13 +462,18 @@ def flash_attention_with_lse(
     varying-mesh-axes types (Pallas outputs carry no vma by default)."""
     if q.shape != k.shape or q.shape != v.shape:
         raise ValueError(f"q/k/v shapes must match: {q.shape} {k.shape} {v.shape}")
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, l, h, d = q.shape
     bq = _pick_block(l, block_q)
     bk = _pick_block(l, block_k)
     out, lse = _flash(
-        causal, bq, bk, interpret,
+        causal, window, bq, bk, interpret,
         frozenset(vma) if vma else None,  # ShapeDtypeStruct wants a set
         _to_bh(q), _to_bh(k), _to_bh(v),
     )

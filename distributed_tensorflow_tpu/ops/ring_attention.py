@@ -223,9 +223,17 @@ def ring_flash_attention(
     return o.astype(q.dtype)
 
 
-def dense_attention(q, k, v, *, causal: bool = False) -> jax.Array:
+def dense_attention(
+    q, k, v, *, causal: bool = False, window: int | None = None
+) -> jax.Array:
     """Reference dense attention on unsharded [B, L, H, D] (for tests and
-    single-device use)."""
+    single-device use). ``window=W`` (requires ``causal``) restricts each
+    query to its last W keys, self included — the sliding-window mask."""
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     d = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.array(d, jnp.float32))
     scores = jnp.einsum(
@@ -234,7 +242,10 @@ def dense_attention(q, k, v, *, causal: bool = False) -> jax.Array:
     ) * scale
     if causal:
         l_q, l_k = scores.shape[-2], scores.shape[-1]
-        mask = jnp.arange(l_q)[:, None] >= jnp.arange(l_k)[None, :]
+        diff = jnp.arange(l_q)[:, None] - jnp.arange(l_k)[None, :]
+        mask = diff >= 0
+        if window is not None:
+            mask &= diff < window
         scores = jnp.where(mask[None, None], scores, _NEG_INF)
     w = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(

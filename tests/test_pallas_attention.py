@@ -122,6 +122,50 @@ def test_bf16_gradients_match_dense():
         )
 
 
+@pytest.mark.parametrize("window", [1, 8, 24, 64])
+def test_sliding_window_matches_dense(window):
+    # window < block, == block, spanning blocks, and >= L (degenerates to
+    # plain causal) — exercising the out-of-window block-skip predicate.
+    q, k, v = _qkv(20, l=64, d=16)
+    got = flash_attention(
+        q, k, v, causal=True, window=window, block_q=16, block_k=16
+    )
+    want = dense_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("block_q,block_k", [(16, 16), (8, 16), (16, 8)])
+def test_sliding_window_gradients_match_dense(block_q, block_k):
+    # Multi-block (nq, nk > 1) with mixed block shapes: exercises the banded
+    # backward index maps' clamp arithmetic, not just the single-block
+    # identity case.
+    q, k, v = _qkv(21, l=64, d=8)
+    cot = jax.random.normal(jax.random.key(22), q.shape, jnp.float32)
+
+    def loss(fn, q, k, v, **kw):
+        return jnp.sum(fn(q, k, v, causal=True, window=6, **kw) * cot)
+
+    g_flash = jax.grad(
+        lambda *a: loss(flash_attention, *a, block_q=block_q, block_k=block_k),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_dense = jax.grad(lambda *a: loss(dense_attention, *a), argnums=(0, 1, 2))(
+        q, k, v
+    )
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(
+            gf, gd, atol=2e-5, rtol=1e-4, err_msg=f"d{name} mismatch"
+        )
+
+
+def test_window_requires_causal():
+    q, k, v = _qkv(23)
+    with pytest.raises(ValueError, match="requires causal"):
+        flash_attention(q, k, v, window=8)
+    with pytest.raises(ValueError, match="window must be"):
+        flash_attention(q, k, v, causal=True, window=0)
+
+
 def test_block_must_divide():
     q, k, v = _qkv(5, l=64)
     with pytest.raises(ValueError, match="must divide"):
