@@ -83,12 +83,15 @@ class GPTLM:
         num_layers: int = 2,
         compute_dtype: jnp.dtype = jnp.bfloat16,
         attention_impl: str = "xla",
+        window: int | None = None,
     ):
         assert model_dim % num_heads == 0
         if attention_impl not in ("xla", "flash"):
             raise ValueError(
                 f"unknown attention_impl {attention_impl!r}; xla|flash"
             )
+        if window is not None and window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
         self.vocab_size = vocab_size
         self.max_len = max_len
         self.model_dim = model_dim
@@ -97,6 +100,7 @@ class GPTLM:
         self.num_layers = num_layers
         self.compute_dtype = compute_dtype
         self.attention_impl = attention_impl
+        self.window = window
 
     # -- init --------------------------------------------------------------
 
@@ -186,8 +190,8 @@ class GPTLM:
                 flash_attention,
             )
 
-            return flash_attention(q, k, v, causal=True)
-        return dense_attention(q, k, v, causal=True)
+            return flash_attention(q, k, v, causal=True, window=self.window)
+        return dense_attention(q, k, v, causal=True, window=self.window)
 
     def _block(self, blk: GPTBlockParams, h, attend=None):
         """Block forward; also returns this block's k/v for cache prefill.
@@ -245,6 +249,14 @@ class GPTLM:
         off-TPU). This is how the LM trains past one device's activation
         memory: L/n tokens of activations per device, KV blocks riding the
         ring."""
+        if self.window is not None:
+            # The ring algorithms attend full-causal; silently dropping the
+            # window would change the model's math between the dense and SP
+            # paths.
+            raise NotImplementedError(
+                "sliding-window attention is not supported on the "
+                "sequence-parallel path yet; use window=None"
+            )
         from distributed_tensorflow_tpu.ops.ring_attention import (
             ring_attention,
             ring_flash_attention,
@@ -330,7 +342,12 @@ class GPTLM:
         scores = jnp.einsum(
             "bqhd,bkhd->bhqk", q, ck, preferred_element_type=jnp.float32
         ) / jnp.sqrt(jnp.asarray(self.head_dim, jnp.float32))
-        valid = jnp.arange(self.max_len) <= length  # [max_len]
+        pos_idx = jnp.arange(self.max_len)
+        valid = pos_idx <= length  # [max_len]
+        if self.window is not None:
+            # sliding window: the query at `length` sees only its last W
+            # positions (self included) — same band the training mask uses.
+            valid &= pos_idx > length - self.window
         scores = jnp.where(valid[None, None, None, :], scores, -1e30)
         w = jax.nn.softmax(scores, axis=-1)
         attn = jnp.einsum(

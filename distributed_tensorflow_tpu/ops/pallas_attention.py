@@ -95,6 +95,17 @@ def _block_needed(iq, ik, bq, bk, window):
     return needed
 
 
+def _use_banding(window, l) -> bool:
+    """Banded (clamped) index maps defeat Mosaic's affine prefetch analysis,
+    which costs more than the saved DMA until the band is much smaller than
+    the row: measured on v5e (block 512, W=1024), banding LOSES below
+    L≈4·W (18.8 vs 11.4 ms at L=2048) and wins above (13.2 vs 15.6 ms at
+    L=8192, 17.1 vs 29.9 at 16384 — docs/performance.md). Below the
+    crossover the plain affine walk with in-kernel masking is used; the
+    math is identical either way."""
+    return window is not None and 4 * window <= l
+
+
 def _banded_k_index(window, bq, bk):
     """Index-map factory clamping the k-block index into the causal window
     band of its q block. Out-of-band grid steps re-reference an in-band
@@ -189,7 +200,7 @@ def _fwd_call(q, k, v, *, causal, window, bq, bk, scale, interpret, vma):
     nq, nk = l // bq, l // bk
     kmap = (
         _banded_k_index(window, bq, bk)
-        if window is not None
+        if _use_banding(window, l)
         else (lambda b, iq, ik: (b, ik, 0))
     )
     return pl.pallas_call(
@@ -309,7 +320,7 @@ def _bwd_call(q, k, v, o, lse, do, delta, *, causal, window, bq, bk, scale, inte
     rowspec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
     kmap = (
         _banded_k_index(window, bq, bk)
-        if window is not None
+        if _use_banding(window, l)
         else (lambda b, i, j: (b, j, 0))
     )
     kspec = pl.BlockSpec((1, bk, d), kmap)
@@ -325,7 +336,7 @@ def _bwd_call(q, k, v, o, lse, do, delta, *, causal, window, bq, bk, scale, inte
     )(q, k, v, do, lse, delta)
 
     # k-major: q/do/lse/delta blocks walk the innermost dim.
-    if window is not None:
+    if _use_banding(window, l):
         qmap = _banded_q_index(window, bq, bk, nq)
         qspec2 = pl.BlockSpec((1, bq, d), qmap)
         rowspec2 = pl.BlockSpec((1, bq, 1), qmap)

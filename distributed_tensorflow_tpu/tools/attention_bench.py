@@ -1,0 +1,138 @@
+"""Measured attention benchmark: dense XLA vs Pallas flash vs windowed.
+
+The reference validated performance by pasting wall-clocks into its README
+(reference README.md:38-40); this framework generates its benchmark records
+from tools (same philosophy as ``tools/benchmark_suite.py``). This one
+times the attention implementations across sequence lengths with the
+correct D2H execution barrier (CLAUDE.md timing trap: through the tunneled
+TPU, ``block_until_ready`` measures enqueue, not execution — only a
+device-to-host value fetch is trustworthy).
+
+Usage::
+
+    python -m distributed_tensorflow_tpu.tools.attention_bench
+    python -m distributed_tensorflow_tpu.tools.attention_bench \
+        --lengths 1024 4096 --window 1024 --block 512 --iters 10
+
+Prints a markdown table (one row per L) and a one-line JSON summary.
+Dense rows that fail to compile (the O(L²) score matrix at long L) are
+reported as ``oom`` rather than aborting the sweep — that boundary is
+itself the result.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def _timed(fn, args, iters: int) -> float:
+    out = fn(*args)
+    _ = float(out.reshape(-1)[-1].astype(jnp.float32))  # D2H barrier
+    t0 = time.perf_counter()
+    for _i in range(iters):
+        out = fn(*args)
+    _ = float(out.reshape(-1)[-1].astype(jnp.float32))
+    return (time.perf_counter() - t0) / iters
+
+
+def run(
+    lengths=(1024, 2048, 4096),
+    *,
+    batch: int = 2,
+    heads: int = 8,
+    head_dim: int = 64,
+    window: int | None = None,
+    block: int | None = None,
+    iters: int = 10,
+    dtype=jnp.bfloat16,
+) -> list[dict]:
+    from distributed_tensorflow_tpu.ops.pallas_attention import flash_attention
+    from distributed_tensorflow_tpu.ops.ring_attention import dense_attention
+
+    rows = []
+    for l in lengths:
+        kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+        shape = (batch, l, heads, head_dim)
+        q = jax.random.normal(kq, shape, dtype)
+        k = jax.random.normal(kk, shape, dtype)
+        v = jax.random.normal(kv, shape, dtype)
+        row = {"L": l}
+        try:
+            dense = jax.jit(lambda q, k, v: dense_attention(q, k, v, causal=True))
+            row["dense_ms"] = _timed(dense, (q, k, v), iters) * 1e3
+        except Exception as exc:  # noqa: BLE001 — recorded, not swallowed
+            # The expected failure is the O(L²) compile/OOM boundary, but
+            # record WHAT failed so a genuine bug can't masquerade as "oom"
+            # in a published table.
+            row["dense_ms"] = None
+            row["dense_error"] = f"{type(exc).__name__}: {exc}"[:200]
+        bq = min(block, l) if block else None
+        flash = jax.jit(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bq
+            )
+        )
+        row["flash_ms"] = _timed(flash, (q, k, v), iters) * 1e3
+        if window is not None and window < l:
+            win = jax.jit(
+                lambda q, k, v: flash_attention(
+                    q, k, v, causal=True, window=window, block_q=bq, block_k=bq
+                )
+            )
+            row["window_ms"] = _timed(win, (q, k, v), iters) * 1e3
+        rows.append(row)
+    return rows
+
+
+def render(rows, *, window=None) -> str:
+    cols = ["L", "dense XLA (ms)", "flash (ms)", "speedup"]
+    if window is not None:
+        cols.append(f"window={window} (ms)")
+    out = ["| " + " | ".join(cols) + " |", "|" + "---|" * len(cols)]
+    for r in rows:
+        dense = "oom" if r["dense_ms"] is None else f"{r['dense_ms']:.2f}"
+        speed = (
+            "—"
+            if r["dense_ms"] is None
+            else f"{r['dense_ms'] / r['flash_ms']:.2f}x"
+        )
+        cells = [str(r["L"]), dense, f"{r['flash_ms']:.2f}", speed]
+        if window is not None:
+            cells.append(
+                f"{r['window_ms']:.2f}" if "window_ms" in r else "—"
+            )
+        out.append("| " + " | ".join(cells) + " |")
+    return "\n".join(out)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--lengths", type=int, nargs="+", default=[1024, 2048, 4096])
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--window", type=int, default=None)
+    ap.add_argument("--block", type=int, default=None)
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args(argv)
+    rows = run(
+        tuple(args.lengths),
+        batch=args.batch,
+        heads=args.heads,
+        head_dim=args.head_dim,
+        window=args.window,
+        block=args.block,
+        iters=args.iters,
+    )
+    print(f"device: {jax.devices()[0].device_kind}")
+    print(render(rows, window=args.window))
+    print(json.dumps({"rows": rows, "backend": jax.default_backend()}))
+
+
+if __name__ == "__main__":
+    main()

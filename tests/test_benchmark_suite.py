@@ -113,3 +113,24 @@ def test_single_compiled_row_runs(small_datasets):
     assert row["mode"] == "whole-run"
     assert row["epochs_timed"] == 1
     assert row["examples_per_sec"] > 0
+
+
+def test_attention_bench_smoke(capsys):
+    # Tiny shapes on the CPU interpreter: the tool must produce a table row
+    # per length and valid JSON, with the window column present.
+    from distributed_tensorflow_tpu.tools import attention_bench
+
+    attention_bench.main(
+        [
+            "--lengths", "32", "64",
+            "--batch", "1", "--heads", "2", "--head-dim", "8",
+            "--window", "16", "--iters", "1",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert "| 32 |" in out and "| 64 |" in out
+    import json as _json
+
+    payload = _json.loads(out.strip().splitlines()[-1])
+    assert len(payload["rows"]) == 2
+    assert all("flash_ms" in r for r in payload["rows"])

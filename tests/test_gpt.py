@@ -216,6 +216,53 @@ def test_sample_decode_valid_and_key_dependent():
     assert not np.array_equal(a, b)
 
 
+def test_windowed_lm_decode_matches_reforward():
+    # Sliding-window LM: the decode-path cache mask must reproduce exactly
+    # the band the training mask applies, including once the context has
+    # outgrown the window.
+    model = _model(window=4)
+    params = _noisy(model.init(seed=19))
+    rng = np.random.default_rng(19)
+    prompt = _tokens(rng, 2, 7)  # prompt alone exceeds the window
+    max_new = 8
+
+    got = np.asarray(
+        jax.jit(lambda p, t: model.greedy_decode(p, t, max_new))(params, prompt)
+    )
+    seq = prompt
+    for _ in range(max_new):
+        nxt = jnp.argmax(model.apply(params, seq)[:, -1], -1).astype(seq.dtype)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(got, np.asarray(seq))
+
+    # and the window genuinely binds: the unwindowed model decodes differently
+    full = _model()
+    got_full = np.asarray(
+        jax.jit(lambda p, t: full.greedy_decode(p, t, max_new))(params, prompt)
+    )
+    assert not np.array_equal(got, got_full)
+
+
+def test_windowed_flash_matches_windowed_xla():
+    xla = _model(window=8)
+    flash = _model(window=8, attention_impl="flash")
+    params = xla.init(seed=20)
+    toks = _tokens(np.random.default_rng(20), 2, 32)
+    np.testing.assert_allclose(
+        np.asarray(flash.apply(params, toks)),
+        np.asarray(xla.apply(params, toks)),
+        atol=2e-4,
+    )
+
+
+def test_windowed_lm_rejects_sequence_parallel():
+    model = _model(window=4)
+    with pytest.raises(NotImplementedError, match="sliding-window"):
+        model.apply_sequence_parallel(
+            model.init(seed=21), jnp.zeros((1, 8), jnp.int32)
+        )
+
+
 def test_tensor_parallel_step_matches_single_device():
     # GSPMD TP: params placed per partition_specs on a (data, model) mesh,
     # the ordinary jitted step runs, XLA inserts the collectives — results
