@@ -54,6 +54,26 @@ class GPTBlockParams(NamedTuple):
     b_down: jax.Array
 
 
+class GPTMoEBlockParams(NamedTuple):
+    """Decoder block whose FFN is a Switch-style top-1 MoE
+    (ops/moe.py): attention fields as in :class:`GPTBlockParams`, FFN
+    weights stacked over experts (axis 1; axis 0 remains num_layers)."""
+
+    ln1_scale: jax.Array
+    ln1_bias: jax.Array
+    wq: jax.Array
+    wk: jax.Array
+    wv: jax.Array
+    wo: jax.Array
+    ln2_scale: jax.Array
+    ln2_bias: jax.Array
+    wg: jax.Array  # [n, d, E] gate
+    w_up: jax.Array  # [n, E, d, 4d]
+    b_up: jax.Array  # [n, E, 4d]
+    w_down: jax.Array  # [n, E, 4d, d]
+    b_down: jax.Array  # [n, E, d]
+
+
 class GPTLMParams(NamedTuple):
     embed: jax.Array  # [vocab, d] (also the tied LM head)
     pos: jax.Array  # [max_len, d]
@@ -84,6 +104,8 @@ class GPTLM:
         compute_dtype: jnp.dtype = jnp.bfloat16,
         attention_impl: str = "xla",
         window: int | None = None,
+        moe_experts: int | None = None,
+        moe_capacity_factor: float = 2.0,
     ):
         assert model_dim % num_heads == 0
         if attention_impl not in ("xla", "flash"):
@@ -92,6 +114,8 @@ class GPTLM:
             )
         if window is not None and window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
+        if moe_experts is not None and moe_experts < 2:
+            raise ValueError(f"moe_experts must be >= 2, got {moe_experts}")
         self.vocab_size = vocab_size
         self.max_len = max_len
         self.model_dim = model_dim
@@ -101,6 +125,8 @@ class GPTLM:
         self.compute_dtype = compute_dtype
         self.attention_impl = attention_impl
         self.window = window
+        self.moe_experts = moe_experts
+        self.moe_capacity_factor = moe_capacity_factor
 
     # -- init --------------------------------------------------------------
 
@@ -115,27 +141,42 @@ class GPTLM:
                 shape[-2]
             )
 
+        attn = dict(
+            ln1_scale=jnp.ones((n, d), jnp.float32),
+            ln1_bias=jnp.zeros((n, d), jnp.float32),
+            wq=dense_init(keys[2], (n, d, d)),
+            wk=dense_init(keys[3], (n, d, d)),
+            wv=dense_init(keys[4], (n, d, d)),
+            # residual-path projections start at zero: the depth-N stack
+            # begins as the identity, a stable start at any depth.
+            wo=jnp.zeros((n, d, d), jnp.float32),
+            ln2_scale=jnp.ones((n, d), jnp.float32),
+            ln2_bias=jnp.zeros((n, d), jnp.float32),
+        )
+        if self.moe_experts is None:
+            blocks = GPTBlockParams(
+                **attn,
+                w_up=dense_init(keys[5], (n, d, 4 * d)),
+                b_up=jnp.zeros((n, 4 * d), jnp.float32),
+                w_down=jnp.zeros((n, 4 * d, d), jnp.float32),
+                b_down=jnp.zeros((n, d), jnp.float32),
+            )
+        else:
+            e = self.moe_experts
+            blocks = GPTMoEBlockParams(
+                **attn,
+                wg=dense_init(keys[6], (n, d, e)),
+                w_up=dense_init(keys[5], (n, e, d, 4 * d)),
+                b_up=jnp.zeros((n, e, 4 * d), jnp.float32),
+                w_down=jnp.zeros((n, e, 4 * d, d), jnp.float32),
+                b_down=jnp.zeros((n, e, d), jnp.float32),
+            )
         return GPTLMParams(
             embed=0.02
             * jax.random.normal(keys[0], (self.vocab_size, d), jnp.float32),
             pos=0.02
             * jax.random.normal(keys[1], (self.max_len, d), jnp.float32),
-            blocks=GPTBlockParams(
-                ln1_scale=jnp.ones((n, d), jnp.float32),
-                ln1_bias=jnp.zeros((n, d), jnp.float32),
-                wq=dense_init(keys[2], (n, d, d)),
-                wk=dense_init(keys[3], (n, d, d)),
-                wv=dense_init(keys[4], (n, d, d)),
-                # residual-path projections start at zero: the depth-N stack
-                # begins as the identity, a stable start at any depth.
-                wo=jnp.zeros((n, d, d), jnp.float32),
-                ln2_scale=jnp.ones((n, d), jnp.float32),
-                ln2_bias=jnp.zeros((n, d), jnp.float32),
-                w_up=dense_init(keys[5], (n, d, 4 * d)),
-                b_up=jnp.zeros((n, 4 * d), jnp.float32),
-                w_down=jnp.zeros((n, 4 * d, d), jnp.float32),
-                b_down=jnp.zeros((n, d), jnp.float32),
-            ),
+            blocks=blocks,
             lnf_scale=jnp.ones((d,), jnp.float32),
             lnf_bias=jnp.zeros((d,), jnp.float32),
         )
@@ -153,6 +194,11 @@ class GPTLM:
         and biases on the residual stream stay replicated. Apply by placing
         params with ``NamedSharding(mesh, spec)`` and calling the ordinary
         jitted step — GSPMD inserts the collectives."""
+        if self.moe_experts is not None:
+            raise NotImplementedError(
+                "tensor parallelism is not defined for the MoE blocks; "
+                "use expert parallelism (apply_expert_parallel)"
+            )
         from jax.sharding import PartitionSpec as P
 
         return GPTLMParams(
@@ -193,12 +239,69 @@ class GPTLM:
             return flash_attention(q, k, v, causal=True, window=self.window)
         return dense_attention(q, k, v, causal=True, window=self.window)
 
-    def _block(self, blk: GPTBlockParams, h, attend=None):
+    def _moe_capacity(self, tokens: int) -> int:
+        """Static per-expert capacity for a call with ``tokens`` routable
+        tokens (Switch convention: factor × tokens/experts, min 1)."""
+        import math
+
+        return max(
+            1, math.ceil(self.moe_capacity_factor * tokens / self.moe_experts)
+        )
+
+    def _moe_block_ffn(self, blk, hn2, moe_call):
+        """Shared MoE-FFN scaffold for the dense and expert-parallel paths:
+        token flattening, compute_dtype casting (expert matmuls ride the
+        MXU at one bf16 pass like every other matmul here; the gate stays
+        f32 so routing decisions keep full precision), and the capacity
+        policy. ``moe_call(mp, x2d, capacity)`` is the only difference
+        between the two paths — keeping ep==dense pinned by construction.
+
+        Capacity: training applies the Switch convention
+        (``moe_capacity_factor`` × tokens/experts, drops beyond). Single-
+        token calls (the KV-cache decode step, L==1) never drop — capacity
+        drops are a training-time load-balancing device, and a decode-time
+        drop would make generation diverge from the training forward at the
+        default factor (B tokens routed per step vs B·L in training)."""
+        cd = self.compute_dtype
+        from distributed_tensorflow_tpu.ops.moe import MoEParams
+
+        b, l, d = hn2.shape
+        t = b * l
+        capacity = t if l == 1 else self._moe_capacity(t)
+        mp = MoEParams(
+            blk.wg,
+            blk.w_up.astype(cd),
+            blk.b_up.astype(cd),
+            blk.w_down.astype(cd),
+            blk.b_down.astype(cd),
+        )
+        out = moe_call(mp, hn2.reshape(t, d).astype(cd), capacity)
+        return out.astype(jnp.float32).reshape(b, l, d)
+
+    def _ffn(self, blk, hn2):
+        """Dense-FFN or (for MoE blocks) locally-computed switch MoE on
+        [B, L, d]; includes the output bias."""
+        if isinstance(blk, GPTMoEBlockParams):
+            from distributed_tensorflow_tpu.ops.moe import moe_ffn_dense
+
+            return self._moe_block_ffn(
+                blk, hn2, lambda mp, x, c: moe_ffn_dense(mp, x, capacity=c)
+            )
+        return (
+            self._dot(
+                jax.nn.gelu(self._dot(hn2, blk.w_up) + blk.b_up), blk.w_down
+            )
+            + blk.b_down
+        )
+
+    def _block(self, blk, h, attend=None, ffn=None):
         """Block forward; also returns this block's k/v for cache prefill.
-        h: [B, L, d]. ``attend`` swaps the attention algorithm (the
-        sequence-parallel path passes the ring) without duplicating the
-        surrounding layernorm/projection/MLP math — one source of truth for
-        the block, so sp==dense stays pinned by construction."""
+        h: [B, L, d]. ``attend``/``ffn`` swap the attention algorithm (the
+        sequence-parallel path passes the ring) or the FFN (the
+        expert-parallel path passes the all-to-all MoE) without duplicating
+        the surrounding layernorm/projection/residual math — one source of
+        truth for the block, so sp==dense and ep==dense stay pinned by
+        construction."""
         b, l, d = h.shape
         hn = _layernorm(h, blk.ln1_scale, blk.ln1_bias)
         shape = (b, l, self.num_heads, self.head_dim)
@@ -208,10 +311,7 @@ class GPTLM:
         attn = (attend or self._attend)(q, k, v)
         h = h + self._dot(attn.reshape(b, l, d), blk.wo)
         hn2 = _layernorm(h, blk.ln2_scale, blk.ln2_bias)
-        mlp = self._dot(
-            jax.nn.gelu(self._dot(hn2, blk.w_up) + blk.b_up), blk.w_down
-        )
-        return h + mlp + blk.b_down, (k, v)
+        return h + (ffn or self._ffn)(blk, hn2), (k, v)
 
     def _logits(self, p: GPTLMParams, h):
         hf = _layernorm(h, p.lnf_scale, p.lnf_bias)
@@ -296,6 +396,48 @@ class GPTLM:
         h, _ = lax.scan(body, h, params.blocks)
         return self._logits(params, h)
 
+    def apply_expert_parallel(
+        self,
+        params: GPTLMParams,
+        tokens: jax.Array,
+        axis_name: str = "expert",
+    ) -> jax.Array:
+        """Expert-parallel causal forward *body* (MoE models): call inside
+        ``jax.shard_map`` with tokens sharded on the BATCH dim [B/n, L] and
+        the blocks' expert dims sharded over ``axis_name`` (one expert's
+        FFN weights per device; gate and attention weights replicated).
+        Attention runs locally on the batch shard; each block's FFN is the
+        all-to-all token exchange (``ops/moe.moe_ffn``). Equals
+        :meth:`apply` whenever no token overflows capacity — the same
+        top-1 routing and per-source capacity semantics as the dense
+        reference (``moe_ffn_dense``)."""
+        if self.moe_experts is None:
+            raise ValueError("apply_expert_parallel requires moe_experts")
+        n = lax.axis_size(axis_name)
+        if n != self.moe_experts:
+            raise ValueError(
+                f"{axis_name!r} axis size {n} != moe_experts "
+                f"{self.moe_experts}"
+            )
+        from distributed_tensorflow_tpu.ops.moe import moe_ffn
+
+        def ep_ffn(blk, hn2):
+            return self._moe_block_ffn(
+                blk,
+                hn2,
+                lambda mp, x, c: moe_ffn(mp, x, axis_name, capacity=c),
+            )
+
+        l = tokens.shape[1]
+        h = params.embed[tokens] + params.pos[:l]
+
+        def body(h, blk):
+            h, _ = self._block(blk, h, ffn=ep_ffn)
+            return h, None
+
+        h, _ = lax.scan(body, h, params.blocks)
+        return self._logits(params, h)
+
     def loss(self, params: GPTLMParams, tokens: jax.Array) -> jax.Array:
         """Mean next-token cross-entropy (positions 0..L-2 predict 1..L-1),
         f32 log-softmax."""
@@ -358,10 +500,7 @@ class GPTLM:
         )
         h = h + self._dot(attn.reshape(b, 1, self.model_dim), blk.wo)
         hn2 = _layernorm(h, blk.ln2_scale, blk.ln2_bias)
-        mlp = self._dot(
-            jax.nn.gelu(self._dot(hn2, blk.w_up) + blk.b_up), blk.w_down
-        )
-        return h + mlp + blk.b_down, ck, cv
+        return h + self._ffn(blk, hn2), ck, cv
 
     def decode_step(self, params: GPTLMParams, token: jax.Array, cache: KVCache):
         """Append one token [B] int32; returns (logits [B, vocab], cache).

@@ -95,7 +95,12 @@ def render(rows, *, window=None) -> str:
         cols.append(f"window={window} (ms)")
     out = ["| " + " | ".join(cols) + " |", "|" + "---|" * len(cols)]
     for r in rows:
-        dense = "oom" if r["dense_ms"] is None else f"{r['dense_ms']:.2f}"
+        if r["dense_ms"] is None:
+            err = r.get("dense_error", "").lower()
+            oomish = any(w in err for w in ("resource", "memory", "oom"))
+            dense = "oom" if oomish else "error"
+        else:
+            dense = f"{r['dense_ms']:.2f}"
         speed = (
             "—"
             if r["dense_ms"] is None

@@ -371,6 +371,95 @@ def test_lm_checkpoint_resume_bitwise(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
 
 
+def test_moe_lm_trains_on_copy_task():
+    model = _model(moe_experts=4)
+    params = model.init(seed=22)
+    opt = optim_lib.make("adam", 3e-3)
+    opt_state = opt.init(params)
+    step = make_lm_train_step(model, opt)
+    rng = np.random.default_rng(22)
+
+    def batch():
+        half = rng.integers(0, 61, size=(16, 8))
+        return jnp.asarray(np.concatenate([half, half], axis=1), jnp.int32)
+
+    first = None
+    for _ in range(120):
+        params, opt_state, loss = step(params, opt_state, batch())
+        first = float(loss) if first is None else first
+    assert float(loss) < first * 0.8, (first, float(loss))
+
+
+def test_moe_lm_decode_matches_reforward():
+    # The KV-cache decode path routes single-token batches through the same
+    # switch FFN; decode never drops (capacity = tokens at L==1), so greedy
+    # decode equals the growing-sequence re-forward whenever the re-forward
+    # side doesn't drop either — hence the ample factor (capacity drops are
+    # a training-time load-balancing device, see _moe_block_ffn).
+    model = _model(moe_experts=4, moe_capacity_factor=8.0)
+    params = _noisy(model.init(seed=23), scale=0.1)
+    prompt = _tokens(np.random.default_rng(23), 2, 5)
+    max_new = 6
+
+    got = np.asarray(
+        jax.jit(lambda p, t: model.greedy_decode(p, t, max_new))(params, prompt)
+    )
+    seq = prompt
+    for _ in range(max_new):
+        nxt = jnp.argmax(model.apply(params, seq)[:, -1], -1).astype(seq.dtype)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(got, np.asarray(seq))
+
+
+def test_moe_lm_expert_parallel_matches_dense():
+    # 4 experts on a 4-device 'expert' mesh, capacity ample so nothing
+    # drops on either path: the all-to-all EP forward must equal the dense
+    # local forward exactly.
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_tensorflow_tpu.models.gpt import GPTMoEBlockParams
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    model = _model(moe_experts=4, moe_capacity_factor=16.0)
+    params = model.init(seed=24)
+    toks = _tokens(np.random.default_rng(24), 8, 16)
+    want = np.asarray(model.apply(params, toks))
+
+    mesh = make_mesh((4,), ("expert",), devices=jax.devices()[:4])
+    block_specs = GPTMoEBlockParams(
+        ln1_scale=P(), ln1_bias=P(), wq=P(), wk=P(), wv=P(), wo=P(),
+        ln2_scale=P(), ln2_bias=P(),
+        wg=P(),
+        w_up=P(None, "expert"),
+        b_up=P(None, "expert"),
+        w_down=P(None, "expert"),
+        b_down=P(None, "expert"),
+    )
+    got = np.asarray(
+        jax.jit(
+            jax.shard_map(
+                lambda p, t: model.apply_expert_parallel(p, t, "expert"),
+                mesh=mesh,
+                in_specs=(
+                    type(params)(
+                        embed=P(), pos=P(), blocks=block_specs,
+                        lnf_scale=P(), lnf_bias=P(),
+                    ),
+                    P("expert"),
+                ),
+                out_specs=P("expert"),
+            )
+        )(params, toks)
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_moe_lm_rejects_tensor_parallel_specs():
+    model = _model(moe_experts=4)
+    with pytest.raises(NotImplementedError, match="expert parallelism"):
+        model.partition_specs()
+
+
 def test_decode_rejects_overflow():
     model = _model()
     params = model.init(seed=6)
