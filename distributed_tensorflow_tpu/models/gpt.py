@@ -251,9 +251,9 @@ class GPTLM:
     def _moe_block_ffn(self, blk, hn2, moe_call):
         """Shared MoE-FFN scaffold for the dense and expert-parallel paths:
         token flattening, compute_dtype casting (expert matmuls ride the
-        MXU at one bf16 pass like every other matmul here; the gate stays
-        f32 so routing decisions keep full precision), and the capacity
-        policy. ``moe_call(mp, x2d, capacity)`` is the only difference
+        MXU at one bf16 pass like every other matmul here; the gate
+        *weights* stay f32 — the activations it sees are compute_dtype like
+        everywhere else), and the capacity policy. ``moe_call(mp, x2d, capacity)`` is the only difference
         between the two paths — keeping ep==dense pinned by construction.
 
         Capacity: training applies the Switch convention
@@ -282,10 +282,13 @@ class GPTLM:
         """Dense-FFN or (for MoE blocks) locally-computed switch MoE on
         [B, L, d]; includes the output bias."""
         if isinstance(blk, GPTMoEBlockParams):
-            from distributed_tensorflow_tpu.ops.moe import moe_ffn_dense
+            # moe_ffn_local: E·capacity token-FFNs (the sparse cost MoE
+            # exists for); moe_ffn_dense would compute all E experts on all
+            # T tokens. Same semantics, proven in tests/test_moe.py.
+            from distributed_tensorflow_tpu.ops.moe import moe_ffn_local
 
             return self._moe_block_ffn(
-                blk, hn2, lambda mp, x, c: moe_ffn_dense(mp, x, capacity=c)
+                blk, hn2, lambda mp, x, c: moe_ffn_local(mp, x, capacity=c)
             )
         return (
             self._dot(
@@ -357,6 +360,14 @@ class GPTLM:
                 "sliding-window attention is not supported on the "
                 "sequence-parallel path yet; use window=None"
             )
+        if self.moe_experts is not None:
+            # Per-shard capacity/routing order would silently diverge from
+            # the dense forward under drops — same principle as the window
+            # guard above; expert parallelism is the MoE sharding.
+            raise NotImplementedError(
+                "MoE blocks are not supported on the sequence-parallel "
+                "path; use apply_expert_parallel"
+            )
         from distributed_tensorflow_tpu.ops.ring_attention import (
             ring_attention,
             ring_flash_attention,
@@ -407,10 +418,13 @@ class GPTLM:
         the blocks' expert dims sharded over ``axis_name`` (one expert's
         FFN weights per device; gate and attention weights replicated).
         Attention runs locally on the batch shard; each block's FFN is the
-        all-to-all token exchange (``ops/moe.moe_ffn``). Equals
-        :meth:`apply` whenever no token overflows capacity — the same
-        top-1 routing and per-source capacity semantics as the dense
-        reference (``moe_ffn_dense``)."""
+        all-to-all token exchange (``ops/moe.moe_ffn``). Routing (top-1)
+        is identical to :meth:`apply`; capacity is applied per
+        (expert, source device) here vs per expert globally there, so the
+        two are exactly equal whenever no token overflows capacity (ample
+        ``moe_capacity_factor``) and may drop different tokens under
+        overflow — drops are a training-time load-balancing device, not a
+        semantic guarantee."""
         if self.moe_experts is None:
             raise ValueError("apply_expert_parallel requires moe_experts")
         n = lax.axis_size(axis_name)
@@ -618,7 +632,9 @@ def make_lm_train_step(model: GPTLM, optimizer, mesh=None, axis: str = "data"):
     all-reduced — the LM analog of ``SyncDataParallel``'s compiled
     collective (the reference's sync mode, tfdist_between_sync.py:66-68,
     minus the parameter server). Identical math to the single-device step on
-    the same global batch. Under ``shard_map`` AD auto-inserts a psum for
+    the same global batch for dense models; MoE models compute switch
+    capacity from the LOCAL batch shard (standard practice), so dp equals
+    single-device exactly only in the no-drop regime. Under ``shard_map`` AD auto-inserts a psum for
     grads of the replicated params, so the local grads are *summed* — the
     code divides by the axis size rather than pmean-ing (CLAUDE.md)."""
     import optax

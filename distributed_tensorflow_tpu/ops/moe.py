@@ -86,6 +86,31 @@ def moe_ffn_dense(params: MoEParams, x: jax.Array, capacity: int) -> jax.Array:
     return jnp.where(keep[:, None], gate_prob[:, None] * picked, 0.0)
 
 
+def moe_ffn_local(params: MoEParams, x: jax.Array, capacity: int) -> jax.Array:
+    """Single-device switch FFN at sparse cost: route, gather each expert's
+    ≤``capacity`` tokens into its buffer, run every expert ONCE on its
+    buffer, scatter back. Identical semantics to :func:`moe_ffn_dense`
+    (same ``_route``, same per-expert in-arrival-order capacity — a single
+    source makes per-source and global capacity the same thing) at
+    ``E·capacity`` token-FFNs instead of dense's ``E·T`` — the sparse
+    compute MoE exists for, without the cross-device exchange."""
+    e = params.wg.shape[1]
+    t, d = x.shape
+    expert_idx, gate_prob, slot, keep = _route(x, params.wg, e, capacity)
+
+    send = jnp.zeros((e, capacity, d), x.dtype)
+    rows = jnp.where(keep, expert_idx, 0)
+    cols = jnp.where(keep, slot, 0)
+    contrib = jnp.where(keep[:, None], x, 0.0)
+    send = send.at[rows, cols].add(contrib)
+
+    out = jax.vmap(_expert_ffn)(
+        send, params.w_up, params.b_up, params.w_down, params.b_down
+    )  # [E, C, D]
+    gathered = out[rows, cols]
+    return jnp.where(keep[:, None], gate_prob[:, None] * gathered, 0.0)
+
+
 def moe_ffn(params: MoEParams, x: jax.Array, axis_name: str, capacity: int):
     """Expert-parallel forward body (inside shard_map over ``axis_name``).
 

@@ -76,3 +76,26 @@ def test_routing_covers_multiple_experts(setup):
     params, x = setup
     logits = x @ np.asarray(params.wg)
     assert len(np.unique(logits.argmax(-1))) > 1
+
+
+def test_moe_ffn_local_matches_dense():
+    # The sparse local path (gather per-expert buffers, one FFN per expert)
+    # must reproduce the dense reference exactly, including capacity drops.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_tpu.ops.moe import (
+        init_moe,
+        moe_ffn_dense,
+        moe_ffn_local,
+    )
+
+    params = init_moe(jax.random.key(0), 16, 32, 4)
+    x = jax.random.normal(jax.random.key(1), (24, 16), jnp.float32)
+    for capacity in (2, 6, 24):  # drops, partial drops, no drops
+        want = moe_ffn_dense(params, x, capacity=capacity)
+        got = moe_ffn_local(params, x, capacity=capacity)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
+        )
