@@ -12,6 +12,7 @@ from distributed_tensorflow_tpu.models.gpt import (  # noqa: F401
     GPTLM,
     GPTLMParams,
     KVCache,
+    make_lm_async_train_step,
     make_lm_train_step,
 )
 from distributed_tensorflow_tpu.models.mlp import MLP, MLPParams  # noqa: F401

@@ -623,6 +623,102 @@ class GPTLM:
         return self._decode_loop(params, prompt, max_new, pick, key)
 
 
+def make_lm_async_train_step(
+    model: GPTLM,
+    optimizer,
+    mesh,
+    *,
+    axis: str = "data",
+    avg_every: int = 1,
+    update_scale: float = 1.0,
+):
+    """Async local-SGD for the LM — the reference's signature training mode
+    (HOGWILD applies to PS variables, reference tfdist_between.py:64-66),
+    emulated the way ``AsyncDataParallel`` does for the classifiers: each
+    device owns a private (params, opt_state) copy advancing on its own
+    token stream, and every ``avg_every`` steps all copies jump to the
+    cross-device parameter mean (one all-reduce; zero traffic between
+    exchanges).
+
+    Returns ``(init_state, step)``:
+
+    - ``init_state(params, opt_state) -> state`` stacks per-device copies
+      ([n, ...] leaves, sharded over ``axis``) plus a step counter;
+    - ``step(state, tokens) -> (state, loss)`` with tokens [n·B, L] sharded
+      on the batch dim; loss is the cross-device mean of the local losses.
+
+    For plain SGD with ``avg_every=1`` and ``update_scale=1`` this is
+    *exactly* the sync data-parallel step (mean of independent SGD updates
+    from a common point = update by the mean gradient — SGD is linear in
+    the gradient), which the tests assert bitwise-tolerant; with
+    momentum/adam or ``avg_every>1`` it is genuinely async (copies diverge
+    between exchanges, the modeled race). To reproduce the reference
+    async-table behavior (N workers' updates applied sequentially, not
+    averaged), pass ``update_scale=n`` — the same knob
+    ``AsyncDataParallel`` defaults to N for exactly that purpose
+    (strategy.py; averaging alone gives sync-like dynamics). The default
+    here is 1.0 so the sync-equivalence property holds out of the box."""
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if avg_every < 1:
+        raise ValueError(f"avg_every must be >= 1, got {avg_every}")
+    n = mesh.shape[axis]
+
+    def init_state(params, opt_state):
+        stacked = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (n,) + x.shape),
+            (params, opt_state),
+        )
+        stacked = jax.device_put(
+            stacked, NamedSharding(mesh, P(axis))
+        )
+        return (*stacked, jnp.zeros((), jnp.int32))
+
+    def local(params, opt_state, tokens, count):
+        p = jax.tree.map(lambda x: x[0], params)
+        o = jax.tree.map(lambda x: x[0], opt_state)
+        loss, grads = jax.value_and_grad(model.loss)(p, tokens)
+        updates, o = optimizer.update(grads, o, p)
+        if update_scale != 1.0:
+            updates = jax.tree.map(lambda u: u * update_scale, updates)
+        p = optax.apply_updates(p, updates)
+        # lax.cond, not jnp.where: where evaluates both branches, so the
+        # all-reduce would fire on EVERY step and void avg_every's traffic
+        # bound. The predicate derives from the replicated count, so all
+        # devices agree and the collective is uniform.
+        # pmean outputs are typed invariant; cast back to varying so both
+        # cond branches agree under check_vma (same pattern as the ring's
+        # skip branch, strategy.py _to_varying).
+        pvary = partial(lax.pcast, axis_name=(axis,), to="varying")
+        p = lax.cond(
+            (count + 1) % avg_every == 0,
+            lambda p: jax.tree.map(lambda x: pvary(lax.pmean(x, axis)), p),
+            lambda p: p,
+            p,
+        )
+        return (
+            jax.tree.map(lambda x: x[None], p),
+            jax.tree.map(lambda x: x[None], o),
+            lax.pmean(loss, axis),
+        )
+
+    mapped = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P()),
+        out_specs=(P(axis), P(axis), P()),
+    )
+
+    @partial(jax.jit, donate_argnums=0)
+    def step(state, tokens):
+        params, opt_state, count = state
+        params, opt_state, loss = mapped(params, opt_state, tokens, count)
+        return (params, opt_state, count + 1), loss
+
+    return init_state, step
+
+
 def make_lm_train_step(model: GPTLM, optimizer, mesh=None, axis: str = "data"):
     """``step(params, opt_state, tokens) -> (params, opt_state, loss)``,
     jitted, for any optax ``GradientTransformation`` (ops/optim.make).
