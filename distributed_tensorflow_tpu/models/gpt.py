@@ -100,6 +100,7 @@ class GPTLM:
         max_len: int = 128,
         model_dim: int = 64,
         num_heads: int = 4,
+        num_kv_heads: int | None = None,
         num_layers: int = 2,
         compute_dtype: jnp.dtype = jnp.bfloat16,
         attention_impl: str = "xla",
@@ -116,10 +117,20 @@ class GPTLM:
             raise ValueError(f"window must be >= 1, got {window}")
         if moe_experts is not None and moe_experts < 2:
             raise ValueError(f"moe_experts must be >= 2, got {moe_experts}")
+        if num_kv_heads is None:
+            num_kv_heads = num_heads
+        if num_kv_heads < 1:
+            raise ValueError(f"num_kv_heads must be >= 1, got {num_kv_heads}")
+        if num_heads % num_kv_heads:
+            raise ValueError(
+                f"num_heads {num_heads} must be a multiple of num_kv_heads "
+                f"{num_kv_heads}"
+            )
         self.vocab_size = vocab_size
         self.max_len = max_len
         self.model_dim = model_dim
         self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads
         self.head_dim = model_dim // num_heads
         self.num_layers = num_layers
         self.compute_dtype = compute_dtype
@@ -145,8 +156,11 @@ class GPTLM:
             ln1_scale=jnp.ones((n, d), jnp.float32),
             ln1_bias=jnp.zeros((n, d), jnp.float32),
             wq=dense_init(keys[2], (n, d, d)),
-            wk=dense_init(keys[3], (n, d, d)),
-            wv=dense_init(keys[4], (n, d, d)),
+            # GQA: k/v project to num_kv_heads·head_dim (≤ d); query head
+            # groups share KV heads in the attention kernels, and the
+            # decode cache shrinks by the same factor.
+            wk=dense_init(keys[3], (n, d, self.num_kv_heads * self.head_dim)),
+            wv=dense_init(keys[4], (n, d, self.num_kv_heads * self.head_dim)),
             # residual-path projections start at zero: the depth-N stack
             # begins as the identity, a stable start at any depth.
             wo=jnp.zeros((n, d, d), jnp.float32),
@@ -187,7 +201,10 @@ class GPTLM:
         leading num_layers axis unsharded).
 
         Attention: wq/wk/wv column-split on their output dim — the split
-        lands on whole heads as long as the axis size divides num_heads —
+        lands on whole heads as long as the axis size divides num_heads
+        (and, under GQA, num_kv_heads: wk/wv only have num_kv_heads·head_dim
+        columns; a mid-KV-head split stays numerically correct under GSPMD
+        but loses the whole-head one-all-reduce layout) —
         and wo row-split, so attention computes on local head groups with
         one all-reduce after the output projection. MLP: w_up column-split,
         w_down row-split (all-reduce after). Embeddings, positions, norms,
@@ -307,10 +324,10 @@ class GPTLM:
         construction."""
         b, l, d = h.shape
         hn = _layernorm(h, blk.ln1_scale, blk.ln1_bias)
-        shape = (b, l, self.num_heads, self.head_dim)
-        q = self._dot(hn, blk.wq).reshape(shape)
-        k = self._dot(hn, blk.wk).reshape(shape)
-        v = self._dot(hn, blk.wv).reshape(shape)
+        kv_shape = (b, l, self.num_kv_heads, self.head_dim)
+        q = self._dot(hn, blk.wq).reshape(b, l, self.num_heads, self.head_dim)
+        k = self._dot(hn, blk.wk).reshape(kv_shape)
+        v = self._dot(hn, blk.wv).reshape(kv_shape)
         attn = (attend or self._attend)(q, k, v)
         h = h + self._dot(attn.reshape(b, l, d), blk.wo)
         hn2 = _layernorm(h, blk.ln2_scale, blk.ln2_bias)
@@ -398,10 +415,16 @@ class GPTLM:
         )
         h = params.embed[tokens] + pos
 
+        def sp_attend(q, k, v):
+            # The ring algorithms take equal head counts; repeating KV up
+            # to Hq keeps GQA semantics exact (it forgoes only the
+            # kernel-level bandwidth saving).
+            from distributed_tensorflow_tpu.ops.ring_attention import repeat_kv
+
+            return ring(*((q,) + repeat_kv(k, v, self.num_heads)), axis_name, causal=True)
+
         def body(h, blk):
-            h, _ = self._block(
-                blk, h, attend=lambda q, k, v: ring(q, k, v, axis_name, causal=True)
-            )
+            h, _ = self._block(blk, h, attend=sp_attend)
             return h, None
 
         h, _ = lax.scan(body, h, params.blocks)
@@ -487,16 +510,21 @@ class GPTLM:
         (this layer's cache). Returns (h, updated ck, updated cv)."""
         b = h.shape[0]
         hn = _layernorm(h, blk.ln1_scale, blk.ln1_bias)
-        shape = (b, 1, self.num_heads, self.head_dim)
-        q = self._dot(hn, blk.wq).reshape(shape)
-        k = self._dot(hn, blk.wk).reshape(shape).astype(ck.dtype)
-        v = self._dot(hn, blk.wv).reshape(shape).astype(cv.dtype)
+        kv_shape = (b, 1, self.num_kv_heads, self.head_dim)
+        q = self._dot(hn, blk.wq).reshape(b, 1, self.num_heads, self.head_dim)
+        k = self._dot(hn, blk.wk).reshape(kv_shape).astype(ck.dtype)
+        v = self._dot(hn, blk.wv).reshape(kv_shape).astype(cv.dtype)
         ck = lax.dynamic_update_slice(ck, k, (0, length, 0, 0))
         cv = lax.dynamic_update_slice(cv, v, (0, length, 0, 0))
         # Attend the one query against the whole static-length cache,
-        # masking positions past `length` (self included via <=).
+        # masking positions past `length` (self included via <=). The cache
+        # stores num_kv_heads; repeat transiently for the score einsum (the
+        # memory win is in what's STORED, not this one-step temporary).
+        from distributed_tensorflow_tpu.ops.ring_attention import repeat_kv
+
+        ck_q, cv_q = repeat_kv(ck, cv, self.num_heads)
         scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, ck, preferred_element_type=jnp.float32
+            "bqhd,bkhd->bhqk", q, ck_q, preferred_element_type=jnp.float32
         ) / jnp.sqrt(jnp.asarray(self.head_dim, jnp.float32))
         pos_idx = jnp.arange(self.max_len)
         valid = pos_idx <= length  # [max_len]
@@ -508,8 +536,8 @@ class GPTLM:
         w = jax.nn.softmax(scores, axis=-1)
         attn = jnp.einsum(
             "bhqk,bkhd->bqhd",
-            w.astype(cv.dtype),
-            cv,
+            w.astype(cv_q.dtype),
+            cv_q,
             preferred_element_type=jnp.float32,
         )
         h = h + self._dot(attn.reshape(b, 1, self.model_dim), blk.wo)

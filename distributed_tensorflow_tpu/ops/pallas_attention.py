@@ -106,18 +106,29 @@ def _use_banding(window, l) -> bool:
     return window is not None and 4 * window <= l
 
 
-def _banded_k_index(window, bq, bk):
+def _kv_row(hq: int, hkv: int):
+    """Grid-row mapping for grouped-query attention: q grid row
+    ``b = batch·Hq + hq_head`` reads the KV row of its head *group*
+    (``Hq/Hkv`` query heads share one KV head). Identity when Hq == Hkv."""
+    g = hq // hkv
+    if g == 1:
+        return lambda b: b
+    return lambda b: (b // hq) * hkv + (b % hq) // g
+
+
+def _banded_k_index(window, bq, bk, row=lambda b: b):
     """Index-map factory clamping the k-block index into the causal window
-    band of its q block. Out-of-band grid steps re-reference an in-band
-    (already-resident) block, so they cost no DMA — their compute is skipped
-    by ``_block_needed`` anyway. Purely an index-map change: the kernels
+    band of its q block (and routing through the GQA ``row`` mapping).
+    Out-of-band grid steps re-reference an in-band (already-resident)
+    block, so they cost no DMA — their compute is skipped by
+    ``_block_needed`` anyway. Purely an index-map change: the kernels
     never see the clamped index (they recompute the true one from
     ``pl.program_id``)."""
 
     def index_map(b, iq, ik):
         lo = jnp.maximum((iq * bq - window + 1) // bk, 0)
         hi = ((iq + 1) * bq - 1) // bk
-        return (b, jnp.clip(ik, lo, hi), 0)
+        return (row(b), jnp.clip(ik, lo, hi), 0)
 
     return index_map
 
@@ -191,17 +202,19 @@ def _fwd_kernel(
         lse_ref[0] = m_scr[:] + jnp.log(l)
 
 
-def _fwd_call(q, k, v, *, causal, window, bq, bk, scale, interpret, vma):
-    """[BH, L, D] → (out [BH, L, D], lse [BH, L, 1]). ``vma`` marks the
-    outputs as varying over those mesh axes — required under a
-    ``check_vma=True`` shard_map (the ring composition)."""
+def _fwd_call(q, k, v, *, causal, window, bq, bk, scale, interpret, vma, hq, hkv):
+    """q [B·Hq, L, D], k/v [B·Hkv, L, D] → (out [B·Hq, L, D], lse
+    [B·Hq, L, 1]). ``vma`` marks the outputs as varying over those mesh
+    axes — required under a ``check_vma=True`` shard_map (the ring
+    composition)."""
     sds = partial(jax.ShapeDtypeStruct, vma=vma) if vma else jax.ShapeDtypeStruct
     bh, l, d = q.shape
     nq, nk = l // bq, l // bk
+    row = _kv_row(hq, hkv)
     kmap = (
-        _banded_k_index(window, bq, bk)
+        _banded_k_index(window, bq, bk, row)
         if _use_banding(window, l)
-        else (lambda b, iq, ik: (b, ik, 0))
+        else (lambda b, iq, ik: (row(b), ik, 0))
     )
     return pl.pallas_call(
         partial(_fwd_kernel, scale=scale, causal=causal, window=window, nk=nk),
@@ -272,14 +285,15 @@ def _dq_kernel(
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_scr, dv_scr,
-    *, scale: float, causal: bool, window: int | None, nq: int,
+    *, scale: float, causal: bool, window: int | None, nq: int, total: int,
 ):
     ik = pl.program_id(1)
-    iq = pl.program_id(2)
+    j = pl.program_id(2)
+    iq = j % nq  # positional q block; j // nq is the GQA head in the group
     bq = q_ref.shape[1]
     bk = k_ref.shape[1]
 
-    @pl.when(iq == 0)
+    @pl.when(j == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -306,22 +320,28 @@ def _dkv_kernel(
     else:
         _accumulate()
 
-    @pl.when(iq == nq - 1)
+    @pl.when(j == total - 1)
     def _finalize():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd_call(q, k, v, o, lse, do, delta, *, causal, window, bq, bk, scale, interpret, vma):
+def _bwd_call(
+    q, k, v, o, lse, do, delta,
+    *, causal, window, bq, bk, scale, interpret, vma, hq, hkv,
+):
     sds = partial(jax.ShapeDtypeStruct, vma=vma) if vma else jax.ShapeDtypeStruct
     bh, l, d = q.shape
+    bhkv = k.shape[0]
+    g = hq // hkv
     nq, nk = l // bq, l // bk
+    row = _kv_row(hq, hkv)
     qspec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
     rowspec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
     kmap = (
-        _banded_k_index(window, bq, bk)
+        _banded_k_index(window, bq, bk, row)
         if _use_banding(window, l)
-        else (lambda b, i, j: (b, j, 0))
+        else (lambda b, i, j: (row(b), j, 0))
     )
     kspec = pl.BlockSpec((1, bk, d), kmap)
 
@@ -335,23 +355,38 @@ def _bwd_call(q, k, v, o, lse, do, delta, *, causal, window, bq, bk, scale, inte
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
-    # k-major: q/do/lse/delta blocks walk the innermost dim.
+    # k-major: q/do/lse/delta blocks walk the innermost dim, which under
+    # GQA spans all g query heads sharing this KV head (j = head·nq + jq) —
+    # dk/dv accumulate over the whole group in one scratch pass.
+    def qrow(b, j):
+        return (b // hkv) * hq + (b % hkv) * g + j // nq
+
     if _use_banding(window, l):
-        qmap = _banded_q_index(window, bq, bk, nq)
-        qspec2 = pl.BlockSpec((1, bq, d), qmap)
-        rowspec2 = pl.BlockSpec((1, bq, 1), qmap)
+        _band = _banded_q_index(window, bq, bk, nq)
+
+        def qmap(b, i, j):
+            _, jq, _ = _band(b, i, j % nq)
+            return (qrow(b, j), jq, 0)
+
     else:
-        qspec2 = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0))
-        rowspec2 = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, j, 0))
+
+        def qmap(b, i, j):
+            return (qrow(b, j), j % nq, 0)
+
+    qspec2 = pl.BlockSpec((1, bq, d), qmap)
+    rowspec2 = pl.BlockSpec((1, bq, 1), qmap)
     kspec2 = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0))
     dk, dv = pl.pallas_call(
-        partial(_dkv_kernel, scale=scale, causal=causal, window=window, nq=nq),
-        grid=(bh, nk, nq),
+        partial(
+            _dkv_kernel,
+            scale=scale, causal=causal, window=window, nq=nq, total=nq * g,
+        ),
+        grid=(bhkv, nk, nq * g),
         in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
         out_specs=(kspec2, kspec2),
         out_shape=(
-            sds((bh, l, d), k.dtype),
-            sds((bh, l, d), v.dtype),
+            sds((bhkv, l, d), k.dtype),
+            sds((bhkv, l, d), v.dtype),
         ),
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -378,8 +413,8 @@ def _from_bh(x, b, h):
     return jnp.einsum("bhld->blhd", x.reshape(b, h, l, d))
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5))
-def _flash(causal, window, bq, bk, interpret, vma, q, k, v):
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def _flash(causal, window, bq, bk, interpret, vma, hq, hkv, q, k, v):
     """Primal returns (out, lse) — both differentiable. The lse output is
     what makes blockwise *composition* (ring attention) differentiable: a
     cotangent on lse folds into the backward's delta term, since
@@ -388,16 +423,16 @@ def _flash(causal, window, bq, bk, interpret, vma, q, k, v):
     return _fwd_call(
         q, k, v,
         causal=causal, window=window, bq=bq, bk=bk, scale=scale,
-        interpret=interpret, vma=vma,
+        interpret=interpret, vma=vma, hq=hq, hkv=hkv,
     )
 
 
-def _flash_fwd(causal, window, bq, bk, interpret, vma, q, k, v):
-    o, lse = _flash(causal, window, bq, bk, interpret, vma, q, k, v)
+def _flash_fwd(causal, window, bq, bk, interpret, vma, hq, hkv, q, k, v):
+    o, lse = _flash(causal, window, bq, bk, interpret, vma, hq, hkv, q, k, v)
     return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, window, bq, bk, interpret, vma, res, g):
+def _flash_bwd(causal, window, bq, bk, interpret, vma, hq, hkv, res, g):
     q, k, v, o, lse = res
     do, dlse = g
     scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -410,7 +445,7 @@ def _flash_bwd(causal, window, bq, bk, interpret, vma, res, g):
     return _bwd_call(
         q, k, v, o, lse, do, delta,
         causal=causal, window=window, bq=bq, bk=bk, scale=scale,
-        interpret=interpret, vma=vma,
+        interpret=interpret, vma=vma, hq=hq, hkv=hkv,
     )
 
 
@@ -434,6 +469,11 @@ def flash_attention(
     ``window=W`` (requires ``causal``) is sliding-window attention: each
     query sees only its last W keys (self included), and block pairs wholly
     outside the band are skipped — compute scales O(L·W) instead of O(L²).
+
+    Grouped-query attention: k/v may carry fewer heads than q (``Hq`` a
+    multiple of ``Hkv``); each group of ``Hq/Hkv`` query heads reads one KV
+    head via the grid index maps (no materialized repeat), and dk/dv
+    accumulate over the whole group in-kernel.
 
     Drop-in for :func:`ops.ring_attention.dense_attention` (same signature,
     same math, differentiable via fused Pallas backward kernels); use it as
@@ -471,8 +511,19 @@ def flash_attention_with_lse(
     per-hop accumulation). Both outputs are differentiable. Pass
     ``vma=(axis,...)`` when calling inside a ``shard_map`` that checks
     varying-mesh-axes types (Pallas outputs carry no vma by default)."""
-    if q.shape != k.shape or q.shape != v.shape:
-        raise ValueError(f"q/k/v shapes must match: {q.shape} {k.shape} {v.shape}")
+    if k.shape != v.shape:
+        raise ValueError(f"k/v shapes must match: {k.shape} {v.shape}")
+    if (
+        q.shape[0] != k.shape[0]
+        or q.shape[1] != k.shape[1]
+        or q.shape[3] != k.shape[3]
+        or k.shape[2] < 1
+        or q.shape[2] % k.shape[2]
+    ):
+        raise ValueError(
+            f"q {q.shape} incompatible with k/v {k.shape}: batch/len/head_dim"
+            f" must match and query heads must be a multiple of KV heads"
+        )
     if window is not None:
         if not causal:
             raise ValueError("window requires causal=True")
@@ -481,11 +532,13 @@ def flash_attention_with_lse(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, l, h, d = q.shape
+    hkv = k.shape[2]
     bq = _pick_block(l, block_q)
     bk = _pick_block(l, block_k)
     out, lse = _flash(
         causal, window, bq, bk, interpret,
         frozenset(vma) if vma else None,  # ShapeDtypeStruct wants a set
+        h, hkv,
         _to_bh(q), _to_bh(k), _to_bh(v),
     )
     return _from_bh(out, b, h), jnp.transpose(lse.reshape(b, h, l), (0, 2, 1))

@@ -223,17 +223,36 @@ def ring_flash_attention(
     return o.astype(q.dtype)
 
 
+def repeat_kv(k, v, num_q_heads: int):
+    """Repeat k/v heads up to ``num_q_heads`` (GQA semantics as one helper
+    so the dense reference, the LM's ring/decode paths, and any future
+    caller can't silently diverge from the flash kernel's group mapping)."""
+    hkv = k.shape[2]
+    if hkv == num_q_heads:
+        return k, v
+    if num_q_heads % hkv:
+        raise ValueError(
+            f"query heads {num_q_heads} must be a multiple of KV heads {hkv}"
+        )
+    g = num_q_heads // hkv
+    return jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2)
+
+
 def dense_attention(
     q, k, v, *, causal: bool = False, window: int | None = None
 ) -> jax.Array:
     """Reference dense attention on unsharded [B, L, H, D] (for tests and
     single-device use). ``window=W`` (requires ``causal``) restricts each
-    query to its last W keys, self included — the sliding-window mask."""
+    query to its last W keys, self included — the sliding-window mask.
+    Grouped-query attention: k/v with fewer heads are repeated up to the
+    query head count (the semantics the flash kernel implements without the
+    materialized repeat)."""
     if window is not None:
         if not causal:
             raise ValueError("window requires causal=True")
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
+    k, v = repeat_kv(k, v, q.shape[2])
     d = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.array(d, jnp.float32))
     scores = jnp.einsum(

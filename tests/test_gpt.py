@@ -511,6 +511,61 @@ def test_async_lm_copies_diverge_then_converge_on_exchange():
     np.testing.assert_allclose(spread(state), 0.0, atol=1e-7)
 
 
+def test_gqa_lm_decode_matches_reforward_and_shrinks_cache():
+    # Grouped-query attention: 4 query heads over 2 KV heads. The cache
+    # stores only the KV heads (the memory win); decode must still equal
+    # the growing-sequence re-forward exactly.
+    model = _model(num_kv_heads=2)
+    params = _noisy(model.init(seed=27))
+    prompt = _tokens(np.random.default_rng(27), 2, 5)
+    max_new = 8
+
+    _, cache = model.prefill(params, prompt)
+    assert cache.k.shape == (2, 2, 32, 2, 8)  # [layers, B, max_len, Hkv, Dh]
+
+    got = np.asarray(
+        jax.jit(lambda p, t: model.greedy_decode(p, t, max_new))(params, prompt)
+    )
+    seq = prompt
+    for _ in range(max_new):
+        nxt = jnp.argmax(model.apply(params, seq)[:, -1], -1).astype(seq.dtype)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(got, np.asarray(seq))
+
+
+def test_gqa_lm_flash_and_sp_match_xla():
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    xla = _model(num_kv_heads=2)
+    flash = _model(num_kv_heads=2, attention_impl="flash")
+    params = xla.init(seed=28)
+    toks = _tokens(np.random.default_rng(28), 2, 32)
+    want = np.asarray(xla.apply(params, toks))
+    np.testing.assert_allclose(
+        np.asarray(flash.apply(params, toks)), want, atol=2e-4
+    )
+
+    mesh = make_mesh((4,), ("seq",), devices=jax.devices()[:4])
+    got = np.asarray(
+        jax.jit(
+            jax.shard_map(
+                lambda p, t: xla.apply_sequence_parallel(p, t, "seq"),
+                mesh=mesh,
+                in_specs=(P(), P(None, "seq")),
+                out_specs=P(None, "seq"),
+            )
+        )(params, toks)
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_gqa_rejects_bad_head_ratio():
+    with pytest.raises(ValueError, match="multiple of num_kv_heads"):
+        _model(num_kv_heads=3)
+
+
 def test_decode_rejects_overflow():
     model = _model()
     params = model.init(seed=6)
