@@ -36,6 +36,25 @@ from distributed_tensorflow_tpu.models.base import layernorm as _layernorm
 from distributed_tensorflow_tpu.ops.ring_attention import dense_attention
 
 
+def _rope(x, positions, base: float = 10000.0):
+    """Rotary position embedding on [B, L, H, Dh] at absolute ``positions``
+    [L]: pairs (x_i, x_{i+Dh/2}) rotate by pos·base^(−2i/Dh). Computed in
+    f32, cast back — relative-position attention without any learned table,
+    the modern LM default (absent from the reference, which has no sequence
+    models at all)."""
+    b, l, h, dh = x.shape
+    half = dh // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [L, half]
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., :half], x32[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
+
+
 class GPTBlockParams(NamedTuple):
     """One decoder block; every leaf carries a leading [num_layers] axis in
     ``GPTLMParams.blocks`` so the forward can scan over the stack."""
@@ -107,6 +126,7 @@ class GPTLM:
         window: int | None = None,
         moe_experts: int | None = None,
         moe_capacity_factor: float = 2.0,
+        pos_embedding: str = "learned",
     ):
         assert model_dim % num_heads == 0
         if attention_impl not in ("xla", "flash"):
@@ -117,6 +137,14 @@ class GPTLM:
             raise ValueError(f"window must be >= 1, got {window}")
         if moe_experts is not None and moe_experts < 2:
             raise ValueError(f"moe_experts must be >= 2, got {moe_experts}")
+        if pos_embedding not in ("learned", "rope"):
+            raise ValueError(
+                f"unknown pos_embedding {pos_embedding!r}; learned|rope"
+            )
+        if pos_embedding == "rope" and (model_dim // num_heads) % 2:
+            raise ValueError(
+                f"rope needs an even head_dim, got {model_dim // num_heads}"
+            )
         if num_kv_heads is None:
             num_kv_heads = num_heads
         if num_kv_heads < 1:
@@ -138,6 +166,7 @@ class GPTLM:
         self.window = window
         self.moe_experts = moe_experts
         self.moe_capacity_factor = moe_capacity_factor
+        self.pos_embedding = pos_embedding
 
     # -- init --------------------------------------------------------------
 
@@ -188,8 +217,15 @@ class GPTLM:
         return GPTLMParams(
             embed=0.02
             * jax.random.normal(keys[0], (self.vocab_size, d), jnp.float32),
-            pos=0.02
-            * jax.random.normal(keys[1], (self.max_len, d), jnp.float32),
+            # under rope the table is unused (kept zero so the params
+            # pytree, TP specs, and checkpoints are layout-identical
+            # across both position schemes)
+            pos=(
+                0.02
+                * jax.random.normal(keys[1], (self.max_len, d), jnp.float32)
+                if self.pos_embedding == "learned"
+                else jnp.zeros((self.max_len, d), jnp.float32)
+            ),
             blocks=blocks,
             lnf_scale=jnp.ones((d,), jnp.float32),
             lnf_bias=jnp.zeros((d,), jnp.float32),
@@ -256,6 +292,22 @@ class GPTLM:
             return flash_attention(q, k, v, causal=True, window=self.window)
         return dense_attention(q, k, v, causal=True, window=self.window)
 
+    def _embed_tokens(self, params, tokens, positions):
+        """Token embedding, plus the learned position table when that
+        scheme is active (rope instead rotates q/k inside the blocks).
+        Over-length sequences fail loudly here: jnp.take clamps by default,
+        which would silently reuse the last table row (the SP path's guard
+        comment depends on the dense path raising)."""
+        if tokens.ndim > 1 and tokens.shape[1] > self.max_len:
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} exceeds max_len "
+                f"{self.max_len}"
+            )
+        h = params.embed[tokens]
+        if self.pos_embedding == "learned":
+            h = h + jnp.take(params.pos, positions, axis=0)
+        return h
+
     def _moe_capacity(self, tokens: int) -> int:
         """Static per-expert capacity for a call with ``tokens`` routable
         tokens (Switch convention: factor × tokens/experts, min 1)."""
@@ -314,7 +366,7 @@ class GPTLM:
             + blk.b_down
         )
 
-    def _block(self, blk, h, attend=None, ffn=None):
+    def _block(self, blk, h, attend=None, ffn=None, positions=None):
         """Block forward; also returns this block's k/v for cache prefill.
         h: [B, L, d]. ``attend``/``ffn`` swap the attention algorithm (the
         sequence-parallel path passes the ring) or the FFN (the
@@ -328,6 +380,9 @@ class GPTLM:
         q = self._dot(hn, blk.wq).reshape(b, l, self.num_heads, self.head_dim)
         k = self._dot(hn, blk.wk).reshape(kv_shape)
         v = self._dot(hn, blk.wv).reshape(kv_shape)
+        if self.pos_embedding == "rope":
+            q = _rope(q, positions)
+            k = _rope(k, positions)
         attn = (attend or self._attend)(q, k, v)
         h = h + self._dot(attn.reshape(b, l, d), blk.wo)
         hn2 = _layernorm(h, blk.ln2_scale, blk.ln2_bias)
@@ -342,10 +397,11 @@ class GPTLM:
     def apply(self, params: GPTLMParams, tokens: jax.Array) -> jax.Array:
         """tokens [B, L] int32 → logits [B, L, vocab], causal."""
         l = tokens.shape[1]
-        h = params.embed[tokens] + params.pos[:l]
+        positions = jnp.arange(l)
+        h = self._embed_tokens(params, tokens, positions)
 
         def body(h, blk):
-            h, _ = self._block(blk, h)
+            h, _ = self._block(blk, h, positions=positions)
             return h, None
 
         h, _ = lax.scan(body, h, params.blocks)
@@ -410,10 +466,8 @@ class GPTLM:
             raise ValueError(
                 f"global sequence {n * l_loc} exceeds max_len {self.max_len}"
             )
-        pos = lax.dynamic_slice_in_dim(
-            params.pos, my * l_loc, l_loc, axis=0
-        )
-        h = params.embed[tokens] + pos
+        positions = my * l_loc + jnp.arange(l_loc)  # absolute, so rope and
+        h = self._embed_tokens(params, tokens, positions)  # learned agree
 
         def sp_attend(q, k, v):
             # The ring algorithms take equal head counts; repeating KV up
@@ -424,7 +478,7 @@ class GPTLM:
             return ring(*((q,) + repeat_kv(k, v, self.num_heads)), axis_name, causal=True)
 
         def body(h, blk):
-            h, _ = self._block(blk, h, attend=sp_attend)
+            h, _ = self._block(blk, h, attend=sp_attend, positions=positions)
             return h, None
 
         h, _ = lax.scan(body, h, params.blocks)
@@ -466,10 +520,11 @@ class GPTLM:
             )
 
         l = tokens.shape[1]
-        h = params.embed[tokens] + params.pos[:l]
+        positions = jnp.arange(l)
+        h = self._embed_tokens(params, tokens, positions)
 
         def body(h, blk):
-            h, _ = self._block(blk, h, ffn=ep_ffn)
+            h, _ = self._block(blk, h, ffn=ep_ffn, positions=positions)
             return h, None
 
         h, _ = lax.scan(body, h, params.blocks)
@@ -490,10 +545,11 @@ class GPTLM:
         """Run the prompt once, returning (last-position logits [B, vocab],
         cache holding every layer's prompt k/v)."""
         b, l = tokens.shape
-        h = params.embed[tokens] + params.pos[:l]
+        positions = jnp.arange(l)
+        h = self._embed_tokens(params, tokens, positions)
 
         def body(h, blk):
-            h, kv = self._block(blk, h)
+            h, kv = self._block(blk, h, positions=positions)
             return h, kv
 
         h, (ks, vs) = lax.scan(body, h, params.blocks)
@@ -512,8 +568,14 @@ class GPTLM:
         hn = _layernorm(h, blk.ln1_scale, blk.ln1_bias)
         kv_shape = (b, 1, self.num_kv_heads, self.head_dim)
         q = self._dot(hn, blk.wq).reshape(b, 1, self.num_heads, self.head_dim)
-        k = self._dot(hn, blk.wk).reshape(kv_shape).astype(ck.dtype)
-        v = self._dot(hn, blk.wv).reshape(kv_shape).astype(cv.dtype)
+        k = self._dot(hn, blk.wk).reshape(kv_shape)
+        v = self._dot(hn, blk.wv).reshape(kv_shape)
+        if self.pos_embedding == "rope":
+            pos1 = jnp.reshape(length, (1,))
+            q = _rope(q, pos1)
+            k = _rope(k, pos1)
+        k = k.astype(ck.dtype)
+        v = v.astype(cv.dtype)
         ck = lax.dynamic_update_slice(ck, k, (0, length, 0, 0))
         cv = lax.dynamic_update_slice(cv, v, (0, length, 0, 0))
         # Attend the one query against the whole static-length cache,
@@ -558,8 +620,9 @@ class GPTLM:
                     f"KV cache full: length {int(cache.length)} == max_len "
                     f"{self.max_len}; increase max_len"
                 )
-        pos = lax.dynamic_slice_in_dim(params.pos, cache.length, 1, axis=0)
-        h = params.embed[token][:, None, :] + pos
+        h = self._embed_tokens(
+            params, token[:, None], jnp.reshape(cache.length, (1,))
+        )
 
         def body(h, xs):
             blk, ck, cv = xs
