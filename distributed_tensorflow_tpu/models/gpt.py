@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from distributed_tensorflow_tpu.models.base import layernorm as _layernorm
+from distributed_tensorflow_tpu.ops.collectives import to_varying
 from distributed_tensorflow_tpu.ops.ring_attention import dense_attention
 
 
@@ -705,8 +706,13 @@ class GPTLM:
         def pick(logits, k):
             logits = logits.astype(jnp.float32) / temperature
             if top_k is not None:
-                kth = lax.top_k(logits, top_k)[0][..., -1:]
-                logits = jnp.where(logits >= kth, logits, -jnp.inf)
+                # Scatter the top_k entries onto a -inf canvas: exactly
+                # top_k candidates survive even on exact logit ties (a
+                # >= kth threshold would keep every token tied with the
+                # k-th — plausible at low-entropy bf16 logits).
+                vals, idx = lax.top_k(logits, top_k)
+                rows = jnp.arange(logits.shape[0])[:, None]
+                logits = jnp.full_like(logits, -jnp.inf).at[rows, idx].set(vals)
             return jax.random.categorical(k, logits, axis=-1).astype(
                 prompt.dtype
             )
@@ -780,8 +786,8 @@ def make_lm_async_train_step(
         # devices agree and the collective is uniform.
         # pmean outputs are typed invariant; cast back to varying so both
         # cond branches agree under check_vma (same pattern as the ring's
-        # skip branch, strategy.py _to_varying).
-        pvary = partial(lax.pcast, axis_name=(axis,), to="varying")
+        # skip branch, ops/collectives.to_varying).
+        pvary = partial(to_varying, axis_name=(axis,))
         p = lax.cond(
             (count + 1) % avg_every == 0,
             lambda p: jax.tree.map(lambda x: pvary(lax.pmean(x, axis)), p),

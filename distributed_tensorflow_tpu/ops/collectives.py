@@ -30,6 +30,17 @@ def _ring_perm(n: int) -> list[tuple[int, int]]:
     return [(j, (j + 1) % n) for j in range(n)]
 
 
+def to_varying(a, axis_name):
+    """Cast a device-invariant value to varying over ``axis_name`` (vma
+    typing under ``shard_map``; accepts one axis or a tuple). ``pcast`` is
+    the current API; ``pvary`` its predecessor — routing every varying-cast
+    through this one helper keeps the whole framework working on JAX
+    versions that only have one of them."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(a, axis_name, to="varying")
+    return lax.pvary(a, axis_name)
+
+
 def ring_all_reduce(x: jax.Array, axis_name: str) -> jax.Array:
     """Sum ``x`` across the named axis with N-1 neighbor exchanges (each
     step moves one chunk over one ICI hop), no tree/star topology."""

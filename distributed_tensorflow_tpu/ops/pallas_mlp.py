@@ -376,10 +376,10 @@ def make_fused_async_epoch_fn(
     def _exchange(params):
         # Every copy jumps to the mean (AsyncDataParallel.make_exchange_fn
         # semantics), cast back to varying for the scan carry.
-        from distributed_tensorflow_tpu.parallel.strategy import _to_varying
+        from distributed_tensorflow_tpu.ops.collectives import to_varying
 
         return tuple(
-            _to_varying(jax.lax.pmean(p, "data"), "data") for p in params
+            to_varying(jax.lax.pmean(p, "data"), "data") for p in params
         )
 
     def local_epoch(state: FusedState, xs, ys):

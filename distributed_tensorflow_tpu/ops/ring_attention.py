@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from distributed_tensorflow_tpu.ops.collectives import _ring_perm
+from distributed_tensorflow_tpu.ops.collectives import _ring_perm, to_varying
 
 _NEG_INF = -1e30
 
@@ -92,7 +92,7 @@ def ring_attention(
     # pvary: the zero-init carries are device-invariant but the loop body
     # makes them device-varying; shard_map's vma typing requires the carry
     # types to match up front.
-    pvary = partial(lax.pcast, axis_name=(axis_name,), to="varying")
+    pvary = partial(to_varying, axis_name=(axis_name,))
     m = pvary(jnp.full((b, h, l_loc, 1), _NEG_INF, jnp.float32))
     s = pvary(jnp.zeros((b, h, l_loc, 1), jnp.float32))
     o = pvary(jnp.zeros((b, h, l_loc, d), jnp.float32))
@@ -182,7 +182,7 @@ def ring_flash_attention(
     perm = _ring_perm(n)
     kw = dict(block_q=block_q, block_k=block_k, vma=(axis_name,))
 
-    pvary = partial(lax.pcast, axis_name=(axis_name,), to="varying")
+    pvary = partial(to_varying, axis_name=(axis_name,))
     o = pvary(jnp.zeros((b, l_loc, h, d), jnp.float32))
     lse = pvary(jnp.full((b, l_loc, h), _NEG_INF, jnp.float32))
 

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from distributed_tensorflow_tpu.ops.collectives import to_varying
+
 
 def pipeline_apply(
     stage_fn: Callable,
@@ -45,7 +47,7 @@ def pipeline_apply(
     act_shape = x_microbatches.shape[1:]
     perm = [(j, (j + 1) % s_count) for j in range(s_count)]
 
-    pvary = lambda v: lax.pcast(v, axis_name=(axis_name,), to="varying")  # noqa: E731
+    pvary = lambda v: to_varying(v, (axis_name,))  # noqa: E731
     carry = pvary(jnp.zeros(act_shape, x_microbatches.dtype))
     out = pvary(jnp.zeros((m,) + act_shape, jnp.float32))
 

@@ -45,6 +45,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_tensorflow_tpu.ops import losses as losses_lib
 
+from distributed_tensorflow_tpu.ops.collectives import to_varying as _to_varying
+
 
 class TrainState(NamedTuple):
     """On-device training state. ``step`` is the reference's ``global_step``
@@ -60,15 +62,6 @@ LossFn = Callable[[jax.Array, jax.Array], jax.Array]
 
 def _loss_from_model(model, loss_fn: LossFn, params, x, y) -> jax.Array:
     return loss_fn(model.apply(params, x), y)
-
-
-def _to_varying(a: jax.Array, axis_name: str) -> jax.Array:
-    """Mark a device-invariant value (e.g. a pmean result) as varying over
-    ``axis_name`` so it can re-enter a varying scan carry under shard_map.
-    ``pcast`` is the current API; ``pvary`` its deprecated predecessor."""
-    if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(a, axis_name, to="varying")
-    return jax.lax.pvary(a, axis_name)
 
 
 def _scan_with_exchange(step, carry, xs, steps: int, avg_every: int):
