@@ -30,6 +30,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from distributed_tensorflow_tpu.models.base import layernorm as _layernorm
@@ -103,11 +104,18 @@ class GPTLMParams(NamedTuple):
 
 
 class KVCache(NamedTuple):
-    """Decode state: per-layer keys/values at full ``max_len`` (static
-    shape), plus the number of valid positions."""
+    """Decode state: per-layer keys/values at a static cache length, plus
+    the number of tokens decoded so far (``length`` is ABSOLUTE — it keeps
+    counting past the cache size on the rolling path).
 
-    k: jax.Array  # [num_layers, B, max_len, H, Dh]
-    v: jax.Array  # [num_layers, B, max_len, H, Dh]
+    Cache length is ``max_len`` for full-attention models; for windowed
+    models it is only ``min(window, max_len)`` — slots are written mod W
+    (a rolling buffer), because a sliding-window query can never attend
+    anything older. Decode memory and per-step attention are O(W), not
+    O(max_len)."""
+
+    k: jax.Array  # [num_layers, B, cache_len, Hkv, Dh]
+    v: jax.Array  # [num_layers, B, cache_len, Hkv, Dh]
     length: jax.Array  # scalar int32
 
 
@@ -127,6 +135,8 @@ class GPTLM:
         window: int | None = None,
         moe_experts: int | None = None,
         moe_capacity_factor: float = 2.0,
+        moe_balance_coef: float = 1e-2,
+        moe_z_coef: float = 1e-3,
         pos_embedding: str = "learned",
     ):
         assert model_dim % num_heads == 0
@@ -167,6 +177,11 @@ class GPTLM:
         self.window = window
         self.moe_experts = moe_experts
         self.moe_capacity_factor = moe_capacity_factor
+        # Switch load-balance + ST-MoE router-z coefficients (ops/moe.MoEAux);
+        # both enter the training loss via loss_and_metrics. The defaults are
+        # the papers' standard settings (1e-2 balance, 1e-3 z).
+        self.moe_balance_coef = moe_balance_coef
+        self.moe_z_coef = moe_z_coef
         self.pos_embedding = pos_embedding
 
     # -- init --------------------------------------------------------------
@@ -318,13 +333,14 @@ class GPTLM:
             1, math.ceil(self.moe_capacity_factor * tokens / self.moe_experts)
         )
 
-    def _moe_block_ffn(self, blk, hn2, moe_call):
+    def _moe_block_ffn(self, blk, hn2, moe_call, token_mask=None):
         """Shared MoE-FFN scaffold for the dense and expert-parallel paths:
         token flattening, compute_dtype casting (expert matmuls ride the
         MXU at one bf16 pass like every other matmul here; the gate
         *weights* stay f32 — the activations it sees are compute_dtype like
         everywhere else), and the capacity policy. ``moe_call(mp, x2d, capacity)`` is the only difference
         between the two paths — keeping ep==dense pinned by construction.
+        Returns ``(out, aux)`` with the router's :class:`~ops.moe.MoEAux`.
 
         Capacity: training applies the Switch convention
         (``moe_capacity_factor`` × tokens/experts, drops beyond). Single-
@@ -345,12 +361,21 @@ class GPTLM:
             blk.w_down.astype(cd),
             blk.b_down.astype(cd),
         )
-        out = moe_call(mp, hn2.reshape(t, d).astype(cd), capacity)
-        return out.astype(jnp.float32).reshape(b, l, d)
+        flat_mask = None if token_mask is None else token_mask.reshape(t)
+        out, aux = moe_call(
+            mp, hn2.reshape(t, d).astype(cd), capacity, flat_mask
+        )
+        return out.astype(jnp.float32).reshape(b, l, d), aux
 
-    def _ffn(self, blk, hn2):
+    def _ffn(self, blk, hn2, token_mask=None):
         """Dense-FFN or (for MoE blocks) locally-computed switch MoE on
-        [B, L, d]; includes the output bias."""
+        [B, L, d]; includes the output bias. Returns ``(out, aux)`` —
+        aux is the router's MoEAux for MoE blocks, zeros for dense ones
+        (so the layer scan carries a uniform pytree either way).
+        ``token_mask`` [B, L] bool (ragged batches): pad tokens are
+        excluded from MoE routing, capacity, and aux statistics."""
+        from distributed_tensorflow_tpu.ops.moe import MoEAux
+
         if isinstance(blk, GPTMoEBlockParams):
             # moe_ffn_local: E·capacity token-FFNs (the sparse cost MoE
             # exists for); moe_ffn_dense would compute all E experts on all
@@ -358,17 +383,25 @@ class GPTLM:
             from distributed_tensorflow_tpu.ops.moe import moe_ffn_local
 
             return self._moe_block_ffn(
-                blk, hn2, lambda mp, x, c: moe_ffn_local(mp, x, capacity=c)
+                blk,
+                hn2,
+                lambda mp, x, c, m: moe_ffn_local(
+                    mp, x, capacity=c, with_aux=True, token_mask=m
+                ),
+                token_mask,
             )
-        return (
+        out = (
             self._dot(
                 jax.nn.gelu(self._dot(hn2, blk.w_up) + blk.b_up), blk.w_down
             )
             + blk.b_down
         )
+        return out, MoEAux.zero()
 
-    def _block(self, blk, h, attend=None, ffn=None, positions=None):
-        """Block forward; also returns this block's k/v for cache prefill.
+    def _block(self, blk, h, attend=None, ffn=None, positions=None,
+               token_mask=None):
+        """Block forward; also returns this block's k/v for cache prefill
+        and the FFN's router aux (zeros for dense blocks).
         h: [B, L, d]. ``attend``/``ffn`` swap the attention algorithm (the
         sequence-parallel path passes the ring) or the FFN (the
         expert-parallel path passes the all-to-all MoE) without duplicating
@@ -387,7 +420,11 @@ class GPTLM:
         attn = (attend or self._attend)(q, k, v)
         h = h + self._dot(attn.reshape(b, l, d), blk.wo)
         hn2 = _layernorm(h, blk.ln2_scale, blk.ln2_bias)
-        return h + (ffn or self._ffn)(blk, hn2), (k, v)
+        if ffn is not None:
+            ffn_out, aux = ffn(blk, hn2)
+        else:
+            ffn_out, aux = self._ffn(blk, hn2, token_mask)
+        return h + ffn_out, (k, v), aux
 
     def _logits(self, p: GPTLMParams, h):
         hf = _layernorm(h, p.lnf_scale, p.lnf_bias)
@@ -397,16 +434,38 @@ class GPTLM:
 
     def apply(self, params: GPTLMParams, tokens: jax.Array) -> jax.Array:
         """tokens [B, L] int32 → logits [B, L, vocab], causal."""
+        return self.apply_with_aux(params, tokens)[0]
+
+    def apply_with_aux(
+        self,
+        params: GPTLMParams,
+        tokens: jax.Array,
+        lengths: jax.Array | None = None,
+    ):
+        """:meth:`apply` that also returns the per-layer router statistics
+        (:class:`~ops.moe.MoEAux` with [num_layers] leaves; all zeros for
+        dense models) — the observability surface the training loss and the
+        drop-rate metric are built from. ``lengths`` [B] int32 (ragged
+        right-padded batches) keeps pad tokens out of MoE routing/capacity
+        and the aux statistics, making the MoE forward at real positions —
+        and therefore the masked loss — exactly pad-content-independent."""
         l = tokens.shape[1]
         positions = jnp.arange(l)
+        token_mask = (
+            None
+            if lengths is None
+            else positions[None, :] < lengths[:, None]  # [B, L]
+        )
         h = self._embed_tokens(params, tokens, positions)
 
         def body(h, blk):
-            h, _ = self._block(blk, h, positions=positions)
-            return h, None
+            h, _, aux = self._block(
+                blk, h, positions=positions, token_mask=token_mask
+            )
+            return h, aux
 
-        h, _ = lax.scan(body, h, params.blocks)
-        return self._logits(params, h)
+        h, auxs = lax.scan(body, h, params.blocks)
+        return self._logits(params, h), auxs
 
     def apply_sequence_parallel(
         self,
@@ -425,19 +484,15 @@ class GPTLM:
         flash variant needs ``check_vma=False`` in the enclosing shard_map
         off-TPU). This is how the LM trains past one device's activation
         memory: L/n tokens of activations per device, KV blocks riding the
-        ring."""
-        if self.window is not None:
-            # The ring algorithms attend full-causal; silently dropping the
-            # window would change the model's math between the dense and SP
-            # paths.
-            raise NotImplementedError(
-                "sliding-window attention is not supported on the "
-                "sequence-parallel path yet; use window=None"
-            )
+        ring — at ``num_kv_heads`` width under GQA (the repeat to Hq never
+        crosses a device), and for windowed models only
+        ``ceil((W−1)/L_loc)+1`` hops of it (out-of-band blocks never
+        move)."""
         if self.moe_experts is not None:
             # Per-shard capacity/routing order would silently diverge from
-            # the dense forward under drops — same principle as the window
-            # guard above; expert parallelism is the MoE sharding.
+            # the dense forward under drops (window+SP, by contrast, is
+            # implemented exactly — the bounded ring); expert parallelism
+            # is the MoE sharding.
             raise NotImplementedError(
                 "MoE blocks are not supported on the sequence-parallel "
                 "path; use apply_expert_parallel"
@@ -471,15 +526,12 @@ class GPTLM:
         h = self._embed_tokens(params, tokens, positions)  # learned agree
 
         def sp_attend(q, k, v):
-            # The ring algorithms take equal head counts; repeating KV up
-            # to Hq keeps GQA semantics exact (it forgoes only the
-            # kernel-level bandwidth saving).
-            from distributed_tensorflow_tpu.ops.ring_attention import repeat_kv
-
-            return ring(*((q,) + repeat_kv(k, v, self.num_heads)), axis_name, causal=True)
+            # KV circulates at num_kv_heads width; the ring repeats (XLA
+            # ring) or grid-maps (flash ring) locally after each receive.
+            return ring(q, k, v, axis_name, causal=True, window=self.window)
 
         def body(h, blk):
-            h, _ = self._block(blk, h, attend=sp_attend, positions=positions)
+            h, _, _ = self._block(blk, h, attend=sp_attend, positions=positions)
             return h, None
 
         h, _ = lax.scan(body, h, params.blocks)
@@ -490,6 +542,8 @@ class GPTLM:
         params: GPTLMParams,
         tokens: jax.Array,
         axis_name: str = "expert",
+        *,
+        with_aux: bool = False,
     ) -> jax.Array:
         """Expert-parallel causal forward *body* (MoE models): call inside
         ``jax.shard_map`` with tokens sharded on the BATCH dim [B/n, L] and
@@ -502,7 +556,10 @@ class GPTLM:
         two are exactly equal whenever no token overflows capacity (ample
         ``moe_capacity_factor``) and may drop different tokens under
         overflow — drops are a training-time load-balancing device, not a
-        semantic guarantee."""
+        semantic guarantee. ``with_aux=True`` also returns per-layer
+        :class:`~ops.moe.MoEAux` over this device's local tokens — its
+        ``drop_fraction`` is the observable guard on the no-drop-regime
+        claim above (pmean it over ``axis_name`` for the global rate)."""
         if self.moe_experts is None:
             raise ValueError("apply_expert_parallel requires moe_experts")
         n = lax.axis_size(axis_name)
@@ -517,7 +574,9 @@ class GPTLM:
             return self._moe_block_ffn(
                 blk,
                 hn2,
-                lambda mp, x, c: moe_ffn(mp, x, axis_name, capacity=c),
+                lambda mp, x, c, m: moe_ffn(
+                    mp, x, axis_name, capacity=c, with_aux=True, token_mask=m
+                ),
             )
 
         l = tokens.shape[1]
@@ -525,47 +584,188 @@ class GPTLM:
         h = self._embed_tokens(params, tokens, positions)
 
         def body(h, blk):
-            h, _ = self._block(blk, h, ffn=ep_ffn, positions=positions)
-            return h, None
+            h, _, aux = self._block(blk, h, ffn=ep_ffn, positions=positions)
+            return h, aux
 
-        h, _ = lax.scan(body, h, params.blocks)
-        return self._logits(params, h)
+        h, auxs = lax.scan(body, h, params.blocks)
+        logits = self._logits(params, h)
+        return (logits, auxs) if with_aux else logits
 
-    def loss(self, params: GPTLMParams, tokens: jax.Array) -> jax.Array:
-        """Mean next-token cross-entropy (positions 0..L-2 predict 1..L-1),
-        f32 log-softmax."""
-        logits = self.apply(params, tokens)[:, :-1]
+    def pipeline_stage_blocks(self, blocks, num_stages: int):
+        """Reshape the scanned [num_layers, ...] block stack into
+        [num_stages, layers_per_stage, ...] for stage-sharding (leading dim
+        over the ``stage`` mesh axis) — the layout
+        :meth:`apply_pipeline_parallel` consumes."""
+        if self.num_layers % num_stages:
+            raise ValueError(
+                f"num_layers {self.num_layers} not divisible by "
+                f"num_stages {num_stages}"
+            )
+        lps = self.num_layers // num_stages
+        return jax.tree.map(
+            lambda a: a.reshape((num_stages, lps) + a.shape[1:]), blocks
+        )
+
+    def apply_pipeline_parallel(
+        self,
+        params: GPTLMParams,
+        tokens: jax.Array,
+        axis_name: str = "stage",
+        *,
+        num_microbatches: int = 4,
+    ) -> jax.Array:
+        """Pipeline-parallel causal forward *body*: call inside
+        ``jax.shard_map`` over the ``stage`` axis with ``params.blocks`` in
+        :meth:`pipeline_stage_blocks` layout sharded on its leading dim
+        (each device holds one stage's contiguous layer group [1, n/S, ...])
+        and everything else — embed/pos/lnf and tokens [B, L] — replicated.
+        Embedding and the LM head are computed on every stage (cheap,
+        replicated); the block stack runs as a GPipe-microbatched pipeline
+        (``parallel/pipeline.py``): activations flow stage-to-stage over
+        single ppermute hops, ``num_microbatches`` microbatches keep all
+        stages busy after the fill. Returns logits [B, L, vocab], identical
+        to :meth:`apply` — the flagship-model composition PARITY.md §2b's
+        PP row promises (the reference has no stages at all, SURVEY.md
+        §2b)."""
+        if self.moe_experts is not None:
+            raise NotImplementedError(
+                "pipeline parallelism is not defined for MoE blocks; use "
+                "expert parallelism (apply_expert_parallel)"
+            )
+        from distributed_tensorflow_tpu.parallel.pipeline import (
+            microbatch,
+            pipeline_apply,
+        )
+
+        b, l = tokens.shape
+        positions = jnp.arange(l)
+        h = self._embed_tokens(params, tokens, positions)
+
+        def stage_fn(blk_stack, x):
+            # blk_stack leaves [1, layers_per_stage, ...]: this stage's
+            # contiguous layer group, scanned exactly like apply().
+            def body(h, blk):
+                h, _, _ = self._block(blk, h, positions=positions)
+                return h, None
+
+            h, _ = lax.scan(
+                body, x, jax.tree.map(lambda a: a[0], blk_stack)
+            )
+            return h
+
+        hm = microbatch(h, num_microbatches)  # [M, B/M, L, d]
+        out = pipeline_apply(stage_fn, params.blocks, hm, axis_name)
+        return self._logits(params, out.reshape(b, l, -1))
+
+    def loss(
+        self,
+        params: GPTLMParams,
+        tokens: jax.Array,
+        lengths: jax.Array | None = None,
+    ) -> jax.Array:
+        """Training loss: mean next-token cross-entropy (positions 0..L-2
+        predict 1..L-1, f32 log-softmax), plus — for MoE models — the
+        Switch load-balance and router-z auxiliary terms behind
+        ``moe_balance_coef`` / ``moe_z_coef``. Dense models: exactly CE.
+
+        ``lengths`` [B] int32 (each ≥ 1) makes the CE a *masked* mean for
+        right-padded ragged batches: only targets at positions < lengths[b]
+        count. Causal attention keeps pad tokens out of real positions'
+        logits, and ``lengths`` is also threaded into MoE routing (pads
+        never consume expert capacity or enter the aux statistics) — so
+        ragged-batch training is exactly pad-content-independent for dense
+        AND MoE models (proven in test_gpt.py); the attention ops
+        additionally accept ``kv_lens`` for non-causal uses."""
+        return self.loss_and_metrics(params, tokens, lengths)[0]
+
+    def loss_and_metrics(
+        self,
+        params: GPTLMParams,
+        tokens: jax.Array,
+        lengths: jax.Array | None = None,
+    ) -> tuple[jax.Array, dict]:
+        """(total loss, metrics dict). Metrics always include ``ce``; MoE
+        models add ``balance_loss`` / ``z_loss`` (layer means entering the
+        total) and ``drop_fraction`` (pure metric, NOT in the loss — the
+        observable no-drop-regime guard)."""
+        logits, auxs = self.apply_with_aux(params, tokens, lengths)
+        logits = logits[:, :-1]
         targets = tokens[:, 1:]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)
-        return -jnp.mean(picked)
+        if lengths is None:
+            ce = -jnp.mean(picked)
+        else:
+            # Target at position i is token i+1 → valid iff i+1 < lengths[b].
+            w = (
+                jnp.arange(tokens.shape[1] - 1)[None, :]
+                < (lengths[:, None] - 1)
+            ).astype(jnp.float32)
+            ce = -jnp.sum(picked[..., 0] * w) / jnp.maximum(jnp.sum(w), 1.0)
+        metrics = {"ce": ce}
+        if self.moe_experts is None:
+            return ce, metrics
+        balance = jnp.mean(auxs.balance_loss)
+        z = jnp.mean(auxs.z_loss)
+        metrics.update(
+            balance_loss=balance,
+            z_loss=z,
+            drop_fraction=jnp.mean(auxs.drop_fraction),
+            # [E]: dispatch distribution averaged over layers — the direct
+            # utilization readout (uniform = 1/E everywhere).
+            expert_fraction=jnp.mean(auxs.expert_fraction, axis=0),
+        )
+        total = ce + self.moe_balance_coef * balance + self.moe_z_coef * z
+        return total, metrics
 
     # -- KV-cache decoding -------------------------------------------------
 
+    @property
+    def cache_len(self) -> int:
+        """Static KV-cache length per layer: ``min(window, max_len)`` for
+        windowed models (rolling buffer — older keys are unreachable by the
+        sliding-window mask), else ``max_len``."""
+        if self.window is not None:
+            return min(self.window, self.max_len)
+        return self.max_len
+
     def prefill(self, params: GPTLMParams, tokens: jax.Array):
         """Run the prompt once, returning (last-position logits [B, vocab],
-        cache holding every layer's prompt k/v)."""
+        cache holding every layer's prompt k/v). Windowed models keep only
+        the last ``cache_len`` prompt positions, each at slot ``pos mod
+        cache_len`` — the rolling layout :meth:`decode_step` writes."""
         b, l = tokens.shape
         positions = jnp.arange(l)
         h = self._embed_tokens(params, tokens, positions)
 
         def body(h, blk):
-            h, kv = self._block(blk, h, positions=positions)
+            h, kv, _ = self._block(blk, h, positions=positions)
             return h, kv
 
         h, (ks, vs) = lax.scan(body, h, params.blocks)
-        pad = [(0, 0), (0, 0), (0, self.max_len - l), (0, 0), (0, 0)]
-        cache = KVCache(
-            k=jnp.pad(ks.astype(self.compute_dtype), pad),
-            v=jnp.pad(vs.astype(self.compute_dtype), pad),
-            length=jnp.asarray(l, jnp.int32),
-        )
+        ks = ks.astype(self.compute_dtype)
+        vs = vs.astype(self.compute_dtype)
+        c = self.cache_len
+        if l <= c:
+            pad = [(0, 0), (0, 0), (0, c - l), (0, 0), (0, 0)]
+            # Positions land at slot pos % c = pos (l <= c): plain pad.
+            ck, cv = jnp.pad(ks, pad), jnp.pad(vs, pad)
+        else:
+            # Rolling: keep the last c positions at slots pos % c (static
+            # index arrays — l and c are compile-time).
+            ps = np.arange(l - c, l)
+            slots = ps % c
+            shape = ks.shape[:2] + (c,) + ks.shape[3:]
+            ck = jnp.zeros(shape, ks.dtype).at[:, :, slots].set(ks[:, :, ps])
+            cv = jnp.zeros(shape, vs.dtype).at[:, :, slots].set(vs[:, :, ps])
+        cache = KVCache(k=ck, v=cv, length=jnp.asarray(l, jnp.int32))
         return self._logits(params, h)[:, -1], cache
 
     def _decode_block(self, blk: GPTBlockParams, h, ck, cv, length):
-        """Single-token block step. h: [B, 1, d]; ck/cv: [B, max_len, H, Dh]
-        (this layer's cache). Returns (h, updated ck, updated cv)."""
+        """Single-token block step. h: [B, 1, d]; ck/cv: [B, cache_len, Hkv,
+        Dh] (this layer's cache). Returns (h, updated ck, updated cv)."""
         b = h.shape[0]
+        c = self.cache_len
         hn = _layernorm(h, blk.ln1_scale, blk.ln1_bias)
         kv_shape = (b, 1, self.num_kv_heads, self.head_dim)
         q = self._dot(hn, blk.wq).reshape(b, 1, self.num_heads, self.head_dim)
@@ -577,24 +777,30 @@ class GPTLM:
             k = _rope(k, pos1)
         k = k.astype(ck.dtype)
         v = v.astype(cv.dtype)
-        ck = lax.dynamic_update_slice(ck, k, (0, length, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v, (0, length, 0, 0))
+        slot = length % c if self.window is not None else length
+        ck = lax.dynamic_update_slice(ck, k, (0, slot, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v, (0, slot, 0, 0))
         # Attend the one query against the whole static-length cache,
-        # masking positions past `length` (self included via <=). The cache
-        # stores num_kv_heads; repeat transiently for the score einsum (the
-        # memory win is in what's STORED, not this one-step temporary).
+        # masking invalid slots. The cache stores num_kv_heads; repeat
+        # transiently for the score einsum (the memory win is in what's
+        # STORED, not this one-step temporary).
         from distributed_tensorflow_tpu.ops.ring_attention import repeat_kv
 
         ck_q, cv_q = repeat_kv(ck, cv, self.num_heads)
         scores = jnp.einsum(
             "bqhd,bkhd->bhqk", q, ck_q, preferred_element_type=jnp.float32
         ) / jnp.sqrt(jnp.asarray(self.head_dim, jnp.float32))
-        pos_idx = jnp.arange(self.max_len)
-        valid = pos_idx <= length  # [max_len]
+        idx = jnp.arange(c)
         if self.window is not None:
-            # sliding window: the query at `length` sees only its last W
-            # positions (self included) — same band the training mask uses.
-            valid &= pos_idx > length - self.window
+            # Rolling buffer: slot i holds absolute position
+            # length − ((slot − i) mod c) ∈ (length − c, length] — by
+            # construction exactly the window (self included), so the only
+            # invalid slots are the not-yet-written ones (negative
+            # position). No ≤ length or > length − W test needed.
+            slot_pos = length - jnp.mod(slot - idx, c)
+            valid = slot_pos >= 0
+        else:
+            valid = idx <= length  # [cache_len]
         scores = jnp.where(valid[None, None, None, :], scores, -1e30)
         w = jax.nn.softmax(scores, axis=-1)
         attn = jnp.einsum(
@@ -605,7 +811,8 @@ class GPTLM:
         )
         h = h + self._dot(attn.reshape(b, 1, self.model_dim), blk.wo)
         hn2 = _layernorm(h, blk.ln2_scale, blk.ln2_bias)
-        return h + self._ffn(blk, hn2), ck, cv
+        ffn_out, _ = self._ffn(blk, hn2)  # aux unused: decode never drops
+        return h + ffn_out, ck, cv
 
     def decode_step(self, params: GPTLMParams, token: jax.Array, cache: KVCache):
         """Append one token [B] int32; returns (logits [B, vocab], cache).

@@ -39,6 +39,36 @@ class MoEParams(NamedTuple):
     b_down: jax.Array  # [E, D]
 
 
+class MoEAux(NamedTuple):
+    """Router observability/trainability statistics, all scalar f32, computed
+    over the tokens one ``moe_ffn*`` call routes:
+
+    - ``balance_loss``: the Switch load-balancing auxiliary loss
+      ``E · Σ_e f_e · P_e`` (f_e = fraction of tokens argmax-routed to
+      expert e, P_e = mean router probability of e) — differentiable through
+      P, minimized at 1.0 by uniform routing; without it nothing stops
+      top-1 routing from collapsing onto one expert.
+    - ``z_loss``: the ST-MoE router z-loss ``mean(logsumexp(logits)²)``,
+      keeping gate logits small so bf16 routing stays stable.
+    - ``drop_fraction``: fraction of tokens beyond expert capacity (passed
+      through with zero expert contribution). NOT differentiable — a pure
+      metric, and the observable guard on every "equal in the no-drop
+      regime" claim (models/gpt.py ep==dense, dp==single-device).
+    - ``expert_fraction``: the dispatch distribution f itself, [E] — the
+      direct utilization readout (collapse shows as one entry → 1).
+    """
+
+    balance_loss: jax.Array
+    z_loss: jax.Array
+    drop_fraction: jax.Array
+    expert_fraction: jax.Array
+
+    @staticmethod
+    def zero() -> "MoEAux":
+        z = jnp.zeros((), jnp.float32)
+        return MoEAux(z, z, z, z)
+
+
 def init_moe(key, d: int, hidden: int, num_experts: int) -> MoEParams:
     k1, k2, k3 = jax.random.split(key, 3)
     return MoEParams(
@@ -59,44 +89,103 @@ def _expert_ffn(x, w_up, b_up, w_down, b_down):
     return jnp.dot(h, w_down, preferred_element_type=jnp.float32) + b_down
 
 
-def _route(x, wg, num_experts: int, capacity: int):
+def _route(x, wg, num_experts: int, capacity: int, token_mask=None):
     """Shared routing: returns (expert_idx [T], gate_prob [T], slot [T],
-    keep [T]) where slot is the token's position in its (expert, source)
-    capacity buffer and keep = slot < capacity."""
+    keep [T], aux :class:`MoEAux`) where slot is the token's position in its
+    (expert, source) capacity buffer and keep = slot < capacity.
+
+    ``token_mask`` [T] bool marks real tokens in a right-padded ragged
+    batch: pad tokens are never dispatched (keep=False), never consume a
+    capacity slot, and are excluded from every aux statistic — so ragged
+    MoE batches are exactly pad-content-independent (without the mask, a
+    pad token could displace a real one from its expert's queue and the
+    balance/z losses would average over garbage)."""
     logits = jnp.dot(x, wg, preferred_element_type=jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
     expert_idx = jnp.argmax(logits, axis=-1)
     gate_prob = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
     onehot = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.int32)  # [T, E]
-    # Position of each token within its expert's queue (arrival order).
+    if token_mask is not None:
+        onehot = onehot * token_mask[:, None].astype(jnp.int32)
+    # Position of each token within its expert's queue (arrival order; pad
+    # tokens contribute nothing to the cumsum, so they occupy no slot).
     slot = (jnp.cumsum(onehot, axis=0) - 1)[jnp.arange(x.shape[0]), expert_idx]
     keep = slot < capacity
-    return expert_idx, gate_prob, slot, keep
+    if token_mask is not None:
+        keep &= token_mask
+    # Aux statistics over this call's REAL tokens. f rides stop_gradient-
+    # free one_hot (int → no gradient anyway); the differentiable path into
+    # the gate weights is P — exactly the Switch formulation.
+    lse2 = jax.scipy.special.logsumexp(logits, axis=-1) ** 2
+    if token_mask is None:
+        f = jnp.mean(onehot.astype(jnp.float32), axis=0)  # [E] dispatch frac
+        p_mean = jnp.mean(probs, axis=0)  # [E] mean router prob
+        z = jnp.mean(lse2)
+        kept = jnp.mean(keep.astype(jnp.float32))
+    else:
+        w = token_mask.astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(w), 1.0)
+        f = jnp.sum(onehot.astype(jnp.float32), axis=0) / denom
+        p_mean = jnp.sum(probs * w[:, None], axis=0) / denom
+        z = jnp.sum(lse2 * w) / denom
+        kept = jnp.sum(keep.astype(jnp.float32)) / denom
+    aux = MoEAux(
+        balance_loss=num_experts * jnp.sum(f * p_mean),
+        z_loss=z,
+        drop_fraction=1.0 - kept,
+        expert_fraction=f,
+    )
+    return expert_idx, gate_prob, slot, keep, aux
 
 
-def moe_ffn_dense(params: MoEParams, x: jax.Array, capacity: int) -> jax.Array:
+def moe_ffn_dense(
+    params: MoEParams,
+    x: jax.Array,
+    capacity: int,
+    *,
+    with_aux: bool = False,
+    token_mask: jax.Array | None = None,
+):
     """Single-device reference with identical routing/drop semantics: every
-    expert computed locally, per-expert capacity applied in token order."""
+    expert computed locally, per-expert capacity applied in token order.
+    ``with_aux=True`` also returns the router's :class:`MoEAux`;
+    ``token_mask`` [T] bool excludes pad tokens from routing (see
+    :func:`_route`)."""
     e = params.wg.shape[1]
-    expert_idx, gate_prob, _, keep = _route(x, params.wg, e, capacity)
+    expert_idx, gate_prob, _, keep, aux = _route(
+        x, params.wg, e, capacity, token_mask
+    )
     outs = jax.vmap(_expert_ffn, in_axes=(None, 0, 0, 0, 0))(
         x, params.w_up, params.b_up, params.w_down, params.b_down
     )  # [E, T, D]
     picked = outs[expert_idx, jnp.arange(x.shape[0])]  # [T, D]
-    return jnp.where(keep[:, None], gate_prob[:, None] * picked, 0.0)
+    out = jnp.where(keep[:, None], gate_prob[:, None] * picked, 0.0)
+    return (out, aux) if with_aux else out
 
 
-def moe_ffn_local(params: MoEParams, x: jax.Array, capacity: int) -> jax.Array:
+def moe_ffn_local(
+    params: MoEParams,
+    x: jax.Array,
+    capacity: int,
+    *,
+    with_aux: bool = False,
+    token_mask: jax.Array | None = None,
+):
     """Single-device switch FFN at sparse cost: route, gather each expert's
     ≤``capacity`` tokens into its buffer, run every expert ONCE on its
     buffer, scatter back. Identical semantics to :func:`moe_ffn_dense`
     (same ``_route``, same per-expert in-arrival-order capacity — a single
     source makes per-source and global capacity the same thing) at
     ``E·capacity`` token-FFNs instead of dense's ``E·T`` — the sparse
-    compute MoE exists for, without the cross-device exchange."""
+    compute MoE exists for, without the cross-device exchange.
+    ``with_aux=True`` also returns the router's :class:`MoEAux`;
+    ``token_mask`` [T] bool excludes pad tokens from routing (see
+    :func:`_route`)."""
     e = params.wg.shape[1]
     t, d = x.shape
-    expert_idx, gate_prob, slot, keep = _route(x, params.wg, e, capacity)
+    expert_idx, gate_prob, slot, keep, aux = _route(
+        x, params.wg, e, capacity, token_mask
+    )
 
     send = jnp.zeros((e, capacity, d), x.dtype)
     rows = jnp.where(keep, expert_idx, 0)
@@ -108,18 +197,31 @@ def moe_ffn_local(params: MoEParams, x: jax.Array, capacity: int) -> jax.Array:
         send, params.w_up, params.b_up, params.w_down, params.b_down
     )  # [E, C, D]
     gathered = out[rows, cols]
-    return jnp.where(keep[:, None], gate_prob[:, None] * gathered, 0.0)
+    result = jnp.where(keep[:, None], gate_prob[:, None] * gathered, 0.0)
+    return (result, aux) if with_aux else result
 
 
-def moe_ffn(params: MoEParams, x: jax.Array, axis_name: str, capacity: int):
+def moe_ffn(
+    params: MoEParams,
+    x: jax.Array,
+    axis_name: str,
+    capacity: int,
+    *,
+    with_aux: bool = False,
+    token_mask: jax.Array | None = None,
+):
     """Expert-parallel forward body (inside shard_map over ``axis_name``).
 
     ``x``: this device's local tokens [T_loc, D]. ``params.w_up`` etc. carry
     a leading [1, ...] slice — this device's expert. Returns [T_loc, D].
+    ``with_aux=True`` also returns this device's router :class:`MoEAux`
+    (local-token statistics; pmean over the axis for the global view).
     """
     n = lax.axis_size(axis_name)
     t_loc, d = x.shape
-    expert_idx, gate_prob, slot, keep = _route(x, params.wg, n, capacity)
+    expert_idx, gate_prob, slot, keep, aux = _route(
+        x, params.wg, n, capacity, token_mask
+    )
 
     # Build the outgoing buffers: for each destination expert e, a [C, D]
     # block of this device's tokens routed to e (zeros elsewhere).
@@ -145,4 +247,5 @@ def moe_ffn(params: MoEParams, x: jax.Array, axis_name: str, capacity: int):
     # Return to senders and un-permute into token order.
     back = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0, tiled=True)
     gathered = back[rows, cols]  # [T_loc, D]
-    return jnp.where(keep[:, None], gate_prob[:, None] * gathered, 0.0)
+    result = jnp.where(keep[:, None], gate_prob[:, None] * gathered, 0.0)
+    return (result, aux) if with_aux else result

@@ -72,11 +72,13 @@ def _pick_block(l: int, requested: int | None) -> int:
     return l
 
 
-def _causal_mask(iq, ik, bq, bk, window=None):
+def _causal_mask(iq, ik, bq, bk, window=None, offset=0):
     """[bq, bk] bool: global q position >= global k position (and, with
-    ``window=W``, within the last W keys). 2-D broadcasted_iota — plain
-    ``jnp.arange`` is 1-D and TPU rejects it."""
-    q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    ``window=W``, within the last W keys). ``offset`` shifts every q
+    position forward — the ring composition's past hops, where the held KV
+    block originated ``offset`` positions behind the local queries. 2-D
+    broadcasted_iota — plain ``jnp.arange`` is 1-D and TPU rejects it."""
+    q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + offset
     k_pos = ik * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     diff = q_pos - k_pos
     mask = diff >= 0
@@ -85,14 +87,24 @@ def _causal_mask(iq, ik, bq, bk, window=None):
     return mask
 
 
-def _block_needed(iq, ik, bq, bk, window):
+def _block_needed(iq, ik, bq, bk, window, offset=0):
     """Whether any (q, k) pair in this block pair survives the causal(+
     window) mask: max diff >= 0 (not fully above the diagonal) and, with a
     window, min diff < W (not fully fallen out of it)."""
-    needed = (iq + 1) * bq - 1 >= ik * bk
+    needed = (iq + 1) * bq - 1 + offset >= ik * bk
     if window is not None:
-        needed &= iq * bq - (ik + 1) * bk + 1 < window
+        needed &= iq * bq + offset - (ik + 1) * bk + 1 < window
     return needed
+
+
+def _kvlen_mask(s, ik, bk, kvlen_ref):
+    """Key-padding for one score block: keys at global position >= this
+    batch row's kv_len score -inf; exp(s - m) then underflows to exactly 0,
+    so masked keys never enter the softmax statistics — one definition
+    shared by the forward and both backward kernels."""
+    bq = s.shape[0]
+    k_pos = ik * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(k_pos < kvlen_ref[0, 0], s, _NEG_INF)
 
 
 def _use_banding(window, l) -> bool:
@@ -151,9 +163,15 @@ def _banded_q_index(window, bq, bk, nq):
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, scale: float, causal: bool, window: int | None, nk: int,
+    q_ref, k_ref, v_ref, *rest,
+    scale: float, causal: bool, window: int | None, nk: int, has_lens: bool,
+    offset: int = 0,
 ):
+    if has_lens:
+        kvlen_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+        kvlen_ref = None
     iq = pl.program_id(1)
     ik = pl.program_id(2)
     bq = q_ref.shape[1]
@@ -173,7 +191,9 @@ def _fwd_kernel(
         k = k_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = jnp.where(_causal_mask(iq, ik, bq, bk, window), s, _NEG_INF)
+            s = jnp.where(_causal_mask(iq, ik, bq, bk, window, offset), s, _NEG_INF)
+        if has_lens:
+            s = _kvlen_mask(s, ik, bk, kvlen_ref)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         # A still-empty row (everything masked so far) has m_new == -inf;
@@ -191,7 +211,7 @@ def _fwd_kernel(
     if causal:
         # Skip blocks whose every score is masked: strictly above the
         # diagonal, or (windowed) entirely fallen out of the window.
-        pl.when(_block_needed(iq, ik, bq, bk, window))(_accumulate)
+        pl.when(_block_needed(iq, ik, bq, bk, window, offset))(_accumulate)
     else:
         _accumulate()
 
@@ -202,28 +222,42 @@ def _fwd_kernel(
         lse_ref[0] = m_scr[:] + jnp.log(l)
 
 
-def _fwd_call(q, k, v, *, causal, window, bq, bk, scale, interpret, vma, hq, hkv):
+def _fwd_call(
+    q, k, v, kv_lens, *, causal, window, offset, bq, bk, scale, interpret,
+    vma, hq, hkv
+):
     """q [B·Hq, L, D], k/v [B·Hkv, L, D] → (out [B·Hq, L, D], lse
-    [B·Hq, L, 1]). ``vma`` marks the outputs as varying over those mesh
-    axes — required under a ``check_vma=True`` shard_map (the ring
-    composition)."""
+    [B·Hq, L, 1]). ``kv_lens`` is None or [B] int32 (right-padded
+    key-padding; expanded per query head here). ``vma`` marks the outputs
+    as varying over those mesh axes — required under a ``check_vma=True``
+    shard_map (the ring composition)."""
     sds = partial(jax.ShapeDtypeStruct, vma=vma) if vma else jax.ShapeDtypeStruct
     bh, l, d = q.shape
     nq, nk = l // bq, l // bk
     row = _kv_row(hq, hkv)
     kmap = (
         _banded_k_index(window, bq, bk, row)
-        if _use_banding(window, l)
+        if offset == 0 and _use_banding(window, l)
         else (lambda b, iq, ik: (row(b), ik, 0))
     )
+    has_lens = kv_lens is not None
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
+        pl.BlockSpec((1, bk, d), kmap),
+        pl.BlockSpec((1, bk, d), kmap),
+    ]
+    inputs = [q, k, v]
+    if has_lens:
+        in_specs.append(pl.BlockSpec((1, 1), lambda b, iq, ik: (b, 0)))
+        inputs.append(jnp.repeat(kv_lens.astype(jnp.int32), hq)[:, None])
     return pl.pallas_call(
-        partial(_fwd_kernel, scale=scale, causal=causal, window=window, nk=nk),
+        partial(
+            _fwd_kernel,
+            scale=scale, causal=causal, window=window, nk=nk,
+            has_lens=has_lens, offset=offset,
+        ),
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
-            pl.BlockSpec((1, bk, d), kmap),
-            pl.BlockSpec((1, bk, d), kmap),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, iq, ik: (b, iq, 0)),
@@ -238,7 +272,7 @@ def _fwd_call(q, k, v, *, causal, window, bq, bk, scale, interpret, vma, hq, hkv
             pltpu.VMEM((bq, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
 
 
 # ---------------------------------------------------------------------------
@@ -247,9 +281,15 @@ def _fwd_call(q, k, v, *, causal, window, bq, bk, scale, interpret, vma, hq, hkv
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, scale: float, causal: bool, window: int | None, nk: int,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    scale: float, causal: bool, window: int | None, nk: int, has_lens: bool,
+    offset: int = 0,
 ):
+    if has_lens:
+        kvlen_ref, dq_ref, dq_scr = rest
+    else:
+        dq_ref, dq_scr = rest
+        kvlen_ref = None
     iq = pl.program_id(1)
     ik = pl.program_id(2)
     bq = q_ref.shape[1]
@@ -264,7 +304,9 @@ def _dq_kernel(
         k = k_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = jnp.where(_causal_mask(iq, ik, bq, bk, window), s, _NEG_INF)
+            s = jnp.where(_causal_mask(iq, ik, bq, bk, window, offset), s, _NEG_INF)
+        if has_lens:
+            s = _kvlen_mask(s, ik, bk, kvlen_ref)
         p = jnp.exp(s - lse_ref[0])  # masked scores underflow to exactly 0
         dp = jnp.dot(do_ref[0], v_ref[0].T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0]) * scale
@@ -273,7 +315,7 @@ def _dq_kernel(
         )
 
     if causal:
-        pl.when(_block_needed(iq, ik, bq, bk, window))(_accumulate)
+        pl.when(_block_needed(iq, ik, bq, bk, window, offset))(_accumulate)
     else:
         _accumulate()
 
@@ -283,10 +325,15 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr,
-    *, scale: float, causal: bool, window: int | None, nq: int, total: int,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    scale: float, causal: bool, window: int | None, nq: int, total: int,
+    has_lens: bool, offset: int = 0,
 ):
+    if has_lens:
+        kvlen_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
+        kvlen_ref = None
     ik = pl.program_id(1)
     j = pl.program_id(2)
     iq = j % nq  # positional q block; j // nq is the GQA head in the group
@@ -304,7 +351,9 @@ def _dkv_kernel(
         do = do_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = jnp.where(_causal_mask(iq, ik, bq, bk, window), s, _NEG_INF)
+            s = jnp.where(_causal_mask(iq, ik, bq, bk, window, offset), s, _NEG_INF)
+        if has_lens:
+            s = _kvlen_mask(s, ik, bk, kvlen_ref)
         p = jnp.exp(s - lse_ref[0])
         dv_scr[:] += jnp.dot(
             p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
@@ -316,7 +365,7 @@ def _dkv_kernel(
         )
 
     if causal:
-        pl.when(_block_needed(iq, ik, bq, bk, window))(_accumulate)
+        pl.when(_block_needed(iq, ik, bq, bk, window, offset))(_accumulate)
     else:
         _accumulate()
 
@@ -327,8 +376,8 @@ def _dkv_kernel(
 
 
 def _bwd_call(
-    q, k, v, o, lse, do, delta,
-    *, causal, window, bq, bk, scale, interpret, vma, hq, hkv,
+    q, k, v, o, lse, do, delta, kv_lens,
+    *, causal, window, offset, bq, bk, scale, interpret, vma, hq, hkv,
 ):
     sds = partial(jax.ShapeDtypeStruct, vma=vma) if vma else jax.ShapeDtypeStruct
     bh, l, d = q.shape
@@ -340,20 +389,31 @@ def _bwd_call(
     rowspec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
     kmap = (
         _banded_k_index(window, bq, bk, row)
-        if _use_banding(window, l)
+        if offset == 0 and _use_banding(window, l)
         else (lambda b, i, j: (row(b), j, 0))
     )
     kspec = pl.BlockSpec((1, bk, d), kmap)
+    has_lens = kv_lens is not None
+    lens_spec = pl.BlockSpec((1, 1), lambda b, i, j: (b, 0))
 
+    dq_inputs = [q, k, v, do, lse, delta]
+    dq_specs = [qspec, kspec, kspec, qspec, rowspec, rowspec]
+    if has_lens:
+        dq_inputs.append(jnp.repeat(kv_lens.astype(jnp.int32), hq)[:, None])
+        dq_specs.append(lens_spec)
     dq = pl.pallas_call(
-        partial(_dq_kernel, scale=scale, causal=causal, window=window, nk=nk),
+        partial(
+            _dq_kernel,
+            scale=scale, causal=causal, window=window, nk=nk,
+            has_lens=has_lens, offset=offset,
+        ),
         grid=(bh, nq, nk),
-        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        in_specs=dq_specs,
         out_specs=qspec,
         out_shape=sds((bh, l, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_inputs)
 
     # k-major: q/do/lse/delta blocks walk the innermost dim, which under
     # GQA spans all g query heads sharing this KV head (j = head·nq + jq) —
@@ -361,7 +421,7 @@ def _bwd_call(
     def qrow(b, j):
         return (b // hkv) * hq + (b % hkv) * g + j // nq
 
-    if _use_banding(window, l):
+    if offset == 0 and _use_banding(window, l):
         _band = _banded_q_index(window, bq, bk, nq)
 
         def qmap(b, i, j):
@@ -376,13 +436,20 @@ def _bwd_call(
     qspec2 = pl.BlockSpec((1, bq, d), qmap)
     rowspec2 = pl.BlockSpec((1, bq, 1), qmap)
     kspec2 = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0))
+    dkv_inputs = [q, k, v, do, lse, delta]
+    dkv_specs = [qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2]
+    if has_lens:
+        # k-major grid: b indexes B·Hkv rows.
+        dkv_inputs.append(jnp.repeat(kv_lens.astype(jnp.int32), hkv)[:, None])
+        dkv_specs.append(lens_spec)
     dk, dv = pl.pallas_call(
         partial(
             _dkv_kernel,
             scale=scale, causal=causal, window=window, nq=nq, total=nq * g,
+            has_lens=has_lens, offset=offset,
         ),
         grid=(bhkv, nk, nq * g),
-        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        in_specs=dkv_specs,
         out_specs=(kspec2, kspec2),
         out_shape=(
             sds((bhkv, l, d), k.dtype),
@@ -393,7 +460,7 @@ def _bwd_call(
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dkv_inputs)
     return dq, dk, dv
 
 
@@ -413,27 +480,34 @@ def _from_bh(x, b, h):
     return jnp.einsum("bhld->blhd", x.reshape(b, h, l, d))
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
-def _flash(causal, window, bq, bk, interpret, vma, hq, hkv, q, k, v):
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8))
+def _flash(causal, window, offset, bq, bk, interpret, vma, hq, hkv, q, k, v, kv_lens):
     """Primal returns (out, lse) — both differentiable. The lse output is
     what makes blockwise *composition* (ring attention) differentiable: a
     cotangent on lse folds into the backward's delta term, since
-    ∂lse_i/∂s_ij = p_ij means ds = p·(dp − (delta − g_lse))·scale."""
+    ∂lse_i/∂s_ij = p_ij means ds = p·(dp − (delta − g_lse))·scale.
+    ``kv_lens`` (None or [B] int32) is an integer side input — its
+    "gradient" is None."""
     scale = 1.0 / (q.shape[-1] ** 0.5)
     return _fwd_call(
-        q, k, v,
-        causal=causal, window=window, bq=bq, bk=bk, scale=scale,
-        interpret=interpret, vma=vma, hq=hq, hkv=hkv,
+        q, k, v, kv_lens,
+        causal=causal, window=window, offset=offset, bq=bq, bk=bk,
+        scale=scale, interpret=interpret, vma=vma, hq=hq, hkv=hkv,
     )
 
 
-def _flash_fwd(causal, window, bq, bk, interpret, vma, hq, hkv, q, k, v):
-    o, lse = _flash(causal, window, bq, bk, interpret, vma, hq, hkv, q, k, v)
-    return (o, lse), (q, k, v, o, lse)
+def _flash_fwd(
+    causal, window, offset, bq, bk, interpret, vma, hq, hkv, q, k, v, kv_lens
+):
+    o, lse = _flash(
+        causal, window, offset, bq, bk, interpret, vma, hq, hkv, q, k, v,
+        kv_lens,
+    )
+    return (o, lse), (q, k, v, o, lse, kv_lens)
 
 
-def _flash_bwd(causal, window, bq, bk, interpret, vma, hq, hkv, res, g):
-    q, k, v, o, lse = res
+def _flash_bwd(causal, window, offset, bq, bk, interpret, vma, hq, hkv, res, g):
+    q, k, v, o, lse, kv_lens = res
     do, dlse = g
     scale = 1.0 / (q.shape[-1] ** 0.5)
     # delta_i = rowsum(do ⊙ out) − g_lse: tiny elementwise reduce, XLA fuses
@@ -442,11 +516,12 @@ def _flash_bwd(causal, window, bq, bk, interpret, vma, hq, hkv, res, g):
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
     ) - dlse.astype(jnp.float32)
-    return _bwd_call(
-        q, k, v, o, lse, do, delta,
-        causal=causal, window=window, bq=bq, bk=bk, scale=scale,
-        interpret=interpret, vma=vma, hq=hq, hkv=hkv,
+    dq, dk, dv = _bwd_call(
+        q, k, v, o, lse, do, delta, kv_lens,
+        causal=causal, window=window, offset=offset, bq=bq, bk=bk,
+        scale=scale, interpret=interpret, vma=vma, hq=hq, hkv=hkv,
     )
+    return dq, dk, dv, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -459,6 +534,8 @@ def flash_attention(
     *,
     causal: bool = False,
     window: int | None = None,
+    kv_lens: jax.Array | None = None,
+    offset: int = 0,
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
@@ -475,6 +552,12 @@ def flash_attention(
     head via the grid index maps (no materialized repeat), and dk/dv
     accumulate over the whole group in-kernel.
 
+    ``kv_lens`` [B] int32 is the key-padding mask in right-padded form
+    (lengths ≥ 1): keys at positions ≥ kv_lens[b] are masked for every
+    query, forward and backward — identical semantics to
+    ``dense_attention(kv_lens=...)``. Padded *query* rows still produce
+    (well-defined) outputs; mask them downstream (``GPTLM.loss(lengths=)``).
+
     Drop-in for :func:`ops.ring_attention.dense_attention` (same signature,
     same math, differentiable via fused Pallas backward kernels); use it as
     the within-device attention whenever L is long enough that the score
@@ -487,7 +570,8 @@ def flash_attention(
     """
     out, _ = flash_attention_with_lse(
         q, k, v,
-        causal=causal, window=window, block_q=block_q, block_k=block_k,
+        causal=causal, window=window, kv_lens=kv_lens, offset=offset,
+        block_q=block_q, block_k=block_k,
         interpret=interpret, vma=vma,
     )
     return out
@@ -500,6 +584,8 @@ def flash_attention_with_lse(
     *,
     causal: bool = False,
     window: int | None = None,
+    kv_lens: jax.Array | None = None,
+    offset: int = 0,
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
@@ -510,7 +596,15 @@ def flash_attention_with_lse(
     partial attention over disjoint KV chunks exactly (ring attention's
     per-hop accumulation). Both outputs are differentiable. Pass
     ``vma=(axis,...)`` when calling inside a ``shard_map`` that checks
-    varying-mesh-axes types (Pallas outputs carry no vma by default)."""
+    varying-mesh-axes types (Pallas outputs carry no vma by default).
+
+    ``offset=F`` (static, requires ``causal``) shifts every query's global
+    position F ahead of the keys': the mask keeps ``0 <= q+F-k`` (and
+    ``< window``). This is the blockwise-composition hook — a ring hop
+    holding a KV block that originated F positions behind the local queries
+    is exactly causal+window attention at offset F (all-past blocks without
+    a window are the degenerate ``F >= L`` case, where it equals
+    ``causal=False``)."""
     if k.shape != v.shape:
         raise ValueError(f"k/v shapes must match: {k.shape} {v.shape}")
     if (
@@ -529,16 +623,25 @@ def flash_attention_with_lse(
             raise ValueError("window requires causal=True")
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
+    if offset:
+        if not causal:
+            raise ValueError("offset requires causal=True")
+        if offset < 0:
+            raise ValueError(f"offset must be >= 0, got {offset}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, l, h, d = q.shape
     hkv = k.shape[2]
+    if kv_lens is not None and kv_lens.shape != (b,):
+        raise ValueError(
+            f"kv_lens must be [batch]=({b},), got {kv_lens.shape}"
+        )
     bq = _pick_block(l, block_q)
     bk = _pick_block(l, block_k)
     out, lse = _flash(
-        causal, window, bq, bk, interpret,
+        causal, window, offset, bq, bk, interpret,
         frozenset(vma) if vma else None,  # ShapeDtypeStruct wants a set
         h, hkv,
-        _to_bh(q), _to_bh(k), _to_bh(v),
+        _to_bh(q), _to_bh(k), _to_bh(v), kv_lens,
     )
     return _from_bh(out, b, h), jnp.transpose(lse.reshape(b, h, l), (0, 2, 1))

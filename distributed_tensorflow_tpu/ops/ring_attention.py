@@ -68,6 +68,18 @@ def _rotate_unless_last(kv, step, n, *, axis_name, perm):
     )
 
 
+def _window_hops(window: int | None, l_loc: int, n: int) -> int:
+    """Ring steps actually needed under a sliding window: local queries
+    span [my·L, (my+1)·L); the farthest-back key any of them sees is
+    my·L − W + 1, i.e. ceil((W−1)/L) blocks behind — plus the diagonal.
+    Hops beyond that hold KV wholly outside every band and never happen:
+    THIS is sliding-window SP's traffic win (for W ≪ global L most of the
+    ring is skipped), not just masked-out compute."""
+    if window is None:
+        return n
+    return min(n, -(-(window - 1) // l_loc) + 1)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -75,18 +87,37 @@ def ring_attention(
     axis_name: str,
     *,
     causal: bool = False,
+    window: int | None = None,
+    kv_lens: jax.Array | None = None,
 ) -> jax.Array:
     """Exact attention over a sequence sharded on ``axis_name``.
 
-    Args are local blocks [B, L_local, H, D]; returns the local output block
-    of the same shape. Equivalent to dense (optionally causal) softmax
-    attention over the full gathered sequence.
+    q is a local block [B, L_local, Hq, D]; k/v are local blocks with
+    ``Hkv ≤ Hq`` heads (grouped-query attention: ONLY the KV heads ride the
+    ring — the group factor is reclaimed as cross-device bandwidth, the one
+    place GQA's saving matters most; the repeat to Hq happens locally after
+    each receive). Returns the local output block [B, L_local, Hq, D] —
+    equivalent to ``dense_attention`` (optionally causal / windowed) over
+    the full gathered sequence.
+
+    ``window=W`` (requires ``causal``) restricts each query to its last W
+    keys; the ring then runs only ``ceil((W−1)/L_local)+1`` hops (see
+    :func:`_window_hops`). ``kv_lens`` [B] int32 is the key-padding mask in
+    right-padded form, in GLOBAL positions (replicated across the seq
+    axis): keys at global position ≥ kv_lens[b] are masked — exactly
+    ``dense_attention(kv_lens=...)`` on the gathered sequence.
     """
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, l_loc, h, d = q.shape
     scale = 1.0 / jnp.sqrt(jnp.array(d, jnp.float32))
     perm = _ring_perm(n)
+    hops = _window_hops(window, l_loc, n)
 
     q32 = q.astype(jnp.float32)
     # pvary: the zero-init carries are device-invariant but the loop body
@@ -106,13 +137,23 @@ def ring_attention(
         def attend(m, s, o):
             # The block held at `step` originated `step` positions behind us.
             src = (my - step) % n
+            # GQA: the block circulated at Hkv heads; repeat locally (a
+            # transient — never on the wire).
+            k_rep, v_rep = repeat_kv(k_blk, v_blk, h)
             mask = None
+            k_pos = src * l_loc + jnp.arange(l_loc)
             if causal:
-                k_pos = src * l_loc + jnp.arange(l_loc)
-                mask = q_pos[:, None] >= k_pos[None, :]  # [Lq, Lk]
+                diff = q_pos[:, None] - k_pos[None, :]  # [Lq, Lk]
+                mask = diff >= 0
+                if window is not None:
+                    mask &= diff < window
                 mask = mask[None, None]  # broadcast over B, H
+            if kv_lens is not None:
+                valid_k = k_pos[None, :] < kv_lens[:, None]  # [B, Lk]
+                valid_k = valid_k[:, None, None, :]  # over H, Lq
+                mask = valid_k if mask is None else mask & valid_k
             scores = _block_scores(
-                q32, k_blk.astype(jnp.float32), scale=scale, mask=mask
+                q32, k_rep.astype(jnp.float32), scale=scale, mask=mask
             )
             blk_max = jnp.max(scores, axis=-1, keepdims=True)
             m_new = jnp.maximum(m, blk_max)
@@ -120,11 +161,11 @@ def ring_attention(
             m_safe = jnp.where(m_new == _NEG_INF, 0.0, m_new)
             corr = jnp.exp(m - m_safe)
             p = jnp.exp(scores - m_safe)
-            if causal:
+            if mask is not None:
                 p = jnp.where(mask, p, 0.0)
             s_new = s * corr + jnp.sum(p, axis=-1, keepdims=True)
             pv = jnp.einsum(
-                "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32),
+                "bhqk,bkhd->bhqd", p, v_rep.astype(jnp.float32),
                 preferred_element_type=jnp.float32,
             )
             return m_new, s_new, o * corr + pv
@@ -138,11 +179,11 @@ def ring_attention(
         else:
             m, s, o = attend(m, s, o)
         kv = _rotate_unless_last(
-            (k_blk, v_blk), step, n, axis_name=axis_name, perm=perm
+            (k_blk, v_blk), step, hops, axis_name=axis_name, perm=perm
         )
         return m, s, o, kv
 
-    m, s, o, _ = lax.fori_loop(0, n, body, (m, s, o, (k, v)))
+    m, s, o, _ = lax.fori_loop(0, hops, body, (m, s, o, (k, v)))
     out = o / jnp.maximum(s, 1e-30)
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
 
@@ -154,6 +195,8 @@ def ring_flash_attention(
     axis_name: str,
     *,
     causal: bool = False,
+    window: int | None = None,
+    kv_lens: jax.Array | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
 ) -> jax.Array:
@@ -171,11 +214,30 @@ def ring_flash_attention(
     offsets coincide), or entirely in the future (skipped; its weight in the
     combine is exactly zero via lse = -inf). Differentiation rides the flash
     kernel's custom VJP — the lse cotangent folds into its delta term.
+
+    ``kv_lens`` [B] int32: key-padding in right-padded form, GLOBAL
+    positions (replicated across the seq axis) — same semantics as
+    :func:`ring_attention`. Each hop passes the kernel its block-relative
+    remainder ``clip(kv_lens − src·L_loc, 0, L_loc)``; a fully-padded hop
+    contributes weight exp(lse≈−inf) = 0 in the combine.
+
+    Grouped-query attention: k/v may carry fewer heads (Hkv ≤ Hq). Like
+    :func:`ring_attention`, only the Hkv-head blocks ride the ring; the
+    flash kernel maps query-head groups onto KV heads via its grid index
+    maps, so there is no materialized repeat at all on this path.
+
+    ``window=W`` (requires ``causal``): the ring runs only
+    ``ceil((W−1)/L_loc)+1`` statically-unrolled hops (the traffic win —
+    out-of-band blocks never move), the diagonal hop runs causal+windowed
+    flash, and each past hop runs the kernel with a static position
+    ``offset`` of ``step·L_loc`` — the shifted band.
     """
     from distributed_tensorflow_tpu.ops.pallas_attention import (
         flash_attention_with_lse,
     )
 
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, l_loc, h, d = q.shape
@@ -183,41 +245,97 @@ def ring_flash_attention(
     kw = dict(block_q=block_q, block_k=block_k, vma=(axis_name,))
 
     pvary = partial(to_varying, axis_name=(axis_name,))
-    o = pvary(jnp.zeros((b, l_loc, h, d), jnp.float32))
-    lse = pvary(jnp.full((b, l_loc, h), _NEG_INF, jnp.float32))
 
-    def _full(q, kb, vb):
-        return flash_attention_with_lse(q, kb, vb, causal=False, **kw)
+    def _hop_lens(src):
+        # Block-relative key-padding for the block held this hop (its keys
+        # cover global positions [src·L_loc, (src+1)·L_loc)).
+        if kv_lens is None:
+            return None
+        return jnp.clip(kv_lens - src * l_loc, 0, l_loc)
 
-    def _diag(q, kb, vb):
-        return flash_attention_with_lse(q, kb, vb, causal=True, **kw)
-
-    def _skip(q, kb, vb):
+    def _skip(q, kb, vb, lens):
         # Constants, but typed varying to match the flash branches' outputs
-        # under check_vma (all lax.switch branches must agree).
+        # under check_vma (all lax.switch/cond branches must agree).
         return (
             pvary(jnp.zeros((b, l_loc, h, d), q.dtype)),
             pvary(jnp.full((b, l_loc, h), _NEG_INF, jnp.float32)),
         )
 
-    def body(step, carry):
-        o, lse, (k_blk, v_blk) = carry
-        if causal:
-            src = (my - step) % n
-            idx = jnp.where(src > my, 2, jnp.where(src == my, 1, 0))
-            o_i, lse_i = lax.switch(idx, (_full, _diag, _skip), q, k_blk, v_blk)
-        else:
-            o_i, lse_i = _full(q, k_blk, v_blk)
+    def _combine(o, lse, o_i, lse_i):
         new_lse = jnp.logaddexp(lse, lse_i)
         # Weights sum to exactly 1; fully-masked rows keep lse ~ -inf and
         # contribute 0 (exp of a huge negative), never NaN.
         w_prev = jnp.exp(lse - new_lse)
         w_new = jnp.exp(lse_i - new_lse)
         o = o * w_prev[..., None] + o_i.astype(jnp.float32) * w_new[..., None]
+        return o, new_lse
+
+    if window is not None:
+        # Statically-unrolled bounded ring: hop count and each hop's kernel
+        # offset are compile-time constants (the kernel's masks are static).
+        hops = _window_hops(window, l_loc, n)
+        o = pvary(jnp.zeros((b, l_loc, h, d), jnp.float32))
+        lse = pvary(jnp.full((b, l_loc, h), _NEG_INF, jnp.float32))
+        kv = (k, v)
+        for step in range(hops):
+            k_blk, v_blk = kv
+            src = (my - step) % n
+            lens = _hop_lens(src)
+            if step == 0:
+                # src == my always: the diagonal hop.
+                o_i, lse_i = flash_attention_with_lse(
+                    q, k_blk, v_blk,
+                    causal=True, window=window, kv_lens=lens, **kw,
+                )
+            else:
+                o_i, lse_i = lax.cond(
+                    src > my,  # wrapped around: a future block
+                    _skip,
+                    lambda q, kb, vb, lens, _off=step * l_loc: (
+                        flash_attention_with_lse(
+                            q, kb, vb,
+                            causal=True, window=window, offset=_off,
+                            kv_lens=lens, **kw,
+                        )
+                    ),
+                    q, k_blk, v_blk, lens,  # lens=None is an empty pytree
+                )
+            o, lse = _combine(o, lse, o_i, lse_i)
+            if step < hops - 1:
+                kv = jax.tree.map(
+                    lambda x: lax.ppermute(x, axis_name, perm), kv
+                )
+        return o.astype(q.dtype)
+
+    o = pvary(jnp.zeros((b, l_loc, h, d), jnp.float32))
+    lse = pvary(jnp.full((b, l_loc, h), _NEG_INF, jnp.float32))
+
+    def _full(q, kb, vb, lens):
+        return flash_attention_with_lse(
+            q, kb, vb, causal=False, kv_lens=lens, **kw
+        )
+
+    def _diag(q, kb, vb, lens):
+        return flash_attention_with_lse(
+            q, kb, vb, causal=True, kv_lens=lens, **kw
+        )
+
+    def body(step, carry):
+        o, lse, (k_blk, v_blk) = carry
+        src = (my - step) % n
+        lens = _hop_lens(src)
+        if causal:
+            idx = jnp.where(src > my, 2, jnp.where(src == my, 1, 0))
+            o_i, lse_i = lax.switch(
+                idx, (_full, _diag, _skip), q, k_blk, v_blk, lens
+            )
+        else:
+            o_i, lse_i = _full(q, k_blk, v_blk, lens)
+        o, lse = _combine(o, lse, o_i, lse_i)
         kv = _rotate_unless_last(
             (k_blk, v_blk), step, n, axis_name=axis_name, perm=perm
         )
-        return o, new_lse, kv
+        return o, lse, kv
 
     o, lse, _ = lax.fori_loop(0, n, body, (o, lse, (k, v)))
     return o.astype(q.dtype)
@@ -239,11 +357,21 @@ def repeat_kv(k, v, num_q_heads: int):
 
 
 def dense_attention(
-    q, k, v, *, causal: bool = False, window: int | None = None
+    q,
+    k,
+    v,
+    *,
+    causal: bool = False,
+    window: int | None = None,
+    kv_lens: jax.Array | None = None,
 ) -> jax.Array:
     """Reference dense attention on unsharded [B, L, H, D] (for tests and
     single-device use). ``window=W`` (requires ``causal``) restricts each
     query to its last W keys, self included — the sliding-window mask.
+    ``kv_lens`` [B] int32 is the key-padding mask in right-padded form:
+    keys at positions ≥ kv_lens[b] are masked out for every query (each
+    length must be ≥ 1; queries at padded positions produce well-defined
+    garbage — mask them in the loss, e.g. ``GPTLM.loss(lengths=...)``).
     Grouped-query attention: k/v with fewer heads are repeated up to the
     query head count (the semantics the flash kernel implements without the
     materialized repeat)."""
@@ -266,6 +394,10 @@ def dense_attention(
         if window is not None:
             mask &= diff < window
         scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    if kv_lens is not None:
+        l_k = scores.shape[-1]
+        valid_k = jnp.arange(l_k)[None, :] < kv_lens[:, None]  # [B, Lk]
+        scores = jnp.where(valid_k[:, None, None, :], scores, _NEG_INF)
     w = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
         "bhqk,bkhd->bhqd", w, v.astype(jnp.float32),

@@ -255,12 +255,55 @@ def test_windowed_flash_matches_windowed_xla():
     )
 
 
-def test_windowed_lm_rejects_sequence_parallel():
-    model = _model(window=4)
-    with pytest.raises(NotImplementedError, match="sliding-window"):
-        model.apply_sequence_parallel(
-            model.init(seed=21), jnp.zeros((1, 8), jnp.int32)
-        )
+def test_windowed_lm_sequence_parallel_matches_dense():
+    # Round-2 refused window+SP; round 3 implements it (the bounded ring).
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    model = _model(window=6)
+    params = _noisy(model.init(seed=21), scale=0.1)
+    toks = _tokens(np.random.default_rng(21), 2, 32)
+    want = np.asarray(model.apply(params, toks))
+    mesh = make_mesh((4,), ("seq",), devices=jax.devices()[:4])
+    got = np.asarray(
+        jax.jit(
+            jax.shard_map(
+                lambda p, t: model.apply_sequence_parallel(p, t, "seq"),
+                mesh=mesh,
+                in_specs=(P(), P(None, "seq")),
+                out_specs=P(None, "seq"),
+            )
+        )(params, toks)
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_gqa_windowed_lm_sequence_parallel_matches_dense_flash():
+    # GQA + window + SP through the flash ring: KV rides the ring at
+    # num_kv_heads width, hops bounded by the window, kernel offsets mask
+    # the shifted bands — must equal the dense forward.
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    model = _model(window=6, num_kv_heads=2, attention_impl="flash")
+    params = _noisy(model.init(seed=25), scale=0.1)
+    toks = _tokens(np.random.default_rng(25), 2, 32)
+    want = np.asarray(model.apply(params, toks))
+    mesh = make_mesh((4,), ("seq",), devices=jax.devices()[:4])
+    got = np.asarray(
+        jax.jit(
+            jax.shard_map(
+                lambda p, t: model.apply_sequence_parallel(p, t, "seq"),
+                mesh=mesh,
+                in_specs=(P(), P(None, "seq")),
+                out_specs=P(None, "seq"),
+                check_vma=False,  # CPU interpreter: vma-typed kernel bodies
+            )
+        )(params, toks)
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=3e-5)
 
 
 def test_tensor_parallel_step_matches_single_device():
@@ -702,3 +745,250 @@ def test_apply_rejects_overlength_sequence():
     toks = _tokens(np.random.default_rng(33), 1, 40)  # max_len is 32
     with pytest.raises(ValueError, match="exceeds max_len"):
         model.apply(params, toks)
+
+
+def test_dense_loss_is_exactly_ce():
+    # Dense models must be untouched by the MoE aux machinery: loss ==
+    # the ce metric, and metrics carry no router keys.
+    model = _model()
+    params = model.init(seed=30)
+    toks = _tokens(np.random.default_rng(30), 4, 16)
+    total, metrics = model.loss_and_metrics(params, toks)
+    np.testing.assert_array_equal(np.asarray(total), np.asarray(metrics["ce"]))
+    assert set(metrics) == {"ce"}
+    np.testing.assert_array_equal(
+        np.asarray(model.loss(params, toks)), np.asarray(total)
+    )
+
+
+def test_moe_loss_includes_aux_and_exposes_drop_metric():
+    model = _model(moe_experts=4, moe_capacity_factor=16.0)
+    params = model.init(seed=31)
+    toks = _tokens(np.random.default_rng(31), 4, 16)
+    total, metrics = model.loss_and_metrics(params, toks)
+    assert {"ce", "balance_loss", "z_loss", "drop_fraction", "expert_fraction"} <= set(metrics)
+    # Ample capacity: no drops, observable via the metric.
+    assert float(metrics["drop_fraction"]) == 0.0
+    np.testing.assert_allclose(
+        float(total),
+        float(
+            metrics["ce"]
+            + model.moe_balance_coef * metrics["balance_loss"]
+            + model.moe_z_coef * metrics["z_loss"]
+        ),
+        rtol=1e-6,
+    )
+    assert metrics["expert_fraction"].shape == (4,)
+    # Tiny capacity: drops become visible in the same metric.
+    tight = _model(moe_experts=4, moe_capacity_factor=0.3)
+    _, tight_metrics = tight.loss_and_metrics(tight.init(seed=31), toks)
+    assert float(tight_metrics["drop_fraction"]) > 0.0
+
+
+def test_trained_moe_keeps_experts_utilized():
+    # The point of the balance loss (VERDICT round-2 missing #4): after
+    # real training, expert utilization must remain spread — not collapse
+    # onto one expert (which nothing prevented before the aux loss).
+    model = _model(moe_experts=4, num_layers=1)
+    params = model.init(seed=32)
+    opt = optim_lib.make("adam", 3e-3)
+    opt_state = opt.init(params)
+    step = make_lm_train_step(model, opt)
+    rng = np.random.default_rng(32)
+
+    def batch():
+        half = rng.integers(0, 61, size=(16, 8))
+        return jnp.asarray(np.concatenate([half, half], axis=1), jnp.int32)
+
+    for _ in range(150):
+        params, opt_state, loss = step(params, opt_state, batch())
+    _, metrics = model.loss_and_metrics(params, batch())
+    frac = np.asarray(metrics["expert_fraction"])
+    assert frac.min() > 0.10, frac  # every expert still earns tokens
+    assert float(metrics["balance_loss"]) < 1.5  # near-uniform dispatch
+
+
+def test_ragged_batch_masked_loss():
+    # Ragged right-padded batches (VERDICT round-2 missing #5): pad
+    # positions must provably not affect logits at real positions (causal
+    # attention guarantees it) nor the masked loss (lengths= masks it).
+    model = _model()
+    params = model.init(seed=40)
+    rng = np.random.default_rng(40)
+    full = _tokens(rng, 3, 24)
+    lengths = jnp.asarray([24, 15, 7], jnp.int32)
+
+    # Two paddings of the same real content.
+    pad_a = np.asarray(full).copy()
+    pad_b = np.asarray(full).copy()
+    for b, n in enumerate(np.asarray(lengths)):
+        pad_a[b, n:] = 0
+        pad_b[b, n:] = rng.integers(0, 61, size=24 - n)
+    pad_a, pad_b = jnp.asarray(pad_a), jnp.asarray(pad_b)
+
+    # Logits at real positions are identical under either padding.
+    la, lb = model.apply(params, pad_a), model.apply(params, pad_b)
+    for b, n in enumerate(np.asarray(lengths)):
+        np.testing.assert_array_equal(
+            np.asarray(la[b, :n]), np.asarray(lb[b, :n])
+        )
+
+    # Masked loss identical under either padding...
+    loss_a = float(model.loss(params, pad_a, lengths))
+    loss_b = float(model.loss(params, pad_b, lengths))
+    assert loss_a == loss_b, (loss_a, loss_b)
+
+    # ...equals the hand-computed weighted mean of per-sequence losses on
+    # the truncated sequences (loss over length n has n-1 targets)...
+    per_seq = [
+        float(model.loss(params, pad_a[b : b + 1, :n]))
+        for b, n in enumerate(np.asarray(lengths))
+    ]
+    weights = [int(n) - 1 for n in np.asarray(lengths)]
+    want = sum(l * w for l, w in zip(per_seq, weights)) / sum(weights)
+    np.testing.assert_allclose(loss_a, want, rtol=1e-6)
+
+    # ...and with no padding, lengths= is a no-op.
+    np.testing.assert_allclose(
+        float(model.loss(params, full, jnp.full((3,), 24, jnp.int32))),
+        float(model.loss(params, full)),
+        rtol=1e-6,
+    )
+
+
+def test_ragged_loss_trains_through_flash():
+    # The masked loss must differentiate through the flash path too, and
+    # gradients must not depend on pad content.
+    model = _model(attention_impl="flash", max_len=16)
+    params = model.init(seed=41)
+    rng = np.random.default_rng(41)
+    toks = np.asarray(_tokens(rng, 2, 16))
+    lengths = jnp.asarray([16, 9], jnp.int32)
+    toks_b = toks.copy()
+    toks_b[1, 9:] = (toks_b[1, 9:] + 5) % 61
+    g_a = jax.grad(model.loss)(params, jnp.asarray(toks), lengths)
+    g_b = jax.grad(model.loss)(params, jnp.asarray(toks_b), lengths)
+    for a, b in zip(jax.tree.leaves(g_a), jax.tree.leaves(g_b)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_windowed_decode_cache_is_window_sized():
+    # VERDICT round-2 weak #5: windowed decode must be O(W), not
+    # O(max_len). The cache allocates min(window, max_len) slots and the
+    # per-step attention reads only those.
+    model = _model(window=4, max_len=32)
+    params = _noisy(model.init(seed=26))
+    prompt = _tokens(np.random.default_rng(26), 2, 9)
+    _, cache = model.prefill(params, prompt)
+    assert model.cache_len == 4
+    assert cache.k.shape[2] == 4 and cache.v.shape[2] == 4
+    assert int(cache.length) == 9  # absolute count keeps running
+
+    # Rolling equality once decode wraps the buffer several times over.
+    max_new = 16
+    got = np.asarray(
+        jax.jit(lambda p, t: model.greedy_decode(p, t, max_new))(params, prompt)
+    )
+    seq = prompt
+    for _ in range(max_new):
+        nxt = jnp.argmax(model.apply(params, seq)[:, -1], -1).astype(seq.dtype)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(got, np.asarray(seq))
+
+    # Unwindowed model: full-length cache, unchanged behavior.
+    full = _model(max_len=32)
+    _, full_cache = full.prefill(full.init(seed=26), prompt)
+    assert full.cache_len == 32 and full_cache.k.shape[2] == 32
+
+
+def test_windowed_rolling_prefill_short_prompt():
+    # Prompt shorter than the window: plain-pad layout, decode equality.
+    model = _model(window=8, max_len=32)
+    params = _noisy(model.init(seed=27))
+    prompt = _tokens(np.random.default_rng(27), 2, 3)
+    got = np.asarray(
+        jax.jit(lambda p, t: model.greedy_decode(p, t, 12))(params, prompt)
+    )
+    seq = prompt
+    for _ in range(12):
+        nxt = jnp.argmax(model.apply(params, seq)[:, -1], -1).astype(seq.dtype)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(got, np.asarray(seq))
+
+
+@pytest.mark.parametrize("stages", [2, 4])
+def test_pipeline_parallel_matches_dense(stages):
+    # PP composed with the flagship model (VERDICT round-2 missing #3):
+    # the GPipe-microbatched stage pipeline must reproduce apply() exactly.
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_tensorflow_tpu.models.gpt import GPTBlockParams
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    model = _model(num_layers=4)
+    params = _noisy(model.init(seed=28), scale=0.1)
+    toks = _tokens(np.random.default_rng(28), 8, 16)
+    want = np.asarray(model.apply(params, toks))
+
+    staged = params._replace(
+        blocks=model.pipeline_stage_blocks(params.blocks, stages)
+    )
+    mesh = make_mesh((stages,), ("stage",), devices=jax.devices()[:stages])
+    block_specs = GPTBlockParams(*([P("stage")] * 12))
+    got = np.asarray(
+        jax.jit(
+            jax.shard_map(
+                lambda p, t: model.apply_pipeline_parallel(
+                    p, t, "stage", num_microbatches=4
+                ),
+                mesh=mesh,
+                in_specs=(
+                    type(params)(
+                        embed=P(), pos=P(), blocks=block_specs,
+                        lnf_scale=P(), lnf_bias=P(),
+                    ),
+                    P(),
+                ),
+                out_specs=P(),
+            )
+        )(staged, toks)
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_parallel_stage_layout_validated():
+    model = _model(num_layers=3)
+    with pytest.raises(ValueError, match="not divisible"):
+        model.pipeline_stage_blocks(model.init(seed=1).blocks, 2)
+
+
+def test_ragged_moe_loss_is_pad_content_independent():
+    # MoE ragged exactness: pad tokens must not consume expert capacity,
+    # perturb routing of real tokens, or enter the aux statistics — so the
+    # masked loss and its gradients are identical under any pad content,
+    # even at tight capacity (review finding: without the routing mask, a
+    # pad token could displace a real one from its expert's queue).
+    for factor in (16.0, 1.0):
+        model = _model(moe_experts=4, moe_capacity_factor=factor)
+        params = model.init(seed=42)
+        rng = np.random.default_rng(42)
+        toks = np.asarray(_tokens(rng, 3, 16))
+        lengths = jnp.asarray([16, 10, 5], jnp.int32)
+        pad_a, pad_b = toks.copy(), toks.copy()
+        for b, n in enumerate(np.asarray(lengths)):
+            pad_b[b, n:] = (pad_b[b, n:] + 11) % 61
+        la, ma = model.loss_and_metrics(params, jnp.asarray(pad_a), lengths)
+        lb, mb = model.loss_and_metrics(params, jnp.asarray(pad_b), lengths)
+        assert float(la) == float(lb), (factor, float(la), float(lb))
+        for key in ("ce", "balance_loss", "z_loss", "drop_fraction"):
+            np.testing.assert_array_equal(
+                np.asarray(ma[key]), np.asarray(mb[key]), err_msg=key
+            )
+        ga = jax.grad(model.loss)(params, jnp.asarray(pad_a), lengths)
+        gb = jax.grad(model.loss)(params, jnp.asarray(pad_b), lengths)
+        for a, b in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+            )

@@ -99,3 +99,81 @@ def test_moe_ffn_local_matches_dense():
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
         )
+
+
+def test_route_aux_statistics():
+    # Hand-built gate: feature 0 decides the expert outright, so routing
+    # and the aux statistics are fully predictable.
+    from distributed_tensorflow_tpu.ops.moe import _route
+
+    e, t, d = 4, 16, 8
+    wg = np.zeros((d, e), np.float32)
+    wg[0] = [100.0, 0.0, -100.0, -100.0]  # x[0]>0 → expert 0, x[0]<0 → 1
+    x = np.zeros((t, d), np.float32)
+    x[:, 0] = 1.0  # every token → expert 0
+    _, _, _, keep, aux = _route(
+        jnp.asarray(x), jnp.asarray(wg), e, capacity=4
+    )
+    # Full collapse: f = (1,0,0,0), P_0 ≈ 1 → balance ≈ E.
+    np.testing.assert_allclose(float(aux.balance_loss), e, rtol=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(aux.expert_fraction), [1.0, 0.0, 0.0, 0.0], atol=1e-6
+    )
+    # 16 tokens into capacity 4 → 12 dropped.
+    np.testing.assert_allclose(float(aux.drop_fraction), 12 / 16, atol=1e-6)
+    assert int(np.asarray(keep).sum()) == 4
+
+    # Perfectly uniform routing: balance = E · Σ (1/E)·P_e; with the +/-
+    # alternating feature P concentrates on the routed expert → balance ≈ 1.
+    x2 = np.zeros((t, d), np.float32)
+    x2[::2, 0] = 1.0
+    x2[1::2, 0] = -1.0
+    wg2 = np.zeros((d, e), np.float32)
+    wg2[0] = [100.0, -100.0, 0.0, 0.0]
+    # two experts get half each of a 2-expert gate → use e=2 view
+    _, _, _, _, aux2 = _route(jnp.asarray(x2), jnp.asarray(wg2[:, :2]), 2, 100)
+    np.testing.assert_allclose(float(aux2.balance_loss), 1.0, rtol=1e-3)
+    np.testing.assert_allclose(float(aux2.drop_fraction), 0.0, atol=1e-6)
+
+
+def test_moe_ffn_with_aux_matches_plain():
+    # with_aux must not perturb the output on any of the three paths.
+    from distributed_tensorflow_tpu.ops.moe import moe_ffn_local
+
+    params = init_moe(jax.random.key(0), 16, 32, 4)
+    x = jax.random.normal(jax.random.key(1), (24, 16), jnp.float32)
+    plain = moe_ffn_local(params, x, capacity=6)
+    out, aux = moe_ffn_local(params, x, capacity=6, with_aux=True)
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(out))
+    assert 1.0 <= float(aux.balance_loss) <= 4.0
+    assert 0.0 <= float(aux.drop_fraction) < 1.0
+    np.testing.assert_allclose(
+        float(jnp.sum(aux.expert_fraction)), 1.0, atol=1e-6
+    )
+
+
+def test_balance_loss_gradient_spreads_routing():
+    # The balance loss must be differentiable into the gate and push toward
+    # uniform dispatch: a few gradient steps on balance alone should raise
+    # the min expert fraction from near-collapse.
+    from distributed_tensorflow_tpu.ops.moe import _route
+
+    e, t, d = 4, 64, 8
+    rng = np.random.default_rng(0)
+    x = np.asarray(rng.standard_normal((t, d)), np.float32)
+    x[:, 0] = rng.uniform(0.5, 1.5, t)  # positive feature the bias latches on
+    x = jnp.asarray(x)
+    # Biased init: expert 0's column reads the positive feature strongly →
+    # collapsed routing at the start.
+    wg = jnp.asarray(rng.standard_normal((d, e)) * 0.01, jnp.float32)
+    wg = wg.at[0, 0].add(5.0)
+
+    def balance(wg):
+        return _route(x, wg, e, capacity=t)[4].balance_loss
+
+    frac0 = _route(x, wg, e, capacity=t)[4].expert_fraction
+    assert float(jnp.max(frac0)) > 0.9  # collapsed at init
+    for _ in range(100):
+        wg = wg - 0.5 * jax.grad(balance)(wg)
+    frac = _route(x, wg, e, capacity=t)[4].expert_fraction
+    assert float(jnp.min(frac)) > 0.1, np.asarray(frac)

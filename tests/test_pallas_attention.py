@@ -277,3 +277,84 @@ def test_transformer_flash_matches_dense_forward():
         atol=1e-5,
         rtol=1e-5,
     )
+
+
+# -- key padding (kv_lens) ---------------------------------------------------
+
+
+def _lens(b=2, l=64):
+    return jnp.asarray([l // 2 - 3, l - 5][:b], jnp.int32)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_kv_lens_matches_dense(causal):
+    q, k, v = _qkv(11)
+    lens = _lens()
+    got = flash_attention(q, k, v, causal=causal, kv_lens=lens)
+    want = dense_attention(q, k, v, causal=causal, kv_lens=lens)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_kv_lens_equals_truncated_sequence():
+    # The ground truth for the padding semantics: batch row b with
+    # kv_lens[b]=n must equal attention over the truncated length-n
+    # sequence at every real query position.
+    q, k, v = _qkv(12)
+    lens = _lens()
+    out = dense_attention(q, k, v, causal=True, kv_lens=lens)
+    for b, n in enumerate(np.asarray(lens)):
+        want = dense_attention(
+            q[b : b + 1, :n], k[b : b + 1, :n], v[b : b + 1, :n], causal=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[b : b + 1, :n]), np.asarray(want),
+            atol=1e-5, rtol=1e-5,
+        )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_kv_lens_gradients_match_dense(causal):
+    q, k, v = _qkv(13, l=32, d=8)
+    lens = jnp.asarray([13, 29], jnp.int32)
+    cot = jax.random.normal(jax.random.key(7), q.shape, jnp.float32)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v, causal=causal, kv_lens=lens) * cot)
+
+    g_flash = jax.grad(lambda *a: loss(flash_attention, *a), argnums=(0, 1, 2))(
+        q, k, v
+    )
+    g_dense = jax.grad(lambda *a: loss(dense_attention, *a), argnums=(0, 1, 2))(
+        q, k, v
+    )
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(
+            gf, gd, atol=2e-5, rtol=1e-4, err_msg=f"d{name} mismatch"
+        )
+    # Padded keys/values must receive exactly zero gradient.
+    for g, name in zip(g_flash[1:], "kv"):
+        for b, n in enumerate(np.asarray(lens)):
+            assert np.all(np.asarray(g[b, n:]) == 0.0), f"d{name} pad leak"
+
+
+def test_kv_lens_with_gqa_and_window():
+    # Compare REAL query rows only: a padded query whose whole window falls
+    # past kv_len has an empty (fully-masked) score row, where the two
+    # implementations return different well-defined garbage (dense: uniform
+    # softmax; flash: zeros) — both are masked downstream by contract.
+    q, k, v = _qkv(14, l=64, h=4)
+    k, v = k[:, :, :2], v[:, :, :2]  # 2 KV heads for 4 query heads
+    lens = _lens()
+    got = flash_attention(q, k, v, causal=True, window=16, kv_lens=lens)
+    want = dense_attention(q, k, v, causal=True, window=16, kv_lens=lens)
+    for b, n in enumerate(np.asarray(lens)):
+        np.testing.assert_allclose(
+            np.asarray(got[b, :n]), np.asarray(want[b, :n]),
+            atol=1e-5, rtol=1e-5,
+        )
+
+
+def test_kv_lens_shape_validated():
+    q, k, v = _qkv(15)
+    with pytest.raises(ValueError, match="kv_lens"):
+        flash_attention(q, k, v, kv_lens=jnp.asarray([3], jnp.int32))
