@@ -3,3 +3,9 @@ from distributed_tensorflow_tpu.data.mnist import (  # noqa: F401
     Datasets,
     read_data_sets,
 )
+from distributed_tensorflow_tpu.data.tokens import (  # noqa: F401
+    TokenDataset,
+    TokenDatasets,
+    copy_corpus,
+    markov_corpus,
+)

@@ -934,7 +934,7 @@ def make_lm_async_train_step(
     *,
     axis: str = "data",
     avg_every: int = 1,
-    update_scale: float = 1.0,
+    update_scale: float | None = None,
 ):
     """Async local-SGD for the LM — the reference's signature training mode
     (HOGWILD applies to PS variables, reference tfdist_between.py:64-66),
@@ -951,23 +951,27 @@ def make_lm_async_train_step(
     - ``step(state, tokens) -> (state, loss)`` with tokens [n·B, L] sharded
       on the batch dim; loss is the cross-device mean of the local losses.
 
-    For plain SGD with ``avg_every=1`` and ``update_scale=1`` this is
-    *exactly* the sync data-parallel step (mean of independent SGD updates
-    from a common point = update by the mean gradient — SGD is linear in
-    the gradient), which the tests assert bitwise-tolerant; with
-    momentum/adam or ``avg_every>1`` it is genuinely async (copies diverge
-    between exchanges, the modeled race). To reproduce the reference
-    async-table behavior (N workers' updates applied sequentially, not
-    averaged), pass ``update_scale=n`` — the same knob
-    ``AsyncDataParallel`` defaults to N for exactly that purpose
-    (strategy.py; averaging alone gives sync-like dynamics). The default
-    here is 1.0 so the sync-equivalence property holds out of the box."""
+    ``update_scale`` defaults to **N (the replica count)** — the ONE
+    convention both async APIs share (``AsyncDataParallel``,
+    strategy.py): the reference PS applied all N workers' updates
+    sequentially, so reproducing its async-table behavior needs N× the
+    per-exchange step; parameter averaging alone gives sync-like
+    dynamics (tools/parity_converged.py). Pass ``update_scale=1.0``
+    explicitly for pure local-SGD averaging — with plain SGD and
+    ``avg_every=1`` that is *exactly* the sync data-parallel step (mean of
+    independent SGD updates from a common point = update by the mean
+    gradient — SGD is linear in the gradient), which the tests assert
+    bitwise-tolerant; with momentum/adam or ``avg_every>1`` it is
+    genuinely async (copies diverge between exchanges, the modeled
+    race)."""
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     if avg_every < 1:
         raise ValueError(f"avg_every must be >= 1, got {avg_every}")
     n = mesh.shape[axis]
+    if update_scale is None:
+        update_scale = float(n)
 
     def init_state(params, opt_state):
         stacked = jax.tree.map(

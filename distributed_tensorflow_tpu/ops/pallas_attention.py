@@ -97,14 +97,19 @@ def _block_needed(iq, ik, bq, bk, window, offset=0):
     return needed
 
 
-def _kvlen_mask(s, ik, bk, kvlen_ref):
-    """Key-padding for one score block: keys at global position >= this
-    batch row's kv_len score -inf; exp(s - m) then underflows to exactly 0,
-    so masked keys never enter the softmax statistics — one definition
-    shared by the forward and both backward kernels."""
-    bq = s.shape[0]
+def _kvlen_valid(ik, bq, bk, kvlen_ref, by_row: bool):
+    """[bq, bk] bool key-padding validity for one score block: keys at
+    global position >= this grid row's kv_len are invalid — one definition
+    shared by the forward and both backward kernels.
+
+    Two static layouts (``by_row``): on Mosaic the whole [rows, 1] int32
+    array sits in SMEM (full-array blocks are the only sub-(8,128) shapes
+    the TPU lowering accepts) and the row is selected by grid position; the
+    CPU interpreter instead gets a per-row (1, 1) block (it cannot lower
+    ``program_id`` through the whole-array path)."""
+    kl = kvlen_ref[pl.program_id(0), 0] if by_row else kvlen_ref[0, 0]
     k_pos = ik * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return jnp.where(k_pos < kvlen_ref[0, 0], s, _NEG_INF)
+    return k_pos < kl
 
 
 def _use_banding(window, l) -> bool:
@@ -165,7 +170,7 @@ def _banded_q_index(window, bq, bk, nq):
 def _fwd_kernel(
     q_ref, k_ref, v_ref, *rest,
     scale: float, causal: bool, window: int | None, nk: int, has_lens: bool,
-    offset: int = 0,
+    offset: int = 0, lens_by_row: bool = True,
 ):
     if has_lens:
         kvlen_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
@@ -193,7 +198,7 @@ def _fwd_kernel(
         if causal:
             s = jnp.where(_causal_mask(iq, ik, bq, bk, window, offset), s, _NEG_INF)
         if has_lens:
-            s = _kvlen_mask(s, ik, bk, kvlen_ref)
+            s = jnp.where(_kvlen_valid(ik, bq, bk, kvlen_ref, lens_by_row), s, _NEG_INF)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         # A still-empty row (everything masked so far) has m_new == -inf;
@@ -241,6 +246,11 @@ def _fwd_call(
         else (lambda b, iq, ik: (row(b), ik, 0))
     )
     has_lens = kv_lens is not None
+    lens_spec = (
+        pl.BlockSpec((1, 1), lambda b, iq, ik: (b, 0))
+        if interpret
+        else pl.BlockSpec(memory_space=pltpu.SMEM)  # whole array
+    )
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
         pl.BlockSpec((1, bk, d), kmap),
@@ -248,13 +258,13 @@ def _fwd_call(
     ]
     inputs = [q, k, v]
     if has_lens:
-        in_specs.append(pl.BlockSpec((1, 1), lambda b, iq, ik: (b, 0)))
+        in_specs.append(lens_spec)
         inputs.append(jnp.repeat(kv_lens.astype(jnp.int32), hq)[:, None])
     return pl.pallas_call(
         partial(
             _fwd_kernel,
             scale=scale, causal=causal, window=window, nk=nk,
-            has_lens=has_lens, offset=offset,
+            has_lens=has_lens, offset=offset, lens_by_row=not interpret,
         ),
         grid=(bh, nq, nk),
         in_specs=in_specs,
@@ -283,7 +293,7 @@ def _fwd_call(
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     scale: float, causal: bool, window: int | None, nk: int, has_lens: bool,
-    offset: int = 0,
+    offset: int = 0, lens_by_row: bool = True,
 ):
     if has_lens:
         kvlen_ref, dq_ref, dq_scr = rest
@@ -303,11 +313,21 @@ def _dq_kernel(
         q = q_ref[0]
         k = k_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        # p must be masked EXPLICITLY here, not via -1e30 underflow: a
+        # fully-masked row (offset past the window, or window+padding)
+        # saved lse ~= -1e30 too, so exp(s - lse) would be exp(0) = 1 and
+        # the row would inject garbage into every gradient.
+        mask = None
         if causal:
-            s = jnp.where(_causal_mask(iq, ik, bq, bk, window, offset), s, _NEG_INF)
+            mask = _causal_mask(iq, ik, bq, bk, window, offset)
         if has_lens:
-            s = _kvlen_mask(s, ik, bk, kvlen_ref)
-        p = jnp.exp(s - lse_ref[0])  # masked scores underflow to exactly 0
+            lm = _kvlen_valid(ik, bq, bk, kvlen_ref, lens_by_row)
+            mask = lm if mask is None else mask & lm
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0])
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         dp = jnp.dot(do_ref[0], v_ref[0].T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0]) * scale
         dq_scr[:] += jnp.dot(
@@ -327,7 +347,7 @@ def _dq_kernel(
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     scale: float, causal: bool, window: int | None, nq: int, total: int,
-    has_lens: bool, offset: int = 0,
+    has_lens: bool, offset: int = 0, lens_by_row: bool = True,
 ):
     if has_lens:
         kvlen_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
@@ -350,11 +370,19 @@ def _dkv_kernel(
         k = k_ref[0]
         do = do_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        # Explicit p masking — see _dq_kernel (fully-masked rows saved
+        # lse ~= -1e30; underflow alone would give p = 1 there).
+        mask = None
         if causal:
-            s = jnp.where(_causal_mask(iq, ik, bq, bk, window, offset), s, _NEG_INF)
+            mask = _causal_mask(iq, ik, bq, bk, window, offset)
         if has_lens:
-            s = _kvlen_mask(s, ik, bk, kvlen_ref)
+            lm = _kvlen_valid(ik, bq, bk, kvlen_ref, lens_by_row)
+            mask = lm if mask is None else mask & lm
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0])
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         dv_scr[:] += jnp.dot(
             p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
         )
@@ -394,7 +422,11 @@ def _bwd_call(
     )
     kspec = pl.BlockSpec((1, bk, d), kmap)
     has_lens = kv_lens is not None
-    lens_spec = pl.BlockSpec((1, 1), lambda b, i, j: (b, 0))
+    lens_spec = (
+        pl.BlockSpec((1, 1), lambda b, i, j: (b, 0))
+        if interpret
+        else pl.BlockSpec(memory_space=pltpu.SMEM)  # whole array
+    )
 
     dq_inputs = [q, k, v, do, lse, delta]
     dq_specs = [qspec, kspec, kspec, qspec, rowspec, rowspec]
@@ -405,7 +437,7 @@ def _bwd_call(
         partial(
             _dq_kernel,
             scale=scale, causal=causal, window=window, nk=nk,
-            has_lens=has_lens, offset=offset,
+            has_lens=has_lens, offset=offset, lens_by_row=not interpret,
         ),
         grid=(bh, nq, nk),
         in_specs=dq_specs,
@@ -446,7 +478,7 @@ def _bwd_call(
         partial(
             _dkv_kernel,
             scale=scale, causal=causal, window=window, nq=nq, total=nq * g,
-            has_lens=has_lens, offset=offset,
+            has_lens=has_lens, offset=offset, lens_by_row=not interpret,
         ),
         grid=(bhkv, nk, nq * g),
         in_specs=dkv_specs,

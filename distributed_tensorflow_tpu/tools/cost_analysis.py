@@ -53,6 +53,43 @@ def _chip_peaks(device) -> dict | None:
     return None
 
 
+def _roofline(compiled, batch_size: int, device) -> dict:
+    """Shared report body: XLA's analytical FLOPs/bytes for a compiled
+    step, arithmetic intensity, and (when the chip's peaks are known) the
+    balance-point classification and per-step floor — used verbatim by the
+    classifier and LM analyzers so the two can't drift."""
+    cost = compiled.cost_analysis() or {}
+    if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+        cost = cost[0] if cost else {}
+    flops = float(cost.get("flops", 0.0))
+    bytes_accessed = float(cost.get("bytes accessed", 0.0))
+    intensity = flops / bytes_accessed if bytes_accessed else float("inf")
+    report = {
+        "flops_per_step": flops,
+        "bytes_per_step": bytes_accessed,
+        "arithmetic_intensity_flops_per_byte": round(intensity, 3),
+    }
+    peaks = _chip_peaks(device)
+    if peaks is None:
+        report.update(
+            chip_balance_flops_per_byte=None, bound="unknown",
+            roofline_floor_us=None, examples_per_sec_roofline=None,
+        )
+        return report
+    balance = peaks["flops"] / peaks["hbm_bytes_per_s"]  # FLOPs/byte
+    t_compute = flops / peaks["flops"]
+    t_memory = bytes_accessed / peaks["hbm_bytes_per_s"]
+    report.update(
+        chip_balance_flops_per_byte=round(balance, 1),
+        bound="compute" if intensity > balance else "memory",
+        roofline_floor_us=round(max(t_compute, t_memory) * 1e6, 3),
+        examples_per_sec_roofline=round(
+            batch_size / max(t_compute, t_memory, 1e-12), 1
+        ),
+    )
+    return report
+
+
 def analyze(
     model,
     batch_size: int = 100,
@@ -76,13 +113,6 @@ def analyze(
     x = jnp.zeros((batch_size, in_dim), jnp.float32)
     y = jnp.zeros((batch_size, out_dim), jnp.float32)
     compiled = step.lower(state, x, y).compile()
-    cost = compiled.cost_analysis() or {}
-    if isinstance(cost, (list, tuple)):  # older jax returns [dict]
-        cost = cost[0] if cost else {}
-
-    flops = float(cost.get("flops", 0.0))
-    bytes_accessed = float(cost.get("bytes accessed", 0.0))
-    intensity = flops / bytes_accessed if bytes_accessed else float("inf")
     n_params = sum(
         p.size for p in jax.tree_util.tree_leaves(state.params)
     )
@@ -92,33 +122,45 @@ def analyze(
         "batch_size": batch_size,
         "device_kind": device.device_kind,
         "param_count": int(n_params),
-        "flops_per_step": flops,
-        "bytes_per_step": bytes_accessed,
-        "arithmetic_intensity_flops_per_byte": round(intensity, 3),
         "temp_bytes": getattr(mem, "temp_size_in_bytes", None),
         "argument_bytes": getattr(mem, "argument_size_in_bytes", None),
     }
+    report.update(_roofline(compiled, batch_size, device))
+    return report
 
-    peaks = _chip_peaks(device)
-    if peaks is None:
-        report.update(
-            chip_balance_flops_per_byte=None,
-            bound="unknown",
-            roofline_floor_us=None,
-            examples_per_sec_roofline=None,
-        )
-        return report
-    balance = peaks["flops"] / peaks["hbm_bytes_per_s"]  # FLOPs/byte
-    t_compute = flops / peaks["flops"]
-    t_memory = bytes_accessed / peaks["hbm_bytes_per_s"]
-    report.update(
-        chip_balance_flops_per_byte=round(balance, 1),
-        bound="compute" if intensity > balance else "memory",
-        roofline_floor_us=round(max(t_compute, t_memory) * 1e6, 3),
-        examples_per_sec_roofline=round(
-            batch_size / max(t_compute, t_memory, 1e-12), 1
-        ),
-    )
+
+def analyze_lm(
+    model,
+    batch_size: int = 8,
+    *,
+    optimizer=None,
+    device=None,
+) -> dict:
+    """Roofline for one LM training step (``make_lm_train_step`` — the
+    actual program `LMTrainer`/`tools/lm_bench.py` run, not a
+    re-derivation): compiled FLOPs/bytes, arithmetic intensity vs the
+    chip's balance point, per-step floor, and the FLOPs count
+    ``tools/lm_bench.py`` divides by measured step time for MFU."""
+    from distributed_tensorflow_tpu.models.gpt import make_lm_train_step
+    from distributed_tensorflow_tpu.ops import optim as optim_lib
+
+    device = device or jax.devices()[0]
+    optimizer = optimizer or optim_lib.make("adam", 1e-3)
+    params = model.init(seed=1)
+    opt_state = optimizer.init(params)
+    step = make_lm_train_step(model, optimizer)
+    tokens = jnp.zeros((batch_size, model.max_len), jnp.int32)
+    compiled = step.lower(params, opt_state, tokens).compile()
+    n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
+    report = {
+        "model": "GPTLM",
+        "batch_size": batch_size,
+        "seq_len": model.max_len,
+        "tokens_per_step": batch_size * model.max_len,
+        "device_kind": device.device_kind,
+        "param_count": int(n_params),
+    }
+    report.update(_roofline(compiled, batch_size, device))
     return report
 
 
@@ -149,11 +191,30 @@ def main(argv=None) -> int:
     from distributed_tensorflow_tpu.models import MODEL_REGISTRY, build_model
 
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    p.add_argument("--model", default="mlp", choices=sorted(MODEL_REGISTRY))
+    p.add_argument(
+        "--model", default="mlp", choices=sorted(MODEL_REGISTRY) + ["lm"]
+    )
     p.add_argument("--batch", type=int, default=100)
+    p.add_argument("--seq-len", type=int, default=512, help="lm only")
+    p.add_argument("--model-dim", type=int, default=256, help="lm only")
+    p.add_argument("--layers", type=int, default=4, help="lm only")
     p.add_argument("--json", action="store_true", help="emit JSON instead of text")
     args = p.parse_args(argv)
-    report = analyze(build_model(args.model), batch_size=args.batch)
+    if args.model == "lm":
+        from distributed_tensorflow_tpu.models.gpt import GPTLM
+
+        report = analyze_lm(
+            GPTLM(
+                vocab_size=8192,
+                max_len=args.seq_len,
+                model_dim=args.model_dim,
+                num_heads=max(1, args.model_dim // 64),
+                num_layers=args.layers,
+            ),
+            batch_size=args.batch,
+        )
+    else:
+        report = analyze(build_model(args.model), batch_size=args.batch)
     if args.json:
         print(json.dumps(report))
     else:

@@ -75,6 +75,12 @@ class StepLogger:
         self._print("Test-Accuracy: %2.2f" % test_accuracy)
         self._print("Total Time: %3.2fs" % float(time.time() - self._begin_time))
 
+    def log_epoch_metric(self, name: str, value: float) -> None:
+        """Epoch line for non-accuracy metrics (the LM's perplexity) — same
+        shape as the reference's Test-Accuracy/Total Time pair."""
+        self._print("%s: %.4f" % (name, value))
+        self._print("Total Time: %3.2fs" % float(time.time() - self._begin_time))
+
     def log_final(self, *, cost: float) -> None:
         self._print("Final Cost: %.4f" % cost)
         self._print("Done")

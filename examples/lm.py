@@ -1,19 +1,20 @@
 """Language-model training + generation — the capability the reference never
 had (its one model is the MLP classifier, reference tfsingle.py:23-42).
 
-Run: ``python examples/lm.py [steps] [max_new]``
+Run: ``python examples/lm.py [epochs] [max_new]``
 
-Trains a small GPT-style causal LM on a synthetic copy task (sequences of
-the form ``x · x`` — the model must learn to attend back and reproduce the
-first half), printing the reference-style Step/Cost lines, then generates
-from a held-out prompt with the static-shape KV cache: greedy and sampled.
+Drives the full LM lifecycle through :class:`~train.lm_trainer.LMTrainer`
+(the reference loop contract — Step/Cost/AvgTime lines, per-epoch held-out
+perplexity, scanned-epoch fast path, optional checkpointing via
+``DTF_LM_CKPT=dir``) on the synthetic copy task (sequences ``x · x`` — the
+model must attend back and reproduce the first half), then generates from a
+held-out prompt with the static-shape KV cache: greedy and sampled.
 ``DTF_LM_FLASH=1`` switches the causal attention to the Pallas flash
 kernel.
 """
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
@@ -21,11 +22,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from distributed_tensorflow_tpu.models.gpt import GPTLM, make_lm_train_step
-from distributed_tensorflow_tpu.ops import optim as optim_lib
+from distributed_tensorflow_tpu.config import TrainConfig
+from distributed_tensorflow_tpu.data import copy_corpus
+from distributed_tensorflow_tpu.models.gpt import GPTLM
+from distributed_tensorflow_tpu.train import LMTrainer
 
 
-def main(steps: int = 300, max_new: int = 16) -> None:
+def main(epochs: int = 8, max_new: int = 16) -> None:
+    datasets = copy_corpus(num=4096, half_len=8, vocab=61, seed=0)
     model = GPTLM(
         vocab_size=61,
         max_len=48,
@@ -35,24 +39,23 @@ def main(steps: int = 300, max_new: int = 16) -> None:
         compute_dtype=jnp.float32,
         attention_impl="flash" if os.environ.get("DTF_LM_FLASH") else "xla",
     )
-    params = model.init(seed=1)
-    opt = optim_lib.make("adam", 3e-3)
-    opt_state = opt.init(params)
-    step = make_lm_train_step(model, opt)
-    rng = np.random.default_rng(0)
+    trainer = LMTrainer(
+        model,
+        datasets,
+        TrainConfig(
+            epochs=epochs,
+            batch_size=64,
+            optimizer="adam",
+            learning_rate=3e-3,
+            log_frequency=20,
+            checkpoint_dir=os.environ.get("DTF_LM_CKPT"),
+        ),
+    )
+    result = trainer.run()
+    print(f"held-out perplexity: {result['perplexity']:.2f}")
 
-    def batch():
-        half = rng.integers(0, 61, size=(16, 8))
-        return jnp.asarray(np.concatenate([half, half], axis=1), jnp.int32)
-
-    t0 = time.time()
-    for i in range(1, steps + 1):
-        params, opt_state, loss = step(params, opt_state, batch())
-        if i % 50 == 0 or i == 1:
-            print(f"Step: {i},  Cost: {float(loss):.4f}")
-    final = float(loss)  # D2H fetch: the only trustworthy barrier (CLAUDE.md)
-    print(f"Total Time: {time.time() - t0:.2f}s  Final Cost: {final:.4f}")
-
+    params = trainer.state.params
+    rng = np.random.default_rng(1)
     half = rng.integers(0, 61, size=(2, 8))
     prompt = jnp.asarray(
         np.concatenate([half, half[:, :2]], axis=1), jnp.int32

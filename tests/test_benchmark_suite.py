@@ -134,3 +134,18 @@ def test_attention_bench_smoke(capsys):
     payload = _json.loads(out.strip().splitlines()[-1])
     assert len(payload["rows"]) == 2
     assert all("flash_ms" in r for r in payload["rows"])
+
+
+def test_lm_bench_smoke(capsys):
+    # Smallest config, 2 steps, on CPU: the tool must produce a table row
+    # with throughput + MFU fields and valid JSON.
+    from distributed_tensorflow_tpu.tools import lm_bench
+
+    lm_bench.main(["--configs", "gpt-s-L512-xla", "--steps", "2"])
+    out = capsys.readouterr().out
+    assert "gpt-s-L512-xla" in out
+    import json as _json
+
+    payload = _json.loads(out.strip().splitlines()[-1])
+    (row,) = payload["rows"]
+    assert row["tokens_per_sec"] > 0 and row["flops_per_step"] > 0

@@ -68,3 +68,21 @@ def test_unknown_chip_refuses_to_classify():
     assert r["roofline_floor_us"] is None
     assert r["flops_per_step"] > 0  # analytical part still reported
     assert "unknown" in cost_analysis.format_report(r)
+
+
+def test_analyze_lm_reports_roofline():
+    from distributed_tensorflow_tpu.models.gpt import GPTLM
+    from distributed_tensorflow_tpu.tools.cost_analysis import analyze_lm
+
+    report = analyze_lm(
+        GPTLM(
+            vocab_size=64, max_len=32, model_dim=32, num_heads=4,
+            num_layers=2, compute_dtype="float32",
+        ),
+        batch_size=4,
+    )
+    assert report["model"] == "GPTLM"
+    assert report["tokens_per_step"] == 4 * 32
+    assert report["param_count"] > 0
+    assert report["flops_per_step"] > 0
+    assert report["bound"] in ("compute", "memory", "unknown")

@@ -519,8 +519,11 @@ def test_async_lm_sgd_avg1_equals_sync_dp():
     dp = make_lm_train_step(model, opt, mesh=mesh)
     p_sync, _, l_sync = dp(params, opt.init(params), toks)
 
+    # update_scale=1.0 explicitly: the shared default is the reference
+    # convention N (see make_lm_async_train_step docstring); the
+    # sync-equivalence property needs pure averaging.
     init_state, astep = make_lm_async_train_step(
-        model, opt, mesh, avg_every=1
+        model, opt, mesh, avg_every=1, update_scale=1.0
     )
     state, l_async = astep(init_state(params, opt.init(params)), toks)
     p_async = jax.tree.map(lambda x: x[0], state[0])
@@ -540,7 +543,9 @@ def test_async_lm_copies_diverge_then_converge_on_exchange():
     params = model.init(seed=26)
     opt = optim_lib.make("adam", 1e-3)
     mesh = make_mesh((4,), ("data",), devices=jax.devices()[:4])
-    init_state, astep = make_lm_async_train_step(model, opt, mesh, avg_every=2)
+    init_state, astep = make_lm_async_train_step(
+        model, opt, mesh, avg_every=2, update_scale=1.0
+    )
     rng = np.random.default_rng(26)
     state = init_state(params, opt.init(params))
 

@@ -358,3 +358,46 @@ def test_kv_lens_shape_validated():
     q, k, v = _qkv(15)
     with pytest.raises(ValueError, match="kv_lens"):
         flash_attention(q, k, v, kv_lens=jnp.asarray([3], jnp.int32))
+
+
+def test_offset_shifted_band_matches_reference():
+    # offset=F shifts queries F ahead of keys (the ring composition hook).
+    # Regression (found by tools/attention_parity.py on-chip): when
+    # offset > window, the last rows' whole band falls past the sequence
+    # end; the saved lse there is ~-1e30, so the backward's p=exp(s-lse)
+    # was exp(0)=1 instead of 0 and such rows injected garbage into every
+    # gradient. Fixed by explicit p masking in both backward kernels.
+    def dense_off(q, k, v, window, offset):
+        l, d = q.shape[1], q.shape[-1]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+        diff = jnp.arange(l)[:, None] + offset - jnp.arange(l)[None, :]
+        mask = (diff >= 0) & (diff < window)
+        s = jnp.where(mask[None, None], s, -1e30)
+        w = jax.nn.softmax(s, axis=-1)
+        w = jnp.where(mask.any(-1)[None, None, :, None], w, 0.0)
+        return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+    q, k, v = _qkv(16, l=64, h=2, d=8)
+    W, off, blk = 24, 32, 16  # off > W → rows 55.. have empty bands
+    cot = jax.random.normal(jax.random.key(9), q.shape, jnp.float32)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v) * cot)
+
+    flash_fn = lambda q, k, v: flash_attention(  # noqa: E731
+        q, k, v, causal=True, window=W, offset=off, block_q=blk, block_k=blk
+    )
+    dense_fn = lambda q, k, v: dense_off(q, k, v, W, off)  # noqa: E731
+    np.testing.assert_allclose(
+        np.asarray(flash_fn(q, k, v)), np.asarray(dense_fn(q, k, v)),
+        atol=1e-5, rtol=1e-5,
+    )
+    g_f = jax.grad(lambda *a: loss(flash_fn, *a), argnums=(0, 1, 2))(q, k, v)
+    g_d = jax.grad(lambda *a: loss(dense_fn, *a), argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_f, g_d, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gd), atol=2e-5, rtol=1e-4,
+            err_msg=f"d{name}",
+        )
+    # The empty-band rows contribute exactly zero dq.
+    assert np.all(np.asarray(g_f[0][:, 56:]) == 0.0)
