@@ -138,6 +138,7 @@ class GPTLM:
         moe_balance_coef: float = 1e-2,
         moe_z_coef: float = 1e-3,
         pos_embedding: str = "learned",
+        remat: bool = False,
     ):
         assert model_dim % num_heads == 0
         if attention_impl not in ("xla", "flash"):
@@ -183,6 +184,11 @@ class GPTLM:
         self.moe_balance_coef = moe_balance_coef
         self.moe_z_coef = moe_z_coef
         self.pos_embedding = pos_embedding
+        # jax.checkpoint around each scanned block: activation memory drops
+        # from O(num_layers · L · d) to O(L · d) + one block's recompute per
+        # layer in the backward — the standard long-context memory/FLOPs
+        # trade (the reference never needed it: 784-feature MLP).
+        self.remat = remat
 
     # -- init --------------------------------------------------------------
 
@@ -464,6 +470,8 @@ class GPTLM:
             )
             return h, aux
 
+        if self.remat:
+            body = jax.checkpoint(body)
         h, auxs = lax.scan(body, h, params.blocks)
         return self._logits(params, h), auxs
 
@@ -534,6 +542,8 @@ class GPTLM:
             h, _, _ = self._block(blk, h, attend=sp_attend, positions=positions)
             return h, None
 
+        if self.remat:
+            body = jax.checkpoint(body)
         h, _ = lax.scan(body, h, params.blocks)
         return self._logits(params, h)
 
@@ -587,6 +597,8 @@ class GPTLM:
             h, _, aux = self._block(blk, h, ffn=ep_ffn, positions=positions)
             return h, aux
 
+        if self.remat:
+            body = jax.checkpoint(body)
         h, auxs = lax.scan(body, h, params.blocks)
         logits = self._logits(params, h)
         return (logits, auxs) if with_aux else logits
@@ -781,14 +793,20 @@ class GPTLM:
         ck = lax.dynamic_update_slice(ck, k, (0, slot, 0, 0))
         cv = lax.dynamic_update_slice(cv, v, (0, slot, 0, 0))
         # Attend the one query against the whole static-length cache,
-        # masking invalid slots. The cache stores num_kv_heads; repeat
-        # transiently for the score einsum (the memory win is in what's
-        # STORED, not this one-step temporary).
-        from distributed_tensorflow_tpu.ops.ring_attention import repeat_kv
+        # masking invalid slots. GQA runs WITHOUT materializing the head
+        # repeat: q groups to [B, Hkv, g, Dh] (group_query_heads — the one
+        # canonical q-head→KV-head mapping, shared with repeat_kv and the
+        # flash grid maps) and both einsums contract against the Hkv-head
+        # cache directly — per-step temporaries stay at KV width, the same
+        # factor the cache itself saves (round-2 weak spot: the old path
+        # repeated the cache to Hq every step).
+        from distributed_tensorflow_tpu.ops.ring_attention import (
+            group_query_heads,
+        )
 
-        ck_q, cv_q = repeat_kv(ck, cv, self.num_heads)
+        qg = group_query_heads(q[:, 0], self.num_kv_heads)
         scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, ck_q, preferred_element_type=jnp.float32
+            "bhgd,bkhd->bhgk", qg, ck, preferred_element_type=jnp.float32
         ) / jnp.sqrt(jnp.asarray(self.head_dim, jnp.float32))
         idx = jnp.arange(c)
         if self.window is not None:
@@ -804,11 +822,11 @@ class GPTLM:
         scores = jnp.where(valid[None, None, None, :], scores, -1e30)
         w = jax.nn.softmax(scores, axis=-1)
         attn = jnp.einsum(
-            "bhqk,bkhd->bqhd",
-            w.astype(cv_q.dtype),
-            cv_q,
+            "bhgk,bkhd->bhgd",
+            w.astype(cv.dtype),
+            cv,
             preferred_element_type=jnp.float32,
-        )
+        ).reshape(b, 1, self.num_heads, self.head_dim)
         h = h + self._dot(attn.reshape(b, 1, self.model_dim), blk.wo)
         hn2 = _layernorm(h, blk.ln2_scale, blk.ln2_bias)
         ffn_out, _ = self._ffn(blk, hn2)  # aux unused: decode never drops
@@ -925,6 +943,137 @@ class GPTLM:
             )
 
         return self._decode_loop(params, prompt, max_new, pick, key)
+
+
+def expert_parallel_specs(model: GPTLM, axis_name: str = "expert"):
+    """PartitionSpec layout for expert parallelism: every leaf replicated
+    except the MoE blocks' expert-stacked FFN weights, sharded on their
+    expert dim (axis 1 — axis 0 is num_layers). The layout
+    ``apply_expert_parallel`` / ``make_lm_ep_train_step`` consume."""
+    from jax.sharding import PartitionSpec as P
+
+    if model.moe_experts is None:
+        raise ValueError("expert_parallel_specs requires moe_experts")
+    return GPTLMParams(
+        embed=P(),
+        pos=P(),
+        blocks=GPTMoEBlockParams(
+            ln1_scale=P(), ln1_bias=P(), wq=P(), wk=P(), wv=P(), wo=P(),
+            ln2_scale=P(), ln2_bias=P(), wg=P(),
+            w_up=P(None, axis_name),
+            b_up=P(None, axis_name),
+            w_down=P(None, axis_name),
+            b_down=P(None, axis_name),
+        ),
+        lnf_scale=P(),
+        lnf_bias=P(),
+    )
+
+
+def _slot_specs(optimizer, params_shape, param_specs):
+    """Specs for the optimizer state: each optax slot sharded like the
+    parameter it tracks, scalars replicated. Slots are matched by tree-path
+    suffix (optax moment subtrees mirror the param pytree) — the same
+    matching rule parallel/fsdp.py uses for ZeRO."""
+    from jax.sharding import PartitionSpec as P
+    from jax.tree_util import tree_flatten_with_path
+
+    items = [
+        (tuple(path), leaf.shape, spec)
+        for (path, leaf), spec in zip(
+            tree_flatten_with_path(params_shape)[0],
+            jax.tree.leaves(
+                param_specs, is_leaf=lambda x: isinstance(x, type(P()))
+            ),
+        )
+    ]
+
+    def slot_spec(path, leaf):
+        for ppath, pshape, spec in items:
+            if leaf.shape == pshape and tuple(path[-len(ppath):]) == ppath:
+                return spec
+        return P()
+
+    opt_shape = jax.eval_shape(optimizer.init, params_shape)
+    leaves, treedef = tree_flatten_with_path(opt_shape)
+    return jax.tree.unflatten(
+        treedef, [slot_spec(path, leaf) for path, leaf in leaves]
+    )
+
+
+def make_lm_ep_train_step(
+    model: GPTLM, optimizer, mesh, axis: str = "expert"
+):
+    """Expert-parallel TRAINING step for the MoE LM: one expert's FFN
+    weights (and their optimizer slots) live on each device of ``axis``,
+    tokens are sharded on the batch dim, every block's FFN is the
+    all-to-all exchange (``ops/moe.moe_ffn``), and gradients flow back
+    through the collectives. ``step(params, opt_state, tokens) ->
+    (params, opt_state, loss)``, jitted, with params laid out per
+    :func:`expert_parallel_specs` (place them with ``jax.device_put``
+    before the first call, or let shard_map reshard).
+
+    The differentiated loss is the cross-device ``pmean`` of the local
+    masked CE plus the router aux terms (the same total
+    ``loss_and_metrics`` builds): differentiating the *global* mean makes
+    shard_map's automatic psum of replicated-leaf cotangents produce
+    exactly the global gradient — no manual rescaling — while each
+    expert's sharded weights receive their local (already-exact) gradient
+    through the all-to-all transpose.
+
+    Semantics vs the dense step: the CE term equals the dense global-batch
+    CE exactly in the no-drop regime (capacity is per source shard, like
+    the forward); the aux terms are *per-shard* balance/z-losses averaged
+    over shards — standard EP practice (each device regularizes its own
+    router view), differing from the dense global-batch aux by the
+    product-of-averages gap. tests/test_gpt.py pins the exact semantics
+    against a shard-wise dense reference."""
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    if model.moe_experts is None:
+        raise ValueError("make_lm_ep_train_step requires moe_experts")
+    n = mesh.shape[axis]
+    if n != model.moe_experts:
+        raise ValueError(
+            f"{axis!r} axis size {n} != moe_experts {model.moe_experts}"
+        )
+    specs = expert_parallel_specs(model, axis)
+    params_shape = jax.eval_shape(model.init, 1)
+    opt_specs = _slot_specs(optimizer, params_shape, specs)
+
+    def ep_loss(params, tokens):
+        logits, auxs = model.apply_expert_parallel(
+            params, tokens, axis, with_aux=True
+        )
+        logp = jax.nn.log_softmax(
+            logits[:, :-1].astype(jnp.float32), axis=-1
+        )
+        picked = jnp.take_along_axis(
+            logp, tokens[:, 1:][..., None], axis=-1
+        )
+        ce = lax.pmean(-jnp.mean(picked), axis)
+        balance = lax.pmean(jnp.mean(auxs.balance_loss), axis)
+        z = lax.pmean(jnp.mean(auxs.z_loss), axis)
+        return (
+            ce
+            + model.moe_balance_coef * balance
+            + model.moe_z_coef * z
+        )
+
+    def local(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(ep_loss)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    mapped = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(specs, opt_specs, P(axis)),
+        out_specs=(specs, opt_specs, P()),
+    )
+    return jax.jit(mapped)
 
 
 def make_lm_async_train_step(

@@ -341,6 +341,21 @@ def ring_flash_attention(
     return o.astype(q.dtype)
 
 
+def group_query_heads(q: jax.Array, num_kv_heads: int) -> jax.Array:
+    """[..., Hq, D] → [..., Hkv, G, D]: the NON-materializing side of the
+    GQA contract — query head h belongs to KV head ``h // (Hq/Hkv)``,
+    exactly the mapping :func:`repeat_kv` expands (and the flash kernel's
+    grid index maps implement). Callers that contract grouped queries
+    against Hkv-width keys/values (the decode path) go through this helper
+    so the mapping lives in one place."""
+    *lead, hq, d = q.shape
+    if hq % num_kv_heads:
+        raise ValueError(
+            f"query heads {hq} must be a multiple of KV heads {num_kv_heads}"
+        )
+    return q.reshape(*lead, num_kv_heads, hq // num_kv_heads, d)
+
+
 def repeat_kv(k, v, num_q_heads: int):
     """Repeat k/v heads up to ``num_q_heads`` (GQA semantics as one helper
     so the dense reference, the LM's ring/decode paths, and any future

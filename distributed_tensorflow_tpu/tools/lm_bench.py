@@ -105,6 +105,82 @@ CONFIGS = {
 }
 _VOCAB = 8192
 
+# Generation (KV-cache decode) configs: one scan-compiled greedy_decode
+# dispatch per timing — prefill 256 prompt tokens, decode 256 more. The
+# variant axis: full-length cache vs rolling windowed cache (O(W) slots)
+# vs GQA (cache at Hkv width, grouped-einsum attend — no repeat).
+DECODE_CONFIGS = {
+    "decode-full": dict(
+        batch=8, prompt=256, max_new=256,
+        model=dict(model_dim=256, num_layers=4, num_heads=8, max_len=1024),
+    ),
+    "decode-window256": dict(
+        batch=8, prompt=256, max_new=256,
+        model=dict(
+            model_dim=256, num_layers=4, num_heads=8, max_len=1024,
+            window=256,
+        ),
+    ),
+    "decode-gqa2": dict(
+        batch=8, prompt=256, max_new=256,
+        model=dict(
+            model_dim=256, num_layers=4, num_heads=8, num_kv_heads=2,
+            max_len=1024,
+        ),
+    ),
+    "decode-long-full": dict(
+        batch=4, prompt=256, max_new=256,
+        model=dict(model_dim=256, num_layers=4, num_heads=8, max_len=4096),
+    ),
+    "decode-long-window256": dict(
+        batch=4, prompt=256, max_new=256,
+        model=dict(
+            model_dim=256, num_layers=4, num_heads=8, max_len=4096,
+            window=256,
+        ),
+    ),
+}
+
+
+def bench_decode(name: str, *, seed: int = 0) -> dict:
+    spec = DECODE_CONFIGS[name]
+    model = GPTLM(vocab_size=_VOCAB, **spec["model"])
+    b, p_len, max_new = spec["batch"], spec["prompt"], spec["max_new"]
+    params = model.init(seed=1)
+    prompt = jax.random.randint(
+        jax.random.key(seed), (b, p_len), 0, _VOCAB, jnp.int32
+    )
+    gen = jax.jit(lambda pr, t: model.greedy_decode(pr, t, max_new))
+    out = gen(params, prompt)
+    _ = int(out[-1, -1])  # compile + D2H barrier
+    t0 = time.perf_counter()
+    out = gen(params, prompt)
+    _ = int(out[-1, -1])
+    dt = time.perf_counter() - t0
+    return {
+        "config": name,
+        "batch": b,
+        "prompt": p_len,
+        "max_new": max_new,
+        "cache_len": model.cache_len,
+        "ms_per_token": round(dt * 1e3 / max_new, 3),
+        "gen_tokens_per_sec": round(b * max_new / dt, 1),
+    }
+
+
+def render_decode(rows) -> str:
+    cols = ["config", "B", "prompt", "new", "cache", "ms/token", "gen tok/s"]
+    out = ["| " + " | ".join(cols) + " |", "|" + "---|" * len(cols)]
+    for r in rows:
+        if "error" in r:
+            out.append(f"| {r['config']} | error: {r['error']} |" + " |" * 5)
+            continue
+        out.append(
+            "| {config} | {batch} | {prompt} | {max_new} | {cache_len} | "
+            "{ms_per_token:.2f} | {gen_tokens_per_sec:,.0f} |".format(**r)
+        )
+    return "\n".join(out)
+
 
 def bench_config(
     name: str, *, steps: int = 32, lr: float = 1e-3, seed: int = 0
@@ -204,29 +280,65 @@ def main(argv=None) -> None:
         action="store_true",
         help="regenerate docs/benchmarks/lm_tpu.{md,json}",
     )
+    ap.add_argument(
+        "--decode",
+        action="store_true",
+        help="also run the KV-cache generation configs",
+    )
     args = ap.parse_args(argv)
     rows = run(args.configs, steps=args.steps)
     device = jax.devices()[0].device_kind
     print(f"device: {device}  steps/dispatch: {args.steps}")
     table = render(rows)
     print(table)
-    payload = {"rows": rows, "device": device, "backend": jax.default_backend()}
+    decode_rows = []
+    if args.decode:
+        for name in DECODE_CONFIGS:
+            try:
+                decode_rows.append(bench_decode(name))
+            except Exception as exc:  # noqa: BLE001
+                decode_rows.append(
+                    {"config": name,
+                     "error": f"{type(exc).__name__}: {exc}"[:200]}
+                )
+        print(render_decode(decode_rows))
+    payload = {
+        "rows": rows, "decode_rows": decode_rows, "device": device,
+        "backend": jax.default_backend(),
+    }
     print(json.dumps(payload))
     if args.write_docs:
         root = os.path.join(os.path.dirname(__file__), "..", "..", "docs", "benchmarks")
         root = os.path.abspath(root)
-        with open(os.path.join(root, "lm_tpu.json"), "w") as f:
+        json_path = os.path.join(root, "lm_tpu.json")
+        if not decode_rows and os.path.exists(json_path):
+            # A regeneration run without --decode must not erase the decode
+            # record — carry the previous rows forward.
+            try:
+                with open(json_path) as f:
+                    decode_rows = json.load(f).get("decode_rows", [])
+                payload["decode_rows"] = decode_rows
+            except Exception:
+                pass
+        with open(json_path, "w") as f:
             json.dump(payload, f, indent=1)
+        cmd_flags = f"--steps {args.steps}" + (" --decode" if args.decode else "")
         with open(os.path.join(root, "lm_tpu.md"), "w") as f:
             f.write(
                 "# LM training on one TPU chip\n\n"
                 f"Generated by `python -m distributed_tensorflow_tpu.tools."
-                f"lm_bench --steps {args.steps} --write-docs` on {device} "
+                f"lm_bench {cmd_flags} --write-docs` on {device} "
                 "(bf16 matmuls, adam, vocab 8192; "
                 f"{args.steps} steps amortized per dispatch, D2H-barrier "
                 "timing; MFU = XLA-counted FLOPs / measured step time / "
                 "chip peak).\n\n" + table + "\n\n"
-                "Reading the MFU column: it is computed against the v5e "
+                + (
+                    "## Generation (KV-cache greedy decode, one compiled "
+                    "scan)\n\n" + render_decode(decode_rows) + "\n\n"
+                    if decode_rows
+                    else ""
+                )
+                + "Reading the MFU column: it is computed against the v5e "
                 "SPEC peak (197 bf16 TFLOPS). The tunneled chip in this "
                 "environment delivers a single-digit-TFLOPS effective "
                 "ceiling on EVERY workload — the whole-epoch Pallas MLP "
