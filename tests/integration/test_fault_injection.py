@@ -124,17 +124,56 @@ def test_worker_kill_stops_chief_with_restorable_checkpoint(tmp_path):
         env=env,
         text=True,
     )
+    import threading
+
+    # Drain both stdouts on threads so readiness waits have a REAL deadline
+    # (a bare readline() blocks past any time check) and nothing deadlocks
+    # on a full pipe.
+    chief_lines: list = []
+    worker_lines: list = []
+
+    def _drain(proc, sink):
+        for line in proc.stdout:
+            sink.append(line)
+
+    for proc, sink in ((chief, chief_lines), (worker, worker_lines)):
+        threading.Thread(target=_drain, args=(proc, sink), daemon=True).start()
+
+    def _wait_for(sink, token, proc, timeout=120.0):
+        end = time.time() + timeout
+        while time.time() < end:
+            if any(token in l for l in list(sink)):
+                return True
+            if proc.poll() is not None:
+                return any(token in l for l in list(sink))
+            time.sleep(0.2)
+        return False
+
     try:
-        # Let the job reach steady state (both heartbeats up, chief training),
-        # then kill the worker without ceremony.
-        time.sleep(12)
+        # Wait for BOTH sides' own readiness lines before scheduling the
+        # kill: under load (this test runs right after the heavy converged-
+        # parity oracle) jax imports can take >12s on either process, and
+        # killing a worker the chief never saw trips the chief's "worker
+        # never came up" assert instead of the heartbeat-loss path this
+        # test exists to prove.
+        assert _wait_for(worker_lines, "WORKER_UP", worker), (
+            "worker never reported ready:\n" + "".join(worker_lines)
+        )
+        assert _wait_for(chief_lines, "CHIEF_TRAINING", chief), (
+            "chief never reached training:\n" + "".join(chief_lines)
+        )
+        # Steady state (chief sees heartbeats, training underway), then kill
+        # without ceremony.
+        time.sleep(8)
         worker.send_signal(signal.SIGKILL)
-        out, _ = chief.communicate(timeout=120)
+        chief.wait(timeout=120)
     finally:
         for p in (chief, worker):
             if p.poll() is None:
                 p.kill()
     worker.wait(timeout=10)
+    time.sleep(0.5)  # let the drain thread consume the chief's tail
+    out = "".join(chief_lines)
 
     assert chief.returncode == 0, f"chief did not exit cleanly:\n{out}"
     assert "CHIEF_TRAINING" in out and "CHIEF_STOPPED" in out, out
