@@ -136,8 +136,11 @@ def test_worker_kill_stops_chief_with_restorable_checkpoint(tmp_path):
         for line in proc.stdout:
             sink.append(line)
 
+    threads = {}
     for proc, sink in ((chief, chief_lines), (worker, worker_lines)):
-        threading.Thread(target=_drain, args=(proc, sink), daemon=True).start()
+        t = threading.Thread(target=_drain, args=(proc, sink), daemon=True)
+        t.start()
+        threads[proc] = t
 
     def _wait_for(sink, token, proc, timeout=120.0):
         end = time.time() + timeout
@@ -145,6 +148,9 @@ def test_worker_kill_stops_chief_with_restorable_checkpoint(tmp_path):
             if any(token in l for l in list(sink)):
                 return True
             if proc.poll() is not None:
+                # Let the drain thread consume the pipe's tail before
+                # concluding — poll() can precede the buffered output.
+                threads[proc].join(timeout=10)
                 return any(token in l for l in list(sink))
             time.sleep(0.2)
         return False
@@ -172,7 +178,10 @@ def test_worker_kill_stops_chief_with_restorable_checkpoint(tmp_path):
             if p.poll() is None:
                 p.kill()
     worker.wait(timeout=10)
-    time.sleep(0.5)  # let the drain thread consume the chief's tail
+    # Join the drain threads (EOF after process exit) — a fixed sleep could
+    # truncate the captured tail on a loaded host.
+    for t in threads.values():
+        t.join(timeout=10)
     out = "".join(chief_lines)
 
     assert chief.returncode == 0, f"chief did not exit cleanly:\n{out}"
