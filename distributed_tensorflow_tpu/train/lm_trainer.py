@@ -140,6 +140,17 @@ class LMTrainer:
             self._stage_cache[name] = hit = (arr, self._replicated(arr))
         return hit[1]
 
+    def _train_lens(self):
+        """Staged train lengths — real when ragged, else a once-staged zero
+        placeholder (the compiled bodies statically ignore it; staging
+        avoids a per-epoch upload)."""
+        train = self.datasets.train
+        if self._ragged:
+            return self._stage("train_lengths", train.lengths)
+        if not hasattr(self, "_zero_lens"):
+            self._zero_lens = np.zeros((train.num_examples,), np.int32)
+        return self._stage("zero_lengths", self._zero_lens)
+
     def _shard_batch(self, toks):
         if self.mesh is None:
             return toks
@@ -177,45 +188,200 @@ class LMTrainer:
 
         return step
 
-    def _build_scanned_fn(self):
+    def _make_step_body(self, toks_all, lens_all):
+        """The ONE compiled SGD step body shared by the scanned-epoch and
+        whole-run paths (a divergence here would silently break their
+        proven equality): gather the batch by index from the staged
+        arrays, shard it over the mesh, masked loss when ragged."""
         model, opt = self.model, self.optimizer
         ragged = self._ragged
         shard = self._shard_batch
 
-        def epoch(state, toks_all, lens_all, idxs):
-            def body(carry, idx):
-                params, opt_state, step = carry
-                toks = shard(toks_all[idx])
-                lens = lens_all[idx] if ragged else None
-                loss, grads = jax.value_and_grad(model.loss)(
-                    params, toks, lens
-                )
-                updates, opt_state = opt.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
-                return (params, opt_state, step + 1), loss
+        def body(carry, idx):
+            params, opt_state, step = carry
+            toks = shard(toks_all[idx])
+            lens = lens_all[idx] if ragged else None
+            loss, grads = jax.value_and_grad(model.loss)(params, toks, lens)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state, step + 1), loss
 
+        return body
+
+    def _build_scanned_fn(self):
+        def epoch(state, toks_all, lens_all, idxs):
+            body = self._make_step_body(toks_all, lens_all)
             carry = (state.params, state.opt_state, state.step)
             (p, o, s), losses = jax.lax.scan(body, carry, idxs)
             return TrainState(p, o, s), losses
 
         return jax.jit(epoch, donate_argnums=0)
 
-    def _build_eval_chunk(self):
-        model = self.model
-        ragged = self._ragged
+    def _ce_count(self, params, toks, lens):
+        """(CE · target-count, target-count) for one token block — the ONE
+        eval arithmetic shared by the host-side :meth:`evaluate` chunks and
+        the compiled run's in-graph eval (a divergence here would silently
+        break their proven equality, same rationale as
+        :meth:`_make_step_body`); masked when ragged."""
+        l = toks.shape[1]
+        if self._ragged:
+            ce = self.model.loss(params, toks, lens)
+            count = jnp.sum(jnp.maximum(lens - 1, 0))
+        else:
+            ce = self.model.loss(params, toks)
+            count = jnp.asarray(toks.shape[0] * (l - 1), jnp.int32)
+        return ce * count, count
 
+    def _in_graph_perplexity(self, params, val_toks, val_lens):
+        """Per-epoch eval inside the compiled run: chunked over
+        ``eval_batch``-row blocks (trimmed to a chunk multiple), exact
+        CE·count aggregation via :meth:`_ce_count`."""
+        ragged = self._ragged
+        n, l = val_toks.shape
+        b = min(self.eval_batch, n)
+        k = n // b
+        vt = val_toks[: k * b].reshape(k, b, l)
+        vl = val_lens[: k * b].reshape(k, b) if ragged else None
+
+        def chunk(args):
+            toks, lens = args
+            return self._ce_count(params, toks, lens if ragged else None)
+
+        sums, counts = jax.lax.map(
+            chunk, (vt, vl if ragged else jnp.zeros((k, b), jnp.int32))
+        )
+        return jnp.exp(jnp.sum(sums) / jnp.maximum(jnp.sum(counts), 1))
+
+    def _build_compiled_run_fn(self):
+        """The LM analog of ``train/compiled_run.py``: EVERY epoch's steps
+        AND its held-out perplexity eval compiled into ONE dispatch — an
+        outer scan over epochs, an inner scan over that epoch's gathered
+        batches (the SAME step body as the scanned path), and an in-graph
+        chunked eval over the staged validation tokens. Identical update
+        math to the scanned path: the [epochs, steps, batch] index block is
+        drawn from the dataset's own ``next_indices`` stream (proven
+        bitwise in test_lm_trainer.py)."""
+
+        def run(state, toks_all, lens_all, idxs, val_toks, val_lens):
+            step_body = self._make_step_body(toks_all, lens_all)
+
+            def epoch_body(carry, epoch_idxs):
+                carry, losses = jax.lax.scan(step_body, carry, epoch_idxs)
+                ppl = self._in_graph_perplexity(carry[0], val_toks, val_lens)
+                return carry, (losses, ppl)
+
+            carry = (state.params, state.opt_state, state.step)
+            (p, o, s), (losses, ppls) = jax.lax.scan(
+                epoch_body, carry, idxs
+            )
+            return TrainState(p, o, s), losses, ppls
+
+        return jax.jit(run, donate_argnums=0)
+
+    def run_compiled(self, epochs: int | None = None) -> dict:
+        """Whole-run fast path: all epochs + per-epoch in-graph perplexity
+        as ONE dispatch. Log lines (uniform AvgTime), summaries, and
+        history match :meth:`run`; the in-graph perplexity covers the
+        validation split trimmed to an ``eval_batch`` multiple (equal to
+        :meth:`evaluate` whenever ``eval_batch`` divides the split; the
+        final returned perplexity always comes from the exact full-split
+        :meth:`evaluate`). Supervisor semantics differ BY DESIGN from
+        run(): one checkpoint save after the dispatch and no mid-run
+        heartbeat-reactive stop — a single compiled program cannot be
+        interrupted at epoch boundaries; use run() when those matter."""
+        cfg = self.config
+        epochs = cfg.epochs if epochs is None else epochs
+        train = self.datasets.train
+        val = self.datasets.validation
+        steps = train.num_examples // cfg.batch_size
+        logger = StepLogger(freq=cfg.log_frequency, print_fn=self.print_fn)
+        if epochs * steps == 0:
+            # Nothing to dispatch (epochs=0, or dataset smaller than one
+            # batch) — mirror run()'s no-op semantics instead of crashing
+            # on an empty index stack.
+            perplexity = self.evaluate("validation") if self.is_chief else float("nan")
+            if self.is_chief:
+                logger.log_final(cost=float("nan"))
+            return {
+                "perplexity": perplexity,
+                "final_cost": float("nan"),
+                "global_step": self.global_step,
+            }
+
+        # One jitted whole-run program, built once: it closes over nothing
+        # shape-specific, so jax.jit's own shape-keyed cache handles varying
+        # (epochs, steps) without re-tracing a rebuilt wrapper.
+        if not hasattr(self, "_compiled_run_fn"):
+            self._compiled_run_fn = self._build_compiled_run_fn()
+        run_fn = self._compiled_run_fn
+        toks = self._stage("train_tokens", train.tokens)
+        lens = self._train_lens()
+        if self._ragged:
+            val_lens = self._stage("validation_lengths", val.lengths)
+        else:
+            val_lens = None
+        val_toks = self._stage("validation_tokens", val.tokens)
+        idxs = self._replicated(
+            np.stack(
+                [
+                    self._epoch_indices(steps, cfg.batch_size)
+                    for _ in range(epochs)
+                ]
+            )
+        )
+        step_before = self.global_step
+        t0 = time.time()
+        self.state, costs, ppls = run_fn(
+            self.state, toks, lens, idxs, val_toks, val_lens
+        )
+        costs = jax.device_get(costs)  # D2H fetch = execution barrier
+        ppls = jax.device_get(ppls)
+        elapsed = time.time() - t0
+        avg_ms = elapsed * 1000 / max(epochs * steps, 1)
+        self.last_cost = float(costs[-1, -1])
+        for epoch in range(epochs):
+            for i in range(steps):
+                if logger.is_due(i + 1, steps):
+                    logger.log_step_line(
+                        step=step_before + epoch * steps + i + 1,
+                        epoch=epoch,
+                        batch=i,
+                        batch_count=steps,
+                        cost=float(costs[epoch, i]),
+                        avg_ms=avg_ms,
+                    )
+            if self.is_chief:
+                ppl = float(ppls[epoch])
+                logger.log_epoch_metric("Test-Perplexity", ppl)
+                step_now = step_before + (epoch + 1) * steps
+                if self.summary_writer is not None:
+                    for i in range(steps):
+                        self.summary_writer.add_scalar(
+                            "cost",
+                            float(costs[epoch, i]),
+                            step_before + epoch * steps + i + 1,
+                        )
+                    self.summary_writer.add_scalar("perplexity", ppl, step_now)
+                self.history.append(
+                    {"epoch": epoch + 1, "perplexity": ppl, "step": step_now}
+                )
+        if self.supervisor is not None:
+            self.supervisor.save(self.state, self.global_step)
+        perplexity = self.evaluate("validation") if self.is_chief else float("nan")
+        if self.is_chief:
+            logger.log_final(cost=self.last_cost)
+            if self.summary_writer is not None:
+                self.summary_writer.flush()
+        return {
+            "perplexity": perplexity,
+            "final_cost": self.last_cost,
+            "global_step": self.global_step,
+        }
+
+    def _build_eval_chunk(self):
         @jax.jit
         def chunk(params, toks, lens):
-            # (CE · target-count, target-count): exact aggregation across
-            # chunks, masked when ragged.
-            l = toks.shape[1]
-            if ragged:
-                ce = model.loss(params, toks, lens)
-                count = jnp.sum(jnp.maximum(lens - 1, 0))
-            else:
-                ce = model.loss(params, toks)
-                count = jnp.asarray(toks.shape[0] * (l - 1), jnp.int32)
-            return ce * count, count
+            return self._ce_count(params, toks, lens)
 
         return chunk
 
@@ -264,14 +430,7 @@ class LMTrainer:
             if self._scanned_fn is None:
                 self._scanned_fn = self._build_scanned_fn()
             toks = self._stage("train_tokens", train.tokens)
-            if self._ragged:
-                lens = self._stage("train_lengths", train.lengths)
-            else:
-                # Static placeholder (the scanned body ignores it — ragged
-                # is closed over); staged once so no per-epoch upload.
-                if not hasattr(self, "_zero_lens"):
-                    self._zero_lens = np.zeros((train.num_examples,), np.int32)
-                lens = self._stage("zero_lengths", self._zero_lens)
+            lens = self._train_lens()
             idxs = self._replicated(self._epoch_indices(steps, cfg.batch_size))
             t0 = time.time()
             self.state, costs = self._scanned_fn(self.state, toks, lens, idxs)

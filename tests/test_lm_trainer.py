@@ -239,3 +239,67 @@ def test_markov_corpus_generalization_gap():
     # Test split agrees with validation (same chain): the gap is small.
     test_ppl = tr.evaluate("test")
     assert abs(test_ppl - res["perplexity"]) / res["perplexity"] < 0.25
+
+
+def test_run_compiled_matches_scanned_run(corpus):
+    # The whole-run single-dispatch path draws the identical index stream,
+    # so final params must equal the per-epoch scanned path bitwise, and
+    # the in-graph per-epoch perplexities must match host evals.
+    a = LMTrainer(
+        _model(), corpus(), _cfg(epochs=3, scan_epoch=True),
+        print_fn=lambda *a: None,
+    )
+    a.run()
+    b = LMTrainer(
+        _model(), corpus(), _cfg(epochs=3), print_fn=lambda *a: None
+    )
+    res = b.run_compiled(epochs=3)
+    assert b.global_step == a.global_step == 24
+    for la, lb in zip(
+        jax.tree.leaves(a.state.params), jax.tree.leaves(b.state.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    # In-graph eval uses the full 128-row val split (eval_batch >= 128
+    # here), so per-epoch perplexities agree with the host-run history.
+    np.testing.assert_allclose(
+        [h["perplexity"] for h in b.history],
+        [h["perplexity"] for h in a.history],
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(res["perplexity"], a.history[-1]["perplexity"], rtol=1e-5)
+
+
+def test_run_compiled_log_surface(corpus):
+    lines = []
+    tr = LMTrainer(
+        _model(), corpus(), _cfg(),
+        print_fn=lambda *a: lines.append(" ".join(map(str, a))),
+    )
+    tr.run_compiled(epochs=2)
+    assert sum(l.startswith("Step:") for l in lines) == 4  # 8 steps, freq 4
+    assert sum(l.startswith("Test-Perplexity:") for l in lines) == 2
+    assert lines[-1] == "Done"
+
+
+def test_run_compiled_chunked_eval_and_edges(corpus):
+    # eval_batch smaller than the val split: the in-graph eval runs
+    # chunked (lax.map) and must equal the host evaluate() exactly when
+    # eval_batch divides the split (128 = 2 x 64 here).
+    tr = LMTrainer(
+        _model(), corpus(), _cfg(epochs=1),
+        eval_batch=64, print_fn=lambda *a: None,
+    )
+    tr.run_compiled(epochs=1)
+    np.testing.assert_allclose(
+        tr.history[-1]["perplexity"], tr.evaluate("validation"), rtol=1e-6
+    )
+    # epochs=0: a no-op, not a crash (run() semantics).
+    tr0 = LMTrainer(
+        _model(), corpus(), _cfg(), print_fn=lambda *a: None
+    )
+    res = tr0.run_compiled(epochs=0)
+    assert res["global_step"] == 0 and np.isfinite(res["perplexity"])
+    # Repeated call reuses the one cached jitted program.
+    fn = tr._compiled_run_fn
+    tr.run_compiled(epochs=1)
+    assert tr._compiled_run_fn is fn
