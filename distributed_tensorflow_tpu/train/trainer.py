@@ -331,12 +331,16 @@ class Trainer:
             train.num_examples // cfg.batch_size if cfg.per_worker_epoch else None
         )
         use_pallas = cfg.engine == "pallas"
-        if use_pallas and not getattr(self, "_pallas_checked", False):
+        if use_pallas:
             # Probe once per trainer: the check issues eager dispatches
             # (~20-40 ms each through the tunnel) that warm repeated calls
             # must not re-pay. Model/optimizer/loss are fixed at __init__.
-            self._check_pallas_engine()
-            self._pallas_checked = True
+            # (A previous flat elif chain made the SECOND pallas call fall
+            # through to the unknown-engine raise — the already-checked
+            # case must be a no-op, not an error.)
+            if not getattr(self, "_pallas_checked", False):
+                self._check_pallas_engine()
+                self._pallas_checked = True
         elif cfg.engine != "xla":
             raise ValueError(f"unknown engine {cfg.engine!r} (xla|pallas)")
         # Cache per (engine, epochs, batch, steps): each make_*_run_fn call

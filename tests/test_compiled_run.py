@@ -316,3 +316,26 @@ def test_pallas_engine_rejects_unsupported_config(small_datasets):
             ),
             print_fn=lambda *a: None,
         ).run_compiled(1)
+
+
+def test_pallas_engine_repeated_run_compiled(small_datasets):
+    """Regression: the engine-validation elif chain made the SECOND
+    run_compiled call on a pallas-engine trainer fall through to the
+    unknown-engine raise (the already-checked case must be a no-op) —
+    exactly the warmup+timed pattern tools/benchmark_suite.py uses."""
+    from distributed_tensorflow_tpu.config import TrainConfig
+    from distributed_tensorflow_tpu.models import MLP
+    from distributed_tensorflow_tpu.train.trainer import Trainer
+
+    tr = Trainer(
+        MLP(),
+        _fresh(small_datasets),
+        TrainConfig(
+            epochs=1, compiled_run=True, engine="pallas",
+            log_frequency=10**9, logs_path="",
+        ),
+        print_fn=lambda *a: None,
+    )
+    r1 = tr.run_compiled(1)
+    r2 = tr.run_compiled(1)  # raised ValueError("unknown engine") before
+    assert r2["global_step"] == 2 * r1["global_step"]
