@@ -618,6 +618,27 @@ class GPTLM:
             lambda a: a.reshape((num_stages, lps) + a.shape[1:]), blocks
         )
 
+    def _pp_stage_fn(self):
+        """One pipeline stage's forward — the ONE stage body shared by
+        :meth:`apply_pipeline_parallel` and :func:`make_lm_pp_train_step`
+        (a divergence would silently break their proven forward equality):
+        the stage's contiguous layer group ([1, layers_per_stage, ...]
+        leaves) scanned exactly like :meth:`apply`, ``jax.checkpoint``-ed
+        when ``remat`` (backward recomputes one stage group per tick
+        instead of stashing every tick's activations)."""
+
+        def stage_fn(blk_stack, x):
+            positions = jnp.arange(x.shape[1])
+
+            def body(h, blk):
+                h, _, _ = self._block(blk, h, positions=positions)
+                return h, None
+
+            h, _ = lax.scan(body, x, jax.tree.map(lambda a: a[0], blk_stack))
+            return h
+
+        return jax.checkpoint(stage_fn) if self.remat else stage_fn
+
     def apply_pipeline_parallel(
         self,
         params: GPTLMParams,
@@ -650,23 +671,9 @@ class GPTLM:
         )
 
         b, l = tokens.shape
-        positions = jnp.arange(l)
-        h = self._embed_tokens(params, tokens, positions)
-
-        def stage_fn(blk_stack, x):
-            # blk_stack leaves [1, layers_per_stage, ...]: this stage's
-            # contiguous layer group, scanned exactly like apply().
-            def body(h, blk):
-                h, _, _ = self._block(blk, h, positions=positions)
-                return h, None
-
-            h, _ = lax.scan(
-                body, x, jax.tree.map(lambda a: a[0], blk_stack)
-            )
-            return h
-
+        h = self._embed_tokens(params, tokens, jnp.arange(l))
         hm = microbatch(h, num_microbatches)  # [M, B/M, L, d]
-        out = pipeline_apply(stage_fn, params.blocks, hm, axis_name)
+        out = pipeline_apply(self._pp_stage_fn(), params.blocks, hm, axis_name)
         return self._logits(params, out.reshape(b, l, -1))
 
     def loss(
@@ -701,19 +708,7 @@ class GPTLM:
         total) and ``drop_fraction`` (pure metric, NOT in the loss — the
         observable no-drop-regime guard)."""
         logits, auxs = self.apply_with_aux(params, tokens, lengths)
-        logits = logits[:, :-1]
-        targets = tokens[:, 1:]
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)
-        if lengths is None:
-            ce = -jnp.mean(picked)
-        else:
-            # Target at position i is token i+1 → valid iff i+1 < lengths[b].
-            w = (
-                jnp.arange(tokens.shape[1] - 1)[None, :]
-                < (lengths[:, None] - 1)
-            ).astype(jnp.float32)
-            ce = -jnp.sum(picked[..., 0] * w) / jnp.maximum(jnp.sum(w), 1.0)
+        ce = _ce_from_logits(logits, tokens, lengths)
         metrics = {"ce": ce}
         if self.moe_experts is None:
             return ce, metrics
@@ -945,6 +940,25 @@ class GPTLM:
         return self._decode_loop(params, prompt, max_new, pick, key)
 
 
+def _ce_from_logits(logits, tokens, lengths=None):
+    """Mean next-token cross-entropy (positions 0..L-2 predict 1..L-1, f32
+    log-softmax), masked over ``lengths`` when given — the ONE CE arithmetic
+    shared by :meth:`GPTLM.loss_and_metrics` and every parallel train-step
+    factory below (a divergence here would silently break their proven
+    equality with the single-device step)."""
+    logits = logits[:, :-1]
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    if lengths is None:
+        return -jnp.mean(picked)
+    # Target at position i is token i+1 → valid iff i+1 < lengths[b].
+    w = (
+        jnp.arange(tokens.shape[1] - 1)[None, :] < (lengths[:, None] - 1)
+    ).astype(jnp.float32)
+    return -jnp.sum(picked[..., 0] * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
 def expert_parallel_specs(model: GPTLM, axis_name: str = "expert"):
     """PartitionSpec layout for expert parallelism: every leaf replicated
     except the MoE blocks' expert-stacked FFN weights, sharded on their
@@ -1046,13 +1060,7 @@ def make_lm_ep_train_step(
         logits, auxs = model.apply_expert_parallel(
             params, tokens, axis, with_aux=True
         )
-        logp = jax.nn.log_softmax(
-            logits[:, :-1].astype(jnp.float32), axis=-1
-        )
-        picked = jnp.take_along_axis(
-            logp, tokens[:, 1:][..., None], axis=-1
-        )
-        ce = lax.pmean(-jnp.mean(picked), axis)
+        ce = lax.pmean(_ce_from_logits(logits, tokens), axis)
         balance = lax.pmean(jnp.mean(auxs.balance_loss), axis)
         z = lax.pmean(jnp.mean(auxs.z_loss), axis)
         return (
@@ -1074,6 +1082,138 @@ def make_lm_ep_train_step(
         out_specs=(specs, opt_specs, P()),
     )
     return jax.jit(mapped)
+
+
+def pipeline_parallel_specs(model: GPTLM, axis_name: str = "stage"):
+    """PartitionSpec layout for pipeline parallelism over the
+    :meth:`GPTLM.pipeline_stage_blocks` layout: every staged block leaf
+    sharded on its leading ``num_stages`` dim (one contiguous layer group
+    per device of ``axis_name``); embed/pos/lnf replicated — exactly the
+    placement :func:`make_lm_pp_train_step` trains under."""
+    from jax.sharding import PartitionSpec as P
+
+    if model.moe_experts is not None:
+        raise NotImplementedError(
+            "pipeline parallelism is not defined for MoE blocks; use "
+            "expert parallelism (make_lm_ep_train_step)"
+        )
+    params_shape = jax.eval_shape(model.init, 1)
+    return GPTLMParams(
+        embed=P(),
+        pos=P(),
+        blocks=jax.tree.map(lambda _: P(axis_name), params_shape.blocks),
+        lnf_scale=P(),
+        lnf_bias=P(),
+    )
+
+
+def pipeline_stage_params(
+    model: GPTLM, params: GPTLMParams, num_stages: int
+) -> GPTLMParams:
+    """Full params → pipeline layout: blocks reshaped to
+    [num_stages, layers_per_stage, ...] (:meth:`GPTLM.pipeline_stage_blocks`),
+    everything else untouched. Inverse: merge the two leading block dims."""
+    return params._replace(
+        blocks=model.pipeline_stage_blocks(params.blocks, num_stages)
+    )
+
+
+def make_lm_pp_train_step(
+    model: GPTLM,
+    optimizer,
+    mesh,
+    *,
+    axis: str = "stage",
+    num_microbatches: int = 4,
+):
+    """Pipeline-parallel TRAINING step: the GPipe backward as the scan
+    transpose. The reference has no pipeline stages at all (SURVEY.md §2b
+    — one tiny MLP per worker); this completes the parallelism matrix on
+    the *training* side, the reason GPipe exists.
+
+    Layout: params in :func:`pipeline_stage_params` form — each device of
+    ``axis`` owns one contiguous layer group [1, n/S, ...] AND that group's
+    optimizer slots (:func:`pipeline_parallel_specs` + slot matching);
+    embed/pos/lnf and tokens replicated. The forward is the GPipe
+    microbatched pipeline (``parallel/pipeline.py``): M microbatches flow
+    stage-to-stage over ``ppermute`` hops, M + S − 1 ticks. The backward is
+    **not hand-scheduled**: reverse-mode AD through the tick scan replays
+    the ticks in reverse with the transposed hops (``ppermute`` with the
+    inverse permutation) — exactly the GPipe backward schedule, derived by
+    the compiler rather than written out. Each stage's parameter gradient
+    accumulates across its microbatch ticks inside the scan transpose; the
+    embedding/head gradients flow once (embed + LM head run under GSPMD
+    outside the stage loop, so nothing is double-counted across stages).
+
+    ``model.remat=True`` composes: each stage's layer-group forward is
+    ``jax.checkpoint``-ed, so the backward recomputes one stage group per
+    tick instead of stashing all M·(M+S−1) tick activations.
+
+    Returns a jitted ``step(params, opt_state, tokens) -> (params,
+    opt_state, loss)``; place params/slots with ``jax.device_put`` under
+    the :func:`pipeline_parallel_specs` layout first (or let GSPMD
+    reshard on the first call). Proven grad-identical to the sequential
+    single-device step in tests/test_gpt.py on 4- and 8-stage meshes."""
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_tpu.parallel.pipeline import (
+        microbatch,
+        pipeline_apply,
+    )
+
+    s = mesh.shape[axis]
+    if model.num_layers % s:
+        raise ValueError(
+            f"num_layers {model.num_layers} not divisible by {axis!r} axis "
+            f"size {s}"
+        )
+    specs = pipeline_parallel_specs(model, axis)  # raises for MoE blocks
+    staged_shape = jax.eval_shape(
+        lambda: pipeline_stage_params(model, model.init(1), s)
+    )
+    opt_specs = _slot_specs(optimizer, staged_shape, specs)
+    shardings = jax.tree.map(
+        lambda sp: NamedSharding(mesh, sp),
+        specs,
+        is_leaf=lambda x: isinstance(x, type(P())),
+    )
+    opt_shardings = jax.tree.map(
+        lambda sp: NamedSharding(mesh, sp),
+        opt_specs,
+        is_leaf=lambda x: isinstance(x, type(P())),
+    )
+
+    stage_fn = model._pp_stage_fn()
+    pp_body = jax.shard_map(
+        lambda blocks, hm: pipeline_apply(stage_fn, blocks, hm, axis),
+        mesh=mesh,
+        in_specs=(specs.blocks, P()),
+        out_specs=P(),
+    )
+
+    def pp_loss(params, tokens):
+        b, l = tokens.shape
+        positions = jnp.arange(l)
+        h = model._embed_tokens(params, tokens, positions)
+        hm = microbatch(h, num_microbatches)  # [M, B/M, L, d]
+        out = pp_body(params.blocks, hm)
+        logits = model._logits(params, out.reshape(b, l, -1))
+        return _ce_from_logits(logits, tokens)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(pp_loss)(params, tokens)
+        # Pin grads/params/slots to the stage-owner layout so the update
+        # math below stays local to each device's layer group.
+        grads = lax.with_sharding_constraint(grads, shardings)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        params = lax.with_sharding_constraint(params, shardings)
+        opt_state = lax.with_sharding_constraint(opt_state, opt_shardings)
+        return params, opt_state, loss
+
+    return step
 
 
 def make_lm_async_train_step(

