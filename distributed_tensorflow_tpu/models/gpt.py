@@ -984,6 +984,18 @@ def expert_parallel_specs(model: GPTLM, axis_name: str = "expert"):
     )
 
 
+def _as_shardings(mesh, spec_tree):
+    """Spec pytree → NamedSharding pytree over ``mesh`` (the ``is_leaf``
+    guard keeps tree.map from descending into the PartitionSpecs)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.tree.map(
+        lambda sp: NamedSharding(mesh, sp),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, type(P())),
+    )
+
+
 def _slot_specs(optimizer, params_shape, param_specs):
     """Specs for the optimizer state: each optax slot sharded like the
     parameter it tracks, scalars replicated. Slots are matched by tree-path
@@ -1016,7 +1028,12 @@ def _slot_specs(optimizer, params_shape, param_specs):
 
 
 def make_lm_ep_train_step(
-    model: GPTLM, optimizer, mesh, axis: str = "expert"
+    model: GPTLM,
+    optimizer,
+    mesh,
+    axis: str = "expert",
+    *,
+    data_axis: str | None = None,
 ):
     """Expert-parallel TRAINING step for the MoE LM: one expert's FFN
     weights (and their optimizer slots) live on each device of ``axis``,
@@ -1027,12 +1044,22 @@ def make_lm_ep_train_step(
     :func:`expert_parallel_specs` (place them with ``jax.device_put``
     before the first call, or let shard_map reshard).
 
-    The differentiated loss is the cross-device ``pmean`` of the local
-    masked CE plus the router aux terms (the same total
-    ``loss_and_metrics`` builds): differentiating the *global* mean makes
-    shard_map's automatic psum of replicated-leaf cotangents produce
-    exactly the global gradient — no manual rescaling — while each
-    expert's sharded weights receive their local (already-exact) gradient
+    ``data_axis`` composes data parallelism on top — real MoE training is
+    dp×ep on a 2-D ``(data, expert)`` mesh (the reference's only
+    composition story is multi-ps × multi-worker, reference README.md:
+    166-254; this is its modern form). The batch dim is sharded over BOTH
+    axes (data-major), expert weights stay sharded over ``axis`` only
+    (replicated across ``data``), and each data row runs its own expert
+    all-to-all over ``axis``. The ``axis`` size must still equal
+    ``moe_experts`` (that equality is the all-to-all's layout); the data
+    axis is free, so the device count scales past the expert count.
+
+    The differentiated loss is the cross-device ``pmean`` (over both axes
+    when dp is on) of the local masked CE plus the router aux terms (the
+    same total ``loss_and_metrics`` builds): differentiating the *global*
+    mean makes shard_map's automatic psum of replicated-leaf cotangents
+    produce exactly the global gradient — no manual rescaling — while each
+    expert's sharded weights receive their data-summed local gradient
     through the all-to-all transpose.
 
     Semantics vs the dense step: the CE term equals the dense global-batch
@@ -1041,7 +1068,7 @@ def make_lm_ep_train_step(
     over shards — standard EP practice (each device regularizes its own
     router view), differing from the dense global-batch aux by the
     product-of-averages gap. tests/test_gpt.py pins the exact semantics
-    against a shard-wise dense reference."""
+    against a shard-wise dense reference, for 1-D ep and 2-D dp×ep."""
     import optax
     from jax.sharding import PartitionSpec as P
 
@@ -1052,6 +1079,14 @@ def make_lm_ep_train_step(
         raise ValueError(
             f"{axis!r} axis size {n} != moe_experts {model.moe_experts}"
         )
+    if data_axis is not None and data_axis not in mesh.shape:
+        raise ValueError(f"mesh has no {data_axis!r} axis: {dict(mesh.shape)}")
+    if data_axis == axis:
+        raise ValueError(
+            f"data_axis must differ from the expert axis {axis!r}"
+        )
+    axes = (axis,) if data_axis is None else (data_axis, axis)
+    batch_spec = P(axis) if data_axis is None else P((data_axis, axis))
     specs = expert_parallel_specs(model, axis)
     params_shape = jax.eval_shape(model.init, 1)
     opt_specs = _slot_specs(optimizer, params_shape, specs)
@@ -1060,9 +1095,9 @@ def make_lm_ep_train_step(
         logits, auxs = model.apply_expert_parallel(
             params, tokens, axis, with_aux=True
         )
-        ce = lax.pmean(_ce_from_logits(logits, tokens), axis)
-        balance = lax.pmean(jnp.mean(auxs.balance_loss), axis)
-        z = lax.pmean(jnp.mean(auxs.z_loss), axis)
+        ce = lax.pmean(_ce_from_logits(logits, tokens), axes)
+        balance = lax.pmean(jnp.mean(auxs.balance_loss), axes)
+        z = lax.pmean(jnp.mean(auxs.z_loss), axes)
         return (
             ce
             + model.moe_balance_coef * balance
@@ -1078,7 +1113,7 @@ def make_lm_ep_train_step(
     mapped = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(specs, opt_specs, P(axis)),
+        in_specs=(specs, opt_specs, batch_spec),
         out_specs=(specs, opt_specs, P()),
     )
     return jax.jit(mapped)
@@ -1173,16 +1208,8 @@ def make_lm_pp_train_step(
         lambda: pipeline_stage_params(model, model.init(1), s)
     )
     opt_specs = _slot_specs(optimizer, staged_shape, specs)
-    shardings = jax.tree.map(
-        lambda sp: NamedSharding(mesh, sp),
-        specs,
-        is_leaf=lambda x: isinstance(x, type(P())),
-    )
-    opt_shardings = jax.tree.map(
-        lambda sp: NamedSharding(mesh, sp),
-        opt_specs,
-        is_leaf=lambda x: isinstance(x, type(P())),
-    )
+    shardings = _as_shardings(mesh, specs)
+    opt_shardings = _as_shardings(mesh, opt_specs)
 
     stage_fn = model._pp_stage_fn()
     pp_body = jax.shard_map(
@@ -1201,7 +1228,7 @@ def make_lm_pp_train_step(
         logits = model._logits(params, out.reshape(b, l, -1))
         return _ce_from_logits(logits, tokens)
 
-    @partial(jax.jit, donate_argnums=(0, 1))
+    @jax.jit
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(pp_loss)(params, tokens)
         # Pin grads/params/slots to the stage-owner layout so the update
@@ -1316,7 +1343,14 @@ def make_lm_async_train_step(
     return init_state, step
 
 
-def make_lm_train_step(model: GPTLM, optimizer, mesh=None, axis: str = "data"):
+def make_lm_train_step(
+    model: GPTLM,
+    optimizer,
+    mesh=None,
+    axis: str = "data",
+    *,
+    tp_axis: str | None = None,
+):
     """``step(params, opt_state, tokens) -> (params, opt_state, loss)``,
     jitted, for any optax ``GradientTransformation`` (ops/optim.make).
 
@@ -1329,8 +1363,46 @@ def make_lm_train_step(model: GPTLM, optimizer, mesh=None, axis: str = "data"):
     capacity from the LOCAL batch shard (standard practice), so dp equals
     single-device exactly only in the no-drop regime. Under ``shard_map`` AD auto-inserts a psum for
     grads of the replicated params, so the local grads are *summed* — the
-    code divides by the axis size rather than pmean-ing (CLAUDE.md)."""
+    code divides by the axis size rather than pmean-ing (CLAUDE.md).
+
+    ``tp_axis`` switches to the 2-D dp×tp form: params (and optimizer
+    slots) laid out per :meth:`GPTLM.partition_specs` over ``tp_axis``,
+    batch sharded over ``axis``, and the whole step expressed as ONE
+    GSPMD program — XLA inserts the Megatron collectives (all-reduce
+    after attention-out/MLP-down) and the gradient all-reduce over
+    ``axis``. The math is the single-device step verbatim (GSPMD
+    partitioning preserves semantics), proven in tests/test_gpt.py.
+    Place params with ``jax.device_put`` under the returned layout or let
+    GSPMD reshard on first call; dense models only (MoE → EP)."""
     import optax
+
+    if tp_axis is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if mesh is None:
+            raise ValueError("tp_axis requires a mesh")
+        specs = model.partition_specs(tp_axis)  # raises for MoE blocks
+        opt_specs = _slot_specs(
+            optimizer, jax.eval_shape(model.init, 1), specs
+        )
+        shardings = _as_shardings(mesh, specs)
+        opt_shardings = _as_shardings(mesh, opt_specs)
+        batch_sharding = NamedSharding(mesh, P(axis))
+
+        @jax.jit
+        def step(params, opt_state, tokens):
+            tokens = lax.with_sharding_constraint(tokens, batch_sharding)
+            loss, grads = jax.value_and_grad(model.loss)(params, tokens)
+            # Pin grads/params/slots to the TP layout so the update math
+            # stays local to each device's weight shard.
+            grads = lax.with_sharding_constraint(grads, shardings)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            params = lax.with_sharding_constraint(params, shardings)
+            opt_state = lax.with_sharding_constraint(opt_state, opt_shardings)
+            return params, opt_state, loss
+
+        return step
 
     if mesh is None:
 
