@@ -487,15 +487,20 @@ class GPTLM:
         ``jax.shard_map`` with tokens sharded [B, L/n] per device and params
         replicated; returns this device's logits shard [B, L/n, vocab] —
         identical to the matching slice of :meth:`apply` on the gathered
-        sequence. ``attention`` is ``"ring"`` or ``"ring_flash"`` (default
-        follows ``attention_impl``, like the transformer classifier; the
-        flash variant needs ``check_vma=False`` in the enclosing shard_map
+        sequence. ``attention`` is ``"ring"``, ``"ring_flash"`` or
+        ``"ulysses"`` (default follows ``attention_impl``, like the
+        transformer classifier — whose SP menu this matches; the flash
+        variant needs ``check_vma=False`` in the enclosing shard_map
         off-TPU). This is how the LM trains past one device's activation
         memory: L/n tokens of activations per device, KV blocks riding the
         ring — at ``num_kv_heads`` width under GQA (the repeat to Hq never
         crosses a device), and for windowed models only
         ``ceil((W−1)/L_loc)+1`` hops of it (out-of-band blocks never
-        move)."""
+        move). ``"ulysses"`` instead trades sequence shards for head
+        shards in one all-to-all and runs full-sequence attention locally
+        per head group (windowed models apply the band mask there); it
+        needs the axis size to divide ``num_heads`` AND
+        ``num_kv_heads``."""
         if self.moe_experts is not None:
             # Per-shard capacity/routing order would silently diverge from
             # the dense forward under drops (window+SP, by contrast, is
@@ -508,17 +513,17 @@ class GPTLM:
         from distributed_tensorflow_tpu.ops.ring_attention import (
             ring_attention,
             ring_flash_attention,
+            ulysses_attention,
         )
 
         if attention is None:
             attention = (
                 "ring_flash" if self.attention_impl == "flash" else "ring"
             )
-        if attention not in ("ring", "ring_flash"):
+        if attention not in ("ring", "ring_flash", "ulysses"):
             raise ValueError(
-                f"unknown attention {attention!r}; ring|ring_flash"
+                f"unknown attention {attention!r}; ring|ring_flash|ulysses"
             )
-        ring = ring_attention if attention == "ring" else ring_flash_attention
 
         n = lax.axis_size(axis_name)
         my = lax.axis_index(axis_name)
@@ -530,13 +535,35 @@ class GPTLM:
             raise ValueError(
                 f"global sequence {n * l_loc} exceeds max_len {self.max_len}"
             )
+        if attention == "ulysses" and (
+            self.num_heads % n or self.num_kv_heads % n
+        ):
+            raise ValueError(
+                f"ulysses needs heads ({self.num_heads}) and kv heads "
+                f"({self.num_kv_heads}) divisible by the axis size {n}"
+            )
         positions = my * l_loc + jnp.arange(l_loc)  # absolute, so rope and
         h = self._embed_tokens(params, tokens, positions)  # learned agree
 
-        def sp_attend(q, k, v):
-            # KV circulates at num_kv_heads width; the ring repeats (XLA
-            # ring) or grid-maps (flash ring) locally after each receive.
-            return ring(q, k, v, axis_name, causal=True, window=self.window)
+        if attention == "ulysses":
+
+            def sp_attend(q, k, v):
+                return ulysses_attention(
+                    q, k, v, axis_name, causal=True, window=self.window
+                )
+
+        else:
+            ring = (
+                ring_attention if attention == "ring" else ring_flash_attention
+            )
+
+            def sp_attend(q, k, v):
+                # KV circulates at num_kv_heads width; the ring repeats
+                # (XLA ring) or grid-maps (flash ring) locally after each
+                # receive.
+                return ring(
+                    q, k, v, axis_name, causal=True, window=self.window
+                )
 
         def body(h, blk):
             h, _, _ = self._block(blk, h, attend=sp_attend, positions=positions)
@@ -984,47 +1011,13 @@ def expert_parallel_specs(model: GPTLM, axis_name: str = "expert"):
     )
 
 
-def _as_shardings(mesh, spec_tree):
-    """Spec pytree → NamedSharding pytree over ``mesh`` (the ``is_leaf``
-    guard keeps tree.map from descending into the PartitionSpecs)."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    return jax.tree.map(
-        lambda sp: NamedSharding(mesh, sp),
-        spec_tree,
-        is_leaf=lambda x: isinstance(x, type(P())),
-    )
-
-
-def _slot_specs(optimizer, params_shape, param_specs):
-    """Specs for the optimizer state: each optax slot sharded like the
-    parameter it tracks, scalars replicated. Slots are matched by tree-path
-    suffix (optax moment subtrees mirror the param pytree) — the same
-    matching rule parallel/fsdp.py uses for ZeRO."""
-    from jax.sharding import PartitionSpec as P
-    from jax.tree_util import tree_flatten_with_path
-
-    items = [
-        (tuple(path), leaf.shape, spec)
-        for (path, leaf), spec in zip(
-            tree_flatten_with_path(params_shape)[0],
-            jax.tree.leaves(
-                param_specs, is_leaf=lambda x: isinstance(x, type(P()))
-            ),
-        )
-    ]
-
-    def slot_spec(path, leaf):
-        for ppath, pshape, spec in items:
-            if leaf.shape == pshape and tuple(path[-len(ppath):]) == ppath:
-                return spec
-        return P()
-
-    opt_shape = jax.eval_shape(optimizer.init, params_shape)
-    leaves, treedef = tree_flatten_with_path(opt_shape)
-    return jax.tree.unflatten(
-        treedef, [slot_spec(path, leaf) for path, leaf in leaves]
-    )
+# Generic layout utilities, shared with the LM trainer's ZeRO mode and the
+# rest of the parallel surface (parallel/specs.py is their home).
+from distributed_tensorflow_tpu.parallel.specs import (  # noqa: E402
+    as_shardings as _as_shardings,
+    pinned_update as _pinned_update,
+    slot_specs as _slot_specs,
+)
 
 
 def make_lm_ep_train_step(
@@ -1189,8 +1182,7 @@ def make_lm_pp_train_step(
     the :func:`pipeline_parallel_specs` layout first (or let GSPMD
     reshard on the first call). Proven grad-identical to the sequential
     single-device step in tests/test_gpt.py on 4- and 8-stage meshes."""
-    import optax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from distributed_tensorflow_tpu.parallel.pipeline import (
         microbatch,
@@ -1231,13 +1223,11 @@ def make_lm_pp_train_step(
     @jax.jit
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(pp_loss)(params, tokens)
-        # Pin grads/params/slots to the stage-owner layout so the update
-        # math below stays local to each device's layer group.
-        grads = lax.with_sharding_constraint(grads, shardings)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        params = lax.with_sharding_constraint(params, shardings)
-        opt_state = lax.with_sharding_constraint(opt_state, opt_shardings)
+        # Pin to the stage-owner layout: the update stays local to each
+        # device's layer group.
+        params, opt_state = _pinned_update(
+            optimizer, params, opt_state, grads, shardings, opt_shardings
+        )
         return params, opt_state, loss
 
     return step
@@ -1280,6 +1270,52 @@ def make_lm_async_train_step(
     bitwise-tolerant; with momentum/adam or ``avg_every>1`` it is
     genuinely async (copies diverge between exchanges, the modeled
     race)."""
+    init_state, mapped = make_lm_async_parts(
+        model,
+        optimizer,
+        mesh,
+        axis=axis,
+        avg_every=avg_every,
+        update_scale=update_scale,
+    )
+
+    @partial(jax.jit, donate_argnums=0)
+    def step(state, tokens):
+        params, opt_state, count = state
+        params, opt_state, loss = mapped(
+            params, opt_state, tokens, None, count
+        )
+        return (params, opt_state, count + 1), loss
+
+    return init_state, step
+
+
+def make_lm_async_parts(
+    model: GPTLM,
+    optimizer,
+    mesh,
+    *,
+    axis: str = "data",
+    avg_every: int = 1,
+    update_scale: float | None = None,
+    ragged: bool = False,
+):
+    """Building blocks behind :func:`make_lm_async_train_step`, exposed so
+    the :class:`~train.lm_trainer.LMTrainer` can embed the async local-SGD
+    update inside its scanned-epoch / whole-run-compiled bodies (one
+    ``lax.scan`` over many async steps) instead of paying a dispatch per
+    step. Returns ``(init_state, mapped)``:
+
+    - ``init_state(params, opt_state) -> (stacked_params, stacked_opt,
+      count)`` — per-device copies ([n, ...] leaves sharded over ``axis``)
+      plus the step counter the ``avg_every`` exchange keys on;
+    - ``mapped(stacked_params, stacked_opt, tokens, lengths, count) ->
+      (stacked_params, stacked_opt, loss)`` — NOT jitted (call it inside
+      your own jit/scan); tokens [n·B, L] sharded on the batch dim,
+      ``lengths`` [n·B] for ragged corpora (masked CE per copy) or None
+      (``ragged`` is a factory-time choice — it shapes the shard_map
+      signature); loss is the cross-device mean of the local losses.
+    """
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -1299,10 +1335,15 @@ def make_lm_async_train_step(
         )
         return (*stacked, jnp.zeros((), jnp.int32))
 
-    def local(params, opt_state, tokens, count):
+    def local(params, opt_state, tokens, lens, count):
         p = jax.tree.map(lambda x: x[0], params)
         o = jax.tree.map(lambda x: x[0], opt_state)
-        loss, grads = jax.value_and_grad(model.loss)(p, tokens)
+        loss_fn = (
+            (lambda q: model.loss(q, tokens, lens))
+            if ragged
+            else (lambda q: model.loss(q, tokens))
+        )
+        loss, grads = jax.value_and_grad(loss_fn)(p)
         updates, o = optimizer.update(grads, o, p)
         if update_scale != 1.0:
             updates = jax.tree.map(lambda u: u * update_scale, updates)
@@ -1327,20 +1368,22 @@ def make_lm_async_train_step(
             lax.pmean(loss, axis),
         )
 
-    mapped = jax.shard_map(
+    lens_spec = (P(axis),) if ragged else (P(),)
+    inner = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P()),
+        in_specs=(P(axis), P(axis), P(axis)) + lens_spec + (P(),),
         out_specs=(P(axis), P(axis), P()),
     )
 
-    @partial(jax.jit, donate_argnums=0)
-    def step(state, tokens):
-        params, opt_state, count = state
-        params, opt_state, loss = mapped(params, opt_state, tokens, count)
-        return (params, opt_state, count + 1), loss
+    def mapped(params, opt_state, tokens, lens, count):
+        if lens is None:
+            # Static placeholder: the non-ragged local ignores it, but the
+            # shard_map signature needs a concrete array.
+            lens = jnp.zeros((), jnp.int32)
+        return inner(params, opt_state, tokens, lens, count)
 
-    return init_state, step
+    return init_state, mapped
 
 
 def make_lm_train_step(
@@ -1393,13 +1436,11 @@ def make_lm_train_step(
         def step(params, opt_state, tokens):
             tokens = lax.with_sharding_constraint(tokens, batch_sharding)
             loss, grads = jax.value_and_grad(model.loss)(params, tokens)
-            # Pin grads/params/slots to the TP layout so the update math
-            # stays local to each device's weight shard.
-            grads = lax.with_sharding_constraint(grads, shardings)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            params = lax.with_sharding_constraint(params, shardings)
-            opt_state = lax.with_sharding_constraint(opt_state, opt_shardings)
+            # Pin to the TP layout: the update stays local to each
+            # device's weight shard.
+            params, opt_state = _pinned_update(
+                optimizer, params, opt_state, grads, shardings, opt_shardings
+            )
             return params, opt_state, loss
 
         return step

@@ -451,12 +451,22 @@ def all_to_all_heads_to_seq(x: jax.Array, axis_name: str) -> jax.Array:
     return x.reshape(b, l // n, n * h_loc, d)
 
 
-def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False):
+def ulysses_attention(
+    q, k, v, axis_name: str, *, causal: bool = False,
+    window: int | None = None,
+):
     """Sequence-parallel attention via all-to-all (Ulysses): reshard to
     head-parallel, run dense attention on the full sequence locally, reshard
-    back. Requires H divisible by the axis size."""
+    back. Requires H divisible by the axis size — under GQA, BOTH head
+    counts (k/v trade their own Hkv heads, and the n-chunking of q heads
+    aligns with the kv chunks exactly when n | Hkv: local q head j maps to
+    local kv head j//g, which is ``repeat_kv``'s convention, so the local
+    dense attention needs no cross-device head traffic). ``window`` is the
+    sliding-window mask, applied by the full-sequence local attention (no
+    hop-skipping to reason about — the ring's banding trick has no analog
+    here; Ulysses moves heads, not KV blocks)."""
     q2 = all_to_all_seq_to_heads(q, axis_name)
     k2 = all_to_all_seq_to_heads(k, axis_name)
     v2 = all_to_all_seq_to_heads(v, axis_name)
-    out = dense_attention(q2, k2, v2, causal=causal)
+    out = dense_attention(q2, k2, v2, causal=causal, window=window)
     return all_to_all_heads_to_seq(out, axis_name)

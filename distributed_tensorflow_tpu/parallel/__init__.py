@@ -3,6 +3,11 @@ from distributed_tensorflow_tpu.parallel.fsdp import (  # noqa: F401
     fsdp_specs,
 )
 from distributed_tensorflow_tpu.parallel.mesh import make_mesh  # noqa: F401
+from distributed_tensorflow_tpu.parallel.specs import (  # noqa: F401
+    as_shardings,
+    pinned_update,
+    slot_specs,
+)
 from distributed_tensorflow_tpu.parallel.strategy import (  # noqa: F401
     AsyncDataParallel,
     SingleDevice,

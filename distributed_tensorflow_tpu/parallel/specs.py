@@ -1,0 +1,71 @@
+"""Generic sharding-layout utilities shared across the parallelism
+surfaces (TP/PP/EP step factories in ``models/gpt.py``, the ZeRO mode of
+``train/lm_trainer.py``, ``parallel/fsdp.py``): spec-tree → sharding-tree
+mapping and optimizer-slot spec derivation. No reference analog — the
+reference's only layout machinery is ``replica_device_setter``'s variable
+round-robin (reference tfdist_between.py:32-35); here layouts are
+PartitionSpec pytrees consumed by GSPMD."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def as_shardings(mesh, spec_tree):
+    """Spec pytree → NamedSharding pytree over ``mesh`` (the ``is_leaf``
+    guard keeps tree.map from descending into the PartitionSpecs)."""
+    return jax.tree.map(
+        lambda sp: NamedSharding(mesh, sp),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, type(P())),
+    )
+
+
+def slot_specs(optimizer, params_shape, param_specs):
+    """Specs for the optimizer state: each optax slot sharded like the
+    parameter it tracks, scalars replicated. Slots are matched by tree-path
+    suffix (optax moment subtrees mirror the param pytree) — the same
+    matching rule ``parallel/fsdp.py`` uses for ZeRO; shape-only matching
+    would mislayout same-shaped params with different specs."""
+    from jax.tree_util import tree_flatten_with_path
+
+    items = [
+        (tuple(path), leaf.shape, spec)
+        for (path, leaf), spec in zip(
+            tree_flatten_with_path(params_shape)[0],
+            jax.tree.leaves(
+                param_specs, is_leaf=lambda x: isinstance(x, type(P()))
+            ),
+        )
+    ]
+
+    def slot_spec(path, leaf):
+        for ppath, pshape, spec in items:
+            if leaf.shape == pshape and tuple(path[-len(ppath):]) == ppath:
+                return spec
+        return P()
+
+    opt_shape = jax.eval_shape(optimizer.init, params_shape)
+    leaves, treedef = tree_flatten_with_path(opt_shape)
+    return jax.tree.unflatten(
+        treedef, [slot_spec(path, leaf) for path, leaf in leaves]
+    )
+
+
+def pinned_update(optimizer, params, opt_state, grads, shardings,
+                  opt_shardings):
+    """The ONE pin-grads → update → pin-params-and-slots sequence every
+    sharded-layout train step uses (TP, PP, the LM trainer's ZeRO eager
+    and scanned bodies — a divergence between copies would silently break
+    their proven equality): constrain grads to the owner layout so the
+    batch reduction lowers onto it (e.g. reduce-scatter under ZeRO), run
+    the optax update locally on each shard, and pin the results back."""
+    import optax
+
+    grads = jax.lax.with_sharding_constraint(grads, shardings)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    params = jax.lax.with_sharding_constraint(params, shardings)
+    opt_state = jax.lax.with_sharding_constraint(opt_state, opt_shardings)
+    return params, opt_state

@@ -28,6 +28,28 @@ shards each gathered batch over ``data`` via a sharding constraint and
 lets GSPMD insert the gradient all-reduce; both equal the single-device
 math on the global batch. Ragged corpora (datasets with ``lengths``) train
 through the masked loss end to end.
+
+**Mode matrix** (round 4 — the same selection surface the classifier
+Trainer gets from ``TrainConfig``; the reference picked its mode by
+picking which script to launch, reference README.md:90-121):
+
+- ``mesh=None`` → **single** device;
+- ``mesh`` + ``config.sync=True`` + ``dp_mode="replicated"`` → **dp**
+  (gradient all-reduce, the reference's sync mode);
+- ``mesh`` + ``config.sync=True`` + ``dp_mode="zero"`` → **zero**
+  (ZeRO: params AND optimizer slots sharded over ``data`` via
+  ``parallel/fsdp.fsdp_specs``, all-gather fwd/bwd + reduce-scatter
+  grads — identical update semantics to dp);
+- ``mesh`` + ``config.sync=False`` → **async** local-SGD
+  (``models/gpt.make_lm_async_parts``: per-device parameter copies,
+  exchange to the mean every ``config.async_avg_every`` steps, the
+  reference's HOGWILD table emulated as in ``AsyncDataParallel``;
+  held-out perplexity is evaluated at the mean of the copies, and
+  ``update_scale`` defaults to N like every async API here).
+
+Every mode runs the FULL lifecycle: log lines, per-epoch perplexity,
+tfevents, Supervisor save/restore (async checkpoints the stacked copies;
+zero checkpoints sharded arrays), the scanned epoch, and run_compiled.
 """
 
 from __future__ import annotations
@@ -63,6 +85,7 @@ class LMTrainer:
         is_chief: bool = True,
         eval_batch: int = 256,
         print_fn=print,
+        async_update_scale: float | None = None,
     ):
         self.model = model
         self.datasets = datasets
@@ -76,12 +99,11 @@ class LMTrainer:
         self.is_chief = is_chief
         self.eval_batch = eval_batch
         self.print_fn = print_fn
+        self.async_update_scale = async_update_scale
         self._ragged = datasets.train.lengths is not None
+        self.mode = self._resolve_mode()
 
-        params = model.init(seed=self.config.seed)
-        self.state = TrainState(
-            params, self.optimizer.init(params), jnp.zeros((), jnp.int32)
-        )
+        self.state = self._init_state(model.init(seed=self.config.seed))
         self._eager_step = None  # built lazily (scanned path may not need it)
         self._scanned_fn = None
         self._eval_chunk = None
@@ -97,6 +119,7 @@ class LMTrainer:
             self.state, self.start_step = self.supervisor.prepare_or_restore(
                 self.state
             )
+            self.state = self._place_state(self.state)
             # Fast-forward the host-side index stream so a resumed run
             # draws exactly the batches the uninterrupted run would (the
             # reference resumed against live PS state; the TPU-native
@@ -116,6 +139,116 @@ class LMTrainer:
 
         self.last_cost = None
         self.history: list[dict] = []
+
+    # -- modes -------------------------------------------------------------
+
+    def _resolve_mode(self) -> str:
+        cfg = self.config
+        if cfg.dp_mode not in ("replicated", "zero"):
+            raise ValueError(
+                f"unknown dp_mode {cfg.dp_mode!r}; replicated|zero"
+            )
+        if self.mesh is None:
+            return "single"
+        if not cfg.sync:
+            if cfg.dp_mode == "zero":
+                # Fail loudly rather than silently train full replicated
+                # per-chip copies under a config that asked for ZeRO.
+                raise ValueError(
+                    "dp_mode='zero' does not compose with sync=False: the "
+                    "async copies are per-chip by construction; pick one"
+                )
+            if cfg.batch_size % self.mesh.shape[self.data_axis]:
+                raise ValueError(
+                    f"async mode shards the batch over {self.data_axis!r}: "
+                    f"batch_size {cfg.batch_size} must be divisible by the "
+                    f"axis size {self.mesh.shape[self.data_axis]}"
+                )
+            return "async"
+        if cfg.dp_mode == "zero":
+            return "zero"
+        return "dp"
+
+    def _init_state(self, params) -> TrainState:
+        opt_state = self.optimizer.init(params)
+        if self.mode == "zero":
+            from distributed_tensorflow_tpu.parallel import (
+                as_shardings,
+                fsdp_specs,
+                slot_specs,
+            )
+
+            pshape = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params
+            )
+            pspecs = fsdp_specs(pshape, self.mesh, axis=self.data_axis)
+            self._zero_shardings = as_shardings(self.mesh, pspecs)
+            self._zero_opt_shardings = as_shardings(
+                self.mesh, slot_specs(self.optimizer, pshape, pspecs)
+            )
+            return TrainState(
+                jax.device_put(params, self._zero_shardings),
+                jax.device_put(opt_state, self._zero_opt_shardings),
+                jnp.zeros((), jnp.int32),
+            )
+        if self.mode == "async":
+            from distributed_tensorflow_tpu.models.gpt import (
+                make_lm_async_parts,
+            )
+
+            init_state, self._async_mapped = make_lm_async_parts(
+                self.model,
+                self.optimizer,
+                self.mesh,
+                axis=self.data_axis,
+                # async_avg_every=0 means "never exchange" (classifier
+                # convention) — key the cond on an unreachable period.
+                avg_every=self.config.async_avg_every or (1 << 30),
+                update_scale=self.async_update_scale,
+                ragged=self._ragged,
+            )
+            stacked_p, stacked_o, count = init_state(params, opt_state)
+            return TrainState(stacked_p, stacked_o, count)
+        return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+
+    def _place_state(self, state: TrainState) -> TrainState:
+        """Re-place a state pytree into the mode's device layout. Needed
+        after Supervisor restore: orbax hands back arrays committed to the
+        default device, and a committed single-device leaf conflicts with
+        the mesh-placed staging arrays under jit ("incompatible devices").
+        Idempotent for already-placed states."""
+        if self.mesh is None:
+            return state
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(self.mesh, P())
+        if self.mode == "zero":
+            return TrainState(
+                jax.device_put(state.params, self._zero_shardings),
+                jax.device_put(state.opt_state, self._zero_opt_shardings),
+                jax.device_put(state.step, repl),
+            )
+        if self.mode == "async":
+            stacked = NamedSharding(self.mesh, P(self.data_axis))
+            return TrainState(
+                jax.device_put(state.params, stacked),
+                jax.device_put(state.opt_state, stacked),
+                jax.device_put(state.step, repl),
+            )
+        return TrainState(
+            jax.device_put(state.params, repl),
+            jax.device_put(state.opt_state, repl),
+            jax.device_put(state.step, repl),
+        )
+
+    def _eval_params(self, params):
+        """Parameters the held-out metric is computed at: async evaluates
+        the mean of the per-chip copies (strategy.py convention), every
+        other mode the parameters themselves. Works traced (the compiled
+        run folds in-graph) and concrete alike."""
+        if self.mode == "async":
+            return jax.tree.map(lambda x: jnp.mean(x, axis=0), params)
+        return params
 
     # -- compiled pieces ---------------------------------------------------
 
@@ -166,13 +299,51 @@ class LMTrainer:
         return self.model.loss(params, toks, lens)
 
     def _build_eager_step(self):
+        """One per-batch jitted step, uniform across modes:
+        ``step(params, opt_state, count, toks, lens) -> (params, opt_state,
+        loss)`` (``count`` drives the async exchange cadence; the sync
+        modes ignore it)."""
+        if self.mode == "async":
+            mapped = self._async_mapped
+            ragged = self._ragged
+
+            @jax.jit
+            def astep(params, opt_state, count, toks, lens):
+                return mapped(
+                    params, opt_state, toks, lens if ragged else None, count
+                )
+
+            return astep
+        if self.mode == "zero":
+            from distributed_tensorflow_tpu.parallel import pinned_update
+
+            model, opt = self.model, self.optimizer
+            shardings = self._zero_shardings
+            opt_shardings = self._zero_opt_shardings
+            shard = self._shard_batch
+
+            @jax.jit
+            def zstep(params, opt_state, count, toks, lens):
+                toks = shard(toks)
+                loss, grads = jax.value_and_grad(model.loss)(
+                    params, toks, lens
+                )
+                # Owner layout: the batch-sum over 'data' lowers to a
+                # reduce-scatter, the update stays local to each chip's
+                # slice (parallel/fsdp.py rationale).
+                params, opt_state = pinned_update(
+                    opt, params, opt_state, grads, shardings, opt_shardings
+                )
+                return params, opt_state, loss
+
+            return zstep
         if self._ragged:
             # make_lm_train_step has no lengths slot; build the equivalent
             # jitted step over (tokens, lengths) with the masked loss.
             model, opt = self.model, self.optimizer
 
             @jax.jit
-            def step(params, opt_state, toks, lens):
+            def step(params, opt_state, count, toks, lens):
                 loss, grads = jax.value_and_grad(model.loss)(
                     params, toks, lens
                 )
@@ -183,27 +354,52 @@ class LMTrainer:
             return step
         plain = make_lm_train_step(self.model, self.optimizer, mesh=self.mesh)
 
-        def step(params, opt_state, toks, lens):
+        def step(params, opt_state, count, toks, lens):
             return plain(params, opt_state, toks)
 
         return step
 
     def _make_step_body(self, toks_all, lens_all):
-        """The ONE compiled SGD step body shared by the scanned-epoch and
-        whole-run paths (a divergence here would silently break their
+        """The ONE compiled step body per mode, shared by the scanned-epoch
+        and whole-run paths (a divergence here would silently break their
         proven equality): gather the batch by index from the staged
-        arrays, shard it over the mesh, masked loss when ragged."""
+        arrays, shard it over the mesh, masked loss when ragged; the async
+        body is the shard-mapped local-SGD update keyed on the carried
+        step count, the zero body pins grads/params/slots to the FSDP
+        layout so the carry stays sharded across the whole scan."""
         model, opt = self.model, self.optimizer
         ragged = self._ragged
         shard = self._shard_batch
+        if self.mode == "async":
+            mapped = self._async_mapped
+
+            def abody(carry, idx):
+                params, opt_state, step = carry
+                toks = toks_all[idx]
+                lens = lens_all[idx] if ragged else None
+                params, opt_state, loss = mapped(
+                    params, opt_state, toks, lens, step
+                )
+                return (params, opt_state, step + 1), loss
+
+            return abody
+        zero = self.mode == "zero"
+        if zero:
+            from distributed_tensorflow_tpu.parallel import pinned_update
 
         def body(carry, idx):
             params, opt_state, step = carry
             toks = shard(toks_all[idx])
             lens = lens_all[idx] if ragged else None
             loss, grads = jax.value_and_grad(model.loss)(params, toks, lens)
-            updates, opt_state = opt.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
+            if zero:
+                params, opt_state = pinned_update(
+                    opt, params, opt_state, grads,
+                    self._zero_shardings, self._zero_opt_shardings,
+                )
+            else:
+                updates, opt_state = opt.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
             return (params, opt_state, step + 1), loss
 
         return body
@@ -267,7 +463,9 @@ class LMTrainer:
 
             def epoch_body(carry, epoch_idxs):
                 carry, losses = jax.lax.scan(step_body, carry, epoch_idxs)
-                ppl = self._in_graph_perplexity(carry[0], val_toks, val_lens)
+                ppl = self._in_graph_perplexity(
+                    self._eval_params(carry[0]), val_toks, val_lens
+                )
                 return carry, (losses, ppl)
 
             carry = (state.params, state.opt_state, state.step)
@@ -389,6 +587,13 @@ class LMTrainer:
         """Held-out perplexity = exp(total next-token CE / total targets)."""
         if self._eval_chunk is None:
             self._eval_chunk = self._build_eval_chunk()
+        params = self.state.params
+        if self.mode == "async":
+            # Fold the stacked copies to their mean ONCE per evaluate call
+            # (not per chunk) — the parameters the metric is defined at.
+            if not hasattr(self, "_fold_fn"):
+                self._fold_fn = jax.jit(self._eval_params)
+            params = self._fold_fn(params)
         ds = getattr(self.datasets, split)
         toks = self._stage(f"{split}_tokens", ds.tokens)
         lens = (
@@ -404,7 +609,7 @@ class LMTrainer:
             hi = min(lo + b, ds.num_examples)
             t = jax.lax.slice_in_dim(toks, lo, hi)
             ln = jax.lax.slice_in_dim(lens, lo, hi) if self._ragged else None
-            s, c = self._eval_chunk(self.state.params, t, ln)
+            s, c = self._eval_chunk(params, t, ln)
             total += float(s)
             count += int(c)
         return float(np.exp(total / max(count, 1)))
@@ -459,6 +664,7 @@ class LMTrainer:
                 params, opt_state, cost = self._eager_step(
                     self.state.params,
                     self.state.opt_state,
+                    self.state.step,
                     jnp.asarray(toks),
                     None if lens is None else jnp.asarray(lens),
                 )
