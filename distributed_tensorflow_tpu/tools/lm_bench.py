@@ -150,21 +150,52 @@ def bench_decode(name: str, *, seed: int = 0) -> dict:
     prompt = jax.random.randint(
         jax.random.key(seed), (b, p_len), 0, _VOCAB, jnp.int32
     )
-    gen = jax.jit(lambda pr, t: model.greedy_decode(pr, t, max_new))
-    out = gen(params, prompt)
-    _ = int(out[-1, -1])  # compile + D2H barrier
-    t0 = time.perf_counter()
-    out = gen(params, prompt)
-    _ = int(out[-1, -1])
-    dt = time.perf_counter() - t0
+    # Two-point (utils/sync.two_point_seconds): difference a max_new-token
+    # and a short-token decode — cancels the tunnel roundtrip AND the
+    # shared prefill, leaving pure per-token decode cost. Fast decodes
+    # (windowed, GQA) run tens of µs/token, so one generation's delta sits
+    # BELOW the ~±10 ms dispatch jitter (a committed record briefly showed
+    # a 13x phantom speedup from exactly this); chain `reps_in` full
+    # generations per dispatch — each rep's prompt is the previous rep's
+    # tail, a genuine dependency XLA cannot CSE — so the differenced span
+    # is reps_in·(max_new−short) tokens.
+    from distributed_tensorflow_tpu.utils.sync import (
+        timed_fetch,
+        two_point_seconds,
+    )
+
+    short = max_new // 4
+    reps_in = 8
+
+    def make_chain(new_tokens):
+        @jax.jit
+        def chain(pr):
+            def body(pr, _):
+                out = model.greedy_decode(params, pr, new_tokens)
+                return out[:, -p_len:].astype(pr.dtype), None
+
+            pr, _ = lax.scan(body, pr, None, length=reps_in)
+            return pr
+
+        return chain
+
+    gen1, gen4 = make_chain(short), make_chain(max_new)
+
+    def timed(fn):
+        return lambda: timed_fetch(fn, prompt)[0]
+
+    timed(gen1)(), timed(gen4)()  # compile both
+    sec_per_tok = two_point_seconds(
+        timed(gen1), timed(gen4), reps_in * (max_new - short), reps=3
+    )
     return {
         "config": name,
         "batch": b,
         "prompt": p_len,
         "max_new": max_new,
         "cache_len": model.cache_len,
-        "ms_per_token": round(dt * 1e3 / max_new, 3),
-        "gen_tokens_per_sec": round(b * max_new / dt, 1),
+        "ms_per_token": round(sec_per_tok * 1e3, 3),
+        "gen_tokens_per_sec": round(b / sec_per_tok, 1),
     }
 
 
@@ -183,7 +214,8 @@ def render_decode(rows) -> str:
 
 
 def bench_config(
-    name: str, *, steps: int = 32, lr: float = 1e-3, seed: int = 0
+    name: str, *, steps: int = 32, lr: float = 1e-3, seed: int = 0,
+    ceiling_tflops: float | None = None,
 ) -> dict:
     spec = CONFIGS[name]
     model = GPTLM(vocab_size=_VOCAB, **spec["model"])
@@ -195,34 +227,66 @@ def bench_config(
         jax.random.key(seed), (b, l), 0, _VOCAB, jnp.int32
     )
 
-    @jax.jit
-    def epoch(params, opt_state, tokens):
-        def body(carry, _):
-            params, opt_state = carry
-            loss, grads = jax.value_and_grad(model.loss)(params, tokens)
-            updates, opt_state = opt.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return (params, opt_state), loss
+    def make_epoch(length):
+        @jax.jit
+        def epoch(params, opt_state, tokens):
+            def body(carry, _):
+                params, opt_state = carry
+                loss, grads = jax.value_and_grad(model.loss)(params, tokens)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), loss
 
-        (params, opt_state), losses = lax.scan(
-            body, (params, opt_state), None, length=steps
-        )
-        return params, opt_state, losses
+            (params, opt_state), losses = lax.scan(
+                body, (params, opt_state), None, length=length
+            )
+            return params, opt_state, losses
 
-    p, o, losses = epoch(params, opt_state, tokens)  # compile + warm
-    _ = float(losses[-1])  # D2H barrier (CLAUDE.md timing trap)
-    t0 = time.perf_counter()
-    p, o, losses = epoch(params, opt_state, tokens)
-    final_loss = float(losses[-1])
-    dt = time.perf_counter() - t0
+        return epoch
 
-    step_ms = dt * 1e3 / steps
+    # TWO-POINT timing (tools/roofline_bench.py rationale): one
+    # dispatch+fetch through the tunnel carries a ~100 ms fixed roundtrip;
+    # dividing a single chain's wall time by `steps` folds that roundtrip
+    # into every step (the round-3 numbers did exactly this — at 5-50 ms
+    # true step times it inflated them by 10-100%, which is what the
+    # "effective ceiling" story was built on). Difference a 4k-step and a
+    # k-step warm dispatch instead; median over reps vs tunnel jitter.
+    e1, e4 = make_epoch(steps), make_epoch(4 * steps)
+
+    from distributed_tensorflow_tpu.utils.sync import (
+        timed_fetch,
+        two_point_seconds,
+    )
+
+    last = {}
+
+    def timed(fn):
+        def run():
+            dt, out = timed_fetch(fn, params, opt_state, tokens)
+            last[fn] = float(out[2][-1])  # after the barrier: losses[-1]
+            return dt
+
+        return run
+
+    timed(e1)(), timed(e4)()  # compile + warm (fetch = barrier)
+    sec_per_step = two_point_seconds(
+        timed(e1), timed(e4), 3 * steps, reps=3
+    )
+    # The loss after exactly `steps` steps (e1's chain) — the field's
+    # meaning must track steps_per_dispatch, not the 4x timing chain.
+    final_loss = last[e1]
+    dt = sec_per_step * steps
+
+    step_ms = sec_per_step * 1e3
     tokens_per_sec = b * l * steps / dt
     row = {
         "config": name,
         "batch": b,
         "seq_len": l,
         "steps_per_dispatch": steps,
+        # Measurement provenance — carried-forward rows in a chunked
+        # regeneration keep their own method/steps (see --write-docs).
+        "timing": f"two-point d({4 * steps}-{steps})x3",
         "step_ms": round(step_ms, 3),
         "tokens_per_sec": round(tokens_per_sec, 1),
         "final_loss": round(final_loss, 4),
@@ -233,18 +297,43 @@ def bench_config(
     row["param_count"] = report["param_count"]
     peaks = _chip_peaks(jax.devices()[0])
     if peaks and report["flops_per_step"]:
-        achieved = report["flops_per_step"] / (dt / steps)
+        achieved = report["flops_per_step"] / sec_per_step
         row["mfu_pct"] = round(100 * achieved / peaks["flops"], 2)
+        # MFU* — against the MEASURED bf16 ceiling (tools/roofline_bench),
+        # not the spec sheet: 100% means the step saturates what this
+        # chip+tunnel actually sustains on pure matmul chains.
+        if ceiling_tflops:
+            row["mfu_star_pct"] = round(
+                100 * achieved / (ceiling_tflops * 1e12), 2
+            )
+        else:
+            row["mfu_star_pct"] = None
     else:
         row["mfu_pct"] = None
+        row["mfu_star_pct"] = None
     return row
 
 
-def run(configs=None, *, steps: int = 32) -> list[dict]:
+def _roofline_ceiling() -> float | None:
+    """Measured bf16 ceiling from the committed roofline record, if any."""
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "..", "docs", "benchmarks",
+        "roofline_tpu.json",
+    )
+    try:
+        with open(path) as f:
+            return json.load(f).get("ceiling_bf16_tflops")
+    except Exception:
+        return None
+
+
+def run(configs=None, *, steps: int = 32, ceiling_tflops=None) -> list[dict]:
     rows = []
     for name in configs or CONFIGS:
         try:
-            rows.append(bench_config(name, steps=steps))
+            rows.append(
+                bench_config(name, steps=steps, ceiling_tflops=ceiling_tflops)
+            )
         except Exception as exc:  # noqa: BLE001 — record, keep sweeping
             rows.append(
                 {"config": name, "error": f"{type(exc).__name__}: {exc}"[:200]}
@@ -254,17 +343,21 @@ def run(configs=None, *, steps: int = 32) -> list[dict]:
 
 def render(rows) -> str:
     cols = [
-        "config", "B", "L", "step (ms)", "tokens/s", "MFU %", "params",
+        "config", "B", "L", "step (ms)", "tokens/s", "MFU %", "MFU* %",
+        "params",
     ]
     out = ["| " + " | ".join(cols) + " |", "|" + "---|" * len(cols)]
     for r in rows:
         if "error" in r:
-            out.append(f"| {r['config']} | error: {r['error']} |" + " |" * 5)
+            out.append(f"| {r['config']} | error: {r['error']} |" + " |" * 6)
             continue
+        fmt = lambda v: ("%.1f" % v) if v is not None else "—"  # noqa: E731
         out.append(
             "| {config} | {batch} | {seq_len} | {step_ms:.2f} | "
-            "{tokens_per_sec:,.0f} | {mfu} | {param_count:,} |".format(
-                mfu=("%.1f" % r["mfu_pct"]) if r["mfu_pct"] is not None else "—",
+            "{tokens_per_sec:,.0f} | {mfu} | {mfu_star} | "
+            "{param_count:,} |".format(
+                mfu=fmt(r["mfu_pct"]),
+                mfu_star=fmt(r.get("mfu_star_pct")),
                 **r,
             )
         )
@@ -285,10 +378,21 @@ def main(argv=None) -> None:
         action="store_true",
         help="also run the KV-cache generation configs",
     )
+    ap.add_argument(
+        "--ceiling-tflops",
+        type=float,
+        default=None,
+        help="measured bf16 ceiling for the MFU* column (default: read "
+        "docs/benchmarks/roofline_tpu.json)",
+    )
     args = ap.parse_args(argv)
-    rows = run(args.configs, steps=args.steps)
+    ceiling = args.ceiling_tflops or _roofline_ceiling()
+    rows = run(args.configs, steps=args.steps, ceiling_tflops=ceiling)
     device = jax.devices()[0].device_kind
-    print(f"device: {device}  steps/dispatch: {args.steps}")
+    print(
+        f"device: {device}  steps/dispatch: {args.steps}  measured "
+        f"ceiling: {f'{ceiling} TFLOPS' if ceiling else 'none (run roofline_bench)'}"
+    )
     table = render(rows)
     print(table)
     decode_rows = []
@@ -311,15 +415,68 @@ def main(argv=None) -> None:
         root = os.path.join(os.path.dirname(__file__), "..", "..", "docs", "benchmarks")
         root = os.path.abspath(root)
         json_path = os.path.join(root, "lm_tpu.json")
-        if not decode_rows and os.path.exists(json_path):
-            # A regeneration run without --decode must not erase the decode
-            # record — carry the previous rows forward.
+        if os.path.exists(json_path):
+            # Partial regeneration (a --configs subset, or no --decode)
+            # must not erase the rest of the record: carry forward prior
+            # rows for configs not re-measured this run. The full sweep
+            # exceeds one tunnel session's budget, so the record is
+            # routinely rebuilt in chunks. Error rows never displace a
+            # previously committed good measurement — a transient tunnel
+            # failure during a touch-up run must not erase the record —
+            # and an unreadable prior record REFUSES to overwrite (a
+            # truncated json from an interrupted write would otherwise
+            # silently drop every config not re-measured this run).
             try:
                 with open(json_path) as f:
-                    decode_rows = json.load(f).get("decode_rows", [])
-                payload["decode_rows"] = decode_rows
-            except Exception:
-                pass
+                    prev = json.load(f)
+            except Exception as exc:
+                print(
+                    f"REFUSING to write docs: existing {json_path} is "
+                    f"unreadable ({type(exc).__name__}: {exc}) and a "
+                    "partial run would erase its other configs; move it "
+                    "aside to regenerate from scratch"
+                )
+                return
+
+            def merge(new, old, order):
+                old_good = {
+                    r["config"]: r for r in old if "error" not in r
+                }
+                new_good = {r["config"] for r in new if "error" not in r}
+                out = [
+                    r for r in new
+                    if "error" not in r or r["config"] not in old_good
+                ] + [
+                    r for c, r in old_good.items() if c not in new_good
+                ]
+                out.sort(key=lambda r: order.index(r["config"])
+                         if r.get("config") in order else len(order))
+                return out
+
+            rows = merge(rows, prev.get("rows", []), list(CONFIGS))
+            # Carried rows keep their measured times but their MFU* must
+            # track the CURRENT ceiling, or a roofline re-measure would
+            # leave the table silently mixing denominators.
+            peaks = _chip_peaks(jax.devices()[0]) or {}
+            for r in rows:
+                if "error" in r or not r.get("flops_per_step"):
+                    continue
+                achieved = r["flops_per_step"] / (r["step_ms"] / 1e3)
+                if ceiling:
+                    r["mfu_star_pct"] = round(
+                        100 * achieved / (ceiling * 1e12), 2
+                    )
+                if peaks.get("flops"):
+                    r["mfu_pct"] = round(
+                        100 * achieved / peaks["flops"], 2
+                    )
+            payload["rows"] = rows
+            table = render(rows)
+            decode_rows = merge(
+                decode_rows, prev.get("decode_rows", []),
+                list(DECODE_CONFIGS),
+            )
+            payload["decode_rows"] = decode_rows
         with open(json_path, "w") as f:
             json.dump(payload, f, indent=1)
         cmd_flags = f"--steps {args.steps}" + (" --decode" if args.decode else "")
@@ -328,27 +485,40 @@ def main(argv=None) -> None:
                 "# LM training on one TPU chip\n\n"
                 f"Generated by `python -m distributed_tensorflow_tpu.tools."
                 f"lm_bench {cmd_flags} --write-docs` on {device} "
-                "(bf16 matmuls, adam, vocab 8192; "
-                f"{args.steps} steps amortized per dispatch, D2H-barrier "
-                "timing; MFU = XLA-counted FLOPs / measured step time / "
-                "chip peak).\n\n" + table + "\n\n"
+                "(bf16 matmuls, adam, vocab 8192; two-point timing — per "
+                "row, step time is the Δ between a 4k- and a k-step warm "
+                "dispatch over 3k with D2H-fetch barriers, k and the "
+                "method recorded per row in lm_tpu.json `timing` — rows "
+                "may come from different chunked runs; MFU = XLA-counted "
+                "FLOPs / measured step time / v5e spec peak"
+                + (
+                    ", MFU* = the same against the MEASURED bf16 ceiling "
+                    f"({ceiling} TFLOPS, docs/benchmarks/roofline_tpu.md)"
+                    if ceiling
+                    else "; MFU* is dashed — no measured roofline record; "
+                    "run tools/roofline_bench --write-docs first"
+                )
+                + ".\n\n" + table + "\n\n"
                 + (
                     "## Generation (KV-cache greedy decode, one compiled "
                     "scan)\n\n" + render_decode(decode_rows) + "\n\n"
                     if decode_rows
                     else ""
                 )
-                + "Reading the MFU column: it is computed against the v5e "
-                "SPEC peak (197 bf16 TFLOPS). The tunneled chip in this "
-                "environment delivers a single-digit-TFLOPS effective "
-                "ceiling on EVERY workload — the whole-epoch Pallas MLP "
-                "kernel's 10M ex/s headline is likewise ~2.5% of spec "
-                "peak, and the flash kernel's fastest attention dispatch "
-                "sustains ~15 TFLOPS — and MFU here is batch-invariant "
-                "(4x the batch moved tokens/s not at all), i.e. the "
-                "environment, not arithmetic shape, pins it. Compare "
-                "configs against each other; treat the absolute MFU as "
-                "this environment's ceiling, not the kernels'.\n"
+                + "Reading the MFU columns: the measured roofline "
+                "(roofline_tpu.md) showed the tunneled chip sustains "
+                "~98% of spec peak on pure matmul chains — the round-3 "
+                "claim that 'the environment pins MFU at 1-2.5%' was a "
+                "measurement artifact (the ~100 ms dispatch+fetch "
+                "roundtrip was being divided into every step; the "
+                "two-point method cancels it). What remains between "
+                "these MFU* numbers and 100% is the WORKLOAD: toy "
+                "widths (d=256-1024 matmuls tile the MXU poorly next "
+                "to the roofline's 4096² chains), attention/layernorm/"
+                "loss bandwidth-bound phases, and per-step optimizer "
+                "traffic. Compare configs against each other AND "
+                "against MFU*=100 — both comparisons are now "
+                "meaningful.\n"
             )
         print(f"wrote {root}/lm_tpu.md and lm_tpu.json")
 

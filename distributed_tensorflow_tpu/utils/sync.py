@@ -16,8 +16,52 @@ model the fetch must be explicit.
 
 from __future__ import annotations
 
+import time
+
 import jax
+import jax.numpy as jnp
 import numpy as np
+
+
+def timed_fetch(fn, *args):
+    """Run ``fn(*args)`` and return ``(seconds, result)`` with the clock
+    read AFTER a one-scalar D2H fetch of the result — the ONE audited
+    dispatch-timing wrapper (three hand copies of this four-liner existed
+    and one of them read the clock before the fetch, timing enqueue; the
+    round-4 trap CLAUDE.md documents). The barrier fetches a single
+    element of the first array leaf (4 bytes through the ~6 MB/s tunnel —
+    never the whole buffer): any output element becomes available only
+    when the whole dispatch has executed."""
+    t0 = time.perf_counter()
+    out = fn(*args)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(jnp.reshape(leaf, (-1,))[0].astype(jnp.float32))
+    return time.perf_counter() - t0, out
+
+
+def two_point_seconds(time_short, time_long, span: int, reps: int = 5) -> float:
+    """Per-unit seconds by the TWO-POINT method — the ONE audited
+    implementation of the round-4 timing discipline (CLAUDE.md TIMING TRAP
+    2; three hand copies had already drifted to reps 7/3/5 and one sized
+    its span below the jitter floor).
+
+    Each tunnel dispatch+fetch carries a ~100 ms fixed roundtrip with
+    ~±10 ms jitter; dividing one chain's wall time by its length folds the
+    roundtrip into every unit. Instead call ``time_short()`` and
+    ``time_long()`` (each a full timed dispatch whose clock reads AFTER a
+    D2H value fetch) and divide the difference by ``span`` (the extra
+    units the long chain runs). Median over ``reps`` resists the jitter;
+    the caller must size ``span`` so the differenced wall time dwarfs
+    ~±10 ms — negative medians (span below the noise floor) are clamped
+    to 1e-12, so a 0.0-looking result means "span too small", not "free".
+    """
+    deltas = []
+    for _ in range(reps):
+        t_short = time_short()
+        t_long = time_long()
+        deltas.append((t_long - t_short) / span)
+    deltas.sort()
+    return max(deltas[len(deltas) // 2], 1e-12)
 
 
 def d2h_barrier(tree) -> None:

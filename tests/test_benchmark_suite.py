@@ -136,16 +136,43 @@ def test_attention_bench_smoke(capsys):
     assert all("flash_ms" in r for r in payload["rows"])
 
 
-def test_lm_bench_smoke(capsys):
-    # Smallest config, 2 steps, on CPU: the tool must produce a table row
-    # with throughput + MFU fields and valid JSON.
+def test_lm_bench_smoke(capsys, monkeypatch):
+    # A micro config injected into the grid, 2 steps, on CPU: the tool must
+    # produce a table row with throughput + MFU fields and valid JSON.
+    # (This test once ran the real gpt-s config on CPU — 21 MINUTES, half
+    # the whole suite; the smoke's job is the tool's plumbing, not the
+    # model. The real configs are measured on the chip by --write-docs.)
     from distributed_tensorflow_tpu.tools import lm_bench
 
-    lm_bench.main(["--configs", "gpt-s-L512-xla", "--steps", "2"])
+    monkeypatch.setitem(
+        lm_bench.CONFIGS,
+        "micro",
+        dict(
+            batch=4,
+            model=dict(model_dim=32, num_layers=1, num_heads=4, max_len=32),
+        ),
+    )
+    monkeypatch.setattr(lm_bench, "_VOCAB", 64)
+    monkeypatch.setattr(
+        lm_bench,
+        "DECODE_CONFIGS",
+        {
+            "micro-decode": dict(
+                batch=2, prompt=8, max_new=8,
+                model=dict(
+                    model_dim=32, num_layers=1, num_heads=4, max_len=32
+                ),
+            )
+        },
+    )
+    lm_bench.main(["--configs", "micro", "--steps", "2", "--decode"])
     out = capsys.readouterr().out
-    assert "gpt-s-L512-xla" in out
+    assert "micro" in out
     import json as _json
 
     payload = _json.loads(out.strip().splitlines()[-1])
     (row,) = payload["rows"]
     assert row["tokens_per_sec"] > 0 and row["flops_per_step"] > 0
+    assert row["timing"].startswith("two-point")
+    (drow,) = payload["decode_rows"]
+    assert drow["gen_tokens_per_sec"] > 0
