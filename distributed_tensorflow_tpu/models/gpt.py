@@ -139,6 +139,7 @@ class GPTLM:
         moe_z_coef: float = 1e-3,
         pos_embedding: str = "learned",
         remat: bool = False,
+        flash_min_len: int | None = None,
     ):
         assert model_dim % num_heads == 0
         if attention_impl not in ("xla", "flash"):
@@ -184,6 +185,19 @@ class GPTLM:
         self.moe_balance_coef = moe_balance_coef
         self.moe_z_coef = moe_z_coef
         self.pos_embedding = pos_embedding
+        # attention_impl="flash" applies the kernel only at
+        # L >= flash_min_len and falls back to the mathematically
+        # identical dense path below. None → the ONE measured crossover
+        # shared by every model (ops/pallas_attention.FLASH_MIN_LEN — its
+        # comment has the numbers and the re-measure tool); 0 forces the
+        # kernel at every length (tests do, to exercise it at toy L).
+        if flash_min_len is None:
+            from distributed_tensorflow_tpu.ops.pallas_attention import (
+                FLASH_MIN_LEN,
+            )
+
+            flash_min_len = FLASH_MIN_LEN
+        self.flash_min_len = flash_min_len
         # jax.checkpoint around each scanned block: activation memory drops
         # from O(num_layers · L · d) to O(L · d) + one block's recompute per
         # layer in the backward — the standard long-context memory/FLOPs
@@ -306,7 +320,10 @@ class GPTLM:
         )
 
     def _attend(self, q, k, v):
-        if self.attention_impl == "flash":
+        if (
+            self.attention_impl == "flash"
+            and q.shape[1] >= self.flash_min_len
+        ):
             from distributed_tensorflow_tpu.ops.pallas_attention import (
                 flash_attention,
             )

@@ -69,6 +69,7 @@ class TransformerClassifier:
         num_classes: int = 10,
         compute_dtype: jnp.dtype = jnp.bfloat16,
         attention_impl: str = "xla",
+        flash_min_len: int | None = None,
     ):
         assert model_dim % num_heads == 0
         if attention_impl not in ("xla", "flash"):
@@ -83,6 +84,16 @@ class TransformerClassifier:
         self.num_classes = num_classes
         self.compute_dtype = compute_dtype
         self.attention_impl = attention_impl
+        # Same knob as GPTLM.flash_min_len: None → the ONE measured
+        # crossover (ops/pallas_attention.FLASH_MIN_LEN), 0 forces the
+        # kernel (tests do — the 28-token MNIST rows are toy-length).
+        if flash_min_len is None:
+            from distributed_tensorflow_tpu.ops.pallas_attention import (
+                FLASH_MIN_LEN,
+            )
+
+            flash_min_len = FLASH_MIN_LEN
+        self.flash_min_len = flash_min_len
 
     def init(self, seed: int = 1) -> TransformerParams:
         keys = jax.random.split(jax.random.key(seed), 8)
@@ -155,7 +166,10 @@ class TransformerClassifier:
         """Dense single-device forward: x [B, seq_len*token_dim] → probs."""
         h = self._embed(params, x)
         q, k, v = self._qkv(params, h)
-        if self.attention_impl == "flash":
+        if (
+            self.attention_impl == "flash"
+            and q.shape[1] >= self.flash_min_len
+        ):
             from distributed_tensorflow_tpu.ops.pallas_attention import (
                 flash_attention,
             )

@@ -33,7 +33,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import time
 
 import jax
 import jax.numpy as jnp
@@ -42,8 +41,13 @@ from jax import lax
 
 def _timed_scanned(fn, q, k, v, iters: int, *, grad: bool = False):
     """Per-call seconds for ``fn(q, k, v) -> [B, L, H, D]``: ``iters``
-    applications chained through the carry in one compiled dispatch,
-    D2H-fetch barrier, second (warm) dispatch timed."""
+    applications chained through the carry, TWO-POINT timed
+    (``utils/sync.two_point_seconds``) — the round-3 version divided one
+    chain's wall time by ``iters``, folding the ~100 ms dispatch+fetch
+    roundtrip into every call (at 32 iters that's ~3 ms/call of phantom
+    cost, which COMPRESSED every flash-vs-dense ratio toward 1; the
+    round-3 'flash 0.92x dense at L=2048' was this artifact — honestly
+    measured it is ~3.9x with the round-4 block policy)."""
     if grad:
         # Differentiate w.r.t. ALL of q, k, v (grad over q alone would let
         # dense AD skip the dk/dv backward entirely while flash's custom
@@ -66,17 +70,29 @@ def _timed_scanned(fn, q, k, v, iters: int, *, grad: bool = False):
         def one(q):
             return fn(q, k, v).astype(q.dtype)
 
-    @jax.jit
-    def many(q):
-        out, _ = lax.scan(lambda c, _: (one(c), None), q, None, length=iters)
-        return out
+    from distributed_tensorflow_tpu.utils.sync import (
+        timed_fetch,
+        two_point_seconds,
+    )
 
-    out = many(q)
-    _ = float(out.reshape(-1)[-1].astype(jnp.float32))  # compile + barrier
-    t0 = time.perf_counter()
-    out = many(q)
-    _ = float(out.reshape(-1)[-1].astype(jnp.float32))
-    return (time.perf_counter() - t0) / iters
+    def make(n):
+        @jax.jit
+        def many(q):
+            out, _ = lax.scan(
+                lambda c, _: (one(c), None), q, None, length=n
+            )
+            return out
+
+        return many
+
+    m1, m4 = make(iters), make(4 * iters)
+    timed_fetch(m1, q), timed_fetch(m4, q)  # compile both
+    return two_point_seconds(
+        lambda: timed_fetch(m1, q)[0],
+        lambda: timed_fetch(m4, q)[0],
+        3 * iters,
+        reps=3,
+    )
 
 
 def _record(row, key, fn, q, k, v, iters, grad):
@@ -99,7 +115,7 @@ def run(
     kv_heads: int | None = None,
     window: int | None = None,
     block: int | None = None,
-    iters: int = 32,
+    iters: int | None = None,
     grad: bool = False,
     dtype=jnp.bfloat16,
 ) -> list[dict]:
@@ -108,16 +124,22 @@ def run(
 
     rows = []
     for l in lengths:
+        # Per-length chain sizing: the two-point span (3·iters calls) must
+        # dwarf the ~±10 ms dispatch jitter, and short-L calls are tens of
+        # µs — a fixed iters that suits L=8192 reports noise at L=1024
+        # (two_point_seconds clamps negative medians to 1e-12, which once
+        # rendered as a straight-faced "0.000 ms" table cell).
+        l_iters = iters if iters else max(8, (1 << 18) // l)
         kq, kk, kv = jax.random.split(jax.random.key(0), 3)
         q = jax.random.normal(kq, (batch, l, heads, head_dim), dtype)
         kvshape = (batch, l, kv_heads or heads, head_dim)
         k = jax.random.normal(kk, kvshape, dtype)
         v = jax.random.normal(kv, kvshape, dtype)
-        row = {"L": l, "iters": iters, "grad": grad}
+        row = {"L": l, "iters": l_iters, "grad": grad}
         _record(
             row, "dense",
             lambda q, k, v: dense_attention(q, k, v, causal=True),
-            q, k, v, iters, grad,
+            q, k, v, l_iters, grad,
         )
         bq = min(block, l) if block else None
         _record(
@@ -125,7 +147,7 @@ def run(
             lambda q, k, v: flash_attention(
                 q, k, v, causal=True, block_q=bq, block_k=bq
             ),
-            q, k, v, iters, grad,
+            q, k, v, l_iters, grad,
         )
         if window is not None and window < l:
             _record(
@@ -133,14 +155,14 @@ def run(
                 lambda q, k, v: flash_attention(
                     q, k, v, causal=True, window=window, block_q=bq, block_k=bq
                 ),
-                q, k, v, iters, grad,
+                q, k, v, l_iters, grad,
             )
             _record(
                 row, "window_dense",
                 lambda q, k, v: dense_attention(
                     q, k, v, causal=True, window=window
                 ),
-                q, k, v, iters, grad,
+                q, k, v, l_iters, grad,
             )
         rows.append(row)
     return rows
@@ -181,7 +203,10 @@ def main(argv=None) -> None:
     ap.add_argument("--kv-heads", type=int, default=None)
     ap.add_argument("--window", type=int, default=None)
     ap.add_argument("--block", type=int, default=None)
-    ap.add_argument("--iters", type=int, default=32)
+    ap.add_argument(
+        "--iters", type=int, default=None,
+        help="chain length (default: auto per L — 2^18/L, min 8)",
+    )
     ap.add_argument("--grad", action="store_true", help="time fwd+bwd")
     args = ap.parse_args(argv)
     rows = run(

@@ -58,7 +58,7 @@ CONFIGS = {
         batch=32,
         model=dict(
             model_dim=256, num_layers=4, num_heads=8, max_len=512,
-            attention_impl="flash",
+            attention_impl="flash", flash_min_len=0,
         ),
     ),
     # long-context point: same model at L=2048
@@ -70,21 +70,21 @@ CONFIGS = {
         batch=8,
         model=dict(
             model_dim=256, num_layers=4, num_heads=8, max_len=2048,
-            attention_impl="flash",
+            attention_impl="flash", flash_min_len=0,
         ),
     ),
     "gpt-s-L2048-flash-W512": dict(
         batch=8,
         model=dict(
             model_dim=256, num_layers=4, num_heads=8, max_len=2048,
-            attention_impl="flash", window=512,
+            attention_impl="flash", flash_min_len=0, window=512,
         ),
     ),
     "gpt-s-L2048-flash-gqa2": dict(
         batch=8,
         model=dict(
             model_dim=256, num_layers=4, num_heads=8, num_kv_heads=2,
-            max_len=2048, attention_impl="flash",
+            max_len=2048, attention_impl="flash", flash_min_len=0,
         ),
     ),
     # bigger-model points: d=512 and d=1024 (wider matmuls → real MFU)
@@ -92,14 +92,14 @@ CONFIGS = {
         batch=16,
         model=dict(
             model_dim=512, num_layers=8, num_heads=8, max_len=1024,
-            attention_impl="flash",
+            attention_impl="flash", flash_min_len=0,
         ),
     ),
     "gpt-l-L1024-flash": dict(
         batch=8,
         model=dict(
             model_dim=1024, num_layers=8, num_heads=16, max_len=1024,
-            attention_impl="flash",
+            attention_impl="flash", flash_min_len=0,
         ),
     ),
 }

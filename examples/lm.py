@@ -38,6 +38,7 @@ def main(epochs: int = 8, max_new: int = 16) -> None:
         num_layers=2,
         compute_dtype=jnp.float32,
         attention_impl="flash" if os.environ.get("DTF_LM_FLASH") else "xla",
+        flash_min_len=0,  # demo corpus is toy-length; keep the knob real
     )
     trainer = LMTrainer(
         model,

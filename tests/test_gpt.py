@@ -102,13 +102,27 @@ def test_decode_step_logits_match_full_forward():
 def test_flash_variant_matches_xla():
     # L=32 has small divisors, so flash runs blockwise even at toy size.
     xla = _model()
-    flash = _model(attention_impl="flash")
+    flash = _model(attention_impl="flash", flash_min_len=0)
     params = xla.init(seed=4)
     toks = _tokens(np.random.default_rng(4), 2, 32)
     np.testing.assert_allclose(
         np.asarray(flash.apply(params, toks)),
         np.asarray(xla.apply(params, toks)),
         atol=2e-4,
+    )
+
+
+def test_flash_crossover_short_seq_uses_dense():
+    # Below flash_min_len (default 1024 — the measured crossover) the
+    # flash model must take the dense path: outputs BITWISE equal to the
+    # xla model, which the kernel's different reduction order would not be.
+    xla = _model()
+    flash = _model(attention_impl="flash")
+    params = xla.init(seed=4)
+    toks = _tokens(np.random.default_rng(4), 2, 32)
+    np.testing.assert_array_equal(
+        np.asarray(flash.apply(params, toks)),
+        np.asarray(xla.apply(params, toks)),
     )
 
 
@@ -289,7 +303,7 @@ def test_windowed_lm_decode_matches_reforward():
 
 def test_windowed_flash_matches_windowed_xla():
     xla = _model(window=8)
-    flash = _model(window=8, attention_impl="flash")
+    flash = _model(window=8, attention_impl="flash", flash_min_len=0)
     params = xla.init(seed=20)
     toks = _tokens(np.random.default_rng(20), 2, 32)
     np.testing.assert_allclose(
@@ -331,7 +345,8 @@ def test_gqa_windowed_lm_sequence_parallel_matches_dense_flash():
 
     from distributed_tensorflow_tpu.parallel import make_mesh
 
-    model = _model(window=6, num_kv_heads=2, attention_impl="flash")
+    model = _model(window=6, num_kv_heads=2, attention_impl="flash",
+                   flash_min_len=0)
     params = _noisy(model.init(seed=25), scale=0.1)
     toks = _tokens(np.random.default_rng(25), 2, 32)
     want = np.asarray(model.apply(params, toks))
@@ -631,7 +646,7 @@ def test_gqa_lm_flash_and_sp_match_xla():
     from distributed_tensorflow_tpu.parallel import make_mesh
 
     xla = _model(num_kv_heads=2)
-    flash = _model(num_kv_heads=2, attention_impl="flash")
+    flash = _model(num_kv_heads=2, attention_impl="flash", flash_min_len=0)
     params = xla.init(seed=28)
     toks = _tokens(np.random.default_rng(28), 2, 32)
     want = np.asarray(xla.apply(params, toks))
@@ -908,7 +923,7 @@ def test_ragged_batch_masked_loss():
 def test_ragged_loss_trains_through_flash():
     # The masked loss must differentiate through the flash path too, and
     # gradients must not depend on pad content.
-    model = _model(attention_impl="flash", max_len=16)
+    model = _model(attention_impl="flash", max_len=16, flash_min_len=0)
     params = model.init(seed=41)
     rng = np.random.default_rng(41)
     toks = np.asarray(_tokens(rng, 2, 16))
@@ -923,6 +938,7 @@ def test_ragged_loss_trains_through_flash():
         )
 
 
+@pytest.mark.heavy
 def test_windowed_decode_cache_is_window_sized():
     # VERDICT round-2 weak #5: windowed decode must be O(W), not
     # O(max_len). The cache allocates min(window, max_len) slots and the
@@ -1039,7 +1055,9 @@ def _merge_stages(params):
     )
 
 
-@pytest.mark.parametrize("stages", [4, 8])
+@pytest.mark.parametrize(
+    "stages", [4, pytest.param(8, marks=pytest.mark.heavy)]
+)
 def test_pp_train_step_matches_single_device(stages):
     # GPipe TRAINING (VERDICT round-3 weak #1): the backward through the
     # tick scan (transposed ppermute hops) + stage-sharded adam slots must
@@ -1115,6 +1133,7 @@ def test_pp_train_step_validates_layout():
         pipeline_parallel_specs(_model(num_layers=4, moe_experts=4))
 
 
+@pytest.mark.heavy
 def test_ragged_moe_loss_is_pad_content_independent():
     # MoE ragged exactness: pad tokens must not consume expert capacity,
     # perturb routing of real tokens, or enter the aux statistics — so the
@@ -1206,6 +1225,7 @@ def test_ep_train_step_matches_dense_dp():
         )
 
 
+@pytest.mark.heavy
 def test_ep_train_step_dp_composes():
     # dp×ep on a 2-D ('data','expert') mesh (VERDICT round-3 weak #5): 8
     # devices, 4 experts, data axis 2 — the device count scales past the

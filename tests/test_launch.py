@@ -2,6 +2,7 @@
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from distributed_tensorflow_tpu.config import TrainConfig
 from distributed_tensorflow_tpu.launch import build_strategy, build_trainer
@@ -109,6 +110,7 @@ def test_env_override_compiled_run(monkeypatch):
     assert config_from_env().compiled_run is True
 
 
+@pytest.mark.heavy
 def test_remat_knob_gradients_match(small_datasets):
     """remat=True recomputes activations in the backward pass; gradients
     must be identical to the stored-activation path."""

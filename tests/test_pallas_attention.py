@@ -267,7 +267,7 @@ def test_transformer_flash_matches_dense_forward():
 
     dense_model = TransformerClassifier(compute_dtype=jnp.float32)
     flash_model = TransformerClassifier(
-        compute_dtype=jnp.float32, attention_impl="flash"
+        compute_dtype=jnp.float32, attention_impl="flash", flash_min_len=0
     )
     params = dense_model.init(seed=1)
     x = jax.random.normal(jax.random.key(6), (4, 28 * 28), jnp.float32)
