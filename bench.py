@@ -13,11 +13,13 @@ the reference loop (SGD lr=0.001, batch 100). Each dispatch covers
 `BENCH_EPOCHS_PER_DISPATCH` epochs (default 5, each with its own shuffle)
 so the per-dispatch host/tunnel round trip is amortised the way any real
 multi-epoch run would amortise it. Timing: warmups first (compile +
-donation settling), then three measured regions of several back-to-back
-dispatches each, synced by *fetching* the final cost — on the tunneled
-chip `jax.block_until_ready` returns optimistically, so a D2H value read
-(which transitively depends on every enqueued step) is the only
-trustworthy execution barrier. Median region per-epoch time is reported.
+donation settling), then three TWO-POINT region pairs — each pair times a
+5-dispatch and a 20-dispatch region, both synced by *fetching* the final
+cost (on the tunneled chip `jax.block_until_ready` returns
+optimistically, so a D2H value read that transitively depends on every
+enqueued step is the only trustworthy barrier), and per-epoch time is the
+pair's DIFFERENCE over the extra epochs (the fetch's ~100 ms roundtrip
+cancels — CLAUDE.md TIMING TRAP 2). Median pair is reported.
 
 `BENCH_IMPL=pallas-epoch` (default) runs the whole dispatch as ONE Pallas
 kernel launch (ops/pallas_mlp.py `make_fused_epoch_fn`: grid over every
@@ -167,36 +169,55 @@ def main(impl: str) -> None:
         _ = float(costs[-1])  # D2H fetch = execution barrier (see below)
         log(f"warmup {i + 1}: {time.perf_counter() - t0:.2f}s")
 
-    # Sustained measurement: enqueue all timed dispatches back-to-back and
-    # sync once at the end by *fetching* the final cost — on the tunneled
-    # chip `block_until_ready` returns optimistically, so a D2H value read
-    # (which transitively depends on every enqueued step) is the only
-    # trustworthy barrier. One long region measures what an actual
-    # multi-epoch run achieves.
-    timed_epochs = TIMED_DISPATCHES * epochs_per_dispatch
-    times = []
+    # Sustained measurement, TWO-POINT (CLAUDE.md TIMING TRAP 2): each
+    # region enqueues its dispatches back-to-back and syncs once by
+    # *fetching* the final cost (on the tunneled chip `block_until_ready`
+    # returns optimistically — a D2H value read that transitively depends
+    # on every enqueued step is the only trustworthy barrier), but that
+    # one fetch still carries the ~100 ms tunnel roundtrip: at ~5 ms/epoch
+    # x 25 epochs the roundtrip was ~40% of the round-3 regions. Per-epoch
+    # time is therefore the DIFFERENCE between a 4k-dispatch and a
+    # k-dispatch region over the extra epochs, median of 3 pairs.
+    from distributed_tensorflow_tpu.utils.sync import two_point_seconds
+
     region_costs = []
-    for region in range(3):
+    region_count = [0]
+
+    def region(dispatches):
+        nonlocal state
+        region_count[0] += 1
         t0 = time.perf_counter()
-        for _ in range(TIMED_DISPATCHES):
+        for _ in range(dispatches):
             state, costs = run_epoch(state, xs, ys)
         final_cost = float(costs[-1])  # D2H fetch = execution barrier
         total = time.perf_counter() - t0
-        times.append(total / timed_epochs)
+        epochs = dispatches * epochs_per_dispatch
         region_costs.append(final_cost)
         log(
-            f"region {region + 1}: {timed_epochs} epochs in {total * 1000:.1f}ms "
-            f"({total / timed_epochs * 1000:.2f}ms/epoch)  cost={final_cost:.4f}"
+            f"region {region_count[0]}: {epochs} epochs in "
+            f"{total * 1000:.1f}ms ({total / epochs * 1000:.2f}ms/epoch "
+            f"raw)  cost={final_cost:.4f}"
         )
+        return total
 
-    # Validity: each region trains 25 more epochs, so the fetched costs must
-    # be finite, descend overall by MORE than tol (a flat trajectory means
-    # updates were no-ops — e.g. a donation bug returning stale params — and
-    # must be refused, not published), and never *increase* between adjacent
+    sec_per_epoch = two_point_seconds(
+        lambda: region(TIMED_DISPATCHES),
+        lambda: region(4 * TIMED_DISPATCHES),
+        3 * TIMED_DISPATCHES * epochs_per_dispatch,
+        reps=3,
+    )
+    log(f"two-point: {sec_per_epoch * 1000:.3f}ms/epoch (median of 3 pairs)")
+
+    # Validity: every region trains MORE epochs (pairs alternate 25- and
+    # 100-epoch regions), so the fetched costs must be finite, descend
+    # overall by MORE than tol (a flat trajectory means updates were
+    # no-ops — e.g. a donation bug returning stale params — and must be
+    # refused, not published), and never *increase* between adjacent
     # regions (tolerance: near convergence adjacent regions may plateau to
-    # within ulps). Anything else means the barrier did not actually observe
-    # execution (or training diverged/stalled) — refuse to publish a number
-    # rather than emit a silently-corrupt measurement.
+    # within ulps; the unequal epoch spacing only makes descent easier to
+    # observe). Anything else means the barrier did not actually observe
+    # execution (or training diverged/stalled) — refuse to publish a
+    # number rather than emit a silently-corrupt measurement.
     tol = 1e-3
     if (
         not all(np.isfinite(c) for c in region_costs)
@@ -206,7 +227,6 @@ def main(impl: str) -> None:
         log(f"FATAL: region costs not finite+descending: {region_costs}")
         raise SystemExit(1)
 
-    sec_per_epoch = float(np.median(times))
     examples_per_sec = steps * batch / sec_per_epoch
     print(
         json.dumps(

@@ -31,6 +31,22 @@ class Model(Protocol):
     def apply(self, params: Any, x: jax.Array) -> jax.Array: ...
 
 
+def resolve_flash_min_len(value: int | None) -> int:
+    """The ONE resolver for every model's ``flash_min_len`` knob (GPT and
+    transformer families — a second copy would let the measured crossover
+    drift between them): ``None`` → the shared measured default,
+    ``ops/pallas_attention.FLASH_MIN_LEN``. Deliberately LAZY — called at
+    forward time behind the ``attention_impl == "flash"`` short-circuit,
+    so xla-only models never import the Pallas stack."""
+    if value is not None:
+        return value
+    from distributed_tensorflow_tpu.ops.pallas_attention import (
+        FLASH_MIN_LEN,
+    )
+
+    return FLASH_MIN_LEN
+
+
 def layernorm(x, scale, bias, eps=1e-5):
     """Shared f32 layernorm over the last axis (transformer and GPT
     families; one copy so numeric changes cannot diverge silently)."""

@@ -189,14 +189,10 @@ class GPTLM:
         # L >= flash_min_len and falls back to the mathematically
         # identical dense path below. None → the ONE measured crossover
         # shared by every model (ops/pallas_attention.FLASH_MIN_LEN — its
-        # comment has the numbers and the re-measure tool); 0 forces the
-        # kernel at every length (tests do, to exercise it at toy L).
-        if flash_min_len is None:
-            from distributed_tensorflow_tpu.ops.pallas_attention import (
-                FLASH_MIN_LEN,
-            )
-
-            flash_min_len = FLASH_MIN_LEN
+        # comment has the numbers and the re-measure tool), resolved
+        # LAZILY at forward time (models/base.resolve_flash_min_len) so
+        # xla models never import Pallas; 0 forces the kernel at every
+        # length (tests do, to exercise it at toy L).
         self.flash_min_len = flash_min_len
         # jax.checkpoint around each scanned block: activation memory drops
         # from O(num_layers · L · d) to O(L · d) + one block's recompute per
@@ -320,9 +316,12 @@ class GPTLM:
         )
 
     def _attend(self, q, k, v):
-        if (
-            self.attention_impl == "flash"
-            and q.shape[1] >= self.flash_min_len
+        from distributed_tensorflow_tpu.models.base import (
+            resolve_flash_min_len,
+        )
+
+        if self.attention_impl == "flash" and q.shape[1] >= (
+            resolve_flash_min_len(self.flash_min_len)
         ):
             from distributed_tensorflow_tpu.ops.pallas_attention import (
                 flash_attention,
@@ -533,11 +532,9 @@ class GPTLM:
             ulysses_attention,
         )
 
-        if attention is None:
-            attention = (
-                "ring_flash" if self.attention_impl == "flash" else "ring"
-            )
-        if attention not in ("ring", "ring_flash", "ulysses"):
+        if attention is not None and attention not in (
+            "ring", "ring_flash", "ulysses"
+        ):
             raise ValueError(
                 f"unknown attention {attention!r}; ring|ring_flash|ulysses"
             )
@@ -545,6 +542,22 @@ class GPTLM:
         n = lax.axis_size(axis_name)
         my = lax.axis_index(axis_name)
         b, l_loc = tokens.shape
+        if attention is None:
+            # Default follows attention_impl, honoring the flash_min_len
+            # crossover at the PER-SHARD length (the flash ring runs the
+            # kernel on l_loc-sized blocks each hop, so l_loc is the
+            # length that decides kernel-vs-dense — an explicit
+            # attention="ring_flash" still forces the kernel).
+            from distributed_tensorflow_tpu.models.base import (
+                resolve_flash_min_len,
+            )
+
+            attention = (
+                "ring_flash"
+                if self.attention_impl == "flash"
+                and l_loc >= resolve_flash_min_len(self.flash_min_len)
+                else "ring"
+            )
         if n * l_loc > self.max_len:
             # dynamic_slice would silently CLAMP the positional slice for
             # the last devices (duplicating other shards' positions) where

@@ -85,14 +85,9 @@ class TransformerClassifier:
         self.compute_dtype = compute_dtype
         self.attention_impl = attention_impl
         # Same knob as GPTLM.flash_min_len: None → the ONE measured
-        # crossover (ops/pallas_attention.FLASH_MIN_LEN), 0 forces the
-        # kernel (tests do — the 28-token MNIST rows are toy-length).
-        if flash_min_len is None:
-            from distributed_tensorflow_tpu.ops.pallas_attention import (
-                FLASH_MIN_LEN,
-            )
-
-            flash_min_len = FLASH_MIN_LEN
+        # crossover, resolved lazily at forward time
+        # (models/base.resolve_flash_min_len); 0 forces the kernel
+        # (tests do — the 28-token MNIST rows are toy-length).
         self.flash_min_len = flash_min_len
 
     def init(self, seed: int = 1) -> TransformerParams:
@@ -166,9 +161,12 @@ class TransformerClassifier:
         """Dense single-device forward: x [B, seq_len*token_dim] → probs."""
         h = self._embed(params, x)
         q, k, v = self._qkv(params, h)
-        if (
-            self.attention_impl == "flash"
-            and q.shape[1] >= self.flash_min_len
+        from distributed_tensorflow_tpu.models.base import (
+            resolve_flash_min_len,
+        )
+
+        if self.attention_impl == "flash" and q.shape[1] >= (
+            resolve_flash_min_len(self.flash_min_len)
         ):
             from distributed_tensorflow_tpu.ops.pallas_attention import (
                 flash_attention,

@@ -61,8 +61,8 @@ FLASH_MIN_LEN = 1024
 
 
 def _pick_block(l: int, requested: int | None) -> int:
-    """Largest MXU-friendly block that divides ``l`` (512 up to L=2048,
-    1024 beyond), or ``l`` itself for short/odd sequences (Mosaic pads
+    """Largest MXU-friendly block that divides ``l`` (512 below L=4096,
+    1024 from there up), or ``l`` itself for short/odd sequences (Mosaic pads
     non-tile-multiple shapes). A long sequence with no small divisor would
     silently degenerate to one whole-sequence block — an O(L²) VMEM score
     tile, exactly what this kernel exists to avoid — so that case is an
@@ -622,7 +622,7 @@ def flash_attention(
     matrix dominates memory (the crossover on v5e is roughly L ≥ 512).
 
     Auto-picked blocks follow the measured per-length policy in
-    ``_pick_block`` (512 up to L=2048, 1024 beyond — the round-3 ≤128
+    ``_pick_block`` (512 below L=4096, 1024 from there up — the round-3 ≤128
     cap was 4x slower at L=2048); pass ``block_q``/``block_k`` to
     override for odd shapes.
     """

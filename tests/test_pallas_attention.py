@@ -256,7 +256,7 @@ def test_block_must_divide():
 
 def test_long_odd_sequence_rejected():
     q, k, v = _qkv(11, l=1034, d=8)
-    with pytest.raises(ValueError, match="no block-size divisor"):
+    with pytest.raises(ValueError, match="no power-of-two block divisor"):
         flash_attention(q, k, v)
 
 
