@@ -108,10 +108,14 @@ class TrainConfig:
     checkpoint_dir: str | None = None  # deliberate upgrade: orbax checkpointing
     sync: bool = True  # sync DP (pmean all-reduce) vs async emulation
     async_avg_every: int = 0  # async mode: average params every N steps (0 = never)
-    # Sync-DP parameter layout: "replicated" (params on every chip, gradient
+    # Sync parameter layout: "replicated" (params on every chip, gradient
     # all-reduce — the reference-parity mode) or "zero" (ZeRO-3/FSDP: params
     # and optimizer state sharded over 'data', all-gather fwd/bwd +
-    # reduce-scatter grads — parallel/fsdp.py). Identical update semantics.
+    # reduce-scatter grads — parallel/fsdp.py); identical update semantics.
+    # The LM trainer additionally accepts "tp" (Megatron tensor parallel,
+    # composes with a data axis → dp×tp), "ep" (expert parallel, MoE
+    # models, → dp×ep), and "pp" (GPipe pipeline, → dp×pp) — see
+    # train/lm_trainer.py; the classifier path rejects those three.
     dp_mode: str = "replicated"
     # Compile each epoch as one lax.scan dispatch (train/scan.py): identical
     # update semantics, ~100x less host overhead. Log lines are emitted from

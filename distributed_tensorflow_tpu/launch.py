@@ -65,7 +65,9 @@ def config_from_env(base: TrainConfig | None = None) -> TrainConfig:
 def build_strategy(config: TrainConfig, *, devices=None, mesh=None):
     if config.dp_mode not in ("replicated", "zero"):
         raise ValueError(
-            f"unknown dp_mode {config.dp_mode!r}; use 'replicated' or 'zero'"
+            f"unknown dp_mode {config.dp_mode!r} for the classifier path; "
+            "use 'replicated' or 'zero' ('tp'/'ep'/'pp' are LM-trainer "
+            "modes — train/lm_trainer.py)"
         )
     if config.dp_mode == "zero" and not config.sync:
         raise ValueError("dp_mode='zero' requires sync=True (async keeps per-chip copies)")

@@ -611,6 +611,7 @@ class GPTLM:
         axis_name: str = "expert",
         *,
         with_aux: bool = False,
+        lengths: jax.Array | None = None,
     ) -> jax.Array:
         """Expert-parallel causal forward *body* (MoE models): call inside
         ``jax.shard_map`` with tokens sharded on the BATCH dim [B/n, L] and
@@ -626,7 +627,11 @@ class GPTLM:
         semantic guarantee. ``with_aux=True`` also returns per-layer
         :class:`~ops.moe.MoEAux` over this device's local tokens — its
         ``drop_fraction`` is the observable guard on the no-drop-regime
-        claim above (pmean it over ``axis_name`` for the global rate)."""
+        claim above (pmean it over ``axis_name`` for the global rate).
+        ``lengths`` [B/n] int32 (this shard's rows of a ragged right-padded
+        batch) keeps pad tokens out of MoE routing/capacity and the aux
+        statistics, exactly as :meth:`apply_with_aux` does in the dense
+        path — EP ragged training is pad-content-independent too."""
         if self.moe_experts is None:
             raise ValueError("apply_expert_parallel requires moe_experts")
         n = lax.axis_size(axis_name)
@@ -637,6 +642,14 @@ class GPTLM:
             )
         from distributed_tensorflow_tpu.ops.moe import moe_ffn
 
+        l = tokens.shape[1]
+        positions = jnp.arange(l)
+        token_mask = (
+            None
+            if lengths is None
+            else positions[None, :] < lengths[:, None]  # [B/n, L]
+        )
+
         def ep_ffn(blk, hn2):
             return self._moe_block_ffn(
                 blk,
@@ -644,10 +657,9 @@ class GPTLM:
                 lambda mp, x, c, m: moe_ffn(
                     mp, x, axis_name, capacity=c, with_aux=True, token_mask=m
                 ),
+                token_mask,
             )
 
-        l = tokens.shape[1]
-        positions = jnp.arange(l)
         h = self._embed_tokens(params, tokens, positions)
 
         def body(h, blk):
@@ -1092,6 +1104,46 @@ def make_lm_ep_train_step(
     router view), differing from the dense global-batch aux by the
     product-of-averages gap. tests/test_gpt.py pins the exact semantics
     against a shard-wise dense reference, for 1-D ep and 2-D dp×ep."""
+    specs, opt_specs, mapped = make_lm_ep_parts(
+        model, optimizer, mesh, axis, data_axis=data_axis
+    )
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        return mapped(params, opt_state, tokens, None)
+
+    return step
+
+
+def make_lm_ep_parts(
+    model: GPTLM,
+    optimizer,
+    mesh,
+    axis: str = "expert",
+    *,
+    data_axis: str | None = None,
+    ragged: bool = False,
+):
+    """Building blocks behind :func:`make_lm_ep_train_step`, exposed (like
+    :func:`make_lm_async_parts`) so the LM trainer can embed the
+    expert-parallel update inside its scanned-epoch / whole-run-compiled
+    bodies. Returns ``(specs, opt_specs, mapped)``:
+
+    - ``specs`` / ``opt_specs`` — PartitionSpec pytrees for the params and
+      their optimizer slots (:func:`expert_parallel_specs` + slot
+      matching); place states with ``NamedSharding(mesh, spec)``;
+    - ``mapped(params, opt_state, tokens, lengths) -> (params, opt_state,
+      loss)`` — NOT jitted (call inside your own jit/scan); tokens [B, L]
+      sharded on the batch dim over ``(data_axis?, axis)``, ``lengths``
+      [B] for ragged corpora (masked CE + masked routing per shard, the
+      same pad-independence the dense path proves) or None (``ragged`` is
+      a factory-time choice — it shapes the shard_map signature).
+
+    Ragged loss convention: the differentiated loss is the pmean of each
+    shard's *masked mean* CE — shards weight equally regardless of their
+    valid-token counts (the same convention as ``make_lm_async_parts``'s
+    per-copy masked CE), equal to the global masked mean exactly when the
+    per-shard valid counts are equal."""
     import optax
     from jax.sharding import PartitionSpec as P
 
@@ -1114,11 +1166,11 @@ def make_lm_ep_train_step(
     params_shape = jax.eval_shape(model.init, 1)
     opt_specs = _slot_specs(optimizer, params_shape, specs)
 
-    def ep_loss(params, tokens):
+    def ep_loss(params, tokens, lens):
         logits, auxs = model.apply_expert_parallel(
-            params, tokens, axis, with_aux=True
+            params, tokens, axis, with_aux=True, lengths=lens
         )
-        ce = lax.pmean(_ce_from_logits(logits, tokens), axes)
+        ce = lax.pmean(_ce_from_logits(logits, tokens, lens), axes)
         balance = lax.pmean(jnp.mean(auxs.balance_loss), axes)
         z = lax.pmean(jnp.mean(auxs.z_loss), axes)
         return (
@@ -1127,19 +1179,30 @@ def make_lm_ep_train_step(
             + model.moe_z_coef * z
         )
 
-    def local(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(ep_loss)(params, tokens)
+    def local(params, opt_state, tokens, lens):
+        loss, grads = jax.value_and_grad(ep_loss)(
+            params, tokens, lens if ragged else None
+        )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    mapped = jax.shard_map(
+    lens_spec = batch_spec if ragged else P()
+    inner = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(specs, opt_specs, batch_spec),
+        in_specs=(specs, opt_specs, batch_spec, lens_spec),
         out_specs=(specs, opt_specs, P()),
     )
-    return jax.jit(mapped)
+
+    def mapped(params, opt_state, tokens, lens):
+        if lens is None:
+            # Static placeholder: the non-ragged local ignores it, but the
+            # shard_map signature needs a concrete array.
+            lens = jnp.zeros((), jnp.int32)
+        return inner(params, opt_state, tokens, lens)
+
+    return specs, opt_specs, mapped
 
 
 def pipeline_parallel_specs(model: GPTLM, axis_name: str = "stage"):
@@ -1183,6 +1246,7 @@ def make_lm_pp_train_step(
     *,
     axis: str = "stage",
     num_microbatches: int = 4,
+    data_axis: str | None = None,
 ):
     """Pipeline-parallel TRAINING step: the GPipe backward as the scan
     transpose. The reference has no pipeline stages at all (SURVEY.md §2b
@@ -1207,48 +1271,30 @@ def make_lm_pp_train_step(
     ``jax.checkpoint``-ed, so the backward recomputes one stage group per
     tick instead of stashing all M·(M+S−1) tick activations.
 
+    ``data_axis`` composes data parallelism on top — dp×pp on a 2-D
+    ``(data, stage)`` mesh: each microbatch's rows are sharded over
+    ``data_axis`` (every data row runs the same GPipe schedule on its
+    shard of every microbatch), embed/head/CE run under GSPMD on the
+    data-sharded batch, and the stage-owned layer groups (replicated
+    across ``data``) receive their data-summed gradients through
+    shard_map's auto-psum — the same composition form as dp×ep.
+
     Returns a jitted ``step(params, opt_state, tokens) -> (params,
     opt_state, loss)``; place params/slots with ``jax.device_put`` under
     the :func:`pipeline_parallel_specs` layout first (or let GSPMD
     reshard on the first call). Proven grad-identical to the sequential
-    single-device step in tests/test_gpt.py on 4- and 8-stage meshes."""
-    from jax.sharding import PartitionSpec as P
-
-    from distributed_tensorflow_tpu.parallel.pipeline import (
-        microbatch,
-        pipeline_apply,
+    single-device step in tests/test_gpt.py on 4- and 8-stage meshes
+    (and 2×4 dp×pp)."""
+    specs, opt_specs, pp_loss = make_lm_pp_parts(
+        model,
+        optimizer,
+        mesh,
+        axis=axis,
+        num_microbatches=num_microbatches,
+        data_axis=data_axis,
     )
-
-    s = mesh.shape[axis]
-    if model.num_layers % s:
-        raise ValueError(
-            f"num_layers {model.num_layers} not divisible by {axis!r} axis "
-            f"size {s}"
-        )
-    specs = pipeline_parallel_specs(model, axis)  # raises for MoE blocks
-    staged_shape = jax.eval_shape(
-        lambda: pipeline_stage_params(model, model.init(1), s)
-    )
-    opt_specs = _slot_specs(optimizer, staged_shape, specs)
     shardings = _as_shardings(mesh, specs)
     opt_shardings = _as_shardings(mesh, opt_specs)
-
-    stage_fn = model._pp_stage_fn()
-    pp_body = jax.shard_map(
-        lambda blocks, hm: pipeline_apply(stage_fn, blocks, hm, axis),
-        mesh=mesh,
-        in_specs=(specs.blocks, P()),
-        out_specs=P(),
-    )
-
-    def pp_loss(params, tokens):
-        b, l = tokens.shape
-        positions = jnp.arange(l)
-        h = model._embed_tokens(params, tokens, positions)
-        hm = microbatch(h, num_microbatches)  # [M, B/M, L, d]
-        out = pp_body(params.blocks, hm)
-        logits = model._logits(params, out.reshape(b, l, -1))
-        return _ce_from_logits(logits, tokens)
 
     @jax.jit
     def step(params, opt_state, tokens):
@@ -1261,6 +1307,78 @@ def make_lm_pp_train_step(
         return params, opt_state, loss
 
     return step
+
+
+def make_lm_pp_parts(
+    model: GPTLM,
+    optimizer,
+    mesh,
+    *,
+    axis: str = "stage",
+    num_microbatches: int = 4,
+    data_axis: str | None = None,
+):
+    """Building blocks behind :func:`make_lm_pp_train_step`, exposed (like
+    :func:`make_lm_ep_parts`) so the LM trainer can embed the pipeline
+    step inside its scanned-epoch / whole-run-compiled bodies. Returns
+    ``(specs, opt_specs, pp_loss)``:
+
+    - ``specs`` / ``opt_specs`` — PartitionSpec pytrees for params in
+      :func:`pipeline_stage_params` layout and their optimizer slots;
+    - ``pp_loss(params, tokens, lengths=None) -> loss`` — differentiable
+      GPipe forward + next-token CE (masked when ``lengths`` [B] is given:
+      ragged right-padded batches train exactly as in :meth:`GPTLM.loss` —
+      causal attention already isolates pads, only the CE needs masking
+      for dense blocks). Call inside jit; differentiate for the GPipe
+      backward (the tick-scan transpose)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_tpu.parallel.pipeline import (
+        microbatch,
+        pipeline_apply,
+    )
+
+    s = mesh.shape[axis]
+    if model.num_layers % s:
+        raise ValueError(
+            f"num_layers {model.num_layers} not divisible by {axis!r} axis "
+            f"size {s}"
+        )
+    if data_axis is not None and data_axis not in mesh.shape:
+        raise ValueError(f"mesh has no {data_axis!r} axis: {dict(mesh.shape)}")
+    if data_axis == axis:
+        raise ValueError(
+            f"data_axis must differ from the stage axis {axis!r}"
+        )
+    specs = pipeline_parallel_specs(model, axis)  # raises for MoE blocks
+    staged_shape = jax.eval_shape(
+        lambda: pipeline_stage_params(model, model.init(1), s)
+    )
+    opt_specs = _slot_specs(optimizer, staged_shape, specs)
+    mb_spec = P() if data_axis is None else P(None, data_axis)
+
+    stage_fn = model._pp_stage_fn()
+    pp_body = jax.shard_map(
+        lambda blocks, hm: pipeline_apply(stage_fn, blocks, hm, axis),
+        mesh=mesh,
+        in_specs=(specs.blocks, mb_spec),
+        out_specs=mb_spec,
+    )
+
+    def pp_loss(params, tokens, lengths=None):
+        b, l = tokens.shape
+        if data_axis is not None:
+            tokens = lax.with_sharding_constraint(
+                tokens, NamedSharding(mesh, P(data_axis))
+            )
+        positions = jnp.arange(l)
+        h = model._embed_tokens(params, tokens, positions)
+        hm = microbatch(h, num_microbatches)  # [M, B/M, L, d]
+        out = pp_body(params.blocks, hm)
+        logits = model._logits(params, out.reshape(b, l, -1))
+        return _ce_from_logits(logits, tokens, lengths)
+
+    return specs, opt_specs, pp_loss
 
 
 def make_lm_async_train_step(

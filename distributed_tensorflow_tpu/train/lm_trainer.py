@@ -45,11 +45,28 @@ picking which script to launch, reference README.md:90-121):
   exchange to the mean every ``config.async_avg_every`` steps, the
   reference's HOGWILD table emulated as in ``AsyncDataParallel``;
   held-out perplexity is evaluated at the mean of the copies, and
-  ``update_scale`` defaults to N like every async API here).
+  ``update_scale`` defaults to N like every async API here);
+- ``mesh`` + ``dp_mode="tp"`` → **tp** (Megatron tensor parallelism over
+  ``tp_axis`` via ``GPTLM.partition_specs``, params AND optimizer slots
+  column/row-sharded, ONE GSPMD program; composes with a ``data`` axis
+  on the same mesh → dp×tp, identical math to the single-device step);
+- ``mesh`` + ``dp_mode="ep"`` → **ep** (MoE models: expert-parallel
+  all-to-all training over ``expert_axis`` via
+  ``models/gpt.make_lm_ep_parts`` — one expert's FFN weights + slots per
+  device; composes with a ``data`` axis → dp×ep; ragged corpora mask
+  routing per shard);
+- ``mesh`` + ``dp_mode="pp"`` → **pp** (GPipe pipeline training over
+  ``stage_axis`` via ``models/gpt.make_lm_pp_parts`` — stage-owned layer
+  groups + slots, backward as the tick-scan transpose; composes with a
+  ``data`` axis → dp×pp; ``pp_microbatches`` microbatches).
 
 Every mode runs the FULL lifecycle: log lines, per-epoch perplexity,
 tfevents, Supervisor save/restore (async checkpoints the stacked copies;
-zero checkpoints sharded arrays), the scanned epoch, and run_compiled.
+zero/tp/ep/pp checkpoint sharded arrays — pp in the staged layout), the
+scanned epoch, and run_compiled. Held-out perplexity is defined at the
+model's dense forward everywhere (async folds the copies to their mean;
+pp merges the staged layer groups back; ep reads the dense forward, ==
+the EP forward in the no-drop regime — ``drop_fraction`` is the guard).
 """
 
 from __future__ import annotations
@@ -86,6 +103,10 @@ class LMTrainer:
         eval_batch: int = 256,
         print_fn=print,
         async_update_scale: float | None = None,
+        tp_axis: str = "model",
+        expert_axis: str = "expert",
+        stage_axis: str = "stage",
+        pp_microbatches: int = 4,
     ):
         self.model = model
         self.datasets = datasets
@@ -100,6 +121,10 @@ class LMTrainer:
         self.eval_batch = eval_batch
         self.print_fn = print_fn
         self.async_update_scale = async_update_scale
+        self.tp_axis = tp_axis
+        self.expert_axis = expert_axis
+        self.stage_axis = stage_axis
+        self.pp_microbatches = pp_microbatches
         self._ragged = datasets.train.lengths is not None
         self.mode = self._resolve_mode()
 
@@ -144,19 +169,21 @@ class LMTrainer:
 
     def _resolve_mode(self) -> str:
         cfg = self.config
-        if cfg.dp_mode not in ("replicated", "zero"):
+        if cfg.dp_mode not in ("replicated", "zero", "tp", "ep", "pp"):
             raise ValueError(
-                f"unknown dp_mode {cfg.dp_mode!r}; replicated|zero"
+                f"unknown dp_mode {cfg.dp_mode!r}; replicated|zero|tp|ep|pp"
             )
         if self.mesh is None:
             return "single"
         if not cfg.sync:
-            if cfg.dp_mode == "zero":
+            if cfg.dp_mode != "replicated":
                 # Fail loudly rather than silently train full replicated
-                # per-chip copies under a config that asked for ZeRO.
+                # per-chip copies under a config that asked for a sharded
+                # layout: the async copies are per-chip by construction.
                 raise ValueError(
-                    "dp_mode='zero' does not compose with sync=False: the "
-                    "async copies are per-chip by construction; pick one"
+                    f"dp_mode={cfg.dp_mode!r} does not compose with "
+                    "sync=False: the async copies are per-chip by "
+                    "construction; pick one"
                 )
             if cfg.batch_size % self.mesh.shape[self.data_axis]:
                 raise ValueError(
@@ -165,31 +192,117 @@ class LMTrainer:
                     f"axis size {self.mesh.shape[self.data_axis]}"
                 )
             return "async"
+        if cfg.dp_mode == "tp":
+            if self.tp_axis not in self.mesh.shape:
+                raise ValueError(
+                    f"dp_mode='tp' needs a {self.tp_axis!r} mesh axis: "
+                    f"{dict(self.mesh.shape)}"
+                )
+            if self.model.moe_experts is not None:
+                raise ValueError(
+                    "dp_mode='tp' is not defined for MoE blocks; use "
+                    "dp_mode='ep' (expert parallelism)"
+                )
+            return "tp"
+        if cfg.dp_mode == "ep":
+            if self.model.moe_experts is None:
+                raise ValueError(
+                    "dp_mode='ep' requires a MoE model (moe_experts=E)"
+                )
+            if self.expert_axis not in self.mesh.shape:
+                raise ValueError(
+                    f"dp_mode='ep' needs a {self.expert_axis!r} mesh axis: "
+                    f"{dict(self.mesh.shape)}"
+                )
+            shards = self.mesh.shape.get(self.expert_axis, 1) * (
+                self.mesh.shape.get(self.data_axis, 1)
+                if self._dp_axis() is not None
+                else 1
+            )
+            if cfg.batch_size % shards:
+                raise ValueError(
+                    f"dp_mode='ep' shards the batch {shards} ways: "
+                    f"batch_size {cfg.batch_size} must divide"
+                )
+            return "ep"
+        if cfg.dp_mode == "pp":
+            if self.stage_axis not in self.mesh.shape:
+                raise ValueError(
+                    f"dp_mode='pp' needs a {self.stage_axis!r} mesh axis: "
+                    f"{dict(self.mesh.shape)}"
+                )
+            m = self.pp_microbatches
+            if cfg.batch_size % m:
+                raise ValueError(
+                    f"dp_mode='pp' splits the batch into {m} microbatches: "
+                    f"batch_size {cfg.batch_size} must be divisible"
+                )
+            d = self.mesh.shape.get(self.data_axis, 1)
+            if self._dp_axis() is not None and (cfg.batch_size // m) % d:
+                raise ValueError(
+                    f"dp×pp shards each {cfg.batch_size // m}-row "
+                    f"microbatch over the {d}-way {self.data_axis!r} axis: "
+                    "sizes must divide"
+                )
+            return "pp"
         if cfg.dp_mode == "zero":
             return "zero"
         return "dp"
 
+    def _dp_axis(self) -> str | None:
+        """The data axis to compose on top of tp/ep/pp — present on the
+        mesh or None (pure tp / ep / pp meshes are legal)."""
+        return self.data_axis if self.data_axis in self.mesh.shape else None
+
     def _init_state(self, params) -> TrainState:
+        if self.mode == "pp":
+            # Parts first (their validations), then restage the params so
+            # the optimizer slots are born in the staged layout.
+            from distributed_tensorflow_tpu.models.gpt import (
+                make_lm_pp_parts,
+                pipeline_stage_params,
+            )
+
+            specs, opt_specs, self._pp_loss = make_lm_pp_parts(
+                self.model,
+                self.optimizer,
+                self.mesh,
+                axis=self.stage_axis,
+                num_microbatches=self.pp_microbatches,
+                data_axis=self._dp_axis(),
+            )
+            params = pipeline_stage_params(
+                self.model, params, self.mesh.shape[self.stage_axis]
+            )
+            return self._sharded_init(params, specs, opt_specs=opt_specs)
         opt_state = self.optimizer.init(params)
         if self.mode == "zero":
-            from distributed_tensorflow_tpu.parallel import (
-                as_shardings,
-                fsdp_specs,
-                slot_specs,
-            )
+            from distributed_tensorflow_tpu.parallel import fsdp_specs
 
             pshape = jax.tree.map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params
             )
             pspecs = fsdp_specs(pshape, self.mesh, axis=self.data_axis)
-            self._zero_shardings = as_shardings(self.mesh, pspecs)
-            self._zero_opt_shardings = as_shardings(
-                self.mesh, slot_specs(self.optimizer, pshape, pspecs)
+            return self._sharded_init(params, pspecs, opt_state=opt_state)
+        if self.mode == "tp":
+            return self._sharded_init(
+                params,
+                self.model.partition_specs(self.tp_axis),
+                opt_state=opt_state,
             )
-            return TrainState(
-                jax.device_put(params, self._zero_shardings),
-                jax.device_put(opt_state, self._zero_opt_shardings),
-                jnp.zeros((), jnp.int32),
+        if self.mode == "ep":
+            from distributed_tensorflow_tpu.models.gpt import make_lm_ep_parts
+
+            specs, opt_specs, self._ep_mapped = make_lm_ep_parts(
+                self.model,
+                self.optimizer,
+                self.mesh,
+                self.expert_axis,
+                data_axis=self._dp_axis(),
+                ragged=self._ragged,
+            )
+            return self._sharded_init(
+                params, specs, opt_specs=opt_specs, opt_state=opt_state
             )
         if self.mode == "async":
             from distributed_tensorflow_tpu.models.gpt import (
@@ -211,6 +324,29 @@ class LMTrainer:
             return TrainState(stacked_p, stacked_o, count)
         return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
 
+    def _sharded_init(
+        self, params, pspecs, *, opt_specs=None, opt_state=None
+    ) -> TrainState:
+        """Shared state construction for every GSPMD-sharded-layout mode
+        (zero / tp / ep / pp): record the param + optimizer-slot shardings
+        and place both pytrees under them."""
+        from distributed_tensorflow_tpu.parallel import as_shardings, slot_specs
+
+        if opt_specs is None:
+            pshape = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params
+            )
+            opt_specs = slot_specs(self.optimizer, pshape, pspecs)
+        self._param_shardings = as_shardings(self.mesh, pspecs)
+        self._opt_shardings = as_shardings(self.mesh, opt_specs)
+        if opt_state is None:
+            opt_state = self.optimizer.init(params)
+        return TrainState(
+            jax.device_put(params, self._param_shardings),
+            jax.device_put(opt_state, self._opt_shardings),
+            jnp.zeros((), jnp.int32),
+        )
+
     def _place_state(self, state: TrainState) -> TrainState:
         """Re-place a state pytree into the mode's device layout. Needed
         after Supervisor restore: orbax hands back arrays committed to the
@@ -222,10 +358,10 @@ class LMTrainer:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         repl = NamedSharding(self.mesh, P())
-        if self.mode == "zero":
+        if self.mode in ("zero", "tp", "ep", "pp"):
             return TrainState(
-                jax.device_put(state.params, self._zero_shardings),
-                jax.device_put(state.opt_state, self._zero_opt_shardings),
+                jax.device_put(state.params, self._param_shardings),
+                jax.device_put(state.opt_state, self._opt_shardings),
                 jax.device_put(state.step, repl),
             )
         if self.mode == "async":
@@ -243,11 +379,20 @@ class LMTrainer:
 
     def _eval_params(self, params):
         """Parameters the held-out metric is computed at: async evaluates
-        the mean of the per-chip copies (strategy.py convention), every
-        other mode the parameters themselves. Works traced (the compiled
-        run folds in-graph) and concrete alike."""
+        the mean of the per-chip copies (strategy.py convention), pp
+        merges the staged layer groups back to the [num_layers, ...]
+        stack (pure reshape — the dense forward then reads the same
+        weights the pipeline trains), every other mode the parameters
+        themselves. Works traced (the compiled run folds in-graph) and
+        concrete alike."""
         if self.mode == "async":
             return jax.tree.map(lambda x: jnp.mean(x, axis=0), params)
+        if self.mode == "pp":
+            return params._replace(
+                blocks=jax.tree.map(
+                    lambda a: a.reshape((-1,) + a.shape[2:]), params.blocks
+                )
+            )
         return params
 
     # -- compiled pieces ---------------------------------------------------
@@ -289,8 +434,11 @@ class LMTrainer:
             return toks
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        # Pure-tp / pure-pp meshes have no data axis: the batch stays
+        # replicated (the sharded dimension is the model, not the batch).
+        spec = P(self.data_axis) if self._dp_axis() is not None else P()
         return jax.lax.with_sharding_constraint(
-            toks, NamedSharding(self.mesh, P(self.data_axis))
+            toks, NamedSharding(self.mesh, spec)
         )
 
     def _loss(self, params, toks, lens):
@@ -314,23 +462,38 @@ class LMTrainer:
                 )
 
             return astep
-        if self.mode == "zero":
+        if self.mode == "ep":
+            mapped = self._ep_mapped
+            ragged = self._ragged
+
+            @jax.jit
+            def estep(params, opt_state, count, toks, lens):
+                return mapped(
+                    params, opt_state, toks, lens if ragged else None
+                )
+
+            return estep
+        if self.mode in ("zero", "tp", "pp"):
             from distributed_tensorflow_tpu.parallel import pinned_update
 
-            model, opt = self.model, self.optimizer
-            shardings = self._zero_shardings
-            opt_shardings = self._zero_opt_shardings
+            opt = self.optimizer
+            loss_fn = (
+                self._pp_loss if self.mode == "pp" else self.model.loss
+            )
+            shardings = self._param_shardings
+            opt_shardings = self._opt_shardings
             shard = self._shard_batch
 
             @jax.jit
             def zstep(params, opt_state, count, toks, lens):
                 toks = shard(toks)
-                loss, grads = jax.value_and_grad(model.loss)(
+                loss, grads = jax.value_and_grad(loss_fn)(
                     params, toks, lens
                 )
-                # Owner layout: the batch-sum over 'data' lowers to a
-                # reduce-scatter, the update stays local to each chip's
-                # slice (parallel/fsdp.py rationale).
+                # Owner layout (zero: the batch-sum over 'data' lowers to
+                # a reduce-scatter; tp: Megatron column/row shards; pp:
+                # stage-owned layer groups) — the update stays local to
+                # each chip's slice.
                 params, opt_state = pinned_update(
                     opt, params, opt_state, grads, shardings, opt_shardings
                 )
@@ -383,19 +546,33 @@ class LMTrainer:
                 return (params, opt_state, step + 1), loss
 
             return abody
-        zero = self.mode == "zero"
-        if zero:
+        if self.mode == "ep":
+            mapped = self._ep_mapped
+
+            def ebody(carry, idx):
+                params, opt_state, step = carry
+                toks = toks_all[idx]
+                lens = lens_all[idx] if ragged else None
+                params, opt_state, loss = mapped(
+                    params, opt_state, toks, lens
+                )
+                return (params, opt_state, step + 1), loss
+
+            return ebody
+        pinned = self.mode in ("zero", "tp", "pp")
+        loss_fn = self._pp_loss if self.mode == "pp" else model.loss
+        if pinned:
             from distributed_tensorflow_tpu.parallel import pinned_update
 
         def body(carry, idx):
             params, opt_state, step = carry
             toks = shard(toks_all[idx])
             lens = lens_all[idx] if ragged else None
-            loss, grads = jax.value_and_grad(model.loss)(params, toks, lens)
-            if zero:
+            loss, grads = jax.value_and_grad(loss_fn)(params, toks, lens)
+            if pinned:
                 params, opt_state = pinned_update(
                     opt, params, opt_state, grads,
-                    self._zero_shardings, self._zero_opt_shardings,
+                    self._param_shardings, self._opt_shardings,
                 )
             else:
                 updates, opt_state = opt.update(grads, opt_state, params)
@@ -588,9 +765,11 @@ class LMTrainer:
         if self._eval_chunk is None:
             self._eval_chunk = self._build_eval_chunk()
         params = self.state.params
-        if self.mode == "async":
-            # Fold the stacked copies to their mean ONCE per evaluate call
-            # (not per chunk) — the parameters the metric is defined at.
+        if self.mode in ("async", "pp"):
+            # Fold to the eval layout ONCE per evaluate call (not per
+            # chunk): async takes the mean of the stacked copies, pp
+            # merges the staged layer groups — the parameters the metric
+            # is defined at.
             if not hasattr(self, "_fold_fn"):
                 self._fold_fn = jax.jit(self._eval_params)
             params = self._fold_fn(params)

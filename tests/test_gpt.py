@@ -1336,3 +1336,119 @@ def test_ep_train_step_reduces_loss():
         params, opt_state, loss = step(params, opt_state, batch())
         first = float(loss) if first is None else first
     assert float(loss) < first * 0.95, (first, float(loss))
+
+
+def test_pp_train_step_dp_composes():
+    # dp×pp on a 2-D ('data','stage') mesh (round 4 — the last missing 2-D
+    # composition; dp×tp and dp×ep already exist): each microbatch's rows
+    # shard over 'data', the GPipe schedule runs per data row, stage-owned
+    # layer-group grads arrive data-summed through shard_map's auto-psum.
+    # Must equal the sequential single-device step on the global batch.
+    from distributed_tensorflow_tpu.models.gpt import (
+        make_lm_pp_parts,
+        make_lm_pp_train_step,
+    )
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    model = _model(num_layers=4)
+    params = model.init(seed=55)
+    opt = optim_lib.make("adam", 1e-3)
+    toks = _tokens(np.random.default_rng(55), 16, 16)
+
+    seq_step = make_lm_train_step(model, opt)
+    p_ref, o_ref = params, opt.init(params)
+    for _ in range(3):
+        p_ref, o_ref, l_ref = seq_step(p_ref, o_ref, toks)
+
+    mesh = make_mesh((2, 4), ("data", "stage"), devices=jax.devices()[:8])
+    pp_step = make_lm_pp_train_step(
+        model, opt, mesh, num_microbatches=4, data_axis="data"
+    )
+    p_pp = _pp_place(params, model, mesh, 4)
+    o_pp = opt.init(p_pp)
+    for _ in range(3):
+        p_pp, o_pp, l_pp = pp_step(p_pp, o_pp, toks)
+
+    np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-5)
+    for a, b in zip(
+        jax.tree.leaves(_merge_stages(p_pp)), jax.tree.leaves(p_ref)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=3e-6
+        )
+
+    with pytest.raises(ValueError, match="no 'nope' axis"):
+        make_lm_pp_parts(model, opt, mesh, data_axis="nope")
+    with pytest.raises(ValueError, match="must differ"):
+        make_lm_pp_parts(model, opt, mesh, data_axis="stage")
+
+
+def test_pp_ragged_loss_pad_independent():
+    # The pipeline loss masks the CE for ragged right-padded batches
+    # exactly like GPTLM.loss: pad content cannot change loss or grads
+    # (causal attention already isolates pads in the dense blocks).
+    from distributed_tensorflow_tpu.models.gpt import make_lm_pp_parts
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    model = _model(num_layers=4)
+    params = model.init(seed=56)
+    opt = optim_lib.make("sgd", 1e-2)
+    mesh = make_mesh((4,), ("stage",), devices=jax.devices()[:4])
+    _, _, pp_loss = make_lm_pp_parts(model, opt, mesh, num_microbatches=2)
+    p_pp = _pp_place(params, model, mesh, 4)
+
+    rng = np.random.default_rng(56)
+    toks = np.asarray(_tokens(rng, 4, 16))
+    lengths = jnp.asarray([16, 9, 5, 12], jnp.int32)
+    other = toks.copy()
+    for b, n in enumerate(np.asarray(lengths)):
+        other[b, n:] = (other[b, n:] + 13) % 61
+    f = jax.jit(lambda p, t: jax.value_and_grad(pp_loss)(p, t, lengths))
+    la, ga = f(p_pp, jnp.asarray(toks))
+    lb, gb = f(p_pp, jnp.asarray(other))
+    assert float(la) == float(lb)
+    # And the masked pp CE equals the dense masked loss exactly.
+    dense = model.loss(params, jnp.asarray(toks), lengths)
+    np.testing.assert_allclose(float(la), float(dense), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        )
+
+
+def test_ep_ragged_step_pad_independent():
+    # EP ragged training (round 4): lengths thread through the all-to-all
+    # routing (pads never consume capacity) and the masked CE — the update
+    # is exactly pad-content-independent.
+    from jax.sharding import NamedSharding
+    from distributed_tensorflow_tpu.models.gpt import (
+        expert_parallel_specs,
+        make_lm_ep_parts,
+    )
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    model = _model(moe_experts=4, moe_capacity_factor=4.0, num_layers=2)
+    params = model.init(seed=57)
+    opt = optim_lib.make("adam", 1e-3)
+    mesh = make_mesh((2, 4), ("data", "expert"), devices=jax.devices()[:8])
+    _, _, mapped = make_lm_ep_parts(
+        model, opt, mesh, data_axis="data", ragged=True
+    )
+    specs = expert_parallel_specs(model)
+    p = jax.device_put(
+        params, jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+    )
+    o = opt.init(p)
+    step = jax.jit(mapped)
+
+    rng = np.random.default_rng(57)
+    toks = np.asarray(_tokens(rng, 16, 16))
+    lengths = jnp.asarray(rng.integers(5, 17, size=16), jnp.int32)
+    other = toks.copy()
+    for b, n in enumerate(np.asarray(lengths)):
+        other[b, n:] = (other[b, n:] + 13) % 61
+    pa, oa, la = step(p, o, jnp.asarray(toks), lengths)
+    pb, ob, lb = step(p, o, jnp.asarray(other), lengths)
+    assert float(la) == float(lb)
+    for a, b in zip(jax.tree.leaves(pa), jax.tree.leaves(pb)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
