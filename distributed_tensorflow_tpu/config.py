@@ -114,8 +114,9 @@ class TrainConfig:
     # reduce-scatter grads — parallel/fsdp.py); identical update semantics.
     # The LM trainer additionally accepts "tp" (Megatron tensor parallel,
     # composes with a data axis → dp×tp), "ep" (expert parallel, MoE
-    # models, → dp×ep), and "pp" (GPipe pipeline, → dp×pp) — see
-    # train/lm_trainer.py; the classifier path rejects those three.
+    # models, → dp×ep), "pp" (GPipe pipeline, → dp×pp), and "sp"
+    # (sequence parallel over the causal ring / Ulysses, → dp×sp) — see
+    # train/lm_trainer.py; the classifier path rejects these.
     dp_mode: str = "replicated"
     # Compile each epoch as one lax.scan dispatch (train/scan.py): identical
     # update semantics, ~100x less host overhead. Log lines are emitted from

@@ -1381,6 +1381,132 @@ def make_lm_pp_parts(
     return specs, opt_specs, pp_loss
 
 
+def make_lm_sp_train_step(
+    model: GPTLM,
+    optimizer,
+    mesh,
+    *,
+    axis: str = "seq",
+    data_axis: str | None = None,
+    attention: str | None = None,
+):
+    """Sequence-parallel TRAINING step: the LM trains past one device's
+    activation memory — L/n tokens of activations per device, KV riding
+    the causal ring (or the Ulysses all-to-all) exactly as in
+    :meth:`GPTLM.apply_sequence_parallel`, gradients back through the
+    collectives. ``step(params, opt_state, tokens) -> (params, opt_state,
+    loss)``, jitted; tokens [B, L] with L divisible by the ``axis`` size,
+    params replicated (no layout to place). ``data_axis`` composes data
+    parallelism → dp×sp on a ``('data','seq')`` mesh. Proven equal to the
+    single-device step in tests/test_gpt.py."""
+    mapped = make_lm_sp_parts(
+        model, optimizer, mesh, axis,
+        data_axis=data_axis, attention=attention,
+    )
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        return mapped(params, opt_state, tokens, None)
+
+    return step
+
+
+def make_lm_sp_parts(
+    model: GPTLM,
+    optimizer,
+    mesh,
+    axis: str = "seq",
+    *,
+    data_axis: str | None = None,
+    attention: str | None = None,
+    ragged: bool = False,
+):
+    """Building blocks behind :func:`make_lm_sp_train_step`, exposed (like
+    the ep/pp parts) so the LM trainer can embed the sequence-parallel
+    update inside its scanned-epoch / whole-run-compiled bodies. Returns
+    ``mapped(params, opt_state, tokens, lengths) -> (params, opt_state,
+    loss)`` — NOT jitted; tokens [B, L] sharded on the SEQUENCE dim over
+    ``axis`` (and the batch dim over ``data_axis`` when given), params
+    and optimizer slots replicated.
+
+    The loss is the EXACT global (masked) next-token CE — not a per-shard
+    mean: each device scores its l_loc positions, the shard-boundary
+    target (position s+l_loc−1 predicts the NEXT shard's first token)
+    arrives over one ``ppermute`` hop, and CE·count sums are
+    ``psum``-aggregated over all axes before the division. Equal to
+    :func:`_ce_from_logits` on the gathered sequence by construction,
+    ragged or not — so sp training is bitwise-tolerant equal to the
+    single-device step (grads of the replicated params arrive through
+    shard_map's auto-psum, already globally summed; no rescaling).
+
+    ``attention`` follows :meth:`GPTLM.apply_sequence_parallel` (ring /
+    ring_flash / ulysses; ring_flash needs a TPU or check_vma=False)."""
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    if model.moe_experts is not None:
+        raise NotImplementedError(
+            "MoE blocks are not supported on the sequence-parallel path; "
+            "use expert parallelism (make_lm_ep_parts)"
+        )
+    n = mesh.shape[axis]
+    if data_axis is not None and data_axis not in mesh.shape:
+        raise ValueError(f"mesh has no {data_axis!r} axis: {dict(mesh.shape)}")
+    if data_axis == axis:
+        raise ValueError(f"data_axis must differ from the seq axis {axis!r}")
+    axes = (axis,) if data_axis is None else (data_axis, axis)
+    batch_spec = P(data_axis, axis)  # data_axis=None → replicated batch dim
+    lens_spec = P(data_axis)
+    # Shard i receives shard (i+1)'s first token — the boundary target.
+    perm = [(j, (j - 1) % n) for j in range(n)]
+
+    def sp_loss(params, toks, lens):
+        l_loc = toks.shape[1]
+        my = lax.axis_index(axis)
+        logits = model.apply_sequence_parallel(
+            params, toks, axis, attention=attention
+        )
+        nxt = lax.ppermute(toks[:, 0], axis, perm)
+        targets = jnp.concatenate([toks[:, 1:], nxt[:, None]], axis=1)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        # Absolute index of each local position's target token.
+        tpos = my * l_loc + jnp.arange(l_loc) + 1
+        valid = tpos[None, :] < n * l_loc  # the last global position has
+        if lens is not None:  # no target (wrapped garbage masked here)
+            valid = valid & (tpos[None, :] < lens[:, None])
+        # Broadcast to [B, l_loc] BEFORE counting: the non-ragged mask is
+        # per-position only and the count must include the batch factor.
+        w = jnp.broadcast_to(valid, picked.shape).astype(jnp.float32)
+        # pvary to the full psum axes first: non-ragged w only varies over
+        # the seq axis, and psum rejects axes the operand is invariant of.
+        ce = lax.psum(to_varying(-jnp.sum(picked * w), axes), axes)
+        cnt = lax.psum(to_varying(jnp.sum(w), axes), axes)
+        return ce / jnp.maximum(cnt, 1.0)
+
+    def local(params, opt_state, toks, lens):
+        loss, grads = jax.value_and_grad(sp_loss)(
+            params, toks, lens if ragged else None
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    inner = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(), batch_spec, lens_spec if ragged else P()),
+        out_specs=(P(), P(), P()),
+    )
+
+    def mapped(params, opt_state, tokens, lens):
+        if lens is None:
+            lens = jnp.zeros((), jnp.int32)
+        return inner(params, opt_state, tokens, lens)
+
+    return mapped
+
+
 def make_lm_async_train_step(
     model: GPTLM,
     optimizer,

@@ -122,11 +122,18 @@ def ring_attention(
     q32 = q.astype(jnp.float32)
     # pvary: the zero-init carries are device-invariant but the loop body
     # makes them device-varying; shard_map's vma typing requires the carry
-    # types to match up front.
+    # types to match up front. Derived from q (q*0, not fresh constants)
+    # so they also inherit any OTHER varying axes — under a 2-D dp×sp
+    # shard_map the batch is varying over 'data' and the carries must be
+    # too (same pattern as parallel/pipeline.py).
     pvary = partial(to_varying, axis_name=(axis_name,))
-    m = pvary(jnp.full((b, h, l_loc, 1), _NEG_INF, jnp.float32))
-    s = pvary(jnp.zeros((b, h, l_loc, 1), jnp.float32))
-    o = pvary(jnp.zeros((b, h, l_loc, d), jnp.float32))
+    # stop_gradient keeps the init off the AD path (a q*0 cotangent route
+    # would put pcast's psum transpose on paths check_vma=False can't
+    # type) while preserving q's vma on the zeros.
+    zeros = jnp.moveaxis(lax.stop_gradient(q32) * 0, 1, 2)  # [b,h,l_loc,d]
+    m = pvary(zeros[..., :1] + _NEG_INF)
+    s = pvary(zeros[..., :1])
+    o = pvary(zeros)
 
     q_pos = my * l_loc + jnp.arange(l_loc)  # global positions of local q rows
 
@@ -242,9 +249,22 @@ def ring_flash_attention(
     my = lax.axis_index(axis_name)
     b, l_loc, h, d = q.shape
     perm = _ring_perm(n)
-    kw = dict(block_q=block_q, block_k=block_k, vma=(axis_name,))
+    # The kernel's declared output vma must match ALL axes the inputs vary
+    # over — under a 2-D dp×sp shard_map that is {data, seq}, not just the
+    # ring axis (jax.typeof reads the tracer's vma; a plain jit gives the
+    # empty set plus the ring axis).
+    from distributed_tensorflow_tpu.ops.collectives import _vma_of
+
+    vma = _vma_of(q) | {axis_name}
+    kw = dict(block_q=block_q, block_k=block_k, vma=tuple(vma))
 
     pvary = partial(to_varying, axis_name=(axis_name,))
+    # Zero/-inf init carries and skip-branch constants derived from q
+    # (stop_gradient(q)*0 — off the AD path) so they inherit q's full vma
+    # (see ring_attention above).
+    _q0 = lax.stop_gradient(q) * 0
+    _zo = lambda dt=jnp.float32: _q0.astype(dt)  # noqa: E731
+    _zlse = _q0[..., 0].astype(jnp.float32) + _NEG_INF  # [b, l_loc, h]
 
     def _hop_lens(src):
         # Block-relative key-padding for the block held this hop (its keys
@@ -256,10 +276,7 @@ def ring_flash_attention(
     def _skip(q, kb, vb, lens):
         # Constants, but typed varying to match the flash branches' outputs
         # under check_vma (all lax.switch/cond branches must agree).
-        return (
-            pvary(jnp.zeros((b, l_loc, h, d), q.dtype)),
-            pvary(jnp.full((b, l_loc, h), _NEG_INF, jnp.float32)),
-        )
+        return pvary(_zo(q.dtype)), pvary(_zlse)
 
     def _combine(o, lse, o_i, lse_i):
         new_lse = jnp.logaddexp(lse, lse_i)
@@ -274,8 +291,8 @@ def ring_flash_attention(
         # Statically-unrolled bounded ring: hop count and each hop's kernel
         # offset are compile-time constants (the kernel's masks are static).
         hops = _window_hops(window, l_loc, n)
-        o = pvary(jnp.zeros((b, l_loc, h, d), jnp.float32))
-        lse = pvary(jnp.full((b, l_loc, h), _NEG_INF, jnp.float32))
+        o = pvary(_zo())
+        lse = pvary(_zlse)
         kv = (k, v)
         for step in range(hops):
             k_blk, v_blk = kv
@@ -307,8 +324,8 @@ def ring_flash_attention(
                 )
         return o.astype(q.dtype)
 
-    o = pvary(jnp.zeros((b, l_loc, h, d), jnp.float32))
-    lse = pvary(jnp.full((b, l_loc, h), _NEG_INF, jnp.float32))
+    o = pvary(_zo())
+    lse = pvary(_zlse)
 
     def _full(q, kb, vb, lens):
         return flash_attention_with_lse(

@@ -48,12 +48,15 @@ def pipeline_apply(
     perm = [(j, (j + 1) % s_count) for j in range(s_count)]
 
     pvary = lambda v: to_varying(v, (axis_name,))  # noqa: E731
-    # Zeros DERIVED from the input (x*0, not fresh constants) so they
-    # inherit its varying-axes type: under a 2-D dp×stage shard_map the
-    # microbatches are varying over 'data' too, and the fori_loop carry
-    # must carry that vma from tick 0 (check_vma rejects a mid-loop lub).
-    carry = pvary(x_microbatches[0] * 0)
-    out = pvary((x_microbatches * 0).astype(jnp.float32))
+    # Zeros DERIVED from the input (stop_gradient(x)*0, not fresh
+    # constants) so they inherit its varying-axes type: under a 2-D
+    # dp×stage shard_map the microbatches are varying over 'data' too,
+    # and the fori_loop carry must carry that vma from tick 0 (check_vma
+    # rejects a mid-loop lub). stop_gradient keeps the zeros off the AD
+    # path (ops/ring_attention.py rationale).
+    x0 = lax.stop_gradient(x_microbatches) * 0
+    carry = pvary(x0[0])
+    out = pvary(x0.astype(jnp.float32))
 
     def tick(t, state):
         carry, out = state

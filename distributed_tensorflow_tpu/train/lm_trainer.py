@@ -58,15 +58,22 @@ picking which script to launch, reference README.md:90-121):
 - ``mesh`` + ``dp_mode="pp"`` → **pp** (GPipe pipeline training over
   ``stage_axis`` via ``models/gpt.make_lm_pp_parts`` — stage-owned layer
   groups + slots, backward as the tick-scan transpose; composes with a
-  ``data`` axis → dp×pp; ``pp_microbatches`` microbatches).
+  ``data`` axis → dp×pp; ``pp_microbatches`` microbatches);
+- ``mesh`` + ``dp_mode="sp"`` → **sp** (sequence-parallel training over
+  ``seq_axis`` via ``models/gpt.make_lm_sp_parts`` — L/n tokens of
+  activations per device, KV on the causal ring (or Ulysses all-to-all,
+  ``sp_attention=``), the EXACT global masked CE assembled from psum'd
+  shard sums with the boundary target over one ppermute hop; params
+  replicated; composes with a ``data`` axis → dp×sp).
 
 Every mode runs the FULL lifecycle: log lines, per-epoch perplexity,
 tfevents, Supervisor save/restore (async checkpoints the stacked copies;
-zero/tp/ep/pp checkpoint sharded arrays — pp in the staged layout), the
-scanned epoch, and run_compiled. Held-out perplexity is defined at the
-model's dense forward everywhere (async folds the copies to their mean;
-pp merges the staged layer groups back; ep reads the dense forward, ==
-the EP forward in the no-drop regime — ``drop_fraction`` is the guard).
+zero/tp/ep/pp checkpoint sharded arrays — pp in the staged layout; sp
+params are replicated), the scanned epoch, and run_compiled. Held-out
+perplexity is defined at the model's dense forward everywhere (async
+folds the copies to their mean; pp merges the staged layer groups back;
+ep reads the dense forward, == the EP forward in the no-drop regime —
+``drop_fraction`` is the guard; sp == dense exactly).
 """
 
 from __future__ import annotations
@@ -107,6 +114,8 @@ class LMTrainer:
         expert_axis: str = "expert",
         stage_axis: str = "stage",
         pp_microbatches: int = 4,
+        seq_axis: str = "seq",
+        sp_attention: str | None = None,
     ):
         self.model = model
         self.datasets = datasets
@@ -125,6 +134,8 @@ class LMTrainer:
         self.expert_axis = expert_axis
         self.stage_axis = stage_axis
         self.pp_microbatches = pp_microbatches
+        self.seq_axis = seq_axis
+        self.sp_attention = sp_attention
         self._ragged = datasets.train.lengths is not None
         self.mode = self._resolve_mode()
 
@@ -169,9 +180,10 @@ class LMTrainer:
 
     def _resolve_mode(self) -> str:
         cfg = self.config
-        if cfg.dp_mode not in ("replicated", "zero", "tp", "ep", "pp"):
+        if cfg.dp_mode not in ("replicated", "zero", "tp", "ep", "pp", "sp"):
             raise ValueError(
-                f"unknown dp_mode {cfg.dp_mode!r}; replicated|zero|tp|ep|pp"
+                f"unknown dp_mode {cfg.dp_mode!r}; "
+                "replicated|zero|tp|ep|pp|sp"
             )
         if self.mesh is None:
             return "single"
@@ -245,6 +257,32 @@ class LMTrainer:
                     "sizes must divide"
                 )
             return "pp"
+        if cfg.dp_mode == "sp":
+            if self.seq_axis not in self.mesh.shape:
+                raise ValueError(
+                    f"dp_mode='sp' needs a {self.seq_axis!r} mesh axis: "
+                    f"{dict(self.mesh.shape)}"
+                )
+            if self.model.moe_experts is not None:
+                raise ValueError(
+                    "dp_mode='sp' is not defined for MoE blocks; use "
+                    "dp_mode='ep' (expert parallelism)"
+                )
+            s = self.mesh.shape[self.seq_axis]
+            seq_len = self.datasets.train.tokens.shape[1]
+            if seq_len % s:
+                raise ValueError(
+                    f"dp_mode='sp' shards the {seq_len}-token sequence "
+                    f"over the {s}-way {self.seq_axis!r} axis: must divide"
+                )
+            d = self.mesh.shape.get(self.data_axis, 1)
+            if self._dp_axis() is not None and cfg.batch_size % d:
+                raise ValueError(
+                    f"dp×sp shards the batch over the {d}-way "
+                    f"{self.data_axis!r} axis: batch_size {cfg.batch_size} "
+                    "must divide"
+                )
+            return "sp"
         if cfg.dp_mode == "zero":
             return "zero"
         return "dp"
@@ -293,7 +331,7 @@ class LMTrainer:
         if self.mode == "ep":
             from distributed_tensorflow_tpu.models.gpt import make_lm_ep_parts
 
-            specs, opt_specs, self._ep_mapped = make_lm_ep_parts(
+            specs, opt_specs, self._mapped_update = make_lm_ep_parts(
                 self.model,
                 self.optimizer,
                 self.mesh,
@@ -304,6 +342,20 @@ class LMTrainer:
             return self._sharded_init(
                 params, specs, opt_specs=opt_specs, opt_state=opt_state
             )
+        if self.mode == "sp":
+            from distributed_tensorflow_tpu.models.gpt import make_lm_sp_parts
+
+            self._mapped_update = make_lm_sp_parts(
+                self.model,
+                self.optimizer,
+                self.mesh,
+                self.seq_axis,
+                data_axis=self._dp_axis(),
+                attention=self.sp_attention,
+                ragged=self._ragged,
+            )
+            # Params stay replicated (sp shards activations, not weights):
+            # the plain TrainState below is already the right layout.
         if self.mode == "async":
             from distributed_tensorflow_tpu.models.gpt import (
                 make_lm_async_parts,
@@ -462,8 +514,8 @@ class LMTrainer:
                 )
 
             return astep
-        if self.mode == "ep":
-            mapped = self._ep_mapped
+        if self.mode in ("ep", "sp"):
+            mapped = self._mapped_update
             ragged = self._ragged
 
             @jax.jit
@@ -546,8 +598,8 @@ class LMTrainer:
                 return (params, opt_state, step + 1), loss
 
             return abody
-        if self.mode == "ep":
-            mapped = self._ep_mapped
+        if self.mode in ("ep", "sp"):
+            mapped = self._mapped_update
 
             def ebody(carry, idx):
                 params, opt_state, step = carry

@@ -1452,3 +1452,86 @@ def test_ep_ragged_step_pad_independent():
     assert float(la) == float(lb)
     for a, b in zip(jax.tree.leaves(pa), jax.tree.leaves(pb)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sp_train_step_matches_single_device():
+    # Sequence-parallel TRAINING (round 4): the LM trains with L/n tokens
+    # of activations per device; the loss is the EXACT global CE — each
+    # shard's boundary target (last local position predicts the NEXT
+    # shard's first token) arrives over one ppermute hop, CE·count sums
+    # psum-aggregated. dp×sp on ('data','seq') must equal the
+    # single-device step on the global batch.
+    from distributed_tensorflow_tpu.models.gpt import (
+        make_lm_sp_parts,
+        make_lm_sp_train_step,
+    )
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    model = _model(num_layers=2)
+    params = model.init(seed=58)
+    opt = optim_lib.make("adam", 1e-3)
+    toks = _tokens(np.random.default_rng(58), 8, 16)
+
+    seq_step = make_lm_train_step(model, opt)
+    p_ref, o_ref = params, opt.init(params)
+    for _ in range(3):
+        p_ref, o_ref, l_ref = seq_step(p_ref, o_ref, toks)
+
+    mesh = make_mesh((2, 4), ("data", "seq"), devices=jax.devices()[:8])
+    sp_step = make_lm_sp_train_step(model, opt, mesh, data_axis="data")
+    p_sp, o_sp = params, opt.init(params)
+    for _ in range(3):
+        p_sp, o_sp, l_sp = sp_step(p_sp, o_sp, toks)
+
+    np.testing.assert_allclose(float(l_sp), float(l_ref), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p_sp), jax.tree.leaves(p_ref)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=3e-6
+        )
+
+    with pytest.raises(ValueError, match="no 'nope' axis"):
+        make_lm_sp_parts(model, opt, mesh, data_axis="nope")
+    with pytest.raises(ValueError, match="must differ"):
+        make_lm_sp_parts(model, opt, mesh, "seq", data_axis="seq")
+    with pytest.raises(NotImplementedError, match="expert parallelism"):
+        make_lm_sp_parts(
+            _model(moe_experts=4, num_layers=2), opt, mesh
+        )
+
+
+@pytest.mark.parametrize("gqa_window", [False, pytest.param(True, marks=pytest.mark.heavy)])
+def test_sp_ragged_loss_exact_and_pad_independent(gqa_window):
+    # The sp loss must equal GPTLM.loss's masked mean EXACTLY (global
+    # psum'd sums, not a per-shard mean) and be pad-content-independent;
+    # also under GQA + sliding window (the bounded ring).
+    from distributed_tensorflow_tpu.models.gpt import make_lm_sp_parts
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    kw = dict(num_layers=2)
+    if gqa_window:
+        kw.update(num_heads=4, num_kv_heads=2, window=6)
+    model = _model(**kw)
+    params = model.init(seed=59)
+    opt = optim_lib.make("adam", 1e-3)
+    mesh = make_mesh((2, 4), ("data", "seq"), devices=jax.devices()[:8])
+    mapped = make_lm_sp_parts(
+        model, opt, mesh, data_axis="data", ragged=True
+    )
+    step = jax.jit(mapped)
+
+    rng = np.random.default_rng(59)
+    toks = np.asarray(_tokens(rng, 8, 16))
+    lengths = jnp.asarray(rng.integers(5, 17, size=8), jnp.int32)
+    other = toks.copy()
+    for b, n in enumerate(np.asarray(lengths)):
+        other[b, n:] = (other[b, n:] + 13) % 61
+    o = opt.init(params)
+    pa, oa, la = step(params, o, jnp.asarray(toks), lengths)
+    pb, ob, lb = step(params, o, jnp.asarray(other), lengths)
+    assert float(la) == float(lb)
+    np.testing.assert_allclose(
+        float(la), float(model.loss(params, jnp.asarray(toks), lengths)),
+        rtol=1e-5,
+    )
+    for a, b in zip(jax.tree.leaves(pa), jax.tree.leaves(pb)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -227,6 +227,10 @@ def _mode_trainer(mode, corpus, cfg_kw=None, **trainer_kw):
         trainer_kw.setdefault("mesh", _mesh8((2, 4), ("data", "stage")))
         cfg_kw.setdefault("dp_mode", "pp")
         model_kw.setdefault("num_layers", 4)
+    elif mode == "sp":
+        # dp×sp: sequence over 4-way 'seq', batch over 2-way 'data'.
+        trainer_kw.setdefault("mesh", _mesh8((2, 4), ("data", "seq")))
+        cfg_kw.setdefault("dp_mode", "sp")
     else:
         raise AssertionError(mode)
     trainer_kw.setdefault("print_fn", lambda *a: None)
@@ -249,6 +253,7 @@ def _mode_trainer(mode, corpus, cfg_kw=None, **trainer_kw):
         pytest.param("tp", marks=pytest.mark.heavy),
         pytest.param("ep", marks=pytest.mark.heavy),
         pytest.param("pp", marks=pytest.mark.heavy),
+        pytest.param("sp", marks=pytest.mark.heavy),
     ],
 )
 def test_lifecycle_matrix(mode, corpus, tmp_path):
@@ -312,6 +317,7 @@ def test_lifecycle_matrix(mode, corpus, tmp_path):
         pytest.param("tp", marks=pytest.mark.heavy),
         pytest.param("ep", marks=pytest.mark.heavy),
         pytest.param("pp", marks=pytest.mark.heavy),
+        pytest.param("sp", marks=pytest.mark.heavy),
     ],
 )
 def test_mode_scanned_equals_eager(mode, corpus):
@@ -416,7 +422,7 @@ def test_ragged_corpus_trains_with_masked_loss():
 
 
 @pytest.mark.heavy
-@pytest.mark.parametrize("mode", ["async", "zero", "tp", "ep", "pp"])
+@pytest.mark.parametrize("mode", ["async", "zero", "tp", "ep", "pp", "sp"])
 def test_ragged_modes_scanned_equals_eager(mode):
     # The ragged lens threading is mode-specific plumbing (async shards
     # lengths P(axis) into each copy's masked loss; zero passes them
