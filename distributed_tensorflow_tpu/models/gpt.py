@@ -979,18 +979,25 @@ class GPTLM:
         *,
         temperature: float = 1.0,
         top_k: int | None = None,
+        top_p: float | None = None,
     ) -> jax.Array:
         """Stochastic counterpart of :meth:`greedy_decode`: categorical
         sampling from ``logits/temperature``, optionally truncated to the
-        ``top_k`` highest-probability tokens. Same one-``lax.scan`` shape —
-        the PRNG key rides the carry, so generation stays fully on-device
-        and reproducible per key. ``top_k=1`` is exactly greedy."""
+        ``top_k`` highest-probability tokens and/or the ``top_p`` nucleus
+        (smallest prefix of the probability-sorted vocabulary whose mass
+        reaches p — Holtzman et al.'s nucleus sampling; applied after
+        ``top_k`` when both are set, the usual composition). Same
+        one-``lax.scan`` shape — the PRNG key rides the carry, so
+        generation stays fully on-device and reproducible per key.
+        ``top_k=1`` is exactly greedy; ``top_p=1.0`` keeps everything."""
         if temperature <= 0:
             raise ValueError(f"temperature must be > 0, got {temperature}")
         if top_k is not None and not 1 <= top_k <= self.vocab_size:
             raise ValueError(
                 f"top_k must be in [1, {self.vocab_size}], got {top_k}"
             )
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
 
         def pick(logits, k):
             logits = logits.astype(jnp.float32) / temperature
@@ -1002,6 +1009,26 @@ class GPTLM:
                 vals, idx = lax.top_k(logits, top_k)
                 rows = jnp.arange(logits.shape[0])[:, None]
                 logits = jnp.full_like(logits, -jnp.inf).at[rows, idx].set(vals)
+            if top_p is not None and top_p < 1.0:
+                # Keep tokens whose EXCLUSIVE cumulative probability (mass
+                # strictly ahead of them in sorted order) is < p: the
+                # smallest prefix reaching p mass, never empty (the top
+                # token's exclusive mass is 0), and the boundary token
+                # that crosses p is kept — the standard nucleus rule.
+                # Scatter the keep mask back through the sort order (not a
+                # >=-threshold test, which would re-admit tokens exactly
+                # tied with the boundary — the same tie hazard the top_k
+                # scatter above avoids).
+                order = jnp.argsort(logits, axis=-1)[..., ::-1]
+                sorted_l = jnp.take_along_axis(logits, order, axis=-1)
+                probs = jax.nn.softmax(sorted_l, axis=-1)
+                keep_sorted = jnp.cumsum(probs, axis=-1) - probs < top_p
+                rows = jnp.arange(logits.shape[0])[:, None]
+                keep = (
+                    jnp.zeros(logits.shape, bool).at[rows, order]
+                    .set(keep_sorted)
+                )
+                logits = jnp.where(keep, logits, -jnp.inf)
             return jax.random.categorical(k, logits, axis=-1).astype(
                 prompt.dtype
             )

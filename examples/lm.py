@@ -63,7 +63,8 @@ def main(epochs: int = 8, max_new: int = 16) -> None:
     )  # first half + 2 copied tokens: the model should continue the copy
     greedy = model.greedy_decode(params, prompt, max_new)
     sampled = model.sample_decode(
-        params, prompt, max_new, jax.random.key(0), temperature=0.7, top_k=8
+        params, prompt, max_new, jax.random.key(0), temperature=0.7,
+        top_k=8, top_p=0.95
     )
     ncheck = min(6, max_new)
     copied = np.asarray(greedy[:, 10 : 10 + ncheck])

@@ -274,6 +274,94 @@ def test_sample_decode_valid_and_key_dependent():
     assert not np.array_equal(a, b)
 
 
+def test_sample_decode_top_p():
+    # Nucleus (top-p) sampling: p→0 degenerates to greedy, p=1.0 keeps
+    # the whole vocabulary (identical draws to plain sampling), and for
+    # mid p every sampled token lies inside the nucleus of its step's
+    # distribution (checked on the first generated position, whose
+    # distribution we can read off prefill logits).
+    model = _model()
+    params = _noisy(model.init(seed=17))
+    prompt = _tokens(np.random.default_rng(17), 2, 5)
+    k = jax.random.key(3)
+    greedy = np.asarray(model.greedy_decode(params, prompt, 8))
+    tiny = np.asarray(
+        model.sample_decode(params, prompt, 8, k, top_p=1e-6)
+    )
+    np.testing.assert_array_equal(tiny, greedy)
+    plain = np.asarray(model.sample_decode(params, prompt, 8, k))
+    full = np.asarray(model.sample_decode(params, prompt, 8, k, top_p=1.0))
+    np.testing.assert_array_equal(plain, full)
+
+    # Nucleus membership at the first generated position.
+    p = 0.5
+    logits, _ = jax.jit(model.prefill)(params, prompt)
+    probs = np.asarray(jax.nn.softmax(logits.astype(jnp.float32), axis=-1))
+    order = np.argsort(-probs, axis=-1)
+    first = jax.jit(
+        lambda key: model.sample_decode(params, prompt, 1, key, top_p=p)[
+            :, -1
+        ]
+    )
+    nuclei = []
+    for b in range(2):
+        srt = probs[b, order[b]]
+        keep = np.cumsum(srt) - srt < p
+        nuclei.append(set(order[b, keep].tolist()))
+        assert 1 <= len(nuclei[b]) < 61
+    draws = np.stack(
+        [np.asarray(first(jax.random.key(s))) for s in range(64)]
+    )
+    for b in range(2):
+        assert set(draws[:, b].tolist()) <= nuclei[b]
+    # Validation surface.
+    with pytest.raises(ValueError, match="top_p"):
+        model.sample_decode(params, prompt, 4, k, top_p=0.0)
+    with pytest.raises(ValueError, match="top_p"):
+        model.sample_decode(params, prompt, 4, k, top_p=1.5)
+
+
+def test_distributed_decode_matches_single_device():
+    # Serving composition (round 4): the SAME jitted decode loop runs
+    # tp×dp-distributed under GSPMD — params in the Megatron layout over
+    # 'model' (KV cache shards over heads by propagation), prompt rows
+    # over 'data' — token-identical to the single-device decode, greedy
+    # and nucleus-sampled alike.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    model = _model(num_kv_heads=2, num_layers=2)
+    params = _noisy(model.init(seed=18))
+    prompt = _tokens(np.random.default_rng(18), 8, 5)
+    want = jax.jit(lambda p, t: model.greedy_decode(p, t, 10))(
+        params, prompt
+    )
+
+    mesh = make_mesh((4, 2), ("data", "model"), devices=jax.devices()[:8])
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        model.partition_specs("model"),
+        is_leaf=lambda x: isinstance(x, type(P())),
+    )
+    tp_params = jax.device_put(params, shardings)
+    dp_prompt = jax.device_put(prompt, NamedSharding(mesh, P("data")))
+    got = jax.jit(lambda p, t: model.greedy_decode(p, t, 10))(
+        tp_params, dp_prompt
+    )
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+    sample = jax.jit(
+        lambda p, t, k: model.sample_decode(
+            p, t, 10, k, temperature=0.8, top_p=0.9
+        )
+    )
+    k = jax.random.key(9)
+    np.testing.assert_array_equal(
+        np.asarray(sample(params, prompt, k)),
+        np.asarray(sample(tp_params, dp_prompt, k)),
+    )
+
+
 def test_windowed_lm_decode_matches_reforward():
     # Sliding-window LM: the decode-path cache mask must reproduce exactly
     # the band the training mask applies, including once the context has
