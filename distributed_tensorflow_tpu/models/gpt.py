@@ -923,10 +923,9 @@ class GPTLM:
         new_cache = KVCache(k=nk, v=nv, length=cache.length + 1)
         return self._logits(params, h)[:, 0], new_cache
 
-    def _decode_loop(self, params, prompt, max_new, pick, key):
-        """Shared generation scaffold: prefill, then one ``lax.scan`` of
-        decode steps, each choosing the next token via ``pick(logits, key)``
-        (greedy ignores the key). Returns [B, L0 + max_new]."""
+    def _check_decode_bounds(self, prompt, max_new):
+        """Shared generation-length validation (every decode entry point:
+        greedy / sampled / beam)."""
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
         if prompt.shape[1] + max_new > self.max_len:
@@ -934,6 +933,12 @@ class GPTLM:
                 f"prompt {prompt.shape[1]} + max_new {max_new} exceeds "
                 f"max_len {self.max_len}"
             )
+
+    def _decode_loop(self, params, prompt, max_new, pick, key):
+        """Shared generation scaffold: prefill, then one ``lax.scan`` of
+        decode steps, each choosing the next token via ``pick(logits, key)``
+        (greedy ignores the key). Returns [B, L0 + max_new]."""
+        self._check_decode_bounds(prompt, max_new)
         logits, cache = self.prefill(params, prompt)
         key, sub = jax.random.split(key)
         first = pick(logits, sub)
@@ -1034,6 +1039,118 @@ class GPTLM:
             )
 
         return self._decode_loop(params, prompt, max_new, pick, key)
+
+    def beam_decode(
+        self,
+        params: GPTLMParams,
+        prompt: jax.Array,
+        max_new: int,
+        beam_size: int,
+        *,
+        eos_id: int | None = None,
+        length_penalty: float = 0.0,
+    ) -> jax.Array:
+        """Beam search over the KV cache: keep the ``beam_size`` highest
+        log-probability continuations at every step, all beams advancing
+        in ONE batched decode (the cache runs at batch B·K; beam
+        reordering is a gather on its batch dim), the whole search one
+        ``lax.scan`` like the samplers. Returns the best beam per row,
+        [B, L0 + max_new].
+
+        ``eos_id``: a beam that emits it is finished — it only extends
+        with further ``eos_id`` tokens at zero cost (its score freezes),
+        so the returned row is the sequence followed by EOS padding.
+        ``length_penalty`` α ranks final beams by ``score / len_gen**α``
+        (α=0 — the default — is pure summed log-probability; α>0 favors
+        longer finished sequences, the usual normalization); ``len_gen``
+        counts generated tokens up to and including the first EOS.
+
+        ``beam_size=1`` is exactly :meth:`greedy_decode`. The first
+        expansion seeds at most ``vocab_size`` distinct beams (top-k of
+        one distribution), so ``beam_size`` must be ≤ ``vocab_size``."""
+        b, l0 = prompt.shape
+        kbeams = beam_size
+        self._check_decode_bounds(prompt, max_new)
+        if not 1 <= kbeams <= self.vocab_size:
+            raise ValueError(
+                f"beam_size must be in [1, {self.vocab_size}], got {kbeams}"
+            )
+        if eos_id is not None and not 0 <= eos_id < self.vocab_size:
+            raise ValueError(
+                f"eos_id must be in [0, {self.vocab_size}), got {eos_id}"
+            )
+        v = self.vocab_size
+
+        logits, cache = self.prefill(params, prompt)
+        logp0 = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        scores, tok = lax.top_k(logp0, kbeams)  # [B, K]
+        tok = tok.astype(prompt.dtype)
+        cache = KVCache(
+            k=jnp.repeat(cache.k, kbeams, axis=1),
+            v=jnp.repeat(cache.v, kbeams, axis=1),
+            length=cache.length,
+        )
+        seqs = jnp.zeros((b, kbeams, max_new), prompt.dtype)
+        seqs = seqs.at[:, :, 0].set(tok)
+        finished = (
+            tok == eos_id
+            if eos_id is not None
+            else jnp.zeros((b, kbeams), bool)
+        )
+
+        def body(carry, t):
+            seqs, scores, finished, cache, tok = carry
+            step_logits, cache = self.decode_step(
+                params, tok.reshape(b * kbeams), cache
+            )
+            logp = jax.nn.log_softmax(
+                step_logits.astype(jnp.float32), axis=-1
+            ).reshape(b, kbeams, v)
+            if eos_id is not None:
+                # Finished beams extend only with EOS, at zero cost.
+                only_eos = jnp.full((v,), -jnp.inf).at[eos_id].set(0.0)
+                logp = jnp.where(finished[..., None], only_eos, logp)
+            flat = (scores[..., None] + logp).reshape(b, kbeams * v)
+            scores, idx = lax.top_k(flat, kbeams)
+            parent = idx // v  # [B, K] — which beam each winner extends
+            tok = (idx % v).astype(prompt.dtype)
+            flat_parent = (
+                jnp.arange(b)[:, None] * kbeams + parent
+            ).reshape(b * kbeams)
+            cache = KVCache(
+                k=jnp.take(cache.k, flat_parent, axis=1),
+                v=jnp.take(cache.v, flat_parent, axis=1),
+                length=cache.length,
+            )
+            seqs = jnp.take_along_axis(seqs, parent[..., None], axis=1)
+            seqs = lax.dynamic_update_slice(seqs, tok[..., None], (0, 0, t))
+            finished = jnp.take_along_axis(finished, parent, axis=1)
+            if eos_id is not None:
+                finished = finished | (tok == eos_id)
+            return (seqs, scores, finished, cache, tok), None
+
+        if max_new > 1:
+            (seqs, scores, finished, _, _), _ = lax.scan(
+                body,
+                (seqs, scores, finished, cache, tok),
+                jnp.arange(1, max_new),
+            )
+        # Rank beams: generated length = up to and including first EOS.
+        if eos_id is not None and length_penalty != 0.0:
+            is_eos = seqs == eos_id
+            first_eos = jnp.argmax(is_eos, axis=-1)  # 0 when none
+            has_eos = jnp.any(is_eos, axis=-1)
+            gen_len = jnp.where(has_eos, first_eos + 1, max_new)
+        else:
+            gen_len = jnp.full((b, kbeams), max_new)
+        ranked = scores / jnp.maximum(
+            gen_len.astype(jnp.float32), 1.0
+        ) ** jnp.float32(length_penalty)
+        best = jnp.argmax(ranked, axis=-1)  # [B]
+        best_seq = jnp.take_along_axis(
+            seqs, best[:, None, None], axis=1
+        )[:, 0]
+        return jnp.concatenate([prompt, best_seq], axis=1)
 
 
 def _ce_from_logits(logits, tokens, lengths=None):

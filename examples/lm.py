@@ -66,11 +66,13 @@ def main(epochs: int = 8, max_new: int = 16) -> None:
         params, prompt, max_new, jax.random.key(0), temperature=0.7,
         top_k=8, top_p=0.95
     )
+    beam = model.beam_decode(params, prompt, max_new, 4)
     ncheck = min(6, max_new)
     copied = np.asarray(greedy[:, 10 : 10 + ncheck])
     want = half[:, 2 : 2 + ncheck]
     print(f"greedy continuation:  {np.asarray(greedy)[0, 10:].tolist()}")
     print(f"sampled continuation: {np.asarray(sampled)[0, 10:].tolist()}")
+    print(f"beam-4 continuation:  {np.asarray(beam)[0, 10:].tolist()}")
     print(f"copy-accuracy (greedy): {(copied == want).mean():.2f}")
     print("Done")
 
