@@ -9,3 +9,9 @@ from distributed_tensorflow_tpu.data.tokens import (  # noqa: F401
     copy_corpus,
     markov_corpus,
 )
+from distributed_tensorflow_tpu.data.text import (  # noqa: F401
+    ByteTokenizer,
+    pack_documents,
+    synthetic_documents,
+    text_corpus,
+)

@@ -1,0 +1,133 @@
+"""Text → token pipeline for the LM family: byte-level tokenizer, EOS
+document packing, and deterministic text corpora.
+
+The reference has no text path at all (its one dataset is MNIST images,
+reference tfsingle.py:13-14); this module gives the GPT family
+(models/gpt.py) a real text-in/text-out story with zero external
+dependencies and zero egress:
+
+- :class:`ByteTokenizer` — the identity tokenizer over UTF-8 bytes
+  (vocab 256 + one EOS id). No merges to train or ship, no OOV by
+  construction, and exact round-trip for any string — the same baseline
+  real frameworks offer as ``byte``-level fallback.
+- :func:`pack_documents` — standard LM packing: each document's bytes
+  followed by EOS, all documents concatenated, the stream chunked into
+  fixed [N, seq_len] rows (static shapes for XLA; the ragged path is the
+  ``lengths`` machinery in data/tokens.py, this is the dense one).
+- :func:`text_corpus` — deterministic synthetic English-like text from a
+  seeded word-Markov chain, packed and split like every corpus here
+  (data/tokens.py conventions), so text-LM tests run identically in the
+  zero-egress environment and on a laptop.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distributed_tensorflow_tpu.data.tokens import TokenDatasets, _split
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer: ids 0..255 are the bytes, ``eos_id`` (=256)
+    terminates documents. ``vocab_size`` (=257) is what the LM should be
+    built with. Round-trip exact for every string; ``decode`` drops EOS
+    and any (never-emitted-by-``encode``) out-of-range ids, and replaces
+    invalid UTF-8 so decoding model samples never raises."""
+
+    eos_id: int = 256
+    vocab_size: int = 257
+
+    def encode(self, text: str, *, eos: bool = False) -> np.ndarray:
+        ids = np.frombuffer(text.encode("utf-8"), np.uint8).astype(np.int32)
+        if eos:
+            ids = np.concatenate([ids, np.array([self.eos_id], np.int32)])
+        return ids
+
+    def decode(self, ids) -> str:
+        arr = np.asarray(ids).reshape(-1)
+        arr = arr[(arr >= 0) & (arr < 256)]
+        return arr.astype(np.uint8).tobytes().decode("utf-8", errors="replace")
+
+
+def pack_documents(
+    docs: list[str] | list[np.ndarray],
+    seq_len: int,
+    tokenizer: ByteTokenizer | None = None,
+) -> np.ndarray:
+    """Concatenate ``doc₀ EOS doc₁ EOS ...`` and chunk the stream into
+    [N, seq_len] int32 rows (the tail that doesn't fill a row is
+    dropped — standard LM packing; no padding, every kept position is a
+    real training target). ``docs`` may be strings (encoded with
+    ``tokenizer``, default :class:`ByteTokenizer`) or pre-tokenized id
+    arrays (used verbatim, EOS appended)."""
+    tok = tokenizer or ByteTokenizer()
+    parts = []
+    for d in docs:
+        if isinstance(d, str):
+            parts.append(tok.encode(d, eos=True))
+        else:
+            parts.append(
+                np.concatenate(
+                    [np.asarray(d, np.int32), np.array([tok.eos_id], np.int32)]
+                )
+            )
+    stream = np.concatenate(parts) if parts else np.zeros((0,), np.int32)
+    n = len(stream) // seq_len
+    if n == 0:
+        raise ValueError(
+            f"packed stream ({len(stream)} tokens) shorter than one "
+            f"seq_len={seq_len} row"
+        )
+    return stream[: n * seq_len].reshape(n, seq_len).astype(np.int32)
+
+
+_WORDS = (
+    "the a one this that model data train step loss grad mesh chip ring "
+    "token batch epoch scan shard sum small fast slow deep wide new old "
+    "red blue green node host core wire pipe gate fuse"
+).split()
+
+
+def synthetic_documents(
+    num_docs: int, *, seed: int = 0, min_words: int = 8, max_words: int = 40
+) -> list[str]:
+    """Deterministic English-like documents from a seeded word-Markov
+    chain (first-order over a fixed 40-word vocabulary, transition rows
+    drawn once from a Dirichlet). Same seed → same corpus, everywhere."""
+    rng = np.random.default_rng(seed)
+    w = len(_WORDS)
+    trans = rng.dirichlet(np.full(w, 0.3), size=w)
+    start = rng.dirichlet(np.full(w, 0.5))
+    docs = []
+    for _ in range(num_docs):
+        length = int(rng.integers(min_words, max_words + 1))
+        idx = int(rng.choice(w, p=start))
+        words = [_WORDS[idx]]
+        for _ in range(length - 1):
+            idx = int(rng.choice(w, p=trans[idx]))
+            words.append(_WORDS[idx])
+        docs.append(" ".join(words) + ".")
+    return docs
+
+
+def text_corpus(
+    *,
+    num_docs: int = 512,
+    seq_len: int = 128,
+    n_val: int = 32,
+    n_test: int = 32,
+    seed: int = 0,
+) -> TokenDatasets:
+    """Byte-level LM corpus over :func:`synthetic_documents`, packed with
+    :func:`pack_documents` and split train/validation/test contiguously
+    (data/tokens.py ``_split`` — the packed rows are draws from one
+    stationary chain, so contiguous splits are i.i.d.-equivalent). Build
+    the model with ``vocab_size=ByteTokenizer.vocab_size`` (257)."""
+    docs = synthetic_documents(num_docs, seed=seed)
+    tokens = pack_documents(docs, seq_len)
+    if len(tokens) <= n_val + n_test:
+        raise ValueError(
+            f"only {len(tokens)} packed rows; need > n_val+n_test "
+            f"({n_val}+{n_test}) — more docs or a smaller seq_len"
+        )
+    return _split(tokens, None, n_val, n_test, seed)
