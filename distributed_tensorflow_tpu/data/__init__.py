@@ -10,6 +10,7 @@ from distributed_tensorflow_tpu.data.tokens import (  # noqa: F401
     markov_corpus,
 )
 from distributed_tensorflow_tpu.data.text import (  # noqa: F401
+    BPETokenizer,
     ByteTokenizer,
     pack_documents,
     synthetic_documents,

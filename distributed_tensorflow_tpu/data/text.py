@@ -49,10 +49,93 @@ class ByteTokenizer:
         return arr.astype(np.uint8).tobytes().decode("utf-8", errors="replace")
 
 
+class BPETokenizer:
+    """Byte-level BPE trained on a corpus: ids 0..255 are bytes, 256 is
+    EOS, 257.. are learned merges (GPT-2's scheme minus the regex
+    pre-tokenizer — merges run over the raw byte stream, which keeps the
+    implementation exact and dependency-free). Deterministic training
+    (ties broken by smallest pair) and exact round-trip for ANY string —
+    unseen bytes simply stay unmerged (the byte fallback real BPE vocabs
+    rely on).
+
+    ``BPETokenizer.train(docs, num_merges=K)`` learns K merges; build the
+    LM with ``vocab_size=tok.vocab_size`` (= 257 + K). ``encode`` applies
+    merges in rank order (lowest rank first, all occurrences left to
+    right); ``decode`` expands each id back to its bytes."""
+
+    eos_id: int = 256
+
+    def __init__(self, merges: list[tuple[int, int]]):
+        self.merges = [tuple(m) for m in merges]
+        self._ranks = {pair: i for i, pair in enumerate(self.merges)}
+        # id → bytes expansion table.
+        table = [bytes([i]) for i in range(256)] + [b""]  # EOS → empty
+        for a, b in self.merges:
+            table.append(table[a] + table[b])
+        self._bytes = table
+        self.vocab_size = len(table)
+
+    @classmethod
+    def train(cls, docs: list[str], *, num_merges: int) -> "BPETokenizer":
+        from collections import Counter
+
+        seqs = [
+            list(np.frombuffer(d.encode("utf-8"), np.uint8)) for d in docs
+        ]
+        merges: list[tuple[int, int]] = []
+        for new_id in range(257, 257 + num_merges):
+            counts = Counter()
+            for s in seqs:
+                counts.update(zip(s, s[1:]))
+            if not counts:
+                break
+            best_n = max(counts.values())
+            pair = min(p for p, n in counts.items() if n == best_n)
+            merges.append((int(pair[0]), int(pair[1])))
+            seqs = [_merge_pair(s, pair, new_id) for s in seqs]
+        return cls(merges)
+
+    def encode(self, text: str, *, eos: bool = False) -> np.ndarray:
+        ids = list(np.frombuffer(text.encode("utf-8"), np.uint8))
+        while len(ids) > 1:
+            pairs = set(zip(ids, ids[1:]))
+            ranked = [p for p in pairs if p in self._ranks]
+            if not ranked:
+                break
+            pair = min(ranked, key=self._ranks.__getitem__)
+            ids = _merge_pair(ids, pair, 257 + self._ranks[pair])
+        if eos:
+            ids = ids + [self.eos_id]
+        return np.asarray(ids, np.int32)
+
+    def decode(self, ids) -> str:
+        arr = np.asarray(ids).reshape(-1)
+        out = b"".join(
+            self._bytes[i] for i in arr if 0 <= i < self.vocab_size
+        )
+        return out.decode("utf-8", errors="replace")
+
+
+def _merge_pair(ids, pair, new_id):
+    """One BPE merge pass: every non-overlapping occurrence of ``pair``
+    (left to right) becomes ``new_id``."""
+    out = []
+    i = 0
+    n = len(ids)
+    while i < n:
+        if i + 1 < n and ids[i] == pair[0] and ids[i + 1] == pair[1]:
+            out.append(new_id)
+            i += 2
+        else:
+            out.append(int(ids[i]))
+            i += 1
+    return out
+
+
 def pack_documents(
     docs: list[str] | list[np.ndarray],
     seq_len: int,
-    tokenizer: ByteTokenizer | None = None,
+    tokenizer: "ByteTokenizer | BPETokenizer | None" = None,
 ) -> np.ndarray:
     """Concatenate ``doc₀ EOS doc₁ EOS ...`` and chunk the stream into
     [N, seq_len] int32 rows (the tail that doesn't fill a row is
@@ -117,14 +200,18 @@ def text_corpus(
     n_val: int = 32,
     n_test: int = 32,
     seed: int = 0,
+    tokenizer: ByteTokenizer | BPETokenizer | None = None,
 ) -> TokenDatasets:
-    """Byte-level LM corpus over :func:`synthetic_documents`, packed with
+    """LM corpus over :func:`synthetic_documents` — byte-level by
+    default, subword with a trained :class:`BPETokenizer` — packed with
     :func:`pack_documents` and split train/validation/test contiguously
     (data/tokens.py ``_split`` — the packed rows are draws from one
     stationary chain, so contiguous splits are i.i.d.-equivalent). Build
-    the model with ``vocab_size=ByteTokenizer.vocab_size`` (257)."""
+    the model with ``vocab_size=tokenizer.vocab_size`` (257 for the
+    default :class:`ByteTokenizer`; a corpus-trained BPE vocabulary packs
+    the same documents into fewer tokens per document)."""
     docs = synthetic_documents(num_docs, seed=seed)
-    tokens = pack_documents(docs, seq_len)
+    tokens = pack_documents(docs, seq_len, tokenizer)
     if len(tokens) <= n_val + n_test:
         raise ValueError(
             f"only {len(tokens)} packed rows; need > n_val+n_test "

@@ -62,6 +62,41 @@ def test_synthetic_corpus_deterministic():
         text_corpus(num_docs=8, seq_len=64, n_val=32, n_test=32)
 
 
+def test_bpe_tokenizer():
+    from distributed_tensorflow_tpu.data import BPETokenizer
+
+    docs = synthetic_documents(64, seed=5)
+    tok = BPETokenizer.train(docs, num_merges=64)
+    assert tok.vocab_size == 257 + 64
+    # Deterministic training.
+    tok2 = BPETokenizer.train(docs, num_merges=64)
+    assert tok.merges == tok2.merges
+    # Exact round-trip for corpus text AND arbitrary unseen strings
+    # (byte fallback: unmergeable bytes stay single tokens).
+    for s in [docs[0], "never-seen tökens ≠ corpus!", "", "a"]:
+        assert tok.decode(tok.encode(s)) == s
+    # Compression: merges shorten corpus text vs raw bytes.
+    byte_len = sum(len(d.encode()) for d in docs)
+    bpe_len = sum(len(tok.encode(d)) for d in docs)
+    assert bpe_len < 0.8 * byte_len, (bpe_len, byte_len)
+    # encode applies merges by rank: the FIRST learned merge is the most
+    # frequent pair of the corpus and must appear merged in encodings.
+    a, b = tok.merges[0]
+    joined = (bytes([a]) + bytes([b])).decode()
+    ids = tok.encode(joined)
+    assert ids.tolist() == [257], ids
+    # eos + known-example sanity: "aaaa" with merge ('a','a') → two ids.
+    tiny = BPETokenizer.train(["aaaa"], num_merges=1)
+    assert tiny.merges == [(97, 97)]
+    assert tiny.encode("aaaa", eos=True).tolist() == [257, 257, tiny.eos_id]
+    # A BPE corpus trains through the unchanged pipeline (packing only).
+    ds = text_corpus(
+        num_docs=96, seq_len=32, n_val=4, n_test=4, seed=5, tokenizer=tok
+    )
+    assert int(ds.train.tokens.max()) < tok.vocab_size
+    assert ds.train.tokens.shape[1] == 32
+
+
 def test_text_lm_end_to_end():
     # The full text story: byte corpus → LMTrainer lifecycle → perplexity
     # falls well below the uniform-257 baseline (the chain's byte-level
