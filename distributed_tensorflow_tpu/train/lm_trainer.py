@@ -726,7 +726,7 @@ class LMTrainer:
             # Nothing to dispatch (epochs=0, or dataset smaller than one
             # batch) — mirror run()'s no-op semantics instead of crashing
             # on an empty index stack.
-            perplexity = self.evaluate("validation") if self.is_chief else float("nan")
+            perplexity = self.evaluate("validation")  # all processes (global mesh)
             if self.is_chief:
                 logger.log_final(cost=float("nan"))
             return {
@@ -794,7 +794,7 @@ class LMTrainer:
                 )
         if self.supervisor is not None:
             self.supervisor.save(self.state, self.global_step)
-        perplexity = self.evaluate("validation") if self.is_chief else float("nan")
+        perplexity = self.evaluate("validation")  # all processes (global mesh)
         if self.is_chief:
             logger.log_final(cost=self.last_cost)
             if self.summary_writer is not None:
@@ -925,8 +925,13 @@ class LMTrainer:
         perplexity = float("nan")
         for epoch in range(epochs):
             self.run_epoch(epoch, logger)
+            # EVERY process runs the eval — it is a global-mesh computation
+            # (GSPMD may partition it with collectives), so a chief-only
+            # dispatch would hang or die once non-chief processes move on
+            # (cost a real multi-host debugging cycle); only the chief
+            # logs and records it.
+            perplexity = self.evaluate("validation")
             if self.is_chief:
-                perplexity = self.evaluate("validation")
                 logger.log_epoch_metric("Test-Perplexity", perplexity)
                 if self.summary_writer is not None:
                     self.summary_writer.add_scalar(

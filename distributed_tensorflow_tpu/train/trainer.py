@@ -601,8 +601,13 @@ class Trainer:
                     self.run_epoch(epoch, logger)
             else:
                 self.run_epoch(epoch, logger)
+            # EVERY process runs the eval — it is a global-mesh computation
+            # (sharded-param strategies gather over collectives), so a
+            # chief-only dispatch would hang or die once non-chief
+            # processes move on (the multi-host LM smoke caught exactly
+            # this in lm_trainer.py); only the chief logs and records it.
+            accuracy = self.evaluate()
             if self.is_chief:
-                accuracy = self.evaluate()
                 logger.log_epoch(test_accuracy=accuracy)
                 if self.summary_writer is not None:
                     self.summary_writer.add_scalar(

@@ -196,6 +196,48 @@ print("MULTIHOST_TRAINER_OK", task, res["global_step"], flush=True)
 """
 
 
+_LM_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from distributed_tensorflow_tpu.cluster import bootstrap
+from distributed_tensorflow_tpu.config import ClusterConfig, TrainConfig
+from distributed_tensorflow_tpu.data import copy_corpus
+from distributed_tensorflow_tpu.models.gpt import GPTLM
+from distributed_tensorflow_tpu.parallel import make_mesh
+from distributed_tensorflow_tpu.train import LMTrainer
+
+task = int(sys.argv[1])
+cluster = ClusterConfig.from_lists(["127.0.0.1:29777", "127.0.0.1:29778"])
+ctx = bootstrap(cluster, "worker", task)
+assert jax.process_count() == 2
+
+# Every process builds the identical deterministic corpus — the premise
+# of the LM trainer's replicated token staging (same as the classifier).
+ds = copy_corpus(num=384, half_len=8, vocab=61, n_val=64, n_test=64, seed=0)
+mesh = make_mesh(axis_names=("data",))
+model = GPTLM(vocab_size=61, max_len=16, model_dim=32, num_heads=4,
+              num_layers=2, compute_dtype=jax.numpy.float32)
+tr = LMTrainer(
+    model, ds,
+    TrainConfig(epochs=2, batch_size=32, optimizer="adam",
+                learning_rate=3e-3, scan_epoch=True, log_frequency=10**9),
+    mesh=mesh,
+    is_chief=ctx.is_chief,
+    print_fn=(print if ctx.is_chief else lambda *a: None),
+)
+assert tr.mode == "dp"
+res = tr.run()
+assert res["global_step"] == 2 * (256 // 32), res
+if ctx.is_chief:
+    assert np.isfinite(res["perplexity"]) and res["perplexity"] < 61, res
+print("MULTIHOST_LM_OK", task, res["global_step"], flush=True)
+"""
+
+
 def test_two_process_sync_dp(tmp_path):
     procs, outs = _run_two(_WORKER)
     for i, out in enumerate(outs):
@@ -222,3 +264,14 @@ def test_two_process_async_and_compiled_run():
     for i, out in enumerate(outs):
         assert procs[i].returncode == 0, f"task {i} failed:\n{out}"
         assert f"MULTIHOST_ASYNC_COMPILED_OK {i}" in out, out
+
+
+def test_two_process_lm_trainer():
+    """The LM trainer's scanned-epoch lifecycle across two real processes
+    (round 4): replicated token staging + per-epoch index uploads over a
+    cross-process mesh, dp batch sharding, chief-side perplexity — the LM
+    analog of test_two_process_trainer_scan_epoch."""
+    procs, outs = _run_two(_LM_WORKER)
+    for i, out in enumerate(outs):
+        assert procs[i].returncode == 0, f"task {i} failed:\n{out}"
+        assert f"MULTIHOST_LM_OK {i}" in out, out
