@@ -22,6 +22,10 @@ dependencies and zero egress:
 
 from __future__ import annotations
 
+import heapq
+import json
+import os
+
 import numpy as np
 
 from distributed_tensorflow_tpu.data.tokens import TokenDatasets, _split
@@ -61,7 +65,16 @@ class BPETokenizer:
     ``BPETokenizer.train(docs, num_merges=K)`` learns K merges; build the
     LM with ``vocab_size=tok.vocab_size`` (= 257 + K). ``encode`` applies
     merges in rank order (lowest rank first, all occurrences left to
-    right); ``decode`` expands each id back to its bytes."""
+    right); ``decode`` expands each id back to its bytes.
+
+    Ship-grade costs (round 5): training maintains pair counts
+    *incrementally* over a linked-list corpus — O(total merge operations),
+    not O(num_merges × corpus) — and ``encode`` is a single heap pass,
+    O(n log n) in the input length. Both have a native C++ fast path
+    (runtime/csrc/dtf_runtime.cc ``dtf_bpe_train``/``dtf_bpe_encode``,
+    bit-identical to the pure-Python fallback). ``save``/``load``
+    round-trip the learned merges as JSON so the tokenizer can ship
+    alongside a checkpoint (LMTrainer writes it into ``checkpoint_dir``)."""
 
     eos_id: int = 256
 
@@ -74,39 +87,59 @@ class BPETokenizer:
             table.append(table[a] + table[b])
         self._bytes = table
         self.vocab_size = len(table)
+        # Flat [2K] int32 view for the native encoder — built once, not
+        # per encode() call (the per-call conversion dominated encode cost
+        # at 8k merges).
+        self._merges_arr = (
+            np.asarray(self.merges, np.int32).reshape(-1)
+            if self.merges
+            else np.zeros(0, np.int32)
+        )
 
     @classmethod
     def train(cls, docs: list[str], *, num_merges: int) -> "BPETokenizer":
-        from collections import Counter
+        try:
+            from distributed_tensorflow_tpu.runtime import native
 
-        seqs = [
-            list(np.frombuffer(d.encode("utf-8"), np.uint8)) for d in docs
-        ]
-        merges: list[tuple[int, int]] = []
-        for new_id in range(257, 257 + num_merges):
-            counts = Counter()
-            for s in seqs:
-                counts.update(zip(s, s[1:]))
-            if not counts:
-                break
-            best_n = max(counts.values())
-            pair = min(p for p, n in counts.items() if n == best_n)
-            merges.append((int(pair[0]), int(pair[1])))
-            seqs = [_merge_pair(s, pair, new_id) for s in seqs]
-        return cls(merges)
+            return cls(native.bpe_train(docs, num_merges))
+        except ImportError:
+            return cls(_bpe_train_py(docs, num_merges))
 
     def encode(self, text: str, *, eos: bool = False) -> np.ndarray:
-        ids = list(np.frombuffer(text.encode("utf-8"), np.uint8))
-        while len(ids) > 1:
-            pairs = set(zip(ids, ids[1:]))
-            ranked = [p for p in pairs if p in self._ranks]
-            if not ranked:
-                break
-            pair = min(ranked, key=self._ranks.__getitem__)
-            ids = _merge_pair(ids, pair, 257 + self._ranks[pair])
+        data = text.encode("utf-8")
+        if len(data) > 1 and self._ranks:
+            try:
+                from distributed_tensorflow_tpu.runtime import native
+
+                ids = native.bpe_encode(self._merges_arr, data).tolist()
+            except ImportError:
+                ids = _bpe_encode_py(self._ranks, data)
+        else:
+            ids = list(data)
         if eos:
             ids = ids + [self.eos_id]
         return np.asarray(ids, np.int32)
+
+    def encode_batch(
+        self, texts: list[str], *, eos: bool = False
+    ) -> list[np.ndarray]:
+        """Encode many documents at once — the native path builds its
+        ranks table a single time instead of per ``encode`` call (the
+        per-call setup dominated corpus encoding at 8k merges)."""
+        blobs = [t.encode("utf-8") for t in texts]
+        try:
+            from distributed_tensorflow_tpu.runtime import native
+
+            pieces = native.bpe_encode_batch(self._merges_arr, blobs)
+        except ImportError:
+            pieces = [
+                np.asarray(_bpe_encode_py(self._ranks, b), np.int32)
+                for b in blobs
+            ]
+        if eos:
+            tail = np.array([self.eos_id], np.int32)
+            pieces = [np.concatenate([p, tail]) for p in pieces]
+        return [np.asarray(p, np.int32) for p in pieces]
 
     def decode(self, ids) -> str:
         arr = np.asarray(ids).reshape(-1)
@@ -114,6 +147,28 @@ class BPETokenizer:
             self._bytes[i] for i in arr if 0 <= i < self.vocab_size
         )
         return out.decode("utf-8", errors="replace")
+
+    # -- serialization (the vocab file that ships with a checkpoint) ------
+
+    def save(self, path: str) -> None:
+        """Write the learned merges as JSON (atomic rename so a reader
+        never sees a partial vocab file)."""
+        payload = {"format": "dtf-bpe-v1", "merges": [list(m) for m in self.merges]}
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "BPETokenizer":
+        with open(path) as f:
+            payload = json.load(f)
+        if payload.get("format") != "dtf-bpe-v1":
+            raise ValueError(
+                f"not a dtf-bpe-v1 vocab file: {path!r} "
+                f"(format={payload.get('format')!r})"
+            )
+        return cls([(int(a), int(b)) for a, b in payload["merges"]])
 
 
 def _merge_pair(ids, pair, new_id):
@@ -132,6 +187,161 @@ def _merge_pair(ids, pair, new_id):
     return out
 
 
+def _bpe_train_py(docs: list[str], num_merges: int) -> list[tuple[int, int]]:
+    """Incremental BPE training over a linked-list corpus.
+
+    Semantics are exactly the naive recount-per-round algorithm (pick the
+    most frequent adjacent pair, ties to the smallest pair; merge every
+    non-overlapping occurrence left to right; never merge across document
+    boundaries) — but pair counts are maintained by ±deltas at each merge
+    site instead of a full corpus rescan per round, and selection is a
+    lazy max-heap. Total work is O(corpus + Σ merge-site updates), so 8k
+    merges over megabytes of text is seconds, not hours. Bit-identical to
+    the native ``dtf_bpe_train`` (tests/test_text.py pins both against
+    the naive reference)."""
+    blobs = [np.frombuffer(d.encode("utf-8"), np.uint8) for d in docs]
+    total = int(sum(len(s) for s in blobs))
+    ids = np.empty(total, np.int32)
+    nxt = np.full(total, -1, np.int64)
+    prv = np.full(total, -1, np.int64)
+    off = 0
+    for s in blobs:
+        n = len(s)
+        if n == 0:
+            continue
+        ids[off : off + n] = s
+        nxt[off : off + n - 1] = np.arange(off + 1, off + n)
+        prv[off + 1 : off + n] = np.arange(off, off + n - 1)
+        off += n
+
+    # Initial counts + occurrence lists in one vectorized pass: positions
+    # grouped per pair, ascending (stable argsort of the position-ordered
+    # code vector).
+    left = np.nonzero(nxt >= 0)[0]
+    counts: dict[tuple[int, int], int] = {}
+    occ0: dict[tuple[int, int], np.ndarray] = {}
+    occ_new: dict[tuple[int, int], list[int]] = {}
+    if len(left):
+        codes = (ids[left].astype(np.int64) << 32) | ids[left + 1]
+        order = np.argsort(codes, kind="stable")
+        sorted_codes = codes[order]
+        uniq, starts = np.unique(sorted_codes, return_index=True)
+        bounds = np.append(starts, len(sorted_codes))
+        for k in range(len(uniq)):
+            pair = (int(uniq[k] >> 32), int(uniq[k] & 0xFFFFFFFF))
+            counts[pair] = int(bounds[k + 1] - bounds[k])
+            occ0[pair] = left[order[bounds[k] : bounds[k + 1]]]
+
+    heap = [(-c, pair) for pair, c in counts.items()]
+    heapq.heapify(heap)
+
+    merges: list[tuple[int, int]] = []
+    while len(merges) < num_merges and heap:
+        negc, pair = heap[0]
+        c = counts.get(pair)
+        if c is None or -negc != c:
+            heapq.heappop(heap)  # stale entry
+            continue
+        heapq.heappop(heap)
+        new_id = 257 + len(merges)
+        merges.append(pair)
+        a, b = pair
+        parts = []
+        if pair in occ0:
+            parts.append(occ0.pop(pair))
+        if pair in occ_new:
+            parts.append(np.asarray(occ_new.pop(pair), np.int64))
+        positions = np.sort(np.concatenate(parts)) if parts else ()
+        # Count deltas accumulate per ROUND and apply once per distinct
+        # changed pair (one heap push each) — per-occurrence pushes drown
+        # the heap in stale entries on repetitive corpora.
+        delta: dict[tuple[int, int], int] = {}
+        for i in positions:
+            i = int(i)
+            if ids[i] != a:
+                continue  # stale occurrence (node merged/killed since)
+            j = int(nxt[i])
+            if j < 0 or ids[j] != b:
+                continue
+            p = int(prv[i])
+            q = int(nxt[j])
+            # Read neighbor ids BEFORE rewriting the nodes (overlap chains
+            # like [a,a,a] with pair (a,a) depend on it).
+            if p >= 0:
+                k = (int(ids[p]), a)
+                delta[k] = delta.get(k, 0) - 1
+            if q >= 0:
+                k = (b, int(ids[q]))
+                delta[k] = delta.get(k, 0) - 1
+            ids[i] = new_id
+            ids[j] = -2  # dead node
+            nxt[i] = q
+            if q >= 0:
+                prv[q] = i
+                k = (new_id, int(ids[q]))
+                delta[k] = delta.get(k, 0) + 1
+                occ_new.setdefault(k, []).append(i)
+            if p >= 0:
+                k = (int(ids[p]), new_id)
+                delta[k] = delta.get(k, 0) + 1
+                occ_new.setdefault(k, []).append(p)
+        for k, d in delta.items():
+            if k == pair or d == 0:
+                continue
+            c2 = counts.get(k, 0) + d
+            if c2 <= 0:
+                counts.pop(k, None)
+            else:
+                counts[k] = c2
+                heapq.heappush(heap, (-c2, k))
+        counts.pop(pair, None)
+    return merges
+
+
+def _bpe_encode_py(
+    ranks: dict[tuple[int, int], int], data: bytes
+) -> list[int]:
+    """Single-heap BPE encode: pop (rank, position) ascending, merge, push
+    the two newly-created neighbor pairs. Equivalent to applying merges in
+    rank order with all occurrences left to right (a pair created by a
+    rank-r merge always has rank > r, so the heap drains rank levels in
+    order), O(n log n) in the input length."""
+    ids = list(data)
+    n = len(ids)
+    nxt = list(range(1, n)) + [-1]
+    prv = [-1] + list(range(n - 1))
+    heap = []
+    for i in range(n - 1):
+        r = ranks.get((ids[i], ids[i + 1]))
+        if r is not None:
+            heap.append((r, i))
+    heapq.heapify(heap)
+    while heap:
+        r, i = heapq.heappop(heap)
+        if ids[i] < 0:
+            continue
+        j = nxt[i]
+        if j < 0:
+            continue
+        if ranks.get((ids[i], ids[j])) != r:
+            continue  # stale entry
+        ids[i] = 257 + r
+        ids[j] = -1
+        q = nxt[j]
+        nxt[i] = q
+        if q >= 0:
+            prv[q] = i
+            r2 = ranks.get((ids[i], ids[q]))
+            if r2 is not None:
+                heapq.heappush(heap, (r2, i))
+        p = prv[i]
+        if p >= 0:
+            r2 = ranks.get((ids[p], ids[i]))
+            if r2 is not None:
+                heapq.heappush(heap, (r2, p))
+    return [t for t in ids if t >= 0]
+
+
 def pack_documents(
     docs: list[str] | list[np.ndarray],
     seq_len: int,
@@ -144,16 +354,20 @@ def pack_documents(
     ``tokenizer``, default :class:`ByteTokenizer`) or pre-tokenized id
     arrays (used verbatim, EOS appended)."""
     tok = tokenizer or ByteTokenizer()
-    parts = []
-    for d in docs:
-        if isinstance(d, str):
-            parts.append(tok.encode(d, eos=True))
-        else:
-            parts.append(
-                np.concatenate(
-                    [np.asarray(d, np.int32), np.array([tok.eos_id], np.int32)]
+    batch_encode = getattr(tok, "encode_batch", None)
+    if batch_encode is not None and docs and all(isinstance(d, str) for d in docs):
+        parts = batch_encode(list(docs), eos=True)
+    else:
+        parts = []
+        for d in docs:
+            if isinstance(d, str):
+                parts.append(tok.encode(d, eos=True))
+            else:
+                parts.append(
+                    np.concatenate(
+                        [np.asarray(d, np.int32), np.array([tok.eos_id], np.int32)]
+                    )
                 )
-            )
     stream = np.concatenate(parts) if parts else np.zeros((0,), np.int32)
     n = len(stream) // seq_len
     if n == 0:

@@ -1355,9 +1355,16 @@ def make_lm_ep_parts(
 
     def mapped(params, opt_state, tokens, lens):
         if lens is None:
-            # Static placeholder: the non-ragged local ignores it, but the
-            # shard_map signature needs a concrete array.
-            lens = jnp.zeros((), jnp.int32)
+            # Non-ragged: local() ignores lens, a rank-0 placeholder matches
+            # the P() spec. Ragged: lens_spec is P(data) rank-1, so a rank-0
+            # placeholder would die in shard_map with a confusing
+            # spec/operand mismatch — synthesize full lengths instead
+            # (every position real == the non-ragged loss).
+            lens = (
+                jnp.full((tokens.shape[0],), tokens.shape[1], jnp.int32)
+                if ragged
+                else jnp.zeros((), jnp.int32)
+            )
         return inner(params, opt_state, tokens, lens)
 
     return specs, opt_specs, mapped
@@ -1658,7 +1665,13 @@ def make_lm_sp_parts(
 
     def mapped(params, opt_state, tokens, lens):
         if lens is None:
-            lens = jnp.zeros((), jnp.int32)
+            # Ragged factories need a rank-1 [B] operand for the P(data)
+            # lens spec; full lengths reproduce the non-ragged loss.
+            lens = (
+                jnp.full((tokens.shape[0],), tokens.shape[1], jnp.int32)
+                if ragged
+                else jnp.zeros((), jnp.int32)
+            )
         return inner(params, opt_state, tokens, lens)
 
     return mapped
@@ -1809,9 +1822,13 @@ def make_lm_async_parts(
 
     def mapped(params, opt_state, tokens, lens, count):
         if lens is None:
-            # Static placeholder: the non-ragged local ignores it, but the
-            # shard_map signature needs a concrete array.
-            lens = jnp.zeros((), jnp.int32)
+            # Ragged factories need a rank-1 [B] operand for the P(axis)
+            # lens spec; full lengths reproduce the non-ragged loss.
+            lens = (
+                jnp.full((tokens.shape[0],), tokens.shape[1], jnp.int32)
+                if ragged
+                else jnp.zeros((), jnp.int32)
+            )
         return inner(params, opt_state, tokens, lens, count)
 
     return init_state, mapped

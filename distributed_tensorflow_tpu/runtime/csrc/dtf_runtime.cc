@@ -20,13 +20,16 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <mutex>
+#include <queue>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -341,5 +344,227 @@ uint32_t dtf_crc32c_masked(const uint8_t* data, size_t n) {
   uint32_t crc = dtf_crc32c(data, n);
   return ((crc >> 15) | (crc << 17)) + 0xA282EAD8u;
 }
+
+// ---------------------------------------------------------------------------
+// Byte-level BPE (data/text.py's fast path). Semantics are pinned by the
+// pure-Python fallback and tests/test_text.py: pick the most frequent
+// adjacent pair (ties → numerically smallest pair), merge every
+// non-overlapping occurrence left to right, never across document
+// boundaries; encode applies merges in rank order, occurrences left to
+// right. Incremental pair-count maintenance over a linked-list corpus —
+// O(corpus + merge-site updates) total — so thousands of merges over a
+// multi-megabyte corpus finish in seconds.
+
+// Trains `num_merges` merges over `n_docs` UTF-8 documents concatenated in
+// `bytes` (document i occupies doc_lens[i] bytes). Writes (a,b) pairs into
+// out_pairs[2k],out_pairs[2k+1]; returns the number of merges learned
+// (< num_merges iff the corpus ran out of pairs).
+long dtf_bpe_train(const uint8_t* bytes, const long* doc_lens, long n_docs,
+                   long num_merges, int32_t* out_pairs) {
+  long total = 0;
+  for (long d = 0; d < n_docs; ++d) total += doc_lens[d];
+  // Node positions are int32 (cache footprint matters at this scale);
+  // refuse corpora that would wrap rather than corrupt merges silently.
+  if (total > 0x7FFFFFF0L) return -1;
+  std::vector<int32_t> ids(total);
+  std::vector<int32_t> nxt(total, -1), prv(total, -1);
+  long off = 0;
+  for (long d = 0; d < n_docs; ++d) {
+    long n = doc_lens[d];
+    for (long k = 0; k < n; ++k) {
+      ids[off + k] = bytes[off + k];
+      if (k + 1 < n) nxt[off + k] = int32_t(off + k + 1);
+      if (k > 0) prv[off + k] = int32_t(off + k - 1);
+    }
+    off += n;
+  }
+  auto key = [](int64_t a, int64_t b) {
+    return (uint64_t(a) << 32) | uint64_t(b);
+  };
+  std::unordered_map<uint64_t, int64_t> counts;
+  std::unordered_map<uint64_t, std::vector<int32_t>> occ;
+  counts.reserve(1 << 16);
+  occ.reserve(1 << 16);
+  for (long i = 0; i < total; ++i) {
+    if (nxt[i] >= 0) {
+      uint64_t k = key(ids[i], ids[nxt[i]]);
+      ++counts[k];
+      occ[k].push_back(int32_t(i));  // ascending by construction
+    }
+  }
+  // Max-heap popping (max count, then smallest pair). Entries are lazy:
+  // validate against `counts` at pop time. Count deltas are accumulated
+  // per merge ROUND and applied once per distinct changed pair — one heap
+  // push per (round, pair), not per occurrence, which keeps the heap
+  // millions of entries smaller (per-occurrence pushes made heap pops 86%
+  // of the runtime on a repetitive corpus).
+  struct Entry {
+    int64_t count;
+    uint64_t pair;
+    bool operator<(const Entry& o) const {
+      if (count != o.count) return count < o.count;
+      return pair > o.pair;
+    }
+  };
+  std::priority_queue<Entry> heap;
+  for (const auto& kv : counts) heap.push({kv.second, kv.first});
+  std::unordered_map<uint64_t, int64_t> delta;
+  delta.reserve(1 << 10);
+  long n_merges = 0;
+  while (n_merges < num_merges && !heap.empty()) {
+    Entry top = heap.top();
+    heap.pop();
+    auto it = counts.find(top.pair);
+    if (it == counts.end() || it->second != top.count) continue;  // stale
+    int32_t a = int32_t(top.pair >> 32), b = int32_t(top.pair & 0xFFFFFFFF);
+    int32_t new_id = int32_t(257 + n_merges);
+    out_pairs[2 * n_merges] = a;
+    out_pairs[2 * n_merges + 1] = b;
+    ++n_merges;
+    std::vector<int32_t> positions;
+    auto oit = occ.find(top.pair);
+    if (oit != occ.end()) {
+      positions = std::move(oit->second);
+      occ.erase(oit);
+    }
+    std::sort(positions.begin(), positions.end());
+    delta.clear();
+    for (int32_t i : positions) {
+      if (ids[i] != a) continue;  // stale occurrence
+      int32_t j = nxt[i];
+      if (j < 0 || ids[j] != b) continue;
+      int32_t p = prv[i], q = nxt[j];
+      if (p >= 0) --delta[key(ids[p], a)];
+      if (q >= 0) --delta[key(b, ids[q])];
+      ids[i] = new_id;
+      ids[j] = -2;  // dead node
+      nxt[i] = q;
+      if (q >= 0) {
+        prv[q] = i;
+        ++delta[key(new_id, ids[q])];
+        occ[key(new_id, ids[q])].push_back(i);
+      }
+      if (p >= 0) {
+        ++delta[key(ids[p], new_id)];
+        occ[key(ids[p], new_id)].push_back(p);
+      }
+    }
+    for (const auto& kv : delta) {
+      if (kv.first == top.pair || kv.second == 0) continue;
+      auto cit = counts.find(kv.first);
+      int64_t c = (cit == counts.end() ? 0 : cit->second) + kv.second;
+      if (c <= 0) {
+        if (cit != counts.end()) counts.erase(cit);
+      } else {
+        counts[kv.first] = c;
+        heap.push({c, kv.first});
+      }
+    }
+    counts.erase(top.pair);
+  }
+  return n_merges;
+}
+
+namespace {
+
+uint64_t bpe_key(int64_t a, int64_t b) {
+  return (uint64_t(a) << 32) | uint64_t(b);
+}
+
+// Single-document heap-pass encode against a prebuilt ranks map; writes ids
+// into `out`, returns encoded length.
+long bpe_encode_one(const std::unordered_map<uint64_t, int32_t>& ranks,
+                    const uint8_t* bytes, long n, int32_t* out);
+
+}  // namespace
+
+// Encodes `n` UTF-8 bytes with `n_merges` learned merges (pairs laid out as
+// in dtf_bpe_train's output). Writes ids into `out` (capacity >= n);
+// returns the encoded length. Single heap pass popping (rank, position):
+// equivalent to rank-order application because a pair created by a rank-r
+// merge always ranks > r.
+long dtf_bpe_encode(const int32_t* merges, long n_merges, const uint8_t* bytes,
+                    long n, int32_t* out) {
+  std::unordered_map<uint64_t, int32_t> ranks;
+  ranks.reserve(size_t(n_merges) * 2);
+  for (long r = 0; r < n_merges; ++r)
+    ranks.emplace(bpe_key(merges[2 * r], merges[2 * r + 1]), int32_t(r));
+  return bpe_encode_one(ranks, bytes, n, out);
+}
+
+// Batch encode: builds the ranks map ONCE and encodes `n_docs` documents
+// concatenated in `bytes` (document i occupies doc_lens[i] bytes). Writes
+// the concatenated ids into `out` (capacity >= total bytes) and each
+// document's encoded length into out_lens; returns the total id count.
+long dtf_bpe_encode_batch(const int32_t* merges, long n_merges,
+                          const uint8_t* bytes, const long* doc_lens,
+                          long n_docs, int32_t* out, long* out_lens) {
+  std::unordered_map<uint64_t, int32_t> ranks;
+  ranks.reserve(size_t(n_merges) * 2);
+  for (long r = 0; r < n_merges; ++r)
+    ranks.emplace(bpe_key(merges[2 * r], merges[2 * r + 1]), int32_t(r));
+  long in_off = 0, out_off = 0;
+  for (long d = 0; d < n_docs; ++d) {
+    long m = bpe_encode_one(ranks, bytes + in_off, doc_lens[d], out + out_off);
+    out_lens[d] = m;
+    in_off += doc_lens[d];
+    out_off += m;
+  }
+  return out_off;
+}
+
+namespace {
+
+long bpe_encode_one(const std::unordered_map<uint64_t, int32_t>& ranks,
+                    const uint8_t* bytes, long n, int32_t* out) {
+  if (n <= 1 || ranks.empty()) {
+    for (long i = 0; i < n; ++i) out[i] = bytes[i];
+    return n;
+  }
+  auto key = bpe_key;
+  std::vector<int32_t> ids(n);
+  std::vector<int64_t> nxt(n), prv(n);
+  for (long i = 0; i < n; ++i) {
+    ids[i] = bytes[i];
+    nxt[i] = (i + 1 < n) ? i + 1 : -1;
+    prv[i] = i - 1;
+  }
+  // Min-heap on (rank, position).
+  using RP = std::pair<int32_t, int64_t>;
+  std::priority_queue<RP, std::vector<RP>, std::greater<RP>> heap;
+  for (long i = 0; i + 1 < n; ++i) {
+    auto it = ranks.find(key(ids[i], ids[i + 1]));
+    if (it != ranks.end()) heap.push({it->second, i});
+  }
+  while (!heap.empty()) {
+    auto [r, i] = heap.top();
+    heap.pop();
+    if (ids[i] < 0) continue;
+    int64_t j = nxt[i];
+    if (j < 0) continue;
+    auto it = ranks.find(key(ids[i], ids[j]));
+    if (it == ranks.end() || it->second != r) continue;  // stale
+    ids[i] = 257 + r;
+    ids[j] = -1;
+    int64_t q = nxt[j];
+    nxt[i] = q;
+    if (q >= 0) {
+      prv[q] = i;
+      auto it2 = ranks.find(key(ids[i], ids[q]));
+      if (it2 != ranks.end()) heap.push({it2->second, i});
+    }
+    int64_t p = prv[i];
+    if (p >= 0) {
+      auto it2 = ranks.find(key(ids[p], ids[i]));
+      if (it2 != ranks.end()) heap.push({it2->second, p});
+    }
+  }
+  long m = 0;
+  for (long i = 0; i < n; ++i)
+    if (ids[i] >= 0) out[m++] = ids[i];
+  return m;
+}
+
+}  // namespace
 
 }  // extern "C"

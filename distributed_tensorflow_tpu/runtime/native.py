@@ -137,6 +137,32 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.dtf_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
     lib.dtf_crc32c_masked.restype = ctypes.c_uint32
     lib.dtf_crc32c_masked.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.dtf_bpe_train.restype = ctypes.c_long
+    lib.dtf_bpe_train.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_long),
+        ctypes.c_long,
+        ctypes.c_long,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.dtf_bpe_encode.restype = ctypes.c_long
+    lib.dtf_bpe_encode.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_long,
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_long,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.dtf_bpe_encode_batch.restype = ctypes.c_long
+    lib.dtf_bpe_encode_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_long,
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_long),
+        ctypes.c_long,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_long),
+    ]
 
 
 def available() -> bool:
@@ -203,6 +229,80 @@ def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
     )
     return out
+
+
+# ---------------------------------------------------------------------------
+# BPE bindings (data/text.py's fast path)
+# ---------------------------------------------------------------------------
+
+
+def bpe_train(docs: list[str], num_merges: int) -> list[tuple[int, int]]:
+    """Train byte-level BPE merges natively; bit-identical to
+    data/text.py's ``_bpe_train_py`` (pinned by tests/test_text.py).
+    Raises ImportError (→ the caller's pure-Python fallback) for corpora
+    beyond the native path's int32 position indexing (~2 GiB)."""
+    lib = load_library()
+    blobs = [d.encode("utf-8") for d in docs]
+    lens = np.asarray([len(b) for b in blobs], np.int64)
+    if int(lens.sum()) > 0x7FFFFFF0:
+        raise ImportError("corpus exceeds native BPE int32 indexing")
+    data = np.frombuffer(b"".join(blobs), np.uint8)
+    data = np.ascontiguousarray(data)
+    out = np.empty(2 * max(num_merges, 1), np.int32)
+    got = lib.dtf_bpe_train(
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        len(blobs),
+        num_merges,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if got < 0:
+        raise ImportError("native BPE train refused the corpus")
+    return [(int(out[2 * k]), int(out[2 * k + 1])) for k in range(got)]
+
+
+def bpe_encode(merges, data: bytes) -> np.ndarray:
+    """Encode UTF-8 bytes with learned merges (list of pairs, or the
+    pre-flattened [2K] int32 array BPETokenizer caches); bit-identical to
+    data/text.py's ``_bpe_encode_py``."""
+    lib = load_library()
+    pairs = np.ascontiguousarray(np.asarray(merges, np.int32).reshape(-1))
+    buf = np.frombuffer(data, np.uint8)
+    buf = np.ascontiguousarray(buf)
+    out = np.empty(max(len(buf), 1), np.int32)
+    got = lib.dtf_bpe_encode(
+        pairs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(pairs) // 2,
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        len(buf),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return out[:got].copy()
+
+
+def bpe_encode_batch(merges, docs: list[bytes]) -> list[np.ndarray]:
+    """Encode many documents in one native call (ranks map built once) —
+    the fast path under data/text.py's ``pack_documents``."""
+    lib = load_library()
+    pairs = np.ascontiguousarray(np.asarray(merges, np.int32).reshape(-1))
+    lens = np.asarray([len(b) for b in docs], np.int64)
+    data = np.ascontiguousarray(np.frombuffer(b"".join(docs), np.uint8))
+    out = np.empty(max(len(data), 1), np.int32)
+    out_lens = np.empty(max(len(docs), 1), np.int64)
+    lib.dtf_bpe_encode_batch(
+        pairs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(pairs) // 2,
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        len(docs),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+    )
+    pieces, off = [], 0
+    for n in out_lens[: len(docs)]:
+        pieces.append(out[off : off + int(n)].copy())
+        off += int(n)
+    return pieces
 
 
 # ---------------------------------------------------------------------------

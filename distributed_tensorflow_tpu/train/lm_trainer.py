@@ -78,6 +78,7 @@ ep reads the dense forward, == the EP forward in the no-drop regime —
 
 from __future__ import annotations
 
+import os
 import time
 
 import jax
@@ -116,6 +117,7 @@ class LMTrainer:
         pp_microbatches: int = 4,
         seq_axis: str = "seq",
         sp_attention: str | None = None,
+        tokenizer=None,
     ):
         self.model = model
         self.datasets = datasets
@@ -149,6 +151,24 @@ class LMTrainer:
         if self.supervisor is None and self.config.checkpoint_dir:
             self.supervisor = Supervisor(
                 is_chief=is_chief, checkpoint_dir=self.config.checkpoint_dir
+            )
+        self.tokenizer = tokenizer
+        if (
+            tokenizer is not None
+            and self.supervisor is not None
+            and self.supervisor.checkpoint_dir
+            and self.supervisor.is_chief
+            and hasattr(tokenizer, "save")
+        ):
+            # The vocab ships WITH the checkpoint: a restored model is
+            # useless without the exact merges that produced its token ids
+            # (reference analog: none — its data pipeline had no learned
+            # state; this is part of the deliberate checkpoint upgrade).
+            # Supervisor only creates the directory when orbax is present,
+            # so make sure it exists before writing the vocab.
+            os.makedirs(self.supervisor.checkpoint_dir, exist_ok=True)
+            tokenizer.save(
+                os.path.join(self.supervisor.checkpoint_dir, "tokenizer.json")
             )
         self.start_step = 0
         if self.supervisor is not None:

@@ -1695,3 +1695,44 @@ def test_sp_ragged_loss_exact_and_pad_independent(gqa_window):
     )
     for a, b in zip(jax.tree.leaves(pa), jax.tree.leaves(pb)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ragged_factories_accept_none_lens():
+    # A ragged=True factory called with lens=None must synthesize full
+    # lengths (== the non-ragged loss), not die on a rank-0 placeholder vs
+    # the rank-1 P(data) lens spec (advisor r4).
+    from distributed_tensorflow_tpu.models.gpt import (
+        expert_parallel_specs,
+        make_lm_ep_parts,
+        make_lm_sp_parts,
+    )
+    from distributed_tensorflow_tpu.parallel import make_mesh
+    from jax.sharding import NamedSharding
+
+    opt = optim_lib.make("adam", 1e-3)
+    rng = np.random.default_rng(60)
+    toks = jnp.asarray(_tokens(rng, 8, 16))
+    full = jnp.full((8,), 16, jnp.int32)
+
+    model = _model(num_layers=2)
+    params = model.init(seed=60)
+    mesh = make_mesh((2, 4), ("data", "seq"), devices=jax.devices()[:8])
+    mapped = make_lm_sp_parts(model, opt, mesh, data_axis="data", ragged=True)
+    o = opt.init(params)
+    _, _, l_none = jax.jit(mapped)(params, o, toks, None)
+    _, _, l_full = jax.jit(mapped)(params, o, toks, full)
+    assert float(l_none) == float(l_full)
+
+    emodel = _model(moe_experts=4, moe_capacity_factor=4.0, num_layers=2)
+    eparams = emodel.init(seed=61)
+    emesh = make_mesh((2, 4), ("data", "expert"), devices=jax.devices()[:8])
+    especs, _, emapped = make_lm_ep_parts(
+        emodel, opt, emesh, data_axis="data", ragged=True
+    )
+    ep = jax.device_put(
+        eparams, jax.tree.map(lambda s: NamedSharding(emesh, s), especs)
+    )
+    eo = opt.init(ep)
+    _, _, el_none = jax.jit(emapped)(ep, eo, toks, None)
+    _, _, el_full = jax.jit(emapped)(ep, eo, toks, full)
+    assert float(el_none) == float(el_full)

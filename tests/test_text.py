@@ -3,6 +3,8 @@ document packing, deterministic corpus, and the end-to-end text-in /
 text-out LM story the reference never had (its one dataset is MNIST
 images, reference tfsingle.py:13-14)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -95,6 +97,154 @@ def test_bpe_tokenizer():
     )
     assert int(ds.train.tokens.max()) < tok.vocab_size
     assert ds.train.tokens.shape[1] == 32
+
+
+def _naive_bpe_train(docs, num_merges):
+    """The O(K × corpus) recount-per-round reference algorithm the
+    incremental trainer must reproduce bit-for-bit."""
+    from collections import Counter
+
+    from distributed_tensorflow_tpu.data.text import _merge_pair
+
+    seqs = [list(np.frombuffer(d.encode("utf-8"), np.uint8)) for d in docs]
+    merges = []
+    for new_id in range(257, 257 + num_merges):
+        counts = Counter()
+        for s in seqs:
+            counts.update(zip(s, s[1:]))
+        if not counts:
+            break
+        best_n = max(counts.values())
+        pair = min(p for p, n in counts.items() if n == best_n)
+        merges.append((int(pair[0]), int(pair[1])))
+        seqs = [_merge_pair(s, pair, new_id) for s in seqs]
+    return merges
+
+
+def _naive_bpe_encode(ranks, text):
+    from distributed_tensorflow_tpu.data.text import _merge_pair
+
+    ids = list(np.frombuffer(text.encode("utf-8"), np.uint8))
+    while len(ids) > 1:
+        pairs = set(zip(ids, ids[1:]))
+        ranked = [p for p in pairs if p in ranks]
+        if not ranked:
+            break
+        pair = min(ranked, key=ranks.__getitem__)
+        ids = _merge_pair(ids, pair, 257 + ranks[pair])
+    return ids
+
+
+def test_bpe_incremental_matches_naive_reference():
+    # The round-5 incremental trainer (linked-list corpus, per-round count
+    # deltas, lazy max-heap) and the heap-pass encoder must be
+    # BIT-IDENTICAL to the naive recount-per-round algorithm — in both the
+    # pure-Python fallback and (when buildable) the native C++ fast path.
+    from distributed_tensorflow_tpu.data.text import (
+        BPETokenizer,
+        _bpe_encode_py,
+        _bpe_train_py,
+    )
+    from distributed_tensorflow_tpu.runtime import native
+
+    docs = synthetic_documents(48, seed=11) + ["aaaa aaaa", "", "ünïcødé"]
+    for K in (1, 7, 40, 120):
+        ref = _naive_bpe_train(docs, K)
+        assert _bpe_train_py(docs, K) == ref, K
+        if native.available():
+            assert native.bpe_train(docs, K) == ref, K
+
+    tok = BPETokenizer(_naive_bpe_train(docs, 40))
+    strings = docs[:6] + ["never-seen tökens!", "a", "aaab" * 7, ""]
+    for s in strings:
+        ref = _naive_bpe_encode(tok._ranks, s)
+        assert _bpe_encode_py(tok._ranks, s.encode("utf-8")) == ref, s
+        assert tok.encode(s).tolist() == ref, s
+    if native.available():
+        batched = tok.encode_batch(strings)
+        for s, ids in zip(strings, batched):
+            assert ids.tolist() == _naive_bpe_encode(tok._ranks, s), s
+
+
+def test_bpe_save_load_round_trip(tmp_path):
+    from distributed_tensorflow_tpu.data import BPETokenizer
+
+    docs = synthetic_documents(32, seed=12)
+    tok = BPETokenizer.train(docs, num_merges=48)
+    path = str(tmp_path / "vocab.json")
+    tok.save(path)
+    tok2 = BPETokenizer.load(path)
+    assert tok2.merges == tok.merges
+    assert tok2.vocab_size == tok.vocab_size
+    for s in docs[:4] + ["unseen ≠ corpus"]:
+        assert tok2.encode(s).tolist() == tok.encode(s).tolist()
+        assert tok2.decode(tok2.encode(s)) == s
+    # Wrong format refuses loudly.
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"format": "something-else", "merges": []}')
+    with pytest.raises(ValueError, match="dtf-bpe-v1"):
+        BPETokenizer.load(str(bad))
+
+
+def test_bpe_tokenizer_ships_with_checkpoint(tmp_path):
+    # A trained tokenizer passed to LMTrainer is saved into checkpoint_dir
+    # as tokenizer.json — restoring a checkpoint without the exact merges
+    # that produced its token ids would be useless (VERDICT r4 #7).
+    from distributed_tensorflow_tpu.data import BPETokenizer
+
+    tok = BPETokenizer.train(synthetic_documents(64, seed=5), num_merges=32)
+    ds = text_corpus(
+        num_docs=96, seq_len=32, n_val=4, n_test=4, seed=5, tokenizer=tok
+    )
+    model = GPTLM(
+        vocab_size=tok.vocab_size, max_len=32, model_dim=32, num_heads=4,
+        num_layers=1, compute_dtype=jnp.float32,
+    )
+    ckpt = str(tmp_path / "ckpt")
+    LMTrainer(
+        model,
+        ds,
+        TrainConfig(
+            epochs=1, batch_size=16, optimizer="adam", learning_rate=3e-3,
+            log_frequency=10**9, scan_epoch=False, checkpoint_dir=ckpt,
+        ),
+        tokenizer=tok,
+        print_fn=lambda *a: None,
+    )
+    vocab_path = os.path.join(ckpt, "tokenizer.json")
+    assert os.path.exists(vocab_path)
+    restored = BPETokenizer.load(vocab_path)
+    assert restored.merges == tok.merges
+
+
+@pytest.mark.heavy
+def test_bpe_scales_to_corpus():
+    # Ship-grade cost check (RUN_SLOW tier): thousands of merges over a
+    # megabyte-scale corpus in seconds via the native path — the naive
+    # algorithm this replaced took minutes at a tenth of this size.
+    import time
+
+    from distributed_tensorflow_tpu.data import BPETokenizer
+    from distributed_tensorflow_tpu.runtime import native
+
+    if not native.available():
+        pytest.skip("native runtime unavailable")
+    docs = synthetic_documents(12000, seed=13)  # ~1.4 MB
+    t0 = time.perf_counter()
+    tok = BPETokenizer.train(docs, num_merges=4000)
+    train_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    pieces = tok.encode_batch(docs)
+    encode_s = time.perf_counter() - t0
+    assert len(tok.merges) == 4000
+    assert train_s < 30, f"BPE train too slow: {train_s:.1f}s"
+    assert encode_s < 30, f"BPE encode too slow: {encode_s:.1f}s"
+    # Compression and exact round-trip at scale.
+    nb = sum(len(d.encode()) for d in docs[:500])
+    ne = sum(len(p) for p in pieces[:500])
+    assert ne < 0.5 * nb
+    for d, p in list(zip(docs, pieces))[:50]:
+        assert tok.decode(p) == d
 
 
 def test_text_lm_end_to_end():
