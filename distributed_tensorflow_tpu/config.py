@@ -141,6 +141,15 @@ class TrainConfig:
     # engine behind the Trainer API; requires the reference workload shape
     # (MLP + plain sgd + naive loss + SingleDevice) and raises otherwise.
     engine: str = "xla"
+    # Middle tier between the per-epoch scanned path and the all-or-nothing
+    # compiled_run (round 5): run() dispatches k epochs at a time through
+    # the whole-run compiled program (in-graph per-epoch eval), prints the
+    # same per-epoch lines from the fetched k-epoch history, and
+    # checkpoints + honors should_stop BETWEEN dispatches — the documented
+    # lifecycle API at near-compiled_run throughput, with a bounded
+    # resume/stop granularity of k epochs instead of the whole run.
+    # None/0 disables. Ignored when compiled_run=True (strictly coarser).
+    epochs_per_dispatch: int | None = None
     # Keep N device-placed batches in flight in the eager per-batch loop
     # (data/prefetch.py): batch i+1's host→device transfer overlaps step i's
     # compute. 0 disables (reference-parity synchronous feed).

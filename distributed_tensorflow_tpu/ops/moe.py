@@ -1,4 +1,4 @@
-"""Expert parallelism: a Switch-style top-1 MoE FFN with all-to-all dispatch.
+"""Expert parallelism: a Switch-style top-k MoE FFN with all-to-all dispatch.
 
 Absent from the reference (SURVEY.md §2b: no experts anywhere in the 6
 files) but provided as first-class parallelism machinery, like tensor and
@@ -10,12 +10,18 @@ would have had to build from PS RPCs.
 Semantics (chosen to be exactly reproducible by a dense reference, which is
 how the tests validate the distributed path):
 
-- top-1 routing: each token goes to ``argmax`` of its gate logits;
+- top-k routing (``k=1`` default = Switch): each token goes to its ``k``
+  highest gate logits. Combine weights are the router probabilities —
+  raw for k=1 (Switch: out = p·expert(x), the gradient path into the
+  gate), renormalized over the chosen experts for k≥2 (the standard
+  top-2/Mixtral convention: Σ over chosen = 1);
 - per-source-device capacity C: each device sends at most C of its local
-  tokens to each expert, keeping shapes static (XLA requirement); tokens
-  over capacity pass through with a zero expert contribution (standard
-  Switch overflow behavior);
-- combined output = gate_prob * expert_out, residual-friendly.
+  (token, choice) dispatches to each expert, keeping shapes static (XLA
+  requirement). Slots fill in CHOICE-MAJOR order (every token's first
+  choice before any second choice — GShard priority: a later token's
+  second choice never evicts an earlier token's first choice); dispatches
+  over capacity contribute zero (standard Switch overflow behavior);
+- combined output = Σ_choices weight·expert_out, residual-friendly.
 
 Call :func:`moe_ffn` inside ``jax.shard_map`` over the ``expert`` axis with
 tokens sharded on the leading dim and expert weights stacked [E, ...]
@@ -89,10 +95,18 @@ def _expert_ffn(x, w_up, b_up, w_down, b_down):
     return jnp.dot(h, w_down, preferred_element_type=jnp.float32) + b_down
 
 
-def _route(x, wg, num_experts: int, capacity: int, token_mask=None):
-    """Shared routing: returns (expert_idx [T], gate_prob [T], slot [T],
-    keep [T], aux :class:`MoEAux`) where slot is the token's position in its
-    (expert, source) capacity buffer and keep = slot < capacity.
+def _route(x, wg, num_experts: int, capacity: int, token_mask=None, k: int = 1):
+    """Shared top-k routing: returns (expert_idx [T, k], gate_w [T, k],
+    slot [T, k], keep [T, k], aux :class:`MoEAux`) where slot is the
+    (token, choice) dispatch's position in its (expert, source) capacity
+    buffer and keep = slot < capacity.
+
+    Combine weights ``gate_w``: the raw router probability for k=1 (Switch
+    — out = p·expert(x) is the gradient path into the gate), probabilities
+    renormalized over the k chosen experts for k≥2 (top-2/Mixtral
+    convention). Capacity slots fill CHOICE-MAJOR (all first choices in
+    token order, then all second choices, ...) — GShard priority: a later
+    token's second choice never evicts an earlier token's first choice.
 
     ``token_mask`` [T] bool marks real tokens in a right-padded ragged
     batch: pad tokens are never dispatched (keep=False), never consume a
@@ -100,42 +114,66 @@ def _route(x, wg, num_experts: int, capacity: int, token_mask=None):
     MoE batches are exactly pad-content-independent (without the mask, a
     pad token could displace a real one from its expert's queue and the
     balance/z losses would average over garbage)."""
+    if not 1 <= k <= num_experts:
+        raise ValueError(f"top-k k={k} must be in [1, num_experts={num_experts}]")
+    t = x.shape[0]
     logits = jnp.dot(x, wg, preferred_element_type=jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
-    expert_idx = jnp.argmax(logits, axis=-1)
-    gate_prob = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
-    onehot = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.int32)  # [T, E]
+    if k == 1:
+        expert_idx = jnp.argmax(logits, axis=-1)[:, None]  # [T, 1]
+    else:
+        _, expert_idx = lax.top_k(logits, k)  # [T, k], rank order
+    gate_w = jnp.take_along_axis(probs, expert_idx, axis=-1)  # [T, k]
+    if k > 1:
+        gate_w = gate_w / jnp.sum(gate_w, axis=-1, keepdims=True)
+    onehot = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.int32)  # [T, k, E]
     if token_mask is not None:
-        onehot = onehot * token_mask[:, None].astype(jnp.int32)
-    # Position of each token within its expert's queue (arrival order; pad
-    # tokens contribute nothing to the cumsum, so they occupy no slot).
-    slot = (jnp.cumsum(onehot, axis=0) - 1)[jnp.arange(x.shape[0]), expert_idx]
+        onehot = onehot * token_mask[:, None, None].astype(jnp.int32)
+    # Queue position per (token, choice) dispatch: cumsum over the
+    # choice-major flattening [k·T, E] (choice c of token t at row c·T+t).
+    flat = onehot.swapaxes(0, 1).reshape(k * t, num_experts)
+    slot_flat = (jnp.cumsum(flat, axis=0) - 1).reshape(k, t, num_experts)
+    slot = jnp.take_along_axis(
+        slot_flat.swapaxes(0, 1), expert_idx[:, :, None], axis=-1
+    )[:, :, 0]  # [T, k]
     keep = slot < capacity
     if token_mask is not None:
-        keep &= token_mask
-    # Aux statistics over this call's REAL tokens. f rides stop_gradient-
-    # free one_hot (int → no gradient anyway); the differentiable path into
-    # the gate weights is P — exactly the Switch formulation.
+        keep &= token_mask[:, None]
+    # Aux statistics over this call's REAL dispatches. f rides
+    # stop_gradient-free one_hot (int → no gradient anyway); the
+    # differentiable path into the gate weights is P — the Switch
+    # formulation, with f normalized over T·k dispatches for top-k (so
+    # uniform routing still minimizes balance_loss at 1.0 for every k).
     lse2 = jax.scipy.special.logsumexp(logits, axis=-1) ** 2
+    dispatch = jnp.sum(onehot, axis=1)  # [T, E] — how many choices hit e
     if token_mask is None:
-        f = jnp.mean(onehot.astype(jnp.float32), axis=0)  # [E] dispatch frac
+        f = jnp.mean(dispatch.astype(jnp.float32), axis=0) / k  # [E]
         p_mean = jnp.mean(probs, axis=0)  # [E] mean router prob
         z = jnp.mean(lse2)
         kept = jnp.mean(keep.astype(jnp.float32))
     else:
         w = token_mask.astype(jnp.float32)
         denom = jnp.maximum(jnp.sum(w), 1.0)
-        f = jnp.sum(onehot.astype(jnp.float32), axis=0) / denom
+        f = jnp.sum(dispatch.astype(jnp.float32), axis=0) / (denom * k)
         p_mean = jnp.sum(probs * w[:, None], axis=0) / denom
         z = jnp.sum(lse2 * w) / denom
-        kept = jnp.sum(keep.astype(jnp.float32)) / denom
+        kept = jnp.sum(
+            keep.astype(jnp.float32) * w[:, None]
+        ) / (denom * k)
     aux = MoEAux(
         balance_loss=num_experts * jnp.sum(f * p_mean),
         z_loss=z,
         drop_fraction=1.0 - kept,
         expert_fraction=f,
     )
-    return expert_idx, gate_prob, slot, keep, aux
+    return expert_idx, gate_w, slot, keep, aux
+
+
+def _combine(gate_w, keep, gathered):
+    """Weighted combine over the k choices: Σ_c keep_c·w_c·out_c.
+    gate_w/keep: [T, k]; gathered: [T, k, D] → [T, D]."""
+    w = jnp.where(keep, gate_w, 0.0)
+    return jnp.einsum("tk,tkd->td", w, gathered)
 
 
 def moe_ffn_dense(
@@ -145,21 +183,22 @@ def moe_ffn_dense(
     *,
     with_aux: bool = False,
     token_mask: jax.Array | None = None,
+    k: int = 1,
 ):
     """Single-device reference with identical routing/drop semantics: every
-    expert computed locally, per-expert capacity applied in token order.
+    expert computed locally, per-expert capacity applied in dispatch order.
     ``with_aux=True`` also returns the router's :class:`MoEAux`;
-    ``token_mask`` [T] bool excludes pad tokens from routing (see
-    :func:`_route`)."""
+    ``token_mask`` [T] bool excludes pad tokens from routing and ``k`` is
+    the top-k routing width (see :func:`_route`)."""
     e = params.wg.shape[1]
-    expert_idx, gate_prob, _, keep, aux = _route(
-        x, params.wg, e, capacity, token_mask
+    expert_idx, gate_w, _, keep, aux = _route(
+        x, params.wg, e, capacity, token_mask, k=k
     )
     outs = jax.vmap(_expert_ffn, in_axes=(None, 0, 0, 0, 0))(
         x, params.w_up, params.b_up, params.w_down, params.b_down
     )  # [E, T, D]
-    picked = outs[expert_idx, jnp.arange(x.shape[0])]  # [T, D]
-    out = jnp.where(keep[:, None], gate_prob[:, None] * picked, 0.0)
+    picked = outs[expert_idx, jnp.arange(x.shape[0])[:, None]]  # [T, k, D]
+    out = _combine(gate_w, keep, picked)
     return (out, aux) if with_aux else out
 
 
@@ -170,34 +209,35 @@ def moe_ffn_local(
     *,
     with_aux: bool = False,
     token_mask: jax.Array | None = None,
+    k: int = 1,
 ):
     """Single-device switch FFN at sparse cost: route, gather each expert's
-    ≤``capacity`` tokens into its buffer, run every expert ONCE on its
+    ≤``capacity`` dispatches into its buffer, run every expert ONCE on its
     buffer, scatter back. Identical semantics to :func:`moe_ffn_dense`
-    (same ``_route``, same per-expert in-arrival-order capacity — a single
+    (same ``_route``, same per-expert choice-major capacity — a single
     source makes per-source and global capacity the same thing) at
     ``E·capacity`` token-FFNs instead of dense's ``E·T`` — the sparse
     compute MoE exists for, without the cross-device exchange.
     ``with_aux=True`` also returns the router's :class:`MoEAux`;
-    ``token_mask`` [T] bool excludes pad tokens from routing (see
-    :func:`_route`)."""
+    ``token_mask`` [T] bool excludes pad tokens from routing and ``k`` is
+    the top-k routing width (see :func:`_route`)."""
     e = params.wg.shape[1]
     t, d = x.shape
-    expert_idx, gate_prob, slot, keep, aux = _route(
-        x, params.wg, e, capacity, token_mask
+    expert_idx, gate_w, slot, keep, aux = _route(
+        x, params.wg, e, capacity, token_mask, k=k
     )
 
     send = jnp.zeros((e, capacity, d), x.dtype)
-    rows = jnp.where(keep, expert_idx, 0)
+    rows = jnp.where(keep, expert_idx, 0)  # [T, k]
     cols = jnp.where(keep, slot, 0)
-    contrib = jnp.where(keep[:, None], x, 0.0)
-    send = send.at[rows, cols].add(contrib)
+    contrib = jnp.where(keep[:, :, None], x[:, None, :], 0.0)  # [T, k, D]
+    send = send.at[rows, cols].add(contrib)  # kept slots unique → add==set
 
     out = jax.vmap(_expert_ffn)(
         send, params.w_up, params.b_up, params.w_down, params.b_down
     )  # [E, C, D]
-    gathered = out[rows, cols]
-    result = jnp.where(keep[:, None], gate_prob[:, None] * gathered, 0.0)
+    gathered = out[rows, cols]  # [T, k, D]
+    result = _combine(gate_w, keep, gathered)
     return (result, aux) if with_aux else result
 
 
@@ -209,27 +249,30 @@ def moe_ffn(
     *,
     with_aux: bool = False,
     token_mask: jax.Array | None = None,
+    k: int = 1,
 ):
     """Expert-parallel forward body (inside shard_map over ``axis_name``).
 
     ``x``: this device's local tokens [T_loc, D]. ``params.w_up`` etc. carry
     a leading [1, ...] slice — this device's expert. Returns [T_loc, D].
     ``with_aux=True`` also returns this device's router :class:`MoEAux`
-    (local-token statistics; pmean over the axis for the global view).
+    (local-token statistics; pmean over the axis for the global view);
+    ``k`` is the top-k routing width (see :func:`_route` — k≥2 sends each
+    token to up to k experts through the same two all-to-alls).
     """
     n = lax.axis_size(axis_name)
     t_loc, d = x.shape
-    expert_idx, gate_prob, slot, keep, aux = _route(
-        x, params.wg, n, capacity, token_mask
+    expert_idx, gate_w, slot, keep, aux = _route(
+        x, params.wg, n, capacity, token_mask, k=k
     )
 
     # Build the outgoing buffers: for each destination expert e, a [C, D]
-    # block of this device's tokens routed to e (zeros elsewhere).
+    # block of this device's dispatches routed to e (zeros elsewhere).
     send = jnp.zeros((n, capacity, d), x.dtype)
-    rows = jnp.where(keep, expert_idx, 0)
+    rows = jnp.where(keep, expert_idx, 0)  # [T_loc, k]
     cols = jnp.where(keep, slot, 0)
-    contrib = jnp.where(keep[:, None], x, 0.0)
-    send = send.at[rows, cols].add(contrib)  # capacity slots are unique → add==set
+    contrib = jnp.where(keep[:, :, None], x[:, None, :], 0.0)  # [T, k, D]
+    send = send.at[rows, cols].add(contrib)  # kept slots unique → add==set
 
     # Exchange: device g's block e goes to device e (and we receive one
     # [C, D] block from every source) → [n, C, D] of tokens for OUR expert.
@@ -246,6 +289,6 @@ def moe_ffn(
 
     # Return to senders and un-permute into token order.
     back = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0, tiled=True)
-    gathered = back[rows, cols]  # [T_loc, D]
-    result = jnp.where(keep[:, None], gate_prob[:, None] * gathered, 0.0)
+    gathered = back[rows, cols]  # [T_loc, k, D]
+    result = _combine(gate_w, keep, gathered)
     return (result, aux) if with_aux else result

@@ -145,6 +145,28 @@ class Strategy:
     def cost_scalar(self, cost: jax.Array) -> float:
         return float(jnp.mean(cost))
 
+    # -- cross-topology checkpoint interchange (round 5) ------------------
+    # Any strategy's state is a re-layout of ONE canonical form — the
+    # single-device (params, opt_state, scalar step). to_canonical folds a
+    # state into it (async merges its per-chip copies at the mean, the
+    # parameters it evaluates at; sync layouts are already canonical in
+    # shape); from_canonical re-stages it into this strategy's layout. A
+    # checkpoint saved canonically therefore restores under ANY strategy —
+    # dp=N→dp=M, async→sync, TP re-layout — where the reference's
+    # Supervisor could only re-attach to the identical topology
+    # (reference tfdist_between.py:78,83). LMTrainer carries the same
+    # surface for the LM modes (train/lm_trainer.py _state_to_canonical).
+
+    def to_canonical(self, state: TrainState) -> TrainState:
+        return TrainState(
+            state.params,
+            state.opt_state,
+            jnp.asarray(jnp.sum(state.step), jnp.int32),
+        )
+
+    def from_canonical(self, canonical: TrainState) -> TrainState:
+        return canonical
+
     @property
     def num_replicas(self) -> int:
         return 1
@@ -162,6 +184,9 @@ class SingleDevice(Strategy):
         # outputs of the first — would miss the jit cache and recompile
         # (docs/performance.md, "The round-1 73-second warmup 2").
         return jax.device_put(state, jax.devices()[0])
+
+    def from_canonical(self, canonical: TrainState) -> TrainState:
+        return jax.device_put(canonical, jax.devices()[0])
 
     def make_train_step(self, model, loss_fn, optimizer):
         @partial(jax.jit, donate_argnums=0)
@@ -262,6 +287,18 @@ class SyncDataParallel(Strategy):
             return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
 
         return _init()
+
+    def from_canonical(self, canonical: TrainState) -> TrainState:
+        if self._param_shardings is None:
+            return jax.device_put(canonical, self._repl)
+        # TP re-layout: shard the params under the specs; optimizer slots
+        # ride replicated (GSPMD re-propagates working layouts from the
+        # param shardings on the first step).
+        return TrainState(
+            jax.device_put(canonical.params, self._param_shardings),
+            jax.device_put(canonical.opt_state, self._repl),
+            jax.device_put(canonical.step, self._repl),
+        )
 
     def make_train_step(self, model, loss_fn, optimizer):
         if self.explicit:
@@ -424,6 +461,37 @@ class AsyncDataParallel(Strategy):
         )
         state = TrainState(stacked[0], stacked[1], jnp.zeros((self.n,), jnp.int32))
         return jax.device_put(state, self._stacked)
+
+    def to_canonical(self, state: TrainState) -> TrainState:
+        """Merge the per-chip copies at the mean — exactly the parameters
+        this strategy evaluates at (effective_params); integer optimizer
+        leaves (identical across copies) survive the mean-then-cast
+        bitwise. Step: the summed per-chip vector (global_step — total
+        applied updates, the PS semantics)."""
+        merge = lambda t: jax.tree.map(  # noqa: E731
+            lambda a: jnp.mean(a, axis=0).astype(a.dtype), t
+        )
+        return TrainState(
+            merge(state.params),
+            merge(state.opt_state),
+            jnp.asarray(jnp.sum(state.step), jnp.int32),
+        )
+
+    def from_canonical(self, canonical: TrainState) -> TrainState:
+        """Broadcast the canonical state into n equal copies (how every
+        async run starts) and spread the scalar step over the per-chip
+        vector so global_step (the sum) is preserved exactly."""
+        stacked = jax.tree.map(
+            lambda a: jnp.broadcast_to(a[None], (self.n,) + a.shape),
+            (canonical.params, canonical.opt_state),
+        )
+        total = jnp.asarray(canonical.step, jnp.int32)
+        base = total // self.n
+        rem = total - base * self.n
+        steps = base + (jnp.arange(self.n, dtype=jnp.int32) < rem)
+        return jax.device_put(
+            TrainState(stacked[0], stacked[1], steps), self._stacked
+        )
 
     def make_train_step(self, model, loss_fn, optimizer):
         scale = self.update_scale

@@ -70,6 +70,11 @@ def _row_specs(n_devices: int):
         # launch (TrainConfig.engine="pallas") — bench.py's engine behind
         # the Trainer API.
         ("single-compiled-pallas", 1, "ref #1, Pallas grid-kernel engine"),
+        # Middle tier (round 5, config.epochs_per_dispatch): run() through
+        # the compiled program 10 epochs per dispatch — full lifecycle
+        # (per-epoch logs + eval + a checkpoint-capable boundary every 10
+        # epochs) at near-whole-run throughput.
+        ("single-k10", 1, "ref #1, k-epochs-per-dispatch lifecycle"),
     ]
     for n in (2, n_devices):
         if n < 2 or n > n_devices:
@@ -130,7 +135,22 @@ def run_suite(
             if rows is None:
                 continue
         model = MLP()
-        if name.startswith("single-compiled"):
+        if name == "single-k10":
+            # The chunked middle tier IS run(): time the full lifecycle
+            # call (logs silenced, eval + chunk boundaries included).
+            epochs_used = max(epochs, compiled_min_epochs)
+            strategy = SingleDevice()
+            cfg = TrainConfig(
+                epochs=epochs_used, batch_size=batch_size,
+                epochs_per_dispatch=10,
+            )
+            tr = Trainer(model, datasets, cfg, strategy=strategy, print_fn=_silent)
+            tr.run()  # warmup: compile the chunk program
+            t0 = time.time()
+            tr.run()
+            s_per_epoch = (time.time() - t0) / epochs_used
+            mode = "chunked-10"
+        elif name.startswith("single-compiled"):
             # Whole-run path: the first call compiles (the Trainer caches
             # the compiled function, so the second call reuses it); the
             # second is timed end-to-end — staging + dispatch + the D2H

@@ -172,10 +172,36 @@ class LMTrainer:
             )
         self.start_step = 0
         if self.supervisor is not None:
-            self.state, self.start_step = self.supervisor.prepare_or_restore(
-                self.state
+            step = self.supervisor.latest_step()
+            src = (
+                self.supervisor.saved_layout(step)
+                if step is not None
+                else None
             )
-            self.state = self._place_state(self.state)
+            if step is not None and src is not None and not (
+                self._layout_compatible(src)
+            ):
+                # Cross-topology restore (round 5): the checkpoint was
+                # written by a DIFFERENT mode layout (pp's staged blocks,
+                # async's stacked copies, or a different stage/replica
+                # count). Restore it in ITS shapes, canonicalize to the
+                # dense single-device layout, then re-stage into this
+                # trainer's layout — elasticity the reference's
+                # Supervisor (topology-pinned re-attach) never had.
+                raw = self.supervisor.restore_raw(
+                    step, self._abstract_state_for(src)
+                )
+                self.state = self._place_state(
+                    self._state_from_canonical(
+                        self._state_to_canonical(raw, src)
+                    )
+                )
+                self.start_step = step
+            else:
+                self.state, self.start_step = (
+                    self.supervisor.prepare_or_restore(self.state)
+                )
+                self.state = self._place_state(self.state)
             # Fast-forward the host-side index stream so a resumed run
             # draws exactly the batches the uninterrupted run would (the
             # reference resumed against live PS state; the TPU-native
@@ -467,6 +493,136 @@ class LMTrainer:
             )
         return params
 
+    # -- cross-topology checkpoint restore (round 5) -----------------------
+    #
+    # Every mode's state is a re-layout of ONE canonical form — the dense
+    # single-device (params, opt_state, step): {single, dp, zero, tp, ep,
+    # sp} share its shapes outright (only GSPMD placement differs), pp
+    # stages the block stack ([L] → [S, L/S]), async stacks N per-replica
+    # copies. A checkpoint therefore restores into ANY mode: restore in
+    # the source layout's shapes, canonicalize (pp unstages; async merges
+    # at the mean — the same parameters async evaluates at), then re-stage
+    # into the target layout. Same-layout resume keeps the old bitwise
+    # path (async replicas keep their individual copies). The reference's
+    # Supervisor could only re-attach to the same topology (reference
+    # tfdist_between.py:78,83) — this is the elasticity upgrade SURVEY §5
+    # flagged as the deliberate next axis.
+
+    # Modes whose state shapes ARE the canonical shapes.
+    _DENSE_LAYOUTS = frozenset({"single", "dp", "zero", "tp", "ep", "sp"})
+
+    def _layout_meta(self) -> dict:
+        """Topology descriptor saved alongside each checkpoint."""
+        meta: dict = {"mode": self.mode}
+        if self.mode == "pp":
+            meta["stages"] = int(self.mesh.shape[self.stage_axis])
+        if self.mode == "async":
+            meta["replicas"] = int(self.mesh.shape[self.data_axis])
+        return meta
+
+    def _layout_compatible(self, src: dict) -> bool:
+        """True when the saved state's SHAPES match this trainer's (the
+        bitwise same-layout resume path applies)."""
+        m = src.get("mode")
+        if self.mode in self._DENSE_LAYOUTS:
+            return m in self._DENSE_LAYOUTS
+        return m == self.mode and all(
+            src.get(k) == v
+            for k, v in self._layout_meta().items()
+            if k != "mode"
+        )
+
+    def _map_params_like(self, fn, tree_):
+        """Apply ``fn`` to every GPTLMParams node in a pytree — the
+        optimizer state mirrors the parameter structure (adam's mu/nu ARE
+        GPTLMParams), so one traversal re-layouts params and slots alike;
+        non-params leaves (e.g. adam's count) pass through."""
+        from distributed_tensorflow_tpu.models.gpt import GPTLMParams
+
+        return jax.tree.map(
+            lambda node: fn(node) if isinstance(node, GPTLMParams) else node,
+            tree_,
+            is_leaf=lambda x: isinstance(x, GPTLMParams),
+        )
+
+    def _abstract_state_for(self, src: dict) -> TrainState:
+        """ShapeDtypeStructs of a checkpoint written under layout ``src``
+        (this model + optimizer; cross-OPTIMIZER restore is out of scope —
+        orbax fails loudly on a structure mismatch)."""
+        params = jax.eval_shape(lambda: self.model.init(seed=0))
+        if src["mode"] == "pp":
+            from distributed_tensorflow_tpu.models.gpt import (
+                pipeline_stage_params,
+            )
+
+            params = jax.eval_shape(
+                lambda p: pipeline_stage_params(
+                    self.model, p, src["stages"]
+                ),
+                params,
+            )
+        opt = jax.eval_shape(self.optimizer.init, params)
+        step = jax.ShapeDtypeStruct((), jnp.int32)
+        if src["mode"] == "async":
+            n = src["replicas"]
+            stack = lambda t: jax.tree.map(  # noqa: E731
+                lambda s: jax.ShapeDtypeStruct((n,) + s.shape, s.dtype), t
+            )
+            return TrainState(stack(params), stack(opt), step)
+        return TrainState(params, opt, step)
+
+    def _state_to_canonical(self, state: TrainState, src: dict) -> TrainState:
+        """Source-layout state → dense single-device layout."""
+        mode = src["mode"]
+        if mode == "async":
+            # Merge the replicas at the mean — exactly the parameters the
+            # async mode itself evaluates at (_eval_params). Integer
+            # leaves (adam count) are identical across replicas, so the
+            # mean-then-cast is exact.
+            merge = lambda t: jax.tree.map(  # noqa: E731
+                lambda x: jnp.mean(x, axis=0).astype(x.dtype), t
+            )
+            return TrainState(
+                merge(state.params), merge(state.opt_state), state.step
+            )
+        if mode == "pp":
+            unstage = lambda p: p._replace(  # noqa: E731
+                blocks=jax.tree.map(
+                    lambda a: a.reshape((-1,) + a.shape[2:]), p.blocks
+                )
+            )
+            return TrainState(
+                self._map_params_like(unstage, state.params),
+                self._map_params_like(unstage, state.opt_state),
+                state.step,
+            )
+        return state
+
+    def _state_from_canonical(self, c: TrainState) -> TrainState:
+        """Dense single-device layout → this trainer's layout (placement
+        itself happens in _place_state)."""
+        if self.mode == "pp":
+            from distributed_tensorflow_tpu.models.gpt import (
+                pipeline_stage_params,
+            )
+
+            stages = int(self.mesh.shape[self.stage_axis])
+            stage = lambda p: pipeline_stage_params(  # noqa: E731
+                self.model, p, stages
+            )
+            return TrainState(
+                self._map_params_like(stage, c.params),
+                self._map_params_like(stage, c.opt_state),
+                c.step,
+            )
+        if self.mode == "async":
+            n = int(self.mesh.shape[self.data_axis])
+            bcast = lambda t: jax.tree.map(  # noqa: E731
+                lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), t
+            )
+            return TrainState(bcast(c.params), bcast(c.opt_state), c.step)
+        return c
+
     # -- compiled pieces ---------------------------------------------------
 
     @property
@@ -725,7 +881,13 @@ class LMTrainer:
 
         return jax.jit(run, donate_argnums=0)
 
-    def run_compiled(self, epochs: int | None = None) -> dict:
+    def run_compiled(
+        self,
+        epochs: int | None = None,
+        *,
+        epoch_offset: int = 0,
+        finalize: bool = True,
+    ) -> dict:
         """Whole-run fast path: all epochs + per-epoch in-graph perplexity
         as ONE dispatch. Log lines (uniform AvgTime), summaries, and
         history match :meth:`run`; the in-graph perplexity covers the
@@ -735,7 +897,10 @@ class LMTrainer:
         :meth:`evaluate`). Supervisor semantics differ BY DESIGN from
         run(): one checkpoint save after the dispatch and no mid-run
         heartbeat-reactive stop — a single compiled program cannot be
-        interrupted at epoch boundaries; use run() when those matter."""
+        interrupted at epoch boundaries; use run() when those matter, or
+        ``config.epochs_per_dispatch`` for the middle tier (k epochs per
+        dispatch with checkpoints + stop checks between dispatches —
+        ``epoch_offset``/``finalize`` are its chunk plumbing)."""
         cfg = self.config
         epochs = cfg.epochs if epochs is None else epochs
         train = self.datasets.train
@@ -791,7 +956,7 @@ class LMTrainer:
                 if logger.is_due(i + 1, steps):
                     logger.log_step_line(
                         step=step_before + epoch * steps + i + 1,
-                        epoch=epoch,
+                        epoch=epoch_offset + epoch,
                         batch=i,
                         batch_count=steps,
                         cost=float(costs[epoch, i]),
@@ -810,10 +975,22 @@ class LMTrainer:
                         )
                     self.summary_writer.add_scalar("perplexity", ppl, step_now)
                 self.history.append(
-                    {"epoch": epoch + 1, "perplexity": ppl, "step": step_now}
+                    {
+                        "epoch": epoch_offset + epoch + 1,
+                        "perplexity": ppl,
+                        "step": step_now,
+                    }
                 )
         if self.supervisor is not None:
-            self.supervisor.save(self.state, self.global_step)
+            self.supervisor.save(
+                self.state, self.global_step, layout=self._layout_meta()
+            )
+        if not finalize:
+            return {
+                "perplexity": float(ppls[-1]),
+                "final_cost": self.last_cost,
+                "global_step": self.global_step,
+            }
         perplexity = self.evaluate("validation")  # all processes (global mesh)
         if self.is_chief:
             logger.log_final(cost=self.last_cost)
@@ -824,6 +1001,37 @@ class LMTrainer:
             "final_cost": self.last_cost,
             "global_step": self.global_step,
         }
+
+    def _run_chunked(self, epochs: int) -> dict:
+        """k-epochs-per-dispatch middle tier (``config.epochs_per_dispatch``,
+        mirror of Trainer._run_chunked): the compiled whole-run program
+        dispatched a chunk at a time — per-epoch logs + in-graph perplexity
+        from each chunk's fetched history, checkpoint per dispatch,
+        ``should_stop`` honored at chunk boundaries."""
+        k = self.config.epochs_per_dispatch
+        res = {
+            "perplexity": float("nan"),
+            "final_cost": float("nan"),
+            "global_step": self.global_step,
+        }
+        done = 0
+        while done < epochs:
+            n = min(k, epochs - done)
+            last = done + n >= epochs
+            res = self.run_compiled(n, epoch_offset=done, finalize=last)
+            done += n
+            if self.supervisor is not None and self.supervisor.should_stop:
+                if not last:
+                    res["perplexity"] = self.evaluate("validation")
+                    if self.is_chief:
+                        StepLogger(
+                            freq=self.config.log_frequency,
+                            print_fn=self.print_fn,
+                        ).log_final(cost=res["final_cost"])
+                        if self.summary_writer is not None:
+                            self.summary_writer.flush()
+                break
+        return res
 
     def _build_eval_chunk(self):
         @jax.jit
@@ -941,6 +1149,8 @@ class LMTrainer:
     def run(self, epochs: int | None = None) -> dict:
         cfg = self.config
         epochs = cfg.epochs if epochs is None else epochs
+        if cfg.epochs_per_dispatch:
+            return self._run_chunked(epochs)
         logger = StepLogger(freq=cfg.log_frequency, print_fn=self.print_fn)
         perplexity = float("nan")
         for epoch in range(epochs):
@@ -965,7 +1175,9 @@ class LMTrainer:
                     }
                 )
             if self.supervisor is not None:
-                self.supervisor.save(self.state, self.global_step)
+                self.supervisor.save(
+                    self.state, self.global_step, layout=self._layout_meta()
+                )
                 if self.supervisor.should_stop:
                     break
         final_cost = (

@@ -17,6 +17,7 @@ Checkpointing is orbax-backed, async-capable, and sharding-aware.
 
 from __future__ import annotations
 
+import json
 import os
 import re
 
@@ -72,14 +73,51 @@ class Supervisor:
     def latest_step(self) -> int | None:
         return latest_checkpoint_step(self.checkpoint_dir)
 
-    def save(self, state: TrainState, step: int) -> None:
+    def save(
+        self, state: TrainState, step: int, layout: dict | None = None
+    ) -> None:
         """Chief-only checkpoint write (non-chiefs no-op, as with the
-        reference's chief-owned init/teardown duties)."""
+        reference's chief-owned init/teardown duties). ``layout`` is an
+        optional topology descriptor (mode, pipeline stages, async
+        replicas — see LMTrainer._layout_meta) written as a JSON sidecar
+        ``step_N.layout.json``; cross-topology restore reads it to know
+        which canonicalization the saved arrays need."""
         if not (self.is_chief and self._ckptr):
             return
         path = os.path.join(self.checkpoint_dir, f"step_{step}")
         self._ckptr.save(path, state, force=True)
         self._ckptr.wait_until_finished()
+        if layout is not None:
+            side = f"{path}.layout.json"
+            tmp = f"{side}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(layout, f)
+            os.replace(tmp, side)
+
+    def saved_layout(self, step: int) -> dict | None:
+        """The layout sidecar written alongside ``step_N``, or None
+        (pre-round-5 checkpoints have none — callers must treat that as
+        "same layout as mine", the old behavior)."""
+        if not self.checkpoint_dir:
+            return None
+        try:
+            with open(
+                os.path.join(self.checkpoint_dir, f"step_{step}.layout.json")
+            ) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def restore_raw(self, step: int, abstract):
+        """Restore ``step_N`` against an explicit abstract pytree (shapes/
+        dtypes of the SOURCE layout) — the cross-topology path: the caller
+        canonicalizes the result rather than assuming it matches its own
+        state's shapes the way :meth:`prepare_or_restore` does."""
+        if self._ckptr is None:
+            raise RuntimeError("no checkpointer (orbax unavailable or no dir)")
+        path = os.path.join(self.checkpoint_dir, f"step_{step}")
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, abstract)
+        return self._ckptr.restore(path, abstract)
 
     def prepare_or_restore(self, state: TrainState) -> tuple[TrainState, int]:
         """Restore-or-init: the analog of ``prepare_or_wait_for_session``.

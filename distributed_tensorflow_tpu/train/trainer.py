@@ -307,7 +307,13 @@ class Trainer:
             step_incr=self._step_incr(step_before, batch_count),
         )
 
-    def run_compiled(self, epochs: int | None = None) -> dict:
+    def run_compiled(
+        self,
+        epochs: int | None = None,
+        *,
+        epoch_offset: int = 0,
+        finalize: bool = True,
+    ) -> dict:
         """Whole-run fast path (train/compiled_run.py): every epoch, shuffle,
         and test eval compiled into ONE dispatch. Observable surface matches
         ``run()`` — same log lines (uniform AvgTime, as in the scanned path),
@@ -315,7 +321,10 @@ class Trainer:
         reconstructed post-hoc from the returned ``[epochs, steps]`` costs
         and ``[epochs]`` accuracies. The epoch shuffle runs on-device
         (distributionally equivalent to the host shuffle; see the module
-        docstring of train/compiled_run.py for the exact semantics)."""
+        docstring of train/compiled_run.py for the exact semantics).
+        ``epoch_offset`` shifts the printed/recorded epoch numbers — the
+        k-epochs-per-dispatch middle tier (``config.epochs_per_dispatch``)
+        calls this once per chunk."""
         cfg = self.config
         epochs = cfg.epochs if epochs is None else epochs
         if not hasattr(self.strategy, "make_compiled_run_fn"):
@@ -425,7 +434,7 @@ class Trainer:
         for epoch in range(epochs):
             self._emit_step_logs(
                 costs[epoch],
-                epoch,
+                epoch_offset + epoch,
                 step_before + epoch * batch_count * incr,
                 avg_ms,
                 logger,
@@ -438,12 +447,16 @@ class Trainer:
                 if self.summary_writer is not None:
                     self.summary_writer.add_scalar("accuracy", accuracy, step_now)
                 self.history.append(
-                    {"epoch": epoch + 1, "accuracy": accuracy, "step": step_now}
+                    {
+                        "epoch": epoch_offset + epoch + 1,
+                        "accuracy": accuracy,
+                        "step": step_now,
+                    }
                 )
         if self.supervisor is not None:
             self.supervisor.save(self.state, self.strategy.global_step(self.state))
         final_cost = float(costs[-1, -1]) if costs.size else float("nan")
-        if self.is_chief:
+        if finalize and self.is_chief:
             logger.log_final(cost=final_cost)
             if self.summary_writer is not None:
                 self.summary_writer.flush()
@@ -452,6 +465,36 @@ class Trainer:
             "final_cost": final_cost,
             "global_step": self.strategy.global_step(self.state),
         }
+
+    def _run_chunked(self, epochs: int) -> dict:
+        """The k-epochs-per-dispatch middle tier
+        (``config.epochs_per_dispatch``): the whole-run compiled program
+        dispatched a chunk at a time — per-epoch logs/eval/summaries come
+        from each chunk's fetched history, a checkpoint lands after every
+        dispatch, and ``should_stop`` is honored at chunk boundaries. The
+        lifecycle surface of ``run()`` at near-``run_compiled`` throughput
+        (docs/benchmarks/tpu_single.md, the ``single-k*`` rows)."""
+        k = self.config.epochs_per_dispatch
+        res = {
+            "accuracy": 0.0,
+            "final_cost": float("nan"),
+            "global_step": self.strategy.global_step(self.state),
+        }
+        done = 0
+        while done < epochs:
+            n = min(k, epochs - done)
+            last = done + n >= epochs
+            res = self.run_compiled(n, epoch_offset=done, finalize=last)
+            done += n
+            if self.supervisor is not None and self.supervisor.should_stop:
+                if not last and self.is_chief:
+                    StepLogger(
+                        freq=self.config.log_frequency, print_fn=self.print_fn
+                    ).log_final(cost=res["final_cost"])
+                    if self.summary_writer is not None:
+                        self.summary_writer.flush()
+                break
+        return res
 
     def _check_pallas_engine(self) -> None:
         """engine="pallas" runs the fused whole-epoch grid kernel, which
@@ -586,6 +629,8 @@ class Trainer:
         if cfg.compiled_run:
             return self.run_compiled(epochs)
         epochs = cfg.epochs if epochs is None else epochs
+        if cfg.epochs_per_dispatch:
+            return self._run_chunked(epochs)
         if self.summary_writer is not None and self.is_chief and not self._graph_written:
             # Once per trainer: TensorBoard expects at most one graph per run,
             # and run() may be called repeatedly (resume, epoch-at-a-time).

@@ -32,6 +32,74 @@ def _trained_state(strategy, steps=2):
 
 
 @pytest.mark.parametrize(
+    "src,dst",
+    [
+        ("async", "sync"),  # stacked copies → mean, continue lockstep
+        ("sync", "async"),  # broadcast into equal copies
+        ("sync", "tp"),  # TP re-layout of replicated params
+        ("async", "single"),
+    ],
+)
+def test_cross_strategy_canonical_restore(tmp_path, src, dst):
+    # Round 5: a checkpoint saved in the CANONICAL layout
+    # (Strategy.to_canonical) restores under any other strategy via
+    # from_canonical — async's per-chip copies fold to the mean (its own
+    # effective_params), sync re-places/re-shards, and the summed step
+    # survives exactly. The reference's Supervisor was topology-pinned.
+    from distributed_tensorflow_tpu.parallel import SingleDevice
+
+    mesh = make_mesh((4, 2))
+    factory = {
+        "single": lambda: SingleDevice(),
+        "sync": lambda: SyncDataParallel(mesh),
+        "tp": lambda: SyncDataParallel(
+            mesh, param_specs=MLP().partition_specs()
+        ),
+        "async": lambda: AsyncDataParallel(mesh, avg_every=3),
+    }
+    strat_a = factory[src]()
+    state_a = _trained_state(strat_a, steps=3)
+    canonical = strat_a.to_canonical(state_a)
+    step_no = strat_a.global_step(state_a)
+    assert int(canonical.step) == step_no
+
+    sup = Supervisor(is_chief=True, checkpoint_dir=str(tmp_path))
+    sup.save(canonical, step_no)
+
+    strat_b = factory[dst]()
+    restored, got_step = sup.prepare_or_restore(
+        jax.tree.map(jnp.zeros_like, canonical)
+    )
+    assert got_step == step_no
+    state_b = strat_b.from_canonical(restored)
+    assert strat_b.global_step(state_b) == step_no
+
+    # The destination's effective parameters == the source's (the one
+    # parameter set the checkpoint denotes), bitwise.
+    for want, got in zip(
+        jax.tree.leaves(strat_a.effective_params(state_a)),
+        jax.tree.leaves(strat_b.effective_params(state_b)),
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(want)), np.asarray(jax.device_get(got))
+        )
+
+    # And training continues in the destination layout.
+    model = MLP(compute_dtype=jnp.float32)
+    opt = sgd(0.001)
+    step_fn = strat_b.make_train_step(model, cross_entropy, opt)
+    rng = np.random.default_rng(1)
+    x = rng.random((800, 784), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 800)]
+    bx, by = strat_b.prepare_batch(x, y)
+    state_b2, cost = step_fn(state_b, bx, by)
+    assert np.isfinite(strat_b.cost_scalar(cost))
+    per_step = strat_b.num_replicas if dst == "async" else 1
+    assert strat_b.global_step(state_b2) == step_no + per_step
+    sup.stop()
+
+
+@pytest.mark.parametrize(
     "make_strategy",
     [
         lambda mesh: SyncDataParallel(mesh),

@@ -152,6 +152,87 @@ def test_run_honors_compiled_run_knob(small_datasets):
     assert any("Test-Accuracy" in l for l in lines)
 
 
+def test_chunked_middle_tier(small_datasets, tmp_path):
+    # config.epochs_per_dispatch (round 5): run() dispatches k epochs at a
+    # time through the compiled program — per-epoch log lines numbered
+    # continuously across chunks, a checkpoint after EVERY dispatch (not
+    # just at the end), exactly one Final Cost line, and history covering
+    # every epoch.
+    import os
+
+    from distributed_tensorflow_tpu.config import TrainConfig
+    from distributed_tensorflow_tpu.train.trainer import Trainer
+
+    lines = []
+    trainer = Trainer(
+        _model(),
+        small_datasets,
+        TrainConfig(
+            batch_size=100, learning_rate=0.05, epochs=5, log_frequency=40,
+            epochs_per_dispatch=2, checkpoint_dir=str(tmp_path),
+        ),
+        print_fn=lambda *a: lines.append(" ".join(map(str, a))),
+    )
+    result = trainer.run()
+    steps = small_datasets.train.num_examples // 100
+    assert result["global_step"] == 5 * steps
+    assert sum("Test-Accuracy" in l for l in lines) == 5
+    assert sum("Final Cost" in l for l in lines) == 1
+    assert [h["epoch"] for h in trainer.history] == [1, 2, 3, 4, 5]
+    assert [h["step"] for h in trainer.history] == [
+        (e + 1) * steps for e in range(5)
+    ]
+    # A checkpoint landed at every chunk boundary (2, 4, 5 epochs).
+    saved = sorted(
+        int(d.split("_")[1])
+        for d in os.listdir(tmp_path)
+        if d.startswith("step_") and not d.endswith(".json")
+    )
+    assert saved == [2 * steps, 4 * steps, 5 * steps]
+
+    # Resume picks up from the last chunk boundary.
+    trainer2 = Trainer(
+        _model(),
+        small_datasets,
+        TrainConfig(
+            batch_size=100, learning_rate=0.05, epochs=5, log_frequency=40,
+            epochs_per_dispatch=2, checkpoint_dir=str(tmp_path),
+        ),
+        print_fn=lambda *a: None,
+    )
+    assert trainer2.start_step == 5 * steps
+
+
+def test_chunked_lm_middle_tier(tmp_path):
+    from distributed_tensorflow_tpu.config import TrainConfig
+    from distributed_tensorflow_tpu.data import copy_corpus
+    from distributed_tensorflow_tpu.models.gpt import GPTLM
+    from distributed_tensorflow_tpu.train import LMTrainer
+
+    lines = []
+    model = GPTLM(
+        vocab_size=61, max_len=16, model_dim=32, num_heads=4, num_layers=2,
+        compute_dtype=jnp.float32,
+    )
+    tr = LMTrainer(
+        model,
+        copy_corpus(num=384, half_len=8, vocab=61, n_val=64, n_test=64, seed=0),
+        TrainConfig(
+            epochs=3, batch_size=64, optimizer="adam", learning_rate=3e-3,
+            log_frequency=2, epochs_per_dispatch=2,
+            checkpoint_dir=str(tmp_path),
+        ),
+        print_fn=lambda *a: lines.append(" ".join(map(str, a))),
+    )
+    res = tr.run()
+    steps = 256 // 64
+    assert res["global_step"] == 3 * steps
+    assert sum(l.startswith("Test-Perplexity:") for l in lines) == 3
+    assert sum("Final Cost" in l for l in lines) == 1
+    assert [h["epoch"] for h in tr.history] == [1, 2, 3]
+    assert np.isfinite(res["perplexity"])
+
+
 def test_zero_steps_degrades_gracefully(small_datasets):
     from distributed_tensorflow_tpu.config import TrainConfig
     from distributed_tensorflow_tpu.train.trainer import Trainer

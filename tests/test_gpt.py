@@ -673,16 +673,18 @@ def test_moe_lm_decode_matches_reforward():
     np.testing.assert_array_equal(got, np.asarray(seq))
 
 
-def test_moe_lm_expert_parallel_matches_dense():
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_moe_lm_expert_parallel_matches_dense(top_k):
     # 4 experts on a 4-device 'expert' mesh, capacity ample so nothing
     # drops on either path: the all-to-all EP forward must equal the dense
-    # local forward exactly.
+    # local forward exactly — for Switch top-1 AND top-2 routing (round 5:
+    # the renormalized-weights top-k through the same two all-to-alls).
     from jax.sharding import PartitionSpec as P
 
     from distributed_tensorflow_tpu.models.gpt import GPTMoEBlockParams
     from distributed_tensorflow_tpu.parallel import make_mesh
 
-    model = _model(moe_experts=4, moe_capacity_factor=16.0)
+    model = _model(moe_experts=4, moe_capacity_factor=16.0, moe_top_k=top_k)
     params = model.init(seed=24)
     toks = _tokens(np.random.default_rng(24), 8, 16)
     want = np.asarray(model.apply(params, toks))
@@ -1339,10 +1341,14 @@ def test_remat_gradients_match_exactly():
         )
 
 
-def test_ep_train_step_matches_dense_dp():
+@pytest.mark.parametrize(
+    "top_k", [1, pytest.param(2, marks=pytest.mark.heavy)]
+)
+def test_ep_train_step_matches_dense_dp(top_k):
     # Expert-parallel TRAINING: gradients flow back through the all-to-all;
     # in the no-drop regime the EP step must equal the single-device step
-    # on the same global batch (which itself equals dense dp).
+    # on the same global batch (which itself equals dense dp) — for Switch
+    # top-1 and renormalized top-2 routing alike.
     from jax.sharding import NamedSharding
     from distributed_tensorflow_tpu.models.gpt import (
         expert_parallel_specs,
@@ -1352,7 +1358,10 @@ def test_ep_train_step_matches_dense_dp():
 
     import optax
 
-    model = _model(moe_experts=4, moe_capacity_factor=16.0, num_layers=2)
+    model = _model(
+        moe_experts=4, moe_capacity_factor=16.0, num_layers=2,
+        moe_top_k=top_k,
+    )
     params = model.init(seed=51)
     opt = optim_lib.make("adam", 1e-3)
     opt_state = opt.init(params)

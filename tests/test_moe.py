@@ -152,6 +152,158 @@ def test_moe_ffn_with_aux_matches_plain():
     )
 
 
+def _ep_forward_k(params, x, capacity, k):
+    mesh = make_mesh((E,), ("expert",))
+    specs = MoEParams(
+        wg=P(), w_up=P("expert"), b_up=P("expert"),
+        w_down=P("expert"), b_down=P("expert"),
+    )
+    fn = jax.jit(
+        jax.shard_map(
+            lambda p, x: moe_ffn(p, x, "expert", capacity, k=k),
+            mesh=mesh,
+            in_specs=(specs, P("expert")),
+            out_specs=P("expert"),
+        )
+    )
+    return np.asarray(fn(params, x))
+
+
+@pytest.mark.parametrize("capacity", [2, CAP, T_LOC])
+def test_top2_ep_matches_dense_reference(setup, capacity):
+    # Top-2 routing through the all-to-all dispatch == the dense reference
+    # at every capacity regime (drops, partial, none) — same _route, so
+    # the choice-major slot assignment and renormalized combine weights
+    # agree by construction; this pins the dispatch/scatter plumbing.
+    params, x = setup
+    got = _ep_forward_k(params, x, capacity, k=2)
+    blocks = x.reshape(E, T_LOC, D)
+    want = np.concatenate(
+        [
+            np.asarray(moe_ffn_dense(params, jnp.asarray(b), capacity, k=2))
+            for b in blocks
+        ],
+        axis=0,
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)
+
+
+def test_top2_local_matches_dense():
+    from distributed_tensorflow_tpu.ops.moe import moe_ffn_local
+
+    params = init_moe(jax.random.key(0), 16, 32, 4)
+    x = jax.random.normal(jax.random.key(1), (24, 16), jnp.float32)
+    for capacity in (2, 6, 24):
+        want = moe_ffn_dense(params, x, capacity=capacity, k=2)
+        got = moe_ffn_local(params, x, capacity=capacity, k=2)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_top2_no_drop_equals_hand_formula():
+    # In the no-drop regime top-2 output is EXACTLY
+    # Σ_{i∈top2} (p_i / Σ_top2 p) · expert_i(x) — the renormalized-weights
+    # convention (Mixtral/ST-MoE), validated against a hand computation.
+    from distributed_tensorflow_tpu.ops.moe import _expert_ffn
+
+    e, t, d, h = 4, 12, 16, 32
+    params = init_moe(jax.random.key(3), d, h, e)
+    x = jax.random.normal(jax.random.key(4), (t, d), jnp.float32)
+    got = np.asarray(moe_ffn_dense(params, x, capacity=t, k=2))
+
+    logits = np.asarray(x @ params.wg)
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+    top2 = np.argsort(-logits, axis=-1)[:, :2]
+    outs = np.stack(
+        [
+            np.asarray(
+                _expert_ffn(
+                    x, params.w_up[i], params.b_up[i],
+                    params.w_down[i], params.b_down[i],
+                )
+            )
+            for i in range(e)
+        ]
+    )  # [E, T, D]
+    want = np.zeros((t, d), np.float32)
+    for ti in range(t):
+        ps = probs[ti, top2[ti]]
+        ws = ps / ps.sum()
+        for c in range(2):
+            want[ti] += ws[c] * outs[top2[ti, c], ti]
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)
+
+
+def test_top2_capacity_priority_is_choice_major():
+    # GShard priority: with capacity 1, an expert serves the FIRST token
+    # whose FIRST choice is it — a later token's first choice beats an
+    # earlier token's second choice never... but an earlier token's second
+    # choice must lose to any token's first choice.
+    from distributed_tensorflow_tpu.ops.moe import _route
+
+    e, d = 2, 4
+    # Token 0: strongly expert 0 first, expert 1 second.
+    # Token 1: strongly expert 1 first.
+    wg = np.zeros((d, e), np.float32)
+    wg[0] = [10.0, 0.0]
+    wg[1] = [0.0, 10.0]
+    x = np.zeros((2, d), np.float32)
+    x[0, 0] = 1.0  # logits (10, 0): first choice e0, second e1
+    x[1, 1] = 1.0  # logits (0, 10): first choice e1, second e0
+    idx, w, slot, keep, _ = _route(
+        jnp.asarray(x), jnp.asarray(wg), e, capacity=1, k=2
+    )
+    idx, keep = np.asarray(idx), np.asarray(keep)
+    # First choices both kept (distinct experts, slot 0 each).
+    assert keep[0, 0] and keep[1, 0]
+    # Second choices both dropped: each expert's slot 0 went to the OTHER
+    # token's first choice (choice-major ordering), not to this token's
+    # second choice.
+    assert not keep[0, 1] and not keep[1, 1]
+
+
+def test_top2_gate_gradient_flows():
+    # The renormalized top-2 combine weights must carry gradient into the
+    # gate: d(sum(out))/d(wg) is nonzero even with balance/z losses off.
+    params = init_moe(jax.random.key(5), 16, 32, 4)
+    x = jax.random.normal(jax.random.key(6), (24, 16), jnp.float32)
+
+    def f(wg):
+        return jnp.sum(
+            moe_ffn_dense(params._replace(wg=wg), x, capacity=24, k=2)
+        )
+
+    g = jax.grad(f)(params.wg)
+    assert float(jnp.max(jnp.abs(g))) > 0.0
+
+
+def test_route_k1_matches_legacy_shapes_and_values():
+    # k=1 must reproduce the Switch behavior exactly (raw-prob combine, one
+    # column): the [T, 1] route against a transposed hand check.
+    from distributed_tensorflow_tpu.ops.moe import _route
+
+    e, t, d = 4, 16, 8
+    x = jax.random.normal(jax.random.key(7), (t, d), jnp.float32)
+    wg = jax.random.normal(jax.random.key(8), (d, e), jnp.float32)
+    idx, w, slot, keep, aux = _route(x, wg, e, capacity=3, k=1)
+    assert idx.shape == (t, 1) and w.shape == (t, 1)
+    logits = np.asarray(x @ wg)
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), -1))
+    np.testing.assert_array_equal(
+        np.asarray(idx)[:, 0], logits.argmax(-1)
+    )
+    np.testing.assert_allclose(
+        np.asarray(w)[:, 0],
+        probs[np.arange(t), logits.argmax(-1)],
+        rtol=1e-6,
+    )
+    with pytest.raises(ValueError, match="top-k"):
+        _route(x, wg, e, capacity=3, k=0)
+    with pytest.raises(ValueError, match="top-k"):
+        _route(x, wg, e, capacity=3, k=e + 1)
+
+
 def test_balance_loss_gradient_spreads_routing():
     # The balance loss must be differentiable into the gate and push toward
     # uniform dispatch: a few gradient steps on balance alone should raise
