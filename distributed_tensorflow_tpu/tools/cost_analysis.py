@@ -43,6 +43,26 @@ CHIP_PEAKS = {
 }
 
 
+def measured_ceiling_tflops() -> float | None:
+    """The MEASURED bf16 ceiling from the committed roofline record
+    (docs/benchmarks/roofline_tpu.json), or None. Every MFU*-style column
+    must divide by THIS, not a hardcoded constant — a roofline re-measure
+    has to propagate to every committed table or the records silently mix
+    denominators (round-5 review finding)."""
+    import json as _json
+    import os as _os
+
+    path = _os.path.join(
+        _os.path.dirname(__file__), "..", "..", "docs", "benchmarks",
+        "roofline_tpu.json",
+    )
+    try:
+        with open(path) as f:
+            return _json.load(f).get("ceiling_bf16_tflops")
+    except Exception:
+        return None
+
+
 def _chip_peaks(device) -> dict | None:
     """Peaks for the device, or None when unknown — a wrong balance point
     misclassifies every program, so refuse rather than guess."""

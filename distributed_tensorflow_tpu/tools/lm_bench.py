@@ -102,6 +102,26 @@ CONFIGS = {
             attention_impl="flash", flash_min_len=0,
         ),
     ),
+    # MXU-sized points (round 5): d=2048 tiles the 128-lane MXU properly;
+    # remat=True is required to fit HBM (the unremat'd d=2048/L=2048
+    # stash is ~20 GB) and trades recompute the model-FLOPs MFU† column
+    # deliberately does not credit. These rows are the measured proof
+    # that the toy rows' low MFU was the workload (docs/benchmarks/
+    # lm_phases.md has the per-phase breakdown).
+    "gpt-xl-L1024-flash-remat": dict(
+        batch=16,
+        model=dict(
+            model_dim=2048, num_layers=4, num_heads=16, max_len=1024,
+            attention_impl="flash", remat=True,
+        ),
+    ),
+    "gpt-xl-L2048-flash-remat": dict(
+        batch=8,
+        model=dict(
+            model_dim=2048, num_layers=4, num_heads=16, max_len=2048,
+            attention_impl="flash", remat=True,
+        ),
+    ),
 }
 _VOCAB = 8192
 
@@ -295,6 +315,12 @@ def bench_config(
     report = analyze_lm(model, batch_size=b, optimizer=opt)
     row["flops_per_step"] = report["flops_per_step"]
     row["param_count"] = report["param_count"]
+    # Model FLOPs (the scaling-book 6·N·P convention): what the MODEL
+    # mathematically requires per step — counts remat recompute as zero
+    # and undercounts attention, so MFU† is the conservative utilization
+    # the field quotes; the XLA-counted column reflects the compiled
+    # program's own op count.
+    row["model_flops_per_step"] = 6 * report["param_count"] * b * l
     peaks = _chip_peaks(jax.devices()[0])
     if peaks and report["flops_per_step"]:
         achieved = report["flops_per_step"] / sec_per_step
@@ -306,25 +332,49 @@ def bench_config(
             row["mfu_star_pct"] = round(
                 100 * achieved / (ceiling_tflops * 1e12), 2
             )
+            row["mfu_model_pct"] = round(
+                100
+                * row["model_flops_per_step"]
+                / sec_per_step
+                / (ceiling_tflops * 1e12),
+                2,
+            )
         else:
             row["mfu_star_pct"] = None
+            row["mfu_model_pct"] = None
     else:
         row["mfu_pct"] = None
         row["mfu_star_pct"] = None
+        row["mfu_model_pct"] = None
     return row
 
 
 def _roofline_ceiling() -> float | None:
-    """Measured bf16 ceiling from the committed roofline record, if any."""
-    path = os.path.join(
-        os.path.dirname(__file__), "..", "..", "docs", "benchmarks",
-        "roofline_tpu.json",
+    """Measured bf16 ceiling from the committed roofline record, if any
+    (shared: tools/cost_analysis.measured_ceiling_tflops)."""
+    from distributed_tensorflow_tpu.tools.cost_analysis import (
+        measured_ceiling_tflops,
     )
-    try:
-        with open(path) as f:
-            return json.load(f).get("ceiling_bf16_tflops")
-    except Exception:
-        return None
+
+    return measured_ceiling_tflops()
+
+
+def merge_rows(new, old, order):
+    """Carry-forward merge for chunked --write-docs regeneration (shared
+    with tools/lm_phase_bench): keep previously committed good rows for
+    configs not re-measured this run; an error row never displaces a
+    previously good measurement."""
+    old_good = {r["config"]: r for r in old if "error" not in r}
+    new_good = {r["config"] for r in new if "error" not in r}
+    out = [
+        r for r in new if "error" not in r or r["config"] not in old_good
+    ] + [r for c, r in old_good.items() if c not in new_good]
+    out.sort(
+        key=lambda r: order.index(r["config"])
+        if r.get("config") in order
+        else len(order)
+    )
+    return out
 
 
 def run(configs=None, *, steps: int = 32, ceiling_tflops=None) -> list[dict]:
@@ -344,20 +394,21 @@ def run(configs=None, *, steps: int = 32, ceiling_tflops=None) -> list[dict]:
 def render(rows) -> str:
     cols = [
         "config", "B", "L", "step (ms)", "tokens/s", "MFU %", "MFU* %",
-        "params",
+        "MFU† %", "params",
     ]
     out = ["| " + " | ".join(cols) + " |", "|" + "---|" * len(cols)]
     for r in rows:
         if "error" in r:
-            out.append(f"| {r['config']} | error: {r['error']} |" + " |" * 6)
+            out.append(f"| {r['config']} | error: {r['error']} |" + " |" * 7)
             continue
         fmt = lambda v: ("%.1f" % v) if v is not None else "—"  # noqa: E731
         out.append(
             "| {config} | {batch} | {seq_len} | {step_ms:.2f} | "
-            "{tokens_per_sec:,.0f} | {mfu} | {mfu_star} | "
+            "{tokens_per_sec:,.0f} | {mfu} | {mfu_star} | {mfu_model} | "
             "{param_count:,} |".format(
                 mfu=fmt(r["mfu_pct"]),
                 mfu_star=fmt(r.get("mfu_star_pct")),
+                mfu_model=fmt(r.get("mfu_model_pct")),
                 **r,
             )
         )
@@ -438,22 +489,7 @@ def main(argv=None) -> None:
                 )
                 return
 
-            def merge(new, old, order):
-                old_good = {
-                    r["config"]: r for r in old if "error" not in r
-                }
-                new_good = {r["config"] for r in new if "error" not in r}
-                out = [
-                    r for r in new
-                    if "error" not in r or r["config"] not in old_good
-                ] + [
-                    r for c, r in old_good.items() if c not in new_good
-                ]
-                out.sort(key=lambda r: order.index(r["config"])
-                         if r.get("config") in order else len(order))
-                return out
-
-            rows = merge(rows, prev.get("rows", []), list(CONFIGS))
+            rows = merge_rows(rows, prev.get("rows", []), list(CONFIGS))
             # Carried rows keep their measured times but their MFU* must
             # track the CURRENT ceiling, or a roofline re-measure would
             # leave the table silently mixing denominators.
@@ -462,17 +498,29 @@ def main(argv=None) -> None:
                 if "error" in r or not r.get("flops_per_step"):
                     continue
                 achieved = r["flops_per_step"] / (r["step_ms"] / 1e3)
+                if "model_flops_per_step" not in r and r.get("param_count"):
+                    r["model_flops_per_step"] = (
+                        6 * r["param_count"] * r["batch"] * r["seq_len"]
+                    )
                 if ceiling:
                     r["mfu_star_pct"] = round(
                         100 * achieved / (ceiling * 1e12), 2
                     )
+                    if r.get("model_flops_per_step"):
+                        r["mfu_model_pct"] = round(
+                            100
+                            * r["model_flops_per_step"]
+                            / (r["step_ms"] / 1e3)
+                            / (ceiling * 1e12),
+                            2,
+                        )
                 if peaks.get("flops"):
                     r["mfu_pct"] = round(
                         100 * achieved / peaks["flops"], 2
                     )
             payload["rows"] = rows
             table = render(rows)
-            decode_rows = merge(
+            decode_rows = merge_rows(
                 decode_rows, prev.get("decode_rows", []),
                 list(DECODE_CONFIGS),
             )
@@ -493,7 +541,10 @@ def main(argv=None) -> None:
                 "FLOPs / measured step time / v5e spec peak"
                 + (
                     ", MFU* = the same against the MEASURED bf16 ceiling "
-                    f"({ceiling} TFLOPS, docs/benchmarks/roofline_tpu.md)"
+                    f"({ceiling} TFLOPS, docs/benchmarks/roofline_tpu.md), "
+                    "MFU† = model FLOPs (6·params·tokens, the scaling-book "
+                    "convention — credits no remat recompute) over the "
+                    "measured ceiling"
                     if ceiling
                     else "; MFU* is dashed — no measured roofline record; "
                     "run tools/roofline_bench --write-docs first"
