@@ -69,6 +69,12 @@ class Predictor:
         ``optimizer`` must match the one used in training (the checkpoint
         holds its slots too); defaults to the reference's SGD, whose slot
         state is empty.
+
+        Round 5: the ``step_N.layout.json`` sidecar makes non-dense
+        checkpoint layouts servable too — an async checkpoint's stacked
+        per-chip copies restore in their own shapes and collapse at the
+        mean (the same parameters async evaluates at), so any mode's
+        checkpoint serves without its training strategy in hand.
         """
         from distributed_tensorflow_tpu.ops import optim as optim_lib
         from distributed_tensorflow_tpu.parallel.strategy import TrainState
@@ -79,7 +85,8 @@ class Predictor:
 
         # Probe before constructing a Supervisor: a read path must not mkdir
         # a typo'd checkpoint_dir as a side effect.
-        if latest_checkpoint_step(checkpoint_dir) is None:
+        step = latest_checkpoint_step(checkpoint_dir)
+        if step is None:
             raise FileNotFoundError(f"no checkpoint under {checkpoint_dir}")
         from distributed_tensorflow_tpu.train import supervisor as _sup
 
@@ -94,7 +101,21 @@ class Predictor:
         optimizer = optimizer or optim_lib.sgd(0.001)
         params = model.init(seed)
         fresh = TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
-        state, _ = Supervisor(checkpoint_dir=checkpoint_dir).prepare_or_restore(fresh)
+        sup = Supervisor(checkpoint_dir=checkpoint_dir)
+        meta = sup.saved_layout(step) or {}
+        if meta.get("mode") == "async":
+            n = int(meta["replicas"])
+            abstract = TrainState(
+                *jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct((n,) + x.shape, x.dtype),
+                    (fresh.params, fresh.opt_state),
+                ),
+                jax.ShapeDtypeStruct((n,), jnp.int32),
+            )
+            stacked = sup.restore_raw(step, abstract)
+            served = jax.tree.map(lambda x: jnp.mean(x, axis=0), stacked.params)
+            return cls(model, served, **kw)
+        state, _ = sup.prepare_or_restore(fresh)
         return cls(model, state.params, **kw)
 
     # -- prediction --------------------------------------------------------

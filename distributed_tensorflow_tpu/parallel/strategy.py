@@ -167,6 +167,12 @@ class Strategy:
     def from_canonical(self, canonical: TrainState) -> TrainState:
         return canonical
 
+    def layout_meta(self) -> dict:
+        """Topology descriptor saved alongside checkpoints (the classifier
+        analog of LMTrainer._layout_meta): sync-family layouts share the
+        canonical shapes; async overrides with its stacked-copies shape."""
+        return {"mode": "sync"}
+
     @property
     def num_replicas(self) -> int:
         return 1
@@ -461,6 +467,9 @@ class AsyncDataParallel(Strategy):
         )
         state = TrainState(stacked[0], stacked[1], jnp.zeros((self.n,), jnp.int32))
         return jax.device_put(state, self._stacked)
+
+    def layout_meta(self) -> dict:
+        return {"mode": "async", "replicas": int(self.n)}
 
     def to_canonical(self, state: TrainState) -> TrainState:
         """Merge the per-chip copies at the mean — exactly the parameters

@@ -78,7 +78,27 @@ class Trainer:
             )
         self.start_step = 0
         if self.supervisor is not None:
-            self.state, self.start_step = self.supervisor.prepare_or_restore(self.state)
+            src = None
+            step = self.supervisor.latest_step()
+            if step is not None:
+                src = self.supervisor.saved_layout(step)
+            if src is not None and not self._layout_compatible(src):
+                # Cross-topology restore (round 5, mirror of LMTrainer):
+                # the checkpoint was written under a different strategy
+                # layout (async's stacked copies, or a different replica
+                # count) — restore in ITS shapes, fold to the canonical
+                # dense form, re-stage into this strategy's layout.
+                raw = self.supervisor.restore_raw(
+                    step, self._abstract_for_layout(src)
+                )
+                self.state = self.strategy.from_canonical(
+                    self._canonicalize_from(raw, src)
+                )
+                self.start_step = step
+            else:
+                self.state, self.start_step = (
+                    self.supervisor.prepare_or_restore(self.state)
+                )
 
         # Scanned-epoch fast path (config.scan_epoch): one dispatch per epoch.
         # config.scan_epoch=None resolves by backend: on an accelerator the
@@ -135,6 +155,54 @@ class Trainer:
             from distributed_tensorflow_tpu.utils import placement
 
             placement.describe(self.state.params, print_fn=self.print_fn)
+
+    # -- cross-topology restore (round 5; LMTrainer carries the LM-mode
+    # analog — see its _state_{to,from}_canonical) ------------------------
+
+    def _layout_compatible(self, src: dict) -> bool:
+        """True when the saved state's SHAPES match this strategy's (the
+        ordinary bitwise prepare_or_restore applies). All sync-family
+        strategies share the canonical dense shapes; async matches only
+        async at the same replica count."""
+        mine = self.strategy.layout_meta()
+        if mine["mode"] != "async":
+            return src.get("mode") != "async"
+        return src == mine
+
+    def _abstract_for_layout(self, src: dict):
+        """ShapeDtypeStructs of a checkpoint written under layout ``src``
+        (this model + optimizer)."""
+        import jax.numpy as jnp
+
+        from distributed_tensorflow_tpu.parallel.strategy import TrainState
+
+        params = jax.eval_shape(lambda: self.model.init(self.config.seed))
+        opt = jax.eval_shape(self.optimizer.init, params)
+        if src.get("mode") == "async":
+            n = int(src["replicas"])
+            stack = lambda t: jax.tree.map(  # noqa: E731
+                lambda s: jax.ShapeDtypeStruct((n,) + s.shape, s.dtype), t
+            )
+            return TrainState(
+                stack(params), stack(opt), jax.ShapeDtypeStruct((n,), jnp.int32)
+            )
+        return TrainState(params, opt, jax.ShapeDtypeStruct((), jnp.int32))
+
+    def _canonicalize_from(self, state, src: dict):
+        """Source-layout state → the canonical dense form (async merges
+        its copies at the mean — its own effective_params — and sums the
+        per-chip step vector; sync layouts only need the step fold)."""
+        import jax.numpy as jnp
+
+        from distributed_tensorflow_tpu.parallel.strategy import TrainState
+
+        step = jnp.asarray(jnp.sum(state.step), jnp.int32)
+        if src.get("mode") == "async":
+            merge = lambda t: jax.tree.map(  # noqa: E731
+                lambda a: jnp.mean(a, axis=0).astype(a.dtype), t
+            )
+            return TrainState(merge(state.params), merge(state.opt_state), step)
+        return TrainState(state.params, state.opt_state, step)
 
     # -- pieces -----------------------------------------------------------
 
@@ -454,7 +522,11 @@ class Trainer:
                     }
                 )
         if self.supervisor is not None:
-            self.supervisor.save(self.state, self.strategy.global_step(self.state))
+            self.supervisor.save(
+                self.state,
+                self.strategy.global_step(self.state),
+                layout=self.strategy.layout_meta(),
+            )
         final_cost = float(costs[-1, -1]) if costs.size else float("nan")
         if finalize and self.is_chief:
             logger.log_final(cost=final_cost)
@@ -666,7 +738,11 @@ class Trainer:
                     }
                 )
             if self.supervisor is not None:
-                self.supervisor.save(self.state, self.strategy.global_step(self.state))
+                self.supervisor.save(
+                    self.state,
+                    self.strategy.global_step(self.state),
+                    layout=self.strategy.layout_meta(),
+                )
                 if self.supervisor.should_stop:
                     break
         final_cost = (

@@ -100,6 +100,70 @@ def test_cross_strategy_canonical_restore(tmp_path, src, dst):
 
 
 @pytest.mark.parametrize(
+    "src,dst",
+    [("async", "sync"), ("sync", "async")],
+)
+def test_trainer_cross_strategy_resume(tmp_path, src, dst):
+    # Round 5 (review finding): the TRAINER's own restore path reads the
+    # layout sidecar — an async checkpoint resumes under a sync Trainer
+    # (copies folded to the mean, step preserved) and vice versa
+    # (broadcast), then training continues.
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.config import TrainConfig
+    from distributed_tensorflow_tpu.data.mnist import DataSet, Datasets
+    from distributed_tensorflow_tpu.train import Trainer
+
+    rng = np.random.default_rng(0)
+    imgs = rng.random((800, 784), dtype=np.float32)
+    labs = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 800)]
+    mkds = lambda: Datasets(  # noqa: E731
+        train=DataSet(imgs, labs, seed=1),
+        validation=None,
+        test=DataSet(imgs[:100], labs[:100], seed=2),
+    )
+    mesh = make_mesh((8, 1))
+    factory = {
+        "sync": lambda: SyncDataParallel(mesh),
+        "async": lambda: AsyncDataParallel(mesh, avg_every=3),
+    }
+    mkcfg = lambda: TrainConfig(  # noqa: E731
+        epochs=1, batch_size=100, scan_epoch=False, log_frequency=10**9,
+        checkpoint_dir=str(tmp_path),
+    )
+    tr_a = Trainer(
+        MLP(compute_dtype=jnp.float32), mkds(), mkcfg(),
+        strategy=factory[src](), print_fn=lambda *a: None,
+    )
+    tr_a.run()
+    saved_step = tr_a.strategy.global_step(tr_a.state)
+    want_params = jax.device_get(
+        tr_a.strategy.effective_params(tr_a.state)
+    )
+
+    tr_b = Trainer(
+        MLP(compute_dtype=jnp.float32), mkds(), mkcfg(),
+        strategy=factory[dst](), print_fn=lambda *a: None,
+    )
+    assert tr_b.start_step == saved_step
+    assert tr_b.strategy.global_step(tr_b.state) == saved_step
+    if dst == "async":
+        # Stronger than the effective mean (whose reduce order costs an
+        # ulp): every broadcast copy IS the source's parameter set.
+        got = jax.device_get(tr_b.state.params)
+        for a, b in zip(jax.tree.leaves(want_params), jax.tree.leaves(got)):
+            for i in range(np.asarray(b).shape[0]):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b)[i])
+    else:
+        got = jax.device_get(tr_b.strategy.effective_params(tr_b.state))
+        for a, b in zip(jax.tree.leaves(want_params), jax.tree.leaves(got)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    res = tr_b.run()
+    assert 0.0 <= res["accuracy"] <= 1.0
+    assert res["global_step"] > saved_step
+
+
+@pytest.mark.parametrize(
     "make_strategy",
     [
         lambda mesh: SyncDataParallel(mesh),

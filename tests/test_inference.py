@@ -71,6 +71,32 @@ def test_from_checkpoint_roundtrip(trained, tmp_path):
     np.testing.assert_allclose(pred.predict_proba(x), direct, rtol=1e-5, atol=1e-7)
 
 
+def test_from_checkpoint_serves_async_stacked_layout(trained, tmp_path):
+    # Round 5: an ASYNC checkpoint (stacked per-chip copies + step vector,
+    # saved with its layout sidecar by the Trainer) serves through
+    # from_checkpoint without the training strategy in hand — the sidecar
+    # tells the restorer to collapse the copies at the mean, exactly
+    # effective_params' answer.
+    model, _, _, x, y = trained
+    from distributed_tensorflow_tpu.train.supervisor import Supervisor
+
+    mesh = make_mesh((8, 1))
+    strat = AsyncDataParallel(mesh, avg_every=3)
+    opt = sgd(0.001)
+    state = strat.init_state(model, opt, seed=1)
+    step = strat.make_train_step(model, cross_entropy, opt)
+    for _ in range(2):
+        state, _ = step(state, *strat.prepare_batch(x[:64], y[:64]))
+    sup = Supervisor(checkpoint_dir=str(tmp_path / "ackpt"))
+    sup.save(state, strat.global_step(state), layout=strat.layout_meta())
+
+    pred = Predictor.from_checkpoint(model, str(tmp_path / "ackpt"), batch_size=100)
+    want = np.asarray(
+        model.apply(strat.effective_params(state), x)
+    )
+    np.testing.assert_allclose(pred.predict_proba(x), want, rtol=1e-5, atol=1e-7)
+
+
 def test_from_checkpoint_missing_raises(tmp_path):
     missing = tmp_path / "nope"
     with pytest.raises(FileNotFoundError):
