@@ -167,5 +167,14 @@ class TrainConfig:
     # epochs (README.md:148-150).
     per_worker_epoch: bool = False
 
+    def __post_init__(self):
+        # Fail fast at construction: None/0 disables the middle tier; a
+        # negative value would otherwise reach run() and loop forever.
+        if self.epochs_per_dispatch is not None and self.epochs_per_dispatch < 0:
+            raise ValueError(
+                "epochs_per_dispatch must be >= 1 (or None/0 to disable), "
+                f"got {self.epochs_per_dispatch}"
+            )
+
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)
