@@ -36,7 +36,18 @@ jax.config.update("jax_platforms", "cpu")
 # first run on a fresh checkout still pays full compiles and fills the
 # cache. Opt out with JAX_TEST_NO_CACHE=1 (e.g. when debugging suspected
 # stale-executable behavior; `rm -rf .jax_test_cache` also resets).
-if not os.environ.get("JAX_TEST_NO_CACHE"):
+#
+# The RUN_SLOW tier runs with the cache OFF: jaxlib 0.9.0's XLA:CPU can
+# abort SILENTLY (no log line, no traceback) in the collective rendezvous
+# when many warm-LOADED multi-device executables precede a fresh
+# multi-device execution in one process (round 5: the full warm-cache
+# tier died twice inside test_lm_trainer's ragged mode matrix at ~230
+# tests in; the same tests pass in isolation, as a module, and paired
+# with their neighbor — only the full warm preamble triggers it, and
+# fresh-compile runs have never aborted). The fast tier — the per-change
+# gate where the 9x matters — keeps the cache; the everything-tier trades
+# ~10 extra minutes for not losing a 23-minute run to a silent abort.
+if not os.environ.get("JAX_TEST_NO_CACHE") and not os.environ.get("RUN_SLOW"):
     _cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_test_cache")
     jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)

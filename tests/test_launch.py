@@ -13,6 +13,19 @@ from distributed_tensorflow_tpu.parallel import (
 )
 
 
+def test_epochs_per_dispatch_validated_at_construction():
+    # A negative value would reach _run_chunked's loop and spin forever
+    # (min(k, remaining) never advances); TrainConfig fails fast instead.
+    import pytest
+
+    with pytest.raises(ValueError, match="epochs_per_dispatch"):
+        TrainConfig(epochs_per_dispatch=-1)
+    with pytest.raises(ValueError, match="epochs_per_dispatch"):
+        TrainConfig().replace(epochs_per_dispatch=-3)
+    for ok in (None, 0, 1, 10):  # None/0 disable; positives enable
+        TrainConfig(epochs_per_dispatch=ok)
+
+
 def test_sync_knob_selects_strategy():
     sync = build_strategy(TrainConfig(sync=True))
     assert isinstance(sync, SyncDataParallel)
