@@ -106,6 +106,30 @@ class TrainConfig:
     loss: str = "naive"
     logs_path: str = "./logs"  # reference logs_path, tfdist_between.py:22
     checkpoint_dir: str | None = None  # deliberate upgrade: orbax checkpointing
+    # -- resilience layer (train/resilience.py; no reference analog — the
+    # reference configured no saver at all, SURVEY.md §5) -----------------
+    # Checkpoint retention: keep the newest N step_N checkpoints, GC the
+    # rest after each save (the newest VALID one is never GC'd). None/0
+    # keeps everything (the old behavior).
+    keep_last_n: int | None = None
+    # Bounded retry-with-backoff around checkpoint save/restore I/O.
+    checkpoint_retries: int = 3
+    checkpoint_retry_backoff: float = 0.25
+    # Preemption contract: run() installs a SIGTERM/SIGINT handler that
+    # flips Supervisor.request_stop, so the loop exits at the next epoch/
+    # dispatch boundary with a final save (TPU-pod preemption semantics).
+    # Only active when a supervisor exists and run() is on the main thread.
+    handle_preemption: bool = True
+    # Anomaly guard (PaLM-style spike/NaN rollback): watch per-epoch cost;
+    # on NaN/inf — or a spike beyond spike_threshold x the median of the
+    # trailing anomaly_window good epochs — restore the last valid
+    # checkpoint, keep the (already advanced) host data stream so the
+    # offending window is skipped, and retry, at most max_rollbacks times
+    # per run. max_rollbacks=0 disables the guard; spike_threshold=0
+    # keeps only the NaN/inf check.
+    max_rollbacks: int = 0
+    anomaly_window: int = 8
+    spike_threshold: float = 3.0
     sync: bool = True  # sync DP (pmean all-reduce) vs async emulation
     async_avg_every: int = 0  # async mode: average params every N steps (0 = never)
     # Sync parameter layout: "replicated" (params on every chip, gradient
@@ -174,6 +198,19 @@ class TrainConfig:
             raise ValueError(
                 "epochs_per_dispatch must be >= 1 (or None/0 to disable), "
                 f"got {self.epochs_per_dispatch}"
+            )
+        if self.max_rollbacks < 0:
+            raise ValueError(
+                f"max_rollbacks must be >= 0 (0 disables), got {self.max_rollbacks}"
+            )
+        if self.keep_last_n is not None and self.keep_last_n < 0:
+            raise ValueError(
+                "keep_last_n must be >= 1 (or None/0 to keep everything), "
+                f"got {self.keep_last_n}"
+            )
+        if self.anomaly_window < 1:
+            raise ValueError(
+                f"anomaly_window must be >= 1, got {self.anomaly_window}"
             )
 
     def replace(self, **kw) -> "TrainConfig":

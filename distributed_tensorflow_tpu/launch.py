@@ -40,11 +40,21 @@ def config_from_env(base: TrainConfig | None = None) -> TrainConfig:
     "Config/flag system"). Recognized: DTF_EPOCHS, DTF_BATCH_SIZE, DTF_LR,
     DTF_SCAN (=1 → scan_epoch), DTF_COMPILED (=1 → compiled_run: the whole
     run as one dispatch), DTF_LOGS (logs path, empty disables),
-    DTF_MODEL (registry name: mlp | cnn | lstm | transformer)."""
+    DTF_MODEL (registry name: mlp | cnn | lstm | transformer), and the
+    resilience knobs (train/resilience.py): DTF_CHECKPOINT (checkpoint
+    dir — what a pod scheduler sets so a preempted run can resume),
+    DTF_KEEP_LAST (checkpoint retention), DTF_MAX_ROLLBACKS (anomaly
+    guard budget)."""
     import os
 
     cfg = base or TrainConfig()
     kw = {}
+    if "DTF_CHECKPOINT" in os.environ:
+        kw["checkpoint_dir"] = os.environ["DTF_CHECKPOINT"] or None
+    if "DTF_KEEP_LAST" in os.environ:
+        kw["keep_last_n"] = int(os.environ["DTF_KEEP_LAST"]) or None
+    if "DTF_MAX_ROLLBACKS" in os.environ:
+        kw["max_rollbacks"] = int(os.environ["DTF_MAX_ROLLBACKS"])
     if "DTF_MODEL" in os.environ:
         kw["model"] = os.environ["DTF_MODEL"]
     if "DTF_EPOCHS" in os.environ:

@@ -60,6 +60,26 @@ class TrainState(NamedTuple):
 LossFn = Callable[[jax.Array, jax.Array], jax.Array]
 
 
+def merge_replica_leaf(a: jax.Array) -> jax.Array:
+    """Fold a leading replica axis to the canonical single copy: float
+    leaves merge at the mean (async's own effective_params semantics);
+    integer/bool leaves (e.g. adam's int32 count) take replica 0's value —
+    the float mean is exact only below 2^24, so mean-then-cast silently
+    corrupts a large step count (ADVICE round 5). Integer replicas are
+    identical by construction (every copy applied the same number of
+    updates); when the call is concrete (the restore paths are), that
+    invariant is asserted rather than assumed."""
+    if not jnp.issubdtype(a.dtype, jnp.floating):
+        if not isinstance(a, jax.core.Tracer) and a.shape[0] > 1:
+            if not bool(jnp.all(a == a[0:1])):
+                raise ValueError(
+                    "integer optimizer-state leaf differs across replicas; "
+                    "refusing to merge (the copies should be identical)"
+                )
+        return a[0]
+    return jnp.mean(a, axis=0).astype(a.dtype)
+
+
 def _loss_from_model(model, loss_fn: LossFn, params, x, y) -> jax.Array:
     return loss_fn(model.apply(params, x), y)
 
@@ -474,12 +494,11 @@ class AsyncDataParallel(Strategy):
     def to_canonical(self, state: TrainState) -> TrainState:
         """Merge the per-chip copies at the mean — exactly the parameters
         this strategy evaluates at (effective_params); integer optimizer
-        leaves (identical across copies) survive the mean-then-cast
-        bitwise. Step: the summed per-chip vector (global_step — total
-        applied updates, the PS semantics)."""
-        merge = lambda t: jax.tree.map(  # noqa: E731
-            lambda a: jnp.mean(a, axis=0).astype(a.dtype), t
-        )
+        leaves (identical across copies) take replica 0's value outright
+        (merge_replica_leaf — the float mean is exact only below 2^24).
+        Step: the summed per-chip vector (global_step — total applied
+        updates, the PS semantics)."""
+        merge = lambda t: jax.tree.map(merge_replica_leaf, t)  # noqa: E731
         return TrainState(
             merge(state.params),
             merge(state.opt_state),

@@ -385,6 +385,19 @@ def crc32c(data: bytes) -> int:
     return int(load_library().dtf_crc32c(data, len(data)))
 
 
+def crc32c_buffer(a: np.ndarray) -> int:
+    """CRC32C over an ndarray's buffer without the ``tobytes`` copy — the
+    checkpoint-manifest writer (train/resilience.py) checksums every state
+    leaf per save, so large parameter tables go through the C kernel
+    directly. Same value as ``crc32c(a.tobytes())``."""
+    a = np.ascontiguousarray(a)
+    return int(
+        load_library().dtf_crc32c(
+            a.ctypes.data_as(ctypes.c_char_p), a.nbytes
+        )
+    )
+
+
 def crc32c_masked(data: bytes) -> int:
     """TFRecord-masked CRC32C (rotate-right-15 + magic), computed natively."""
     return int(load_library().dtf_crc32c_masked(data, len(data)))

@@ -319,8 +319,16 @@ def bench_config(
     # mathematically requires per step — counts remat recompute as zero
     # and undercounts attention, so MFU† is the conservative utilization
     # the field quotes; the XLA-counted column reflects the compiled
-    # program's own op count.
-    row["model_flops_per_step"] = 6 * report["param_count"] * b * l
+    # program's own op count. N EXCLUDES the embedding and position
+    # tables (the Kaplan/Chinchilla reading: lookups and adds do not pay
+    # the per-token 2N matmul FLOPs the 6N derivation counts; the tied
+    # LM head shares the embedding table). Round 5 used total params,
+    # which at vocab 8192/d=256 inflated MFU† by the table's 39% share
+    # (ADVICE round 5); lm_tpu.json keeps both counts.
+    row["param_count_nonembed"] = report["param_count"] - int(
+        params.embed.size + params.pos.size
+    )
+    row["model_flops_per_step"] = 6 * row["param_count_nonembed"] * b * l
     peaks = _chip_peaks(jax.devices()[0])
     if peaks and report["flops_per_step"]:
         achieved = report["flops_per_step"] / sec_per_step
@@ -375,6 +383,50 @@ def merge_rows(new, old, order):
         else len(order)
     )
     return out
+
+
+def _nonembed_param_count(row) -> int | None:
+    """Non-embedding N for a committed row (offline migration of records
+    written before round 6): total params minus the d·(vocab + max_len)
+    embedding+position tables, derived from the config's model spec."""
+    spec = CONFIGS.get(row.get("config"))
+    if spec is None or not row.get("param_count"):
+        return None
+    d = spec["model"]["model_dim"]
+    return row["param_count"] - d * (_VOCAB + spec["model"]["max_len"])
+
+
+def refresh_derived(rows, ceiling, peaks=None) -> None:
+    """Recompute every derived column of committed/carried rows from
+    their MEASURED fields (step_ms, flops_per_step, param_count): the
+    non-embedding N and 6N model FLOPs (round-6 MFU† convention), MFU*
+    against the CURRENT ceiling, and — when chip peaks are known — the
+    spec-peak MFU. Keeps a chunked regeneration from silently mixing
+    denominators, and lets ``--recompute-docs`` migrate the record
+    off-chip (no re-measurement)."""
+    for r in rows:
+        if "error" in r or not r.get("flops_per_step"):
+            continue
+        achieved = r["flops_per_step"] / (r["step_ms"] / 1e3)
+        if "param_count_nonembed" not in r:
+            ne = _nonembed_param_count(r)
+            if ne is not None:
+                r["param_count_nonembed"] = ne
+        n_eff = r.get("param_count_nonembed") or r.get("param_count")
+        if n_eff:
+            r["model_flops_per_step"] = 6 * n_eff * r["batch"] * r["seq_len"]
+        if ceiling:
+            r["mfu_star_pct"] = round(100 * achieved / (ceiling * 1e12), 2)
+            if r.get("model_flops_per_step"):
+                r["mfu_model_pct"] = round(
+                    100
+                    * r["model_flops_per_step"]
+                    / (r["step_ms"] / 1e3)
+                    / (ceiling * 1e12),
+                    2,
+                )
+        if peaks and peaks.get("flops"):
+            r["mfu_pct"] = round(100 * achieved / peaks["flops"], 2)
 
 
 def run(configs=None, *, steps: int = 32, ceiling_tflops=None) -> list[dict]:
@@ -436,8 +488,38 @@ def main(argv=None) -> None:
         help="measured bf16 ceiling for the MFU* column (default: read "
         "docs/benchmarks/roofline_tpu.json)",
     )
+    ap.add_argument(
+        "--recompute-docs",
+        action="store_true",
+        help="no measurement: reload docs/benchmarks/lm_tpu.json and "
+        "recompute every derived column (non-embedding 6N model FLOPs, "
+        "MFU*/MFU† vs the current ceiling) from the committed measured "
+        "fields, then rewrite md+json — runs anywhere, no chip needed",
+    )
     args = ap.parse_args(argv)
     ceiling = args.ceiling_tflops or _roofline_ceiling()
+    root = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "..", "docs", "benchmarks")
+    )
+    json_path = os.path.join(root, "lm_tpu.json")
+    if args.recompute_docs:
+        with open(json_path) as f:
+            payload = json.load(f)
+        refresh_derived(payload["rows"], ceiling)
+        table = render(payload["rows"])
+        print(table)
+        with open(json_path, "w") as f:
+            json.dump(payload, f, indent=1)
+        _write_md(
+            root,
+            table,
+            payload.get("decode_rows", []),
+            ceiling,
+            payload.get("device", "TPU v5 lite"),
+            "--recompute-docs",
+        )
+        print(f"recomputed {root}/lm_tpu.md and lm_tpu.json (no re-measurement)")
+        return
     rows = run(args.configs, steps=args.steps, ceiling_tflops=ceiling)
     device = jax.devices()[0].device_kind
     print(
@@ -463,9 +545,6 @@ def main(argv=None) -> None:
     }
     print(json.dumps(payload))
     if args.write_docs:
-        root = os.path.join(os.path.dirname(__file__), "..", "..", "docs", "benchmarks")
-        root = os.path.abspath(root)
-        json_path = os.path.join(root, "lm_tpu.json")
         if os.path.exists(json_path):
             # Partial regeneration (a --configs subset, or no --decode)
             # must not erase the rest of the record: carry forward prior
@@ -490,34 +569,11 @@ def main(argv=None) -> None:
                 return
 
             rows = merge_rows(rows, prev.get("rows", []), list(CONFIGS))
-            # Carried rows keep their measured times but their MFU* must
-            # track the CURRENT ceiling, or a roofline re-measure would
-            # leave the table silently mixing denominators.
-            peaks = _chip_peaks(jax.devices()[0]) or {}
-            for r in rows:
-                if "error" in r or not r.get("flops_per_step"):
-                    continue
-                achieved = r["flops_per_step"] / (r["step_ms"] / 1e3)
-                if "model_flops_per_step" not in r and r.get("param_count"):
-                    r["model_flops_per_step"] = (
-                        6 * r["param_count"] * r["batch"] * r["seq_len"]
-                    )
-                if ceiling:
-                    r["mfu_star_pct"] = round(
-                        100 * achieved / (ceiling * 1e12), 2
-                    )
-                    if r.get("model_flops_per_step"):
-                        r["mfu_model_pct"] = round(
-                            100
-                            * r["model_flops_per_step"]
-                            / (r["step_ms"] / 1e3)
-                            / (ceiling * 1e12),
-                            2,
-                        )
-                if peaks.get("flops"):
-                    r["mfu_pct"] = round(
-                        100 * achieved / peaks["flops"], 2
-                    )
+            # Carried rows keep their measured times but every derived
+            # column tracks the CURRENT conventions (non-embedding 6N,
+            # current ceiling) — a roofline re-measure or a denominator
+            # fix must not leave the table silently mixing conventions.
+            refresh_derived(rows, ceiling, _chip_peaks(jax.devices()[0]) or {})
             payload["rows"] = rows
             table = render(rows)
             decode_rows = merge_rows(
@@ -528,60 +584,71 @@ def main(argv=None) -> None:
         with open(json_path, "w") as f:
             json.dump(payload, f, indent=1)
         cmd_flags = f"--steps {args.steps}" + (" --decode" if args.decode else "")
-        with open(os.path.join(root, "lm_tpu.md"), "w") as f:
-            f.write(
-                "# LM training on one TPU chip\n\n"
-                f"Generated by `python -m distributed_tensorflow_tpu.tools."
-                f"lm_bench {cmd_flags} --write-docs` on {device} "
-                "(bf16 matmuls, adam, vocab 8192; two-point timing — per "
-                "row, step time is the Δ between a 4k- and a k-step warm "
-                "dispatch over 3k with D2H-fetch barriers, k and the "
-                "method recorded per row in lm_tpu.json `timing` — rows "
-                "may come from different chunked runs; MFU = XLA-counted "
-                "FLOPs / measured step time / v5e spec peak"
-                + (
-                    ", MFU* = the same against the MEASURED bf16 ceiling "
-                    f"({ceiling} TFLOPS, docs/benchmarks/roofline_tpu.md), "
-                    "MFU† = model FLOPs (6·params·tokens, the scaling-book "
-                    "convention — credits no remat recompute) over the "
-                    "measured ceiling"
-                    if ceiling
-                    else "; MFU* is dashed — no measured roofline record; "
-                    "run tools/roofline_bench --write-docs first"
-                )
-                + ".\n\n" + table + "\n\n"
-                + (
-                    "## Generation (KV-cache greedy decode, one compiled "
-                    "scan)\n\n" + render_decode(decode_rows) + "\n\n"
-                    "Decode config gaps now track their KV-cache traffic "
-                    "ratios (full:gqa2 = 4× cache → ~2.3× time; the "
-                    "balance is shared weight/embedding reads). The "
-                    "round-4 record showed decode-full 15× gqa2 — that "
-                    "was the layer `lax.scan` double-buffering the whole "
-                    "stacked cache every token (xs→ys copies); "
-                    "`GPTLM.decode_step` now unrolls the layer loop "
-                    "(939→306 µs/token at c=1024, 2311→191 at c=4096 in "
-                    "the isolation benches; decode graphs are tiny, so "
-                    "compile time is unaffected).\n\n"
-                    if decode_rows
-                    else ""
-                )
-                + "Reading the MFU columns: the measured roofline "
-                "(roofline_tpu.md) showed the tunneled chip sustains "
-                "~98% of spec peak on pure matmul chains — the round-3 "
-                "claim that 'the environment pins MFU at 1-2.5%' was a "
-                "measurement artifact (the ~100 ms dispatch+fetch "
-                "roundtrip was being divided into every step; the "
-                "two-point method cancels it). What remains between "
-                "these MFU* numbers and 100% is the WORKLOAD: toy "
-                "widths (d=256-1024 matmuls tile the MXU poorly next "
-                "to the roofline's 4096² chains), attention/layernorm/"
-                "loss bandwidth-bound phases, and per-step optimizer "
-                "traffic. Compare configs against each other AND "
-                "against MFU*=100 — both comparisons are now "
-                "meaningful.\n"
-            )
+        _write_md(root, table, decode_rows, ceiling, device, cmd_flags)
         print(f"wrote {root}/lm_tpu.md and lm_tpu.json")
+
+
+def _write_md(root, table, decode_rows, ceiling, device, cmd_flags) -> None:
+    with open(os.path.join(root, "lm_tpu.md"), "w") as f:
+        f.write(
+            "# LM training on one TPU chip\n\n"
+            f"Generated by `python -m distributed_tensorflow_tpu.tools."
+            f"lm_bench {cmd_flags} --write-docs` on {device} "
+            "(bf16 matmuls, adam, vocab 8192; two-point timing — per "
+            "row, step time is the Δ between a 4k- and a k-step warm "
+            "dispatch over 3k with D2H-fetch barriers, k and the "
+            "method recorded per row in lm_tpu.json `timing` — rows "
+            "may come from different chunked runs; MFU = XLA-counted "
+            "FLOPs / measured step time / v5e spec peak"
+            + (
+                ", MFU* = the same against the MEASURED bf16 ceiling "
+                f"({ceiling} TFLOPS, docs/benchmarks/roofline_tpu.md), "
+                "MFU† = model FLOPs (6·N·tokens, the scaling-book "
+                "convention — credits no remat recompute; N EXCLUDES "
+                "the embedding/position tables, whose lookups pay no "
+                "per-token matmul FLOPs — the tied head shares the "
+                "embedding; both N's are in lm_tpu.json) over the "
+                "measured ceiling"
+                if ceiling
+                else "; MFU* is dashed — no measured roofline record; "
+                "run tools/roofline_bench --write-docs first"
+            )
+            + ". The `params` column is total parameters.\n\n" + table + "\n\n"
+            + (
+                "## Generation (KV-cache greedy decode, one compiled "
+                "scan)\n\n" + render_decode(decode_rows) + "\n\n"
+                "Decode config gaps now track their KV-cache traffic "
+                "ratios (full:gqa2 = 4× cache → ~2.3× time; the "
+                "balance is shared weight/embedding reads). The "
+                "round-4 record showed decode-full 15× gqa2 — that "
+                "was the layer `lax.scan` double-buffering the whole "
+                "stacked cache every token (xs→ys copies); "
+                "`GPTLM.decode_step` now unrolls the layer loop "
+                "(939→306 µs/token at c=1024, 2311→191 at c=4096 in "
+                "the isolation benches; decode graphs are tiny, so "
+                "compile time is unaffected).\n\n"
+                if decode_rows
+                else ""
+            )
+            + "Reading the MFU columns: the measured roofline "
+            "(roofline_tpu.md) showed the tunneled chip sustains "
+            "~98% of spec peak on pure matmul chains — the round-3 "
+            "claim that 'the environment pins MFU at 1-2.5%' was a "
+            "measurement artifact (the ~100 ms dispatch+fetch "
+            "roundtrip was being divided into every step; the "
+            "two-point method cancels it). What remains between "
+            "these MFU* numbers and 100% is the WORKLOAD: toy "
+            "widths (d=256-1024 matmuls tile the MXU poorly next "
+            "to the roofline's 4096² chains), attention/layernorm/"
+            "loss bandwidth-bound phases, and per-step optimizer "
+            "traffic. Compare configs against each other AND "
+            "against MFU*=100 — both comparisons are now "
+            "meaningful. (Round 6: MFU† switched its N from total to "
+            "non-embedding parameters — the scaling-book reading; at "
+            "d=256 the 8192-entry table was 39% of N, so those rows' "
+            "MFU† dropped by roughly that fraction. Step times are "
+            "unchanged.)\n"
+        )
 
 
 if __name__ == "__main__":

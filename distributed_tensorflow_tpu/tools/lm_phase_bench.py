@@ -233,13 +233,20 @@ def bench_phases(
     per_layer_ffn = micro(ffn_once)
 
     n_params = sum(p.size for p in jax.tree.leaves(params))
+    # 6N model FLOPs with N excluding the embedding/position tables (the
+    # Kaplan/Chinchilla convention — lookups pay no per-token matmul
+    # FLOPs; the tied head shares the embedding). Round 5 used total
+    # params, inflating the toy rows' MFU† by the table's share
+    # (ADVICE round 5; lm_bench.py carries the same fix).
+    n_nonembed = int(n_params - params.embed.size - params.pos.size)
     toks_per_step = b * l
-    model_flops = 6 * n_params * toks_per_step
+    model_flops = 6 * n_nonembed * toks_per_step
     row = {
         "config": name,
         "batch": b,
         "seq_len": l,
         "param_count": int(n_params),
+        "param_count_nonembed": n_nonembed,
         "remat": bool(model.remat),
         "phase_ms": {
             "blocks-fwd": round(sec["blocks-fwd"] * 1e3, 2),
@@ -268,6 +275,40 @@ def bench_phases(
         row["ceiling_tflops"] = None
         row["mfu_model_pct"] = None
     return row
+
+
+def _nonembed_param_count(row) -> int | None:
+    """Non-embedding N for a committed row (offline migration of records
+    written before round 6): total minus the d·(vocab + max_len) tables."""
+    if row.get("config") not in CONFIGS or not row.get("param_count"):
+        return None
+    mkw, _ = CONFIGS[row["config"]]
+    return row["param_count"] - mkw["model_dim"] * (_VOCAB + mkw["max_len"])
+
+
+def refresh_derived(rows, ceiling) -> None:
+    """Recompute the derived columns (non-embedding 6N model FLOPs, MFU†
+    vs the current ceiling) of committed/carried rows from their measured
+    fields — shared by the carry-forward merge and ``--recompute-docs``."""
+    for r in rows:
+        if "error" in r or not r.get("phase_ms"):
+            continue
+        if "param_count_nonembed" not in r:
+            ne = _nonembed_param_count(r)
+            if ne is not None:
+                r["param_count_nonembed"] = ne
+        n_eff = r.get("param_count_nonembed") or r.get("param_count")
+        if n_eff:
+            r["model_flops_per_step"] = 6 * n_eff * r["batch"] * r["seq_len"]
+        if ceiling and r.get("model_flops_per_step"):
+            r["ceiling_tflops"] = ceiling
+            r["mfu_model_pct"] = round(
+                100
+                * r["model_flops_per_step"]
+                / (r["phase_ms"]["step"] / 1e3)
+                / (ceiling * 1e12),
+                2,
+            )
 
 
 def render(rows) -> str:
@@ -302,12 +343,36 @@ def main(argv=None) -> None:
     ap.add_argument("--steps", type=int, default=4)
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--write-docs", action="store_true")
+    ap.add_argument(
+        "--recompute-docs",
+        action="store_true",
+        help="no measurement: reload docs/benchmarks/lm_phases.json, "
+        "recompute the derived columns (non-embedding 6N, MFU† vs the "
+        "current ceiling) and rewrite md+json — runs anywhere, no chip",
+    )
     args = ap.parse_args(argv)
     from distributed_tensorflow_tpu.tools.cost_analysis import (
         measured_ceiling_tflops,
     )
 
     ceiling = measured_ceiling_tflops()
+    root = os.path.abspath(
+        os.path.join(
+            os.path.dirname(__file__), "..", "..", "docs", "benchmarks"
+        )
+    )
+    json_path = os.path.join(root, "lm_phases.json")
+    if args.recompute_docs:
+        with open(json_path) as f:
+            payload = json.load(f)
+        refresh_derived(payload["rows"], ceiling)
+        table = render(payload["rows"])
+        print(table)
+        with open(json_path, "w") as f:
+            json.dump(payload, f, indent=1)
+        _write_md(root, table, ceiling)
+        print(f"recomputed {root}/lm_phases.md and lm_phases.json")
+        return
     rows = []
     for name in args.configs or CONFIGS:
         try:
@@ -325,12 +390,6 @@ def main(argv=None) -> None:
     if args.write_docs:
         from distributed_tensorflow_tpu.tools.lm_bench import merge_rows
 
-        root = os.path.abspath(
-            os.path.join(
-                os.path.dirname(__file__), "..", "..", "docs", "benchmarks"
-            )
-        )
-        json_path = os.path.join(root, "lm_phases.json")
         if os.path.exists(json_path):
             # Carry-forward merge (lm_bench's --write-docs discipline): a
             # --configs touch-up or a transient tunnel error must not
@@ -347,19 +406,9 @@ def main(argv=None) -> None:
                 )
                 return
             rows = merge_rows(rows, prev.get("rows", []), list(CONFIGS))
-            # Carried rows track the CURRENT ceiling.
-            if ceiling:
-                for r in rows:
-                    if "error" in r or not r.get("model_flops_per_step"):
-                        continue
-                    r["ceiling_tflops"] = ceiling
-                    r["mfu_model_pct"] = round(
-                        100
-                        * r["model_flops_per_step"]
-                        / (r["phase_ms"]["step"] / 1e3)
-                        / (ceiling * 1e12),
-                        2,
-                    )
+            # Carried rows track the CURRENT conventions (non-embedding
+            # 6N, current ceiling).
+            refresh_derived(rows, ceiling)
         table = render(rows)
         print(table)
         with open(json_path, "w") as f:
@@ -367,33 +416,39 @@ def main(argv=None) -> None:
                 {"rows": rows, "device": jax.devices()[0].device_kind}, f,
                 indent=1,
             )
-        with open(os.path.join(root, "lm_phases.md"), "w") as f:
-            f.write(
-                "# LM train-step phase decomposition (one TPU v5e chip)\n\n"
-                "Generated by `python -m distributed_tensorflow_tpu.tools."
-                "lm_phase_bench --write-docs`. Phases nest (see the module "
-                "docstring): logits+loss = fwd − blocks-fwd, backward = "
-                "fwd+bwd − fwd, optimizer = step − fwd+bwd; attn/ffn are "
-                "per-layer forward microbenches at the exact block shapes. "
-                "All regions chained scans with data-dependent feeds, "
-                "two-point timed. MFU† = 6·params·tokens (the scaling-book "
-                "model-FLOPs convention — counts remat recompute as zero) "
-                "over the MEASURED bf16 ceiling "
-                f"({ceiling} TFLOPS, roofline_tpu.md).\n\n"
-                + table
-                + "\n\nReading it: the toy rows lose their step time to "
-                "phases that are small matmuls and scatters (d=256 tiles "
-                "an eighth of the MXU lane width), with the BACKWARD "
-                "pass the dominant term. The MXU-sized rows (d=2048, "
-                "remat) put >40% of the measured ceiling into model "
-                "FLOPs — the round-3/4 \"MFU gap\" was the WORKLOAD, as "
-                "the roofline said, not the environment; their backward "
-                "includes one full forward recompute (remat), which "
-                "MFU† deliberately does not credit.\n"
-            )
+        _write_md(root, table, ceiling)
         print(f"wrote {root}/lm_phases.md and lm_phases.json")
     else:
         print(render(rows))
+
+
+def _write_md(root, table, ceiling) -> None:
+    with open(os.path.join(root, "lm_phases.md"), "w") as f:
+        f.write(
+            "# LM train-step phase decomposition (one TPU v5e chip)\n\n"
+            "Generated by `python -m distributed_tensorflow_tpu.tools."
+            "lm_phase_bench --write-docs`. Phases nest (see the module "
+            "docstring): logits+loss = fwd − blocks-fwd, backward = "
+            "fwd+bwd − fwd, optimizer = step − fwd+bwd; attn/ffn are "
+            "per-layer forward microbenches at the exact block shapes. "
+            "All regions chained scans with data-dependent feeds, "
+            "two-point timed. MFU† = 6·N·tokens (the scaling-book "
+            "model-FLOPs convention — counts remat recompute as zero; N "
+            "EXCLUDES the embedding/position tables, whose lookups pay "
+            "no per-token matmul FLOPs — round 6 fixed the denominator, "
+            "lm_phases.json keeps both counts) over the MEASURED bf16 "
+            f"ceiling ({ceiling} TFLOPS, roofline_tpu.md).\n\n"
+            + table
+            + "\n\nReading it: the toy rows lose their step time to "
+            "phases that are small matmuls and scatters (d=256 tiles "
+            "an eighth of the MXU lane width), with the BACKWARD "
+            "pass the dominant term. The MXU-sized rows (d=2048, "
+            "remat) put ~40% of the measured ceiling into model "
+            "FLOPs — the round-3/4 \"MFU gap\" was the WORKLOAD, as "
+            "the roofline said, not the environment; their backward "
+            "includes one full forward recompute (remat), which "
+            "MFU† deliberately does not credit.\n"
+        )
 
 
 if __name__ == "__main__":

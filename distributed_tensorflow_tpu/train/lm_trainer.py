@@ -150,7 +150,11 @@ class LMTrainer:
         self.supervisor = supervisor
         if self.supervisor is None and self.config.checkpoint_dir:
             self.supervisor = Supervisor(
-                is_chief=is_chief, checkpoint_dir=self.config.checkpoint_dir
+                is_chief=is_chief,
+                checkpoint_dir=self.config.checkpoint_dir,
+                keep_last_n=self.config.keep_last_n,
+                io_retries=self.config.checkpoint_retries,
+                io_backoff=self.config.checkpoint_retry_backoff,
             )
         self.tokenizer = tokenizer
         if (
@@ -167,12 +171,13 @@ class LMTrainer:
             # Supervisor only creates the directory when orbax is present,
             # so make sure it exists before writing the vocab.
             os.makedirs(self.supervisor.checkpoint_dir, exist_ok=True)
-            tokenizer.save(
-                os.path.join(self.supervisor.checkpoint_dir, "tokenizer.json")
-            )
+            self._write_tokenizer(tokenizer)
         self.start_step = 0
         if self.supervisor is not None:
-            step = self.supervisor.latest_step()
+            # Newest step that is not known-corrupt (manifest-verified,
+            # train/resilience.py): a truncated latest checkpoint points
+            # the restore at the previous valid one.
+            step = self.supervisor.newest_restorable_step()
             src = (
                 self.supervisor.saved_layout(step)
                 if step is not None
@@ -198,8 +203,12 @@ class LMTrainer:
                 )
                 self.start_step = step
             else:
+                # verified_step: the probe above already CRC-verified this
+                # step's files — skip the redundant disk re-read.
                 self.state, self.start_step = (
-                    self.supervisor.prepare_or_restore(self.state)
+                    self.supervisor.prepare_or_restore(
+                        self.state, verified_step=step
+                    )
                 )
                 self.state = self._place_state(self.state)
             # Fast-forward the host-side index stream so a resumed run
@@ -220,7 +229,38 @@ class LMTrainer:
         self._scan = bool(scan_epoch)
 
         self.last_cost = None
+        self._epoch_costs = None  # per-step costs of the last scanned epoch
         self.history: list[dict] = []
+
+    def _write_tokenizer(self, tokenizer) -> None:
+        """Write ``tokenizer.json`` into checkpoint_dir — unless one is
+        already there. An existing record is the vocab that produced the
+        CHECKPOINT's token ids: matching merges make the write a no-op,
+        mismatched merges refuse loudly instead of silently replacing the
+        record the restored weights depend on (ADVICE round 5)."""
+        path = os.path.join(self.supervisor.checkpoint_dir, "tokenizer.json")
+        if os.path.exists(path):
+            from distributed_tensorflow_tpu.data.text import BPETokenizer
+
+            try:
+                existing = BPETokenizer.load(path)
+            except Exception as exc:
+                raise ValueError(
+                    f"checkpoint_dir already holds an unreadable {path} "
+                    f"({type(exc).__name__}: {exc}); refusing to overwrite "
+                    "the vocab record the checkpoint's token ids depend on"
+                ) from exc
+            if getattr(tokenizer, "merges", None) != existing.merges:
+                raise ValueError(
+                    f"tokenizer mismatch: {path} holds "
+                    f"{len(existing.merges)} merges that differ from this "
+                    f"tokenizer's {len(getattr(tokenizer, 'merges', []))}; "
+                    "refusing to overwrite the vocab that matches the "
+                    "checkpoint's token ids (use a fresh checkpoint_dir "
+                    "to train with a new vocab)"
+                )
+            return  # identical vocab: nothing to do
+        tokenizer.save(path)
 
     # -- modes -------------------------------------------------------------
 
@@ -577,11 +617,15 @@ class LMTrainer:
         if mode == "async":
             # Merge the replicas at the mean — exactly the parameters the
             # async mode itself evaluates at (_eval_params). Integer
-            # leaves (adam count) are identical across replicas, so the
-            # mean-then-cast is exact.
-            merge = lambda t: jax.tree.map(  # noqa: E731
-                lambda x: jnp.mean(x, axis=0).astype(x.dtype), t
+            # leaves (adam count) take replica 0's value outright
+            # (strategy.merge_replica_leaf): the float mean is exact only
+            # below 2^24, past which mean-then-cast silently corrupts the
+            # count the copies share (ADVICE round 5).
+            from distributed_tensorflow_tpu.parallel.strategy import (
+                merge_replica_leaf,
             )
+
+            merge = lambda t: jax.tree.map(merge_replica_leaf, t)  # noqa: E731
             return TrainState(
                 merge(state.params), merge(state.opt_state), state.step
             )
@@ -982,9 +1026,20 @@ class LMTrainer:
                     }
                 )
         if self.supervisor is not None:
-            self.supervisor.save(
-                self.state, self.global_step, layout=self._layout_meta()
-            )
+            if cfg.max_rollbacks and costs.size and not np.isfinite(costs).all():
+                # One compiled dispatch cannot roll back mid-program; the
+                # guard's durability half still holds — never commit a
+                # poisoned state over the last good checkpoint (the
+                # per-epoch run() path does the full restore+retry).
+                if self.is_chief:
+                    self.print_fn(
+                        "Rollback: kind=nan dispatch=compiled save=skipped "
+                        "(state not checkpointed; last good step kept)"
+                    )
+            else:
+                self.supervisor.save(
+                    self.state, self.global_step, layout=self._layout_meta()
+                )
         if not finalize:
             return {
                 "perplexity": float(ppls[-1]),
@@ -1008,7 +1063,12 @@ class LMTrainer:
         dispatched a chunk at a time — per-epoch logs + in-graph perplexity
         from each chunk's fetched history, checkpoint per dispatch,
         ``should_stop`` honored at chunk boundaries."""
+        import math
+
+        from distributed_tensorflow_tpu.train.resilience import AnomalyGuard
+
         k = self.config.epochs_per_dispatch
+        guard = AnomalyGuard.from_config(self.config)
         res = {
             "perplexity": float("nan"),
             "final_cost": float("nan"),
@@ -1018,7 +1078,21 @@ class LMTrainer:
         while done < epochs:
             n = min(k, epochs - done)
             last = done + n >= epochs
+            step_before = self.global_step
             res = self.run_compiled(n, epoch_offset=done, finalize=last)
+            if (
+                guard is not None
+                and not math.isfinite(res["final_cost"])
+                and res["global_step"] > step_before
+            ):
+                # Chunk went NaN mid-dispatch (its save was skipped): roll
+                # back at this host boundary and retry — the retried chunk
+                # draws the NEXT next_indices window, so the offending
+                # data is skipped, not replayed (NaN-only; see
+                # Trainer._run_chunked). Empty dispatches (nan
+                # placeholder, no step advance) are not anomalies.
+                self._anomaly_rollback(guard, "nan", done)
+                continue
             done += n
             if self.supervisor is not None and self.supervisor.should_stop:
                 if not last:
@@ -1090,6 +1164,7 @@ class LMTrainer:
         steps = train.num_examples // cfg.batch_size
         summaries: list[tuple[int, float]] = []
         step_before = self.global_step
+        self._epoch_costs = None  # eager path: guard judges last_cost only
         if self._scan:
             if self._scanned_fn is None:
                 self._scanned_fn = self._build_scanned_fn()
@@ -1101,6 +1176,7 @@ class LMTrainer:
             costs = jax.device_get(costs)  # D2H fetch = execution barrier
             avg_ms = (time.time() - t0) * 1000 / steps
             self.last_cost = float(costs[-1])
+            self._epoch_costs = costs  # anomaly guard sees every step's cost
             for i in range(steps):
                 if logger.is_due(i + 1, steps):
                     logger.log_step_line(
@@ -1146,15 +1222,82 @@ class LMTrainer:
             for step, cost in summaries:
                 self.summary_writer.add_scalar("cost", float(cost), step)
 
+    def _anomaly_rollback(self, guard, kind: str, epoch: int) -> None:
+        """LM analog of Trainer._anomaly_rollback: restore the newest
+        valid checkpoint (re-placed into this mode's device layout), keep
+        the host index stream where it is — the offending epoch's
+        ``next_indices`` draws are consumed, never replayed, so the retry
+        trains on the next data window (the PaLM spike protocol). With no
+        checkpoint yet the target is the deterministic seed re-init.
+        Raises AnomalyError once ``max_rollbacks`` is spent."""
+        from distributed_tensorflow_tpu.train.resilience import AnomalyError
+
+        detected_step = self.global_step
+        if self.supervisor is None or guard.exhausted:
+            raise AnomalyError(
+                f"anomalous cost (kind={kind}) at epoch {epoch} step "
+                f"{detected_step} with no rollback budget left "
+                f"({guard.rollbacks}/{guard.max_rollbacks} used"
+                + ("" if self.supervisor else "; no supervisor") + ")"
+            )
+        guard.rollbacks += 1
+        fresh = self._init_state(self.model.init(seed=self.config.seed))
+        restored, restored_step = self.supervisor.prepare_or_restore(fresh)
+        self.state = self._place_state(restored)
+        self.last_cost = None
+        if self.is_chief:
+            self.print_fn(
+                f"Rollback: kind={kind} epoch={epoch} "
+                f"detected_step={detected_step} restored_step={restored_step} "
+                f"rollback={guard.rollbacks}/{guard.max_rollbacks} "
+                "data_window=skipped"
+            )
+            if self.summary_writer is not None:
+                self.summary_writer.add_scalar(
+                    "rollback", float(restored_step), detected_step
+                )
+
     def run(self, epochs: int | None = None) -> dict:
+        """Public entry: the whole run under the preemption contract —
+        SIGTERM/SIGINT requests a stop, the loop exits at the next epoch
+        (or dispatch-chunk) boundary with a final save, and the process
+        can exit 0 (train/resilience.py)."""
+        from distributed_tensorflow_tpu.train.resilience import preemption_guard
+
+        with preemption_guard(
+            self.supervisor,
+            enabled=self.config.handle_preemption,
+            print_fn=self.print_fn,
+        ):
+            return self._run(epochs)
+
+    def _run(self, epochs: int | None = None) -> dict:
         cfg = self.config
         epochs = cfg.epochs if epochs is None else epochs
         if cfg.epochs_per_dispatch:
             return self._run_chunked(epochs)
         logger = StepLogger(freq=cfg.log_frequency, print_fn=self.print_fn)
+        from distributed_tensorflow_tpu.train.resilience import AnomalyGuard
+
+        guard = AnomalyGuard.from_config(cfg)
         perplexity = float("nan")
-        for epoch in range(epochs):
+        epoch = 0
+        while epoch < epochs:
             self.run_epoch(epoch, logger)
+            if guard is not None:
+                # Judge BEFORE eval/save: an anomalous state must neither
+                # reach the checkpoint directory nor count as a good
+                # epoch; all processes compute the identical verdict.
+                cost = (
+                    float(self.last_cost)
+                    if self.last_cost is not None
+                    else float("nan")
+                )
+                kind = guard.classify(cost, costs=self._epoch_costs)
+                if kind is not None:
+                    self._anomaly_rollback(guard, kind, epoch)
+                    continue  # retry this epoch index on the next window
+                guard.record(cost)
             # EVERY process runs the eval — it is a global-mesh computation
             # (GSPMD may partition it with collectives), so a chief-only
             # dispatch would hang or die once non-chief processes move on
@@ -1180,6 +1323,7 @@ class LMTrainer:
                 )
                 if self.supervisor.should_stop:
                     break
+            epoch += 1
         final_cost = (
             float(self.last_cost) if self.last_cost is not None else float("nan")
         )

@@ -1,0 +1,391 @@
+"""Resilience layer: durable checkpoints, preemption, anomaly rollback.
+
+SURVEY.md §5 names fault tolerance as the reference's weakest layer — the
+TF1 suite configured no saver at all, and a dead worker hung its chief's
+gRPC calls forever. Rounds 1-5 upgraded that to heartbeats + orbax
+checkpoints + a failure-reactive Supervisor stop; this module closes the
+three gaps that remained between "stops cleanly" and "survives":
+
+- **Durable checkpoints** — every ``step_N`` save commits a manifest
+  sidecar (``step_N.manifest.json``, written atomically via tmp +
+  ``os.replace``) carrying a per-leaf CRC32C of the in-memory state plus
+  per-file size/CRC records of everything orbax put on disk. A checkpoint
+  without a verifying manifest is *known-bad* and restore falls back to
+  the newest step that verifies (``Supervisor.prepare_or_restore``);
+  checkpoints predating the manifest (rounds ≤5) restore as before.
+  CRC32C rides the native runtime's fast path
+  (``runtime/native.py::crc32c``, the same C kernel the tfevents writer
+  uses) with the pure table fallback from ``utils/summary.py``.
+
+- **Preemption** — :func:`preemption_guard` installs SIGTERM/SIGINT
+  handlers that flip ``Supervisor.request_stop``, so both trainers exit
+  their epoch/dispatch loop at the next boundary *with a final save* —
+  the TPU-pod preemption contract (the scheduler SIGTERMs, you get a
+  grace window, you checkpoint and exit 0). A second signal restores the
+  previous disposition, so a stuck run can still be killed.
+
+- **Anomaly guard + rollback** — :class:`AnomalyGuard` watches per-epoch
+  cost for NaN/inf and for spikes against a trailing window (the failure
+  mode that dominates real LM runs; PaLM's spike protocol: restore the
+  last good checkpoint and skip the offending data window). The trainers
+  restore the newest *valid* checkpoint, leave the host data stream where
+  it is (the offending epoch's draws are consumed, never replayed — that
+  IS the skip), and retry up to ``max_rollbacks`` times, emitting a
+  structured ``Rollback:`` log line and a ``rollback`` tfevents scalar
+  per event.
+
+Checkpoint I/O additionally gets bounded retry-with-backoff
+(:func:`retry_io`) — a transient filesystem hiccup should cost a retry,
+not the run.
+
+No reference analog for any of this (the reference's fault story was
+"don't crash"); the contracts are documented in docs/resilience.md and
+proven by tests/test_resilience.py + the SIGTERM case in
+tests/integration/test_fault_injection.py.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+
+MANIFEST_FORMAT = "dtf-checkpoint-manifest-v1"
+
+# ---------------------------------------------------------------------------
+# CRC32C — native fast path, pure-Python table fallback.
+# ---------------------------------------------------------------------------
+
+_crc_impl = None
+
+
+def _crc32c_bytes(data: bytes) -> int:
+    """CRC32C of a byte string: the native runtime's C kernel when the
+    library loads (runtime/csrc/dtf_runtime.cc — same code path the
+    tfevents TFRecord framing uses), else the pure-Python table from
+    utils/summary.py. Both produce identical values (pinned by
+    tests/test_runtime_native.py), so manifests written with one verify
+    with the other."""
+    global _crc_impl
+    if _crc_impl is None:
+        try:
+            from distributed_tensorflow_tpu.runtime.native import crc32c
+
+            crc32c(b"probe")  # force the library load now
+            _crc_impl = crc32c
+        except (ImportError, OSError):
+            from distributed_tensorflow_tpu.utils.summary import crc32c
+
+            _crc_impl = crc32c
+    return _crc_impl(data)
+
+
+_buf_impl = None
+
+
+def crc32c_array(a) -> int:
+    """CRC32C of an array's buffer (row-major). Accepts anything numpy can
+    view — device arrays fetch to host here, which doubles as the save
+    barrier for the leaf being checksummed. Uses the native zero-copy
+    buffer kernel when available (runtime/native.py::crc32c_buffer)."""
+    global _buf_impl
+    host = np.ascontiguousarray(np.asarray(a))
+    if _buf_impl is None:
+        try:
+            from distributed_tensorflow_tpu.runtime.native import crc32c_buffer
+
+            crc32c_buffer(np.zeros(1, np.uint8))  # force the library load
+            _buf_impl = crc32c_buffer
+        except (ImportError, OSError):
+            _buf_impl = lambda arr: _crc32c_bytes(arr.tobytes())  # noqa: E731
+    return _buf_impl(host)
+
+
+def crc32c_file(path: str) -> int:
+    with open(path, "rb") as f:
+        return _crc32c_bytes(f.read())
+
+
+# ---------------------------------------------------------------------------
+# Manifest write / verify.
+# ---------------------------------------------------------------------------
+
+
+def manifest_path(checkpoint_dir: str, step: int) -> str:
+    return os.path.join(checkpoint_dir, f"step_{step}.manifest.json")
+
+
+def leaf_checksums(state) -> tuple[dict, bool]:
+    """Per-leaf CRC32C of a state pytree: ``{keystr: {crc32c, shape,
+    dtype}}``. Leaves that are not fully addressable from this process
+    (multi-host shards) are skipped — the second return value is False
+    when any were, so verification knows the leaf map is partial (the
+    per-file records still cover the bytes on disk)."""
+    import jax.tree_util as jtu
+
+    leaves: dict = {}
+    complete = True
+    for kp, leaf in jtu.tree_flatten_with_path(state)[0]:
+        if not getattr(leaf, "is_fully_addressable", True):
+            complete = False
+            continue
+        arr = np.asarray(leaf)
+        leaves[jtu.keystr(kp)] = {
+            "crc32c": crc32c_array(arr),
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+        }
+    return leaves, complete
+
+
+def _file_records(root: str) -> dict:
+    out: dict = {}
+    for dirpath, _, files in os.walk(root):
+        for fname in files:
+            p = os.path.join(dirpath, fname)
+            out[os.path.relpath(p, root)] = {
+                "size": os.path.getsize(p),
+                "crc32c": crc32c_file(p),
+            }
+    return out
+
+
+def write_manifest(checkpoint_dir: str, step: int, state=None) -> dict:
+    """Commit the durability record for ``step_N``: per-file size+CRC over
+    everything orbax wrote, per-leaf CRCs of the in-memory state (when
+    given), and the layout sidecar's CRC when present. Written to a tmp
+    name then ``os.replace``d — the manifest's presence marks a fully
+    committed checkpoint, so a crash mid-save leaves a step that restore
+    classifies as unverified rather than silently trusting it."""
+    step_dir = os.path.join(checkpoint_dir, f"step_{step}")
+    manifest: dict = {
+        "format": MANIFEST_FORMAT,
+        "step": int(step),
+        "files": _file_records(step_dir),
+        "sidecars": {},
+    }
+    layout_side = os.path.join(checkpoint_dir, f"step_{step}.layout.json")
+    if os.path.exists(layout_side):
+        manifest["sidecars"][os.path.basename(layout_side)] = {
+            "size": os.path.getsize(layout_side),
+            "crc32c": crc32c_file(layout_side),
+        }
+    if state is not None:
+        manifest["leaves"], manifest["leaves_complete"] = leaf_checksums(state)
+    path = manifest_path(checkpoint_dir, step)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, path)
+    return manifest
+
+
+def load_manifest(checkpoint_dir: str, step: int) -> dict | None:
+    """The committed manifest for ``step_N``, or None when absent
+    (pre-round-6 checkpoint). A present-but-unparseable manifest raises
+    ValueError — corruption of the durability record itself must be loud,
+    the same contract as ``Supervisor.saved_layout``."""
+    path = manifest_path(checkpoint_dir, step)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except OSError:
+        return None
+    except ValueError as exc:
+        raise ValueError(f"corrupt checkpoint manifest {path}: {exc}") from exc
+
+
+def verify_files(checkpoint_dir: str, step: int) -> bool | None:
+    """Integrity of ``step_N``'s bytes on disk against its manifest.
+
+    Returns True (verified), False (known-bad: missing/truncated/flipped
+    file, or the manifest itself is corrupt), or None (no manifest —
+    unverifiable, the pre-manifest era; callers decide whether to trust)."""
+    try:
+        manifest = load_manifest(checkpoint_dir, step)
+    except ValueError:
+        return False
+    if manifest is None:
+        return None
+    step_dir = os.path.join(checkpoint_dir, f"step_{step}")
+    for rel, rec in manifest.get("files", {}).items():
+        p = os.path.join(step_dir, rel)
+        if not os.path.isfile(p) or os.path.getsize(p) != rec["size"]:
+            return False
+        if crc32c_file(p) != rec["crc32c"]:
+            return False
+    for name, rec in manifest.get("sidecars", {}).items():
+        p = os.path.join(checkpoint_dir, name)
+        if not os.path.isfile(p) or os.path.getsize(p) != rec["size"]:
+            return False
+        if crc32c_file(p) != rec["crc32c"]:
+            return False
+    return True
+
+
+def verify_leaves(state, manifest: dict) -> bool:
+    """Recompute the restored state's per-leaf CRCs against the manifest.
+    Catches corruption the file pass cannot see (a byte flip the storage
+    layer absorbed into a valid-looking read) and skew between manifest
+    and data. Leaves absent from a partial (multi-host) manifest pass."""
+    recorded = manifest.get("leaves")
+    if not recorded:
+        return True
+    import jax.tree_util as jtu
+
+    for kp, leaf in jtu.tree_flatten_with_path(state)[0]:
+        rec = recorded.get(jtu.keystr(kp))
+        if rec is None:
+            continue
+        if not getattr(leaf, "is_fully_addressable", True):
+            continue
+        if crc32c_array(leaf) != rec["crc32c"]:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Bounded retry around checkpoint I/O.
+# ---------------------------------------------------------------------------
+
+
+def retry_io(
+    fn,
+    *,
+    attempts: int = 3,
+    backoff: float = 0.25,
+    retry_on: tuple = (OSError,),
+    describe: str = "checkpoint I/O",
+):
+    """Run ``fn`` with bounded retry + exponential backoff on transient
+    I/O errors. The last failure re-raises — durability means surviving a
+    hiccup, not silently swallowing a dead disk."""
+    last = None
+    for attempt in range(max(1, attempts)):
+        try:
+            return fn()
+        except retry_on as exc:  # noqa: PERF203 — retry loop by design
+            last = exc
+            if attempt + 1 >= attempts:
+                raise
+            time.sleep(backoff * (2**attempt))
+    raise last  # pragma: no cover — unreachable (loop raises)
+
+
+# ---------------------------------------------------------------------------
+# Preemption: SIGTERM/SIGINT → request_stop → boundary exit + final save.
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def preemption_guard(supervisor, *, enabled: bool = True, print_fn=print):
+    """Install SIGTERM/SIGINT handlers for the duration of a training run:
+    the first signal flips ``supervisor.request_stop()`` (the loop exits
+    at the next epoch/dispatch boundary, whose save makes the final
+    checkpoint) and immediately restores the previous handlers, so a
+    second signal falls through to the old disposition (default: die) —
+    graceful first, killable always.
+
+    No-ops (yields None) when disabled, when there is no supervisor to
+    stop, or off the main thread (CPython only delivers signals there)."""
+    if (
+        not enabled
+        or supervisor is None
+        or threading.current_thread() is not threading.main_thread()
+    ):
+        yield None
+        return
+    prev: dict = {}
+
+    def _restore():
+        while prev:
+            sig, old = prev.popitem()
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+
+    def _handler(signum, frame):
+        supervisor.request_stop()
+        # Structured one-liner (greppable key=value, like Step:/Cost:).
+        print_fn(
+            f"Preemption: signal={signum} stop_requested=1 — finishing the "
+            "current epoch, saving, exiting (signal again to force)"
+        )
+        _restore()
+
+    try:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev[sig] = signal.signal(sig, _handler)
+            except (ValueError, OSError):  # pragma: no cover — exotic hosts
+                pass
+        yield _handler
+    finally:
+        _restore()
+
+
+# ---------------------------------------------------------------------------
+# Anomaly guard (NaN/inf + spike-vs-trailing-window) for the epoch loop.
+# ---------------------------------------------------------------------------
+
+
+class AnomalyError(RuntimeError):
+    """Anomalous cost with no rollback budget (or no checkpoint) left."""
+
+
+class AnomalyGuard:
+    """Per-epoch cost monitor. ``classify`` returns ``"nan"`` for any
+    non-finite cost in the epoch, ``"spike"`` when the epoch cost exceeds
+    ``spike_threshold ×`` the median of the last ``window`` *good* epochs
+    (only after a full window of history — early-training descent must
+    not trip it), else None. ``record`` feeds the trailing window; only
+    epochs that passed get recorded, so one spike does not poison the
+    baseline that judges the retry."""
+
+    def __init__(
+        self,
+        *,
+        window: int = 8,
+        spike_threshold: float = 3.0,
+        max_rollbacks: int = 3,
+    ):
+        self.window = max(1, int(window))
+        self.spike_threshold = float(spike_threshold)
+        self.max_rollbacks = int(max_rollbacks)
+        self.history: list[float] = []
+        self.rollbacks = 0
+
+    @classmethod
+    def from_config(cls, config) -> "AnomalyGuard | None":
+        """The TrainConfig surface: ``max_rollbacks=0`` disables the guard
+        entirely; ``spike_threshold=0`` keeps only the NaN/inf check."""
+        if not getattr(config, "max_rollbacks", 0):
+            return None
+        return cls(
+            window=config.anomaly_window,
+            spike_threshold=config.spike_threshold,
+            max_rollbacks=config.max_rollbacks,
+        )
+
+    def classify(self, cost: float, costs=None) -> str | None:
+        vals = np.asarray(costs if costs is not None else [cost], np.float64)
+        if not np.all(np.isfinite(vals)) or not np.isfinite(cost):
+            return "nan"
+        if self.spike_threshold > 0 and len(self.history) >= self.window:
+            ref = float(np.median(self.history[-self.window :]))
+            if ref > 0 and cost > self.spike_threshold * ref:
+                return "spike"
+        return None
+
+    def record(self, cost: float) -> None:
+        self.history.append(float(cost))
+
+    @property
+    def exhausted(self) -> bool:
+        return self.rollbacks >= self.max_rollbacks

@@ -13,6 +13,13 @@ SURVEY.md §5 "Checkpoint/resume"), and cross-process agreement comes from
 ``jax.distributed``'s coordination barrier plus every process computing the
 same deterministic init (same seed ⇒ same params, no broadcast needed).
 Checkpointing is orbax-backed, async-capable, and sharding-aware.
+
+Round 6 makes the checkpoints *durable* (train/resilience.py): every save
+commits a CRC32C manifest sidecar, restore verifies and falls back to the
+newest VALID step when the latest is corrupt or partial, checkpoint I/O
+retries with backoff, and a retention policy (``keep_last_n``) GCs old
+steps without ever removing the last verified one. Contracts in
+docs/resilience.md.
 """
 
 from __future__ import annotations
@@ -20,10 +27,13 @@ from __future__ import annotations
 import json
 import os
 import re
+import shutil
+import warnings
 
 import jax
 
 from distributed_tensorflow_tpu.parallel.strategy import TrainState
+from distributed_tensorflow_tpu.train import resilience
 
 try:
     import orbax.checkpoint as ocp
@@ -35,23 +45,51 @@ except Exception:  # pragma: no cover
 _STEP_DIR = re.compile(r"^step_(\d+)$")
 
 
-def latest_checkpoint_step(checkpoint_dir: str | None) -> int | None:
-    """Newest ``step_N`` under ``checkpoint_dir``, or None. Read-only probe —
-    never creates the directory (unlike constructing a Supervisor)."""
+def checkpoint_steps(checkpoint_dir: str | None) -> list[int]:
+    """All ``step_N`` under ``checkpoint_dir``, ascending. Read-only."""
     if not checkpoint_dir or not os.path.isdir(checkpoint_dir):
-        return None
-    steps = [
+        return []
+    return sorted(
         int(m.group(1))
         for d in os.listdir(checkpoint_dir)
         if (m := _STEP_DIR.match(d))
-    ]
-    return max(steps) if steps else None
+    )
+
+
+def latest_checkpoint_step(
+    checkpoint_dir: str | None, *, verify: bool = False
+) -> int | None:
+    """Newest ``step_N`` under ``checkpoint_dir``, or None. Read-only probe —
+    never creates the directory (unlike constructing a Supervisor).
+
+    ``verify=True`` returns the newest step whose bytes on disk pass the
+    manifest integrity check (train/resilience.py) — skipping corrupt or
+    partially written checkpoints AND pre-manifest ones (no manifest means
+    nothing to verify against; use the default probe to see those)."""
+    steps = checkpoint_steps(checkpoint_dir)
+    if not verify:
+        return steps[-1] if steps else None
+    for step in reversed(steps):
+        if resilience.verify_files(checkpoint_dir, step) is True:
+            return step
+    return None
 
 
 class Supervisor:
-    def __init__(self, *, is_chief: bool = True, checkpoint_dir: str | None = None):
+    def __init__(
+        self,
+        *,
+        is_chief: bool = True,
+        checkpoint_dir: str | None = None,
+        keep_last_n: int | None = None,
+        io_retries: int = 3,
+        io_backoff: float = 0.25,
+    ):
         self.is_chief = is_chief
         self.checkpoint_dir = os.path.abspath(checkpoint_dir) if checkpoint_dir else None
+        self.keep_last_n = keep_last_n
+        self.io_retries = max(1, int(io_retries))
+        self.io_backoff = float(io_backoff)
         self._stop_requested = False
         self._heartbeat = None
         self._ckptr = None
@@ -70,8 +108,34 @@ class Supervisor:
 
     # -- checkpoint/restore (upgrade over the reference's nothing) --------
 
-    def latest_step(self) -> int | None:
-        return latest_checkpoint_step(self.checkpoint_dir)
+    def latest_step(self, *, verify: bool = False) -> int | None:
+        return latest_checkpoint_step(self.checkpoint_dir, verify=verify)
+
+    def newest_restorable_step(self) -> int | None:
+        """Newest step that is not KNOWN-bad: manifest-verified where a
+        manifest exists, trusted where none does (pre-round-6 checkpoints
+        carry no manifest but must keep restoring). The restore entry
+        points use this so a corrupt latest checkpoint points them at the
+        newest valid one instead."""
+        for step in reversed(checkpoint_steps(self.checkpoint_dir)):
+            if resilience.verify_files(self.checkpoint_dir, step) is False:
+                warnings.warn(
+                    f"checkpoint step_{step} fails manifest verification; "
+                    "falling back to the previous step",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                continue
+            return step
+        return None
+
+    def _retry(self, fn, describe: str):
+        return resilience.retry_io(
+            fn,
+            attempts=self.io_retries,
+            backoff=self.io_backoff,
+            describe=describe,
+        )
 
     def save(
         self, state: TrainState, step: int, layout: dict | None = None
@@ -81,32 +145,89 @@ class Supervisor:
         optional topology descriptor (mode, pipeline stages, async
         replicas — see LMTrainer._layout_meta) written as a JSON sidecar
         ``step_N.layout.json``; cross-topology restore reads it to know
-        which canonicalization the saved arrays need."""
+        which canonicalization the saved arrays need.
+
+        Durability (round 6): the orbax write runs under bounded
+        retry-with-backoff, then the manifest sidecar commits atomically
+        (its presence marks a complete checkpoint), then the retention
+        policy GCs steps beyond ``keep_last_n`` — never the last valid."""
         if not (self.is_chief and self._ckptr):
             return
         path = os.path.join(self.checkpoint_dir, f"step_{step}")
-        self._ckptr.save(path, state, force=True)
-        self._ckptr.wait_until_finished()
+
+        def _write():
+            self._ckptr.save(path, state, force=True)
+            self._ckptr.wait_until_finished()
+
+        self._retry(_write, f"save step_{step}")
         if layout is not None:
             side = f"{path}.layout.json"
             tmp = f"{side}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:
                 json.dump(layout, f)
             os.replace(tmp, side)
+        self._retry(
+            lambda: resilience.write_manifest(self.checkpoint_dir, step, state),
+            f"manifest step_{step}",
+        )
+        self._retention_sweep()
+
+    def _retention_sweep(self) -> None:
+        """Delete steps beyond the ``keep_last_n`` newest. The newest
+        VALID step is never deleted, even when it falls outside the
+        window — if every kept step were corrupt, the sweep must not have
+        destroyed the one that restores."""
+        n = self.keep_last_n
+        if not n or n < 1:
+            return
+        steps = checkpoint_steps(self.checkpoint_dir)
+        doomed = steps[:-n]
+        if not doomed:
+            return
+        kept_valid = any(
+            resilience.verify_files(self.checkpoint_dir, s) is True
+            for s in steps[-n:]
+        )
+        protected: set[int] = set()
+        if not kept_valid:
+            for s in reversed(doomed):
+                if resilience.verify_files(self.checkpoint_dir, s) is True:
+                    protected.add(s)
+                    break
+        for s in doomed:
+            if s in protected:
+                continue
+            shutil.rmtree(
+                os.path.join(self.checkpoint_dir, f"step_{s}"),
+                ignore_errors=True,
+            )
+            for side in (f"step_{s}.layout.json", f"step_{s}.manifest.json"):
+                try:
+                    os.remove(os.path.join(self.checkpoint_dir, side))
+                except OSError:
+                    pass
 
     def saved_layout(self, step: int) -> dict | None:
         """The layout sidecar written alongside ``step_N``, or None
         (pre-round-5 checkpoints have none — callers must treat that as
-        "same layout as mine", the old behavior)."""
+        "same layout as mine", the old behavior). A present-but-corrupt
+        sidecar raises ValueError: silently taking the same-layout restore
+        path for (say) an async checkpoint would surface later as an
+        opaque orbax shape mismatch pointing nowhere near the cause."""
         if not self.checkpoint_dir:
             return None
+        path = os.path.join(self.checkpoint_dir, f"step_{step}.layout.json")
         try:
-            with open(
-                os.path.join(self.checkpoint_dir, f"step_{step}.layout.json")
-            ) as f:
-                return json.load(f)
-        except (OSError, ValueError):
-            return None
+            with open(path) as f:
+                raw = f.read()
+        except OSError:
+            return None  # missing sidecar: pre-round-5 checkpoint
+        try:
+            return json.loads(raw)
+        except ValueError as exc:
+            raise ValueError(
+                f"corrupt checkpoint layout sidecar {path}: {exc}"
+            ) from exc
 
     def restore_raw(self, step: int, abstract):
         """Restore ``step_N`` against an explicit abstract pytree (shapes/
@@ -117,23 +238,90 @@ class Supervisor:
             raise RuntimeError("no checkpointer (orbax unavailable or no dir)")
         path = os.path.join(self.checkpoint_dir, f"step_{step}")
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, abstract)
-        return self._ckptr.restore(path, abstract)
+        return self._retry(
+            lambda: self._ckptr.restore(path, abstract),
+            f"restore step_{step}",
+        )
 
-    def prepare_or_restore(self, state: TrainState) -> tuple[TrainState, int]:
+    def prepare_or_restore(
+        self, state: TrainState, *, verified_step: int | None = None
+    ) -> tuple[TrainState, int]:
         """Restore-or-init: the analog of ``prepare_or_wait_for_session``.
 
         Returns (state, start_step). With no checkpoint present, the passed-in
         freshly-initialized state is returned — every process computed the
         identical init from the shared seed, which is how "non-chief waits for
         chief's init" degenerates on a deterministic SPMD system.
-        """
-        step = self.latest_step()
-        if step is None or self._ckptr is None:
+
+        Durability (round 6): candidate steps are tried newest-first; a
+        step whose manifest fails file verification, whose orbax restore
+        raises, or whose restored leaves mismatch their recorded CRCs is
+        skipped (with a RuntimeWarning naming it) and the next-newest is
+        tried — a corrupt or partially written latest checkpoint costs
+        one epoch of progress, not the run. But when checkpoints EXIST
+        and every one of them fails, that is a systemic failure (storage
+        outage outliving the retry budget, format mismatch, a fallback
+        landing on an incompatible older layout) and it RAISES — silently
+        re-initializing at step 0 would discard the run's progress and
+        bury the cause. ``verified_step`` marks a step whose files the
+        caller already verified this session (trainers probe
+        ``newest_restorable_step`` first), skipping the redundant disk
+        re-read+CRC pass for it."""
+        if self._ckptr is None:
             return state, 0
-        path = os.path.join(self.checkpoint_dir, f"step_{step}")
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state)
-        restored = self._ckptr.restore(path, abstract)
-        return restored, step
+        candidates = list(reversed(checkpoint_steps(self.checkpoint_dir)))
+        for step in candidates:
+            if (
+                step != verified_step
+                and resilience.verify_files(self.checkpoint_dir, step) is False
+            ):
+                warnings.warn(
+                    f"checkpoint step_{step} fails manifest verification; "
+                    "trying the previous step",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                continue
+            path = os.path.join(self.checkpoint_dir, f"step_{step}")
+            abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state)
+            try:
+                restored = self._retry(
+                    lambda: self._ckptr.restore(path, abstract),
+                    f"restore step_{step}",
+                )
+            except Exception as exc:  # noqa: BLE001 — fall back per contract
+                warnings.warn(
+                    f"checkpoint step_{step} failed to restore "
+                    f"({type(exc).__name__}: {exc}); trying the previous step",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                continue
+            try:
+                manifest = resilience.load_manifest(self.checkpoint_dir, step)
+            except ValueError:
+                manifest = None
+            if manifest is not None and not resilience.verify_leaves(
+                restored, manifest
+            ):
+                warnings.warn(
+                    f"checkpoint step_{step} restored with leaf CRC "
+                    "mismatches; trying the previous step",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                continue
+            return restored, step
+        if candidates:
+            raise RuntimeError(
+                f"no restorable checkpoint in {self.checkpoint_dir}: all "
+                f"{len(candidates)} candidate step(s) "
+                f"({', '.join(f'step_{s}' for s in candidates)}) failed "
+                "verification or restore — see the RuntimeWarnings above; "
+                "refusing to silently re-initialize at step 0 over an "
+                "existing run's progress"
+            )
+        return state, 0
 
     # -- orderly shutdown (reference sv.request_stop/sv.stop) -------------
 

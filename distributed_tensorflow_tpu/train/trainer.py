@@ -74,12 +74,20 @@ class Trainer:
         self.supervisor = supervisor
         if self.supervisor is None and self.config.checkpoint_dir:
             self.supervisor = Supervisor(
-                is_chief=is_chief, checkpoint_dir=self.config.checkpoint_dir
+                is_chief=is_chief,
+                checkpoint_dir=self.config.checkpoint_dir,
+                keep_last_n=self.config.keep_last_n,
+                io_retries=self.config.checkpoint_retries,
+                io_backoff=self.config.checkpoint_retry_backoff,
             )
         self.start_step = 0
         if self.supervisor is not None:
             src = None
-            step = self.supervisor.latest_step()
+            # Newest step that is not known-corrupt (manifest-verified,
+            # train/resilience.py) — a truncated/flipped latest checkpoint
+            # must point the restore at the previous valid one, not at an
+            # opaque orbax failure.
+            step = self.supervisor.newest_restorable_step()
             if step is not None:
                 src = self.supervisor.saved_layout(step)
             if src is not None and not self._layout_compatible(src):
@@ -96,8 +104,12 @@ class Trainer:
                 )
                 self.start_step = step
             else:
+                # verified_step: the probe above already CRC-verified this
+                # step's files — skip the redundant disk re-read.
                 self.state, self.start_step = (
-                    self.supervisor.prepare_or_restore(self.state)
+                    self.supervisor.prepare_or_restore(
+                        self.state, verified_step=step
+                    )
                 )
 
         # Scanned-epoch fast path (config.scan_epoch): one dispatch per epoch.
@@ -147,6 +159,7 @@ class Trainer:
             self._scan_rng = _np.random.default_rng(self.config.seed)
 
         self.last_cost: jax.Array | None = None
+        self._epoch_costs = None  # per-step costs of the last scanned epoch
         self.history: list[dict] = []
         self._graph_written = False
         self._compiled_run_fns: dict = {}
@@ -191,16 +204,20 @@ class Trainer:
     def _canonicalize_from(self, state, src: dict):
         """Source-layout state → the canonical dense form (async merges
         its copies at the mean — its own effective_params — and sums the
-        per-chip step vector; sync layouts only need the step fold)."""
+        per-chip step vector; sync layouts only need the step fold).
+        Integer leaves (e.g. adam's int32 count) take replica 0's value
+        instead of mean-then-cast — the float mean is only exact below
+        2^24 (parallel/strategy.py::merge_replica_leaf)."""
         import jax.numpy as jnp
 
-        from distributed_tensorflow_tpu.parallel.strategy import TrainState
+        from distributed_tensorflow_tpu.parallel.strategy import (
+            TrainState,
+            merge_replica_leaf,
+        )
 
         step = jnp.asarray(jnp.sum(state.step), jnp.int32)
         if src.get("mode") == "async":
-            merge = lambda t: jax.tree.map(  # noqa: E731
-                lambda a: jnp.mean(a, axis=0).astype(a.dtype), t
-            )
+            merge = lambda t: jax.tree.map(merge_replica_leaf, t)  # noqa: E731
             return TrainState(merge(state.params), merge(state.opt_state), step)
         return TrainState(state.params, state.opt_state, step)
 
@@ -251,6 +268,7 @@ class Trainer:
         )
 
     def run_epoch(self, epoch: int, logger: StepLogger) -> None:
+        self._epoch_costs = None  # eager path: guard judges last_cost only
         if self._scanned_fn is not None or self._indexed_fn is not None:
             return self._run_epoch_scanned(epoch, logger)
         cfg = self.config
@@ -364,6 +382,7 @@ class Trainer:
         costs = jax.device_get(costs)
         elapsed = time.time() - t0
         self.last_cost = costs[-1]
+        self._epoch_costs = costs  # anomaly guard sees every step's cost
         batch_count = costs.shape[0]
         avg_ms = elapsed * 1000 / batch_count  # uniform: one dispatch ran them all
         self._emit_step_logs(
@@ -522,11 +541,24 @@ class Trainer:
                     }
                 )
         if self.supervisor is not None:
-            self.supervisor.save(
-                self.state,
-                self.strategy.global_step(self.state),
-                layout=self.strategy.layout_meta(),
-            )
+            import numpy as _np
+
+            if cfg.max_rollbacks and costs.size and not _np.isfinite(costs).all():
+                # A single compiled dispatch cannot roll back mid-program;
+                # the anomaly guard's durability half still holds — never
+                # commit a poisoned state over the last good checkpoint
+                # (the per-epoch run() path does the full restore+retry).
+                if self.is_chief:
+                    self.print_fn(
+                        "Rollback: kind=nan dispatch=compiled save=skipped "
+                        "(state not checkpointed; last good step kept)"
+                    )
+            else:
+                self.supervisor.save(
+                    self.state,
+                    self.strategy.global_step(self.state),
+                    layout=self.strategy.layout_meta(),
+                )
         final_cost = float(costs[-1, -1]) if costs.size else float("nan")
         if finalize and self.is_chief:
             logger.log_final(cost=final_cost)
@@ -546,7 +578,12 @@ class Trainer:
         dispatch, and ``should_stop`` is honored at chunk boundaries. The
         lifecycle surface of ``run()`` at near-``run_compiled`` throughput
         (docs/benchmarks/tpu_single.md, the ``single-k*`` rows)."""
+        import math
+
+        from distributed_tensorflow_tpu.train.resilience import AnomalyGuard
+
         k = self.config.epochs_per_dispatch
+        guard = AnomalyGuard.from_config(self.config)
         res = {
             "accuracy": 0.0,
             "final_cost": float("nan"),
@@ -556,7 +593,22 @@ class Trainer:
         while done < epochs:
             n = min(k, epochs - done)
             last = done + n >= epochs
+            step_before = self.strategy.global_step(self.state)
             res = self.run_compiled(n, epoch_offset=done, finalize=last)
+            if (
+                guard is not None
+                and not math.isfinite(res["final_cost"])
+                and res["global_step"] > step_before
+            ):
+                # A chunk went NaN mid-dispatch: run_compiled already
+                # skipped its save; this host boundary is where the
+                # restore can run — roll back and retry the chunk
+                # (NaN-only here: the spike baseline needs the per-epoch
+                # history the per-epoch run() path keeps). The
+                # global_step guard keeps an empty dispatch's nan
+                # placeholder from reading as an anomaly.
+                self._anomaly_rollback(guard, "nan", done)
+                continue
             done += n
             if self.supervisor is not None and self.supervisor.should_stop:
                 if not last and self.is_chief:
@@ -694,9 +746,63 @@ class Trainer:
         )
         self.summary_writer.add_graph(self.train_step, self.state, bx, by)
 
+    # -- resilience (round 6: train/resilience.py) ------------------------
+
+    def _anomaly_rollback(self, guard, kind: str, epoch: int) -> None:
+        """Restore the newest valid checkpoint after an anomalous epoch
+        (NaN/inf or spike) and leave the host data stream where it is —
+        the offending epoch's draws are consumed, never replayed, so the
+        retry trains on the NEXT data window (the PaLM spike protocol:
+        restore + skip the offending batches). With no checkpoint yet,
+        the rollback target is the deterministic seed re-init. Raises
+        AnomalyError once ``max_rollbacks`` is spent — training on a
+        poisoned state must be loud, never silent."""
+        from distributed_tensorflow_tpu.train.resilience import AnomalyError
+
+        detected_step = self.strategy.global_step(self.state)
+        if self.supervisor is None or guard.exhausted:
+            raise AnomalyError(
+                f"anomalous cost (kind={kind}) at epoch {epoch} step "
+                f"{detected_step} with no rollback budget left "
+                f"({guard.rollbacks}/{guard.max_rollbacks} used"
+                + ("" if self.supervisor else "; no supervisor") + ")"
+            )
+        guard.rollbacks += 1
+        fresh = self.strategy.init_state(
+            self.model, self.optimizer, self.config.seed
+        )
+        self.state, restored_step = self.supervisor.prepare_or_restore(fresh)
+        self.last_cost = None
+        if self.is_chief:
+            # Structured, greppable — same key=value shape as Preemption:.
+            self.print_fn(
+                f"Rollback: kind={kind} epoch={epoch} "
+                f"detected_step={detected_step} restored_step={restored_step} "
+                f"rollback={guard.rollbacks}/{guard.max_rollbacks} "
+                "data_window=skipped"
+            )
+            if self.summary_writer is not None:
+                self.summary_writer.add_scalar(
+                    "rollback", float(restored_step), detected_step
+                )
+
     # -- the loop ---------------------------------------------------------
 
     def run(self, epochs: int | None = None) -> dict:
+        """Public entry: the whole run under the preemption contract —
+        SIGTERM/SIGINT requests a stop, the loop exits at the next epoch
+        (or dispatch-chunk) boundary with a final save, and the process
+        can exit 0 (train/resilience.py)."""
+        from distributed_tensorflow_tpu.train.resilience import preemption_guard
+
+        with preemption_guard(
+            self.supervisor,
+            enabled=self.config.handle_preemption,
+            print_fn=self.print_fn,
+        ):
+            return self._run(epochs)
+
+    def _run(self, epochs: int | None = None) -> dict:
         cfg = self.config
         if cfg.compiled_run:
             return self.run_compiled(epochs)
@@ -709,15 +815,32 @@ class Trainer:
             self.write_graph()
             self._graph_written = True
         logger = StepLogger(freq=cfg.log_frequency, print_fn=self.print_fn)
+        from distributed_tensorflow_tpu.train.resilience import AnomalyGuard
+
+        guard = AnomalyGuard.from_config(cfg)
         accuracy = 0.0
-        for epoch in range(epochs):
-            if epoch == 0 and cfg.profile_dir:
+        epoch, profiled = 0, False
+        while epoch < epochs:
+            if epoch == 0 and cfg.profile_dir and not profiled:
                 from distributed_tensorflow_tpu.utils import profiler
 
+                profiled = True
                 with profiler.trace(cfg.profile_dir):
                     self.run_epoch(epoch, logger)
             else:
                 self.run_epoch(epoch, logger)
+            if guard is not None:
+                # Judge the epoch BEFORE eval/save: an anomalous state
+                # must neither reach the checkpoint directory nor count
+                # as a good epoch. Every process computes the identical
+                # verdict (deterministic costs), so multi-process runs
+                # branch together.
+                cost = self.strategy.cost_scalar(self.last_cost)
+                kind = guard.classify(cost, costs=self._epoch_costs)
+                if kind is not None:
+                    self._anomaly_rollback(guard, kind, epoch)
+                    continue  # retry this epoch index on the next window
+                guard.record(cost)
             # EVERY process runs the eval — it is a global-mesh computation
             # (sharded-param strategies gather over collectives), so a
             # chief-only dispatch would hang or die once non-chief
@@ -745,6 +868,7 @@ class Trainer:
                 )
                 if self.supervisor.should_stop:
                     break
+            epoch += 1
         final_cost = (
             self.strategy.cost_scalar(self.last_cost)
             if self.last_cost is not None

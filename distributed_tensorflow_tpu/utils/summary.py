@@ -300,10 +300,17 @@ class SummaryWriter:
         )
 
     def flush(self) -> None:
+        # fsync, not just flush: the resilience contract (docs/resilience.md)
+        # flushes at run end and after rollback/preemption events — those
+        # records must survive the process being killed right after.
         self._f.flush()
+        try:
+            os.fsync(self._f.fileno())
+        except OSError:  # pragma: no cover — exotic filesystems
+            pass
 
     def close(self) -> None:
-        self._f.flush()
+        self.flush()
         self._f.close()
 
     def __enter__(self) -> "SummaryWriter":

@@ -64,6 +64,22 @@ def pytest_configure(config):
     )
 
 
+# Round 6 (fast-tier hardening, VERDICT round 5): the warm-cache abort is
+# warm-LOADED multi-device executables preceding a FRESH multi-device
+# execution in one process. On a warm cache the only fresh compiles are
+# the modules that opt OUT of the persistent cache (their autouse
+# fixtures: distinct mesh-mode scan programs trigger the jaxlib 0.9.0
+# AOT cache-LOAD AllReduce abort) — so round 5's full fast tier died
+# inside test_lm_trainer at ~230 warm-loaded tests in. Running the
+# opted-out modules FIRST removes the warm preamble from in front of
+# every fresh multi-device execution; module-level opt-out + front
+# placement together make the fast tier deterministic-green while the
+# rest keeps the ~9x warm-compile win. (RUN_SLOW runs with the cache off
+# entirely — all-fresh compiles have never aborted — so order is
+# irrelevant there.)
+_CACHE_OPT_OUT_FIRST = ("test_lm_trainer.py", "test_cross_topology_restore.py")
+
+
 def pytest_collection_modifyitems(config, items):
     if os.environ.get("RUN_SLOW"):
         return
@@ -71,6 +87,17 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "heavy" in item.keywords:
             item.add_marker(skip)
+    if not os.environ.get("JAX_TEST_NO_CACHE"):
+        front = [
+            i for i in items if i.fspath.basename in _CACHE_OPT_OUT_FIRST
+        ]
+        if front:
+            rest = [
+                i
+                for i in items
+                if i.fspath.basename not in _CACHE_OPT_OUT_FIRST
+            ]
+            items[:] = front + rest
 
 
 @pytest.fixture(scope="session")
