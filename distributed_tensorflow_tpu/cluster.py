@@ -74,6 +74,18 @@ class ProcessContext:
     def should_exit(self) -> bool:
         return self.is_ps
 
+    def report_progress(self, progress: int) -> None:
+        """Advance the monotonic progress counter carried by this process's
+        heartbeats (trainers call this at epoch boundaries with the global
+        step — train/supervisor.py::report_progress). The counter is what
+        lets the detector tell *stalled* from *dead*: a rank hung in a
+        collective keeps beating from its native sender thread, but its
+        counter freezes. No-op when no sender is armed."""
+        for h in (self.heartbeat_sender, self.heartbeat):
+            if h is not None and hasattr(h, "set_progress"):
+                h.set_progress(progress)
+                return
+
     def close(self) -> None:
         """Stop the native heartbeat threads (coordinator or sender, plus
         the chief's loopback sender). Idempotent; without this a library
@@ -84,6 +96,98 @@ class ProcessContext:
                 h.stop()
 
 
+class BootstrapError(RuntimeError):
+    """jax.distributed.initialize failed every bounded attempt."""
+
+
+def bounded_initialize(
+    cluster: ClusterConfig,
+    task_index: int,
+    *,
+    timeout_s: int | None = None,
+    attempts: int | None = None,
+    backoff: float = 1.0,
+    initialize_fn=None,
+    shutdown_fn=None,
+    sleep=None,
+    print_fn=print,
+) -> None:
+    """``jax.distributed.initialize`` under a bounded timeout + bounded
+    retry-with-backoff (resilience.retry, jittered so a restarting gang's
+    rendezvous attempts de-synchronize).
+
+    The raw call blocks until ``initialization_timeout`` (default 300 s)
+    and then dies; a gang relaunched by the elastic agent
+    (train/elastic.py) routinely comes up BEFORE its task-0 coordinator
+    process does, so the first attempt timing out must cost a retried,
+    clearly-logged attempt — not an indefinite hang or an opaque one-shot
+    failure. Raises :class:`BootstrapError` naming the coordinator and the
+    attempt budget when every attempt fails."""
+    import time as _time
+
+    from distributed_tensorflow_tpu.train import resilience
+
+    timeout_s = cluster.connect_timeout_s if timeout_s is None else timeout_s
+    attempts = cluster.connect_attempts if attempts is None else attempts
+    if initialize_fn is None:
+        initialize_fn = jax.distributed.initialize
+        if shutdown_fn is None:
+            shutdown_fn = jax.distributed.shutdown
+
+    def _attempt():
+        initialize_fn(
+            coordinator_address=cluster.coordinator_address,
+            num_processes=cluster.num_processes,
+            process_id=task_index,
+            initialization_timeout=int(timeout_s),
+        )
+
+    def _on_retry(exc, attempt, delay):
+        # jax assigns its global distributed client BEFORE connect(), so a
+        # timed-out attempt leaves half-initialized state behind and the
+        # bare retry would die instantly with "initialize should only be
+        # called once" — tear it down first so the retry is real.
+        if shutdown_fn is not None:
+            try:
+                shutdown_fn()
+            except Exception:  # noqa: BLE001 — half-initialized teardown
+                pass
+        print_fn(
+            f"bootstrap: jax.distributed.initialize attempt {attempt + 1}/"
+            f"{attempts} failed ({type(exc).__name__}: {exc}); retrying in "
+            f"{delay:.1f}s"
+        )
+
+    try:
+        resilience.retry(
+            _attempt,
+            attempts=max(1, attempts),
+            backoff=backoff,
+            jitter=0.25,
+            retry_on=(RuntimeError, TimeoutError, OSError),
+            describe="jax.distributed.initialize",
+            on_retry=_on_retry,
+            sleep=sleep or _time.sleep,
+        )
+    except (RuntimeError, TimeoutError, OSError) as exc:
+        # Tear down after the FINAL failure too: a caller that catches
+        # BootstrapError and retries bootstrap later in the same process
+        # must not inherit the half-initialized global client (its first
+        # fresh attempt would die with "initialize should only be called
+        # once" and burn budget on a misleading error).
+        if shutdown_fn is not None:
+            try:
+                shutdown_fn()
+            except Exception:  # noqa: BLE001 — half-initialized teardown
+                pass
+        raise BootstrapError(
+            f"jax.distributed.initialize to {cluster.coordinator_address} "
+            f"(process {task_index}/{cluster.num_processes}) failed after "
+            f"{attempts} attempt(s) of {timeout_s}s each: "
+            f"{type(exc).__name__}: {exc}"
+        ) from exc
+
+
 def bootstrap(
     cluster: ClusterConfig,
     job_name: str = "worker",
@@ -91,21 +195,28 @@ def bootstrap(
     *,
     initialize_distributed: bool | None = None,
     heartbeat_port: int | None = None,
-    heartbeat_timeout_ms: int = 10_000,
+    heartbeat_timeout_ms: int | None = None,
+    heartbeat_host: str | None = None,
     print_fn=print,
 ) -> ProcessContext:
     """Resolve this process's role; join the multi-host group if one exists.
 
     The reference's ``Server`` + ``ClusterSpec`` bootstrap becomes
     ``jax.distributed.initialize(coordinator, num_processes, process_id)``
-    when ``worker_svrs`` lists more than one host (multi-host DCN group);
-    single-process runs skip initialization entirely.
+    when ``worker_svrs`` lists more than one host (multi-host DCN group) —
+    under a bounded timeout + retry (:func:`bounded_initialize`), so a
+    restarting gang whose coordinator isn't up yet gets a retried, loud
+    error instead of an indefinite hang; single-process runs skip
+    initialization entirely.
 
-    ``heartbeat_port`` (optional) arms the native failure detector
-    (runtime/csrc): the chief runs a UDP heartbeat coordinator, non-chiefs a
-    sender — explicit worker-liveness tracking the reference never had
-    (SURVEY.md §5 "Failure detection"). Requires the C++ runtime; silently
-    skipped when unavailable.
+    ``heartbeat_port`` (optional; defaults from ``cluster.heartbeat_port``)
+    arms the native failure detector (runtime/csrc): the chief runs a UDP
+    heartbeat coordinator, non-chiefs a sender — explicit worker-liveness
+    tracking the reference never had (SURVEY.md §5 "Failure detection").
+    With ``heartbeat_host`` set (elastic mode, train/elastic.py) the
+    detector is hosted THERE — out-of-band of the job, by the supervising
+    agent — and every task including the chief is a plain sender to it.
+    Requires the C++ runtime; silently skipped when unavailable.
     """
     if job_name == "ps":
         # Reference: print("ps setting up ...") then server.join() forever
@@ -125,21 +236,43 @@ def bootstrap(
 
     print_fn("worker setting up ...")
     n = cluster.num_processes
+    if heartbeat_port is None:
+        heartbeat_port = cluster.heartbeat_port
+    if heartbeat_timeout_ms is None:
+        heartbeat_timeout_ms = cluster.heartbeat_timeout_ms
+    if heartbeat_host is None:
+        heartbeat_host = cluster.heartbeat_host
     if initialize_distributed is None:
         initialize_distributed = n > 1
     if initialize_distributed and n > 1:
-        jax.distributed.initialize(
-            coordinator_address=cluster.coordinator_address,
-            num_processes=n,
-            process_id=task_index,
-        )
+        bounded_initialize(cluster, task_index, print_fn=print_fn)
     heartbeat = None
     heartbeat_sender = None
-    if heartbeat_port is not None and n > 1:
+    if heartbeat_port is not None and (n > 1 or heartbeat_host is not None):
+        # Beat interval scaled to the silence window: at the old fixed
+        # 1000 ms a tight timeout (say 1200 ms) left a 200 ms margin and a
+        # loaded host's scheduling jitter read as death (cost a debugging
+        # cycle in this round's e2e). >=5 beats per window keeps one
+        # dropped datagram + jitter from ever looking like silence — for
+        # timeouts >= 500 ms; below that the 100 ms interval floor wins
+        # and the margin thins again (sub-500 ms windows are test
+        # configs, not production settings).
+        interval_ms = min(1000, max(100, heartbeat_timeout_ms // 5))
         try:
             from distributed_tensorflow_tpu.runtime import native
 
-            if cluster.is_chief(task_index):
+            if heartbeat_host is not None:
+                # Elastic mode: the supervising agent (train/elastic.py)
+                # hosts the detector out-of-band; every task — chief
+                # included — is a plain sender to it. No in-job coordinator:
+                # recovery is the agent's job, not the chief's.
+                heartbeat = native.HeartbeatWorker(
+                    heartbeat_host,
+                    heartbeat_port,
+                    worker_id=task_index,
+                    interval_ms=interval_ms,
+                )
+            elif cluster.is_chief(task_index):
                 heartbeat = native.HeartbeatCoordinator(
                     heartbeat_port, expected_workers=n, timeout_ms=heartbeat_timeout_ms
                 )
@@ -151,7 +284,10 @@ def bootstrap(
                 # abort a healthy run.
                 try:
                     heartbeat_sender = native.HeartbeatWorker(
-                        "127.0.0.1", heartbeat_port, worker_id=task_index
+                        "127.0.0.1",
+                        heartbeat_port,
+                        worker_id=task_index,
+                        interval_ms=interval_ms,
                     )
                 except (ImportError, OSError):
                     heartbeat.stop()
@@ -160,7 +296,10 @@ def bootstrap(
             else:
                 host = cluster.coordinator_address.rsplit(":", 1)[0]
                 heartbeat = native.HeartbeatWorker(
-                    host, heartbeat_port, worker_id=task_index
+                    host,
+                    heartbeat_port,
+                    worker_id=task_index,
+                    interval_ms=interval_ms,
                 )
         except (ImportError, OSError) as e:  # degrade to no liveness tracking
             print_fn(f"heartbeat disabled: {e}")

@@ -39,6 +39,25 @@ class ClusterConfig:
 
     worker_svrs: tuple[str, ...] = ()
     ps_svrs: tuple[str, ...] = ()  # accepted, ignored (no PS role on TPU)
+    # -- failure detection (round 7: cluster-level so launch.run(cluster)
+    # arms it without the caller pre-building a ProcessContext) ----------
+    # UDP port of the native heartbeat detector (runtime/csrc). None
+    # disables. By default the chief hosts the coordinator; when
+    # heartbeat_host is set, the detector lives THERE instead (an elastic
+    # agent out-of-band of the job — train/elastic.py) and every task,
+    # chief included, is a plain sender to it.
+    heartbeat_port: int | None = None
+    heartbeat_timeout_ms: int = 10_000
+    heartbeat_host: str | None = None
+    # Bounded jax.distributed.initialize (cluster.bounded_initialize): a
+    # restarting gang whose coordinator isn't up yet gets timeout + retry
+    # with backoff instead of an indefinite hang. The per-attempt window
+    # deliberately matches jax's own initialization_timeout default
+    # (300 s): a slow-assembling pod that worked under the raw call keeps
+    # working; tighten it for fast local gangs where 300 s per attempt is
+    # an eternity.
+    connect_timeout_s: int = 300
+    connect_attempts: int = 3
 
     @property
     def num_processes(self) -> int:
@@ -130,6 +149,21 @@ class TrainConfig:
     max_rollbacks: int = 0
     anomaly_window: int = 8
     spike_threshold: float = 3.0
+    # Elastic gang-restart budget (train/elastic.py): how many times the
+    # supervising agent may kill + rendezvous + relaunch the gang after a
+    # worker dies or stalls, with exponential backoff between attempts.
+    # 0 (default) preserves fail-stop: the first failure ends the job.
+    # Consumed OUTSIDE the trainer (the agent supervises the process): the
+    # elastic driver reads it via DTF_MAX_RESTARTS (tools/launch_local's
+    # --max-restarts default); this knob keeps config_from_env's surface
+    # the single source of truth for config-driven deployments.
+    max_restarts: int = 0
+    # A worker whose heartbeat keeps arriving but whose progress counter
+    # has not moved for this long is classified STALLED and recovered the
+    # same way as a dead one (a rank hung in a collective beats forever —
+    # silence timeouts alone never fire). 0 disables stall detection.
+    # Size it above the worst-case epoch + first-compile latency.
+    stall_timeout_ms: int = 0
     sync: bool = True  # sync DP (pmean all-reduce) vs async emulation
     async_avg_every: int = 0  # async mode: average params every N steps (0 = never)
     # Sync parameter layout: "replicated" (params on every chip, gradient
@@ -211,6 +245,14 @@ class TrainConfig:
         if self.anomaly_window < 1:
             raise ValueError(
                 f"anomaly_window must be >= 1, got {self.anomaly_window}"
+            )
+        if self.max_restarts < 0:
+            raise ValueError(
+                f"max_restarts must be >= 0 (0 = fail-stop), got {self.max_restarts}"
+            )
+        if self.stall_timeout_ms < 0:
+            raise ValueError(
+                f"stall_timeout_ms must be >= 0 (0 disables), got {self.stall_timeout_ms}"
             )
 
     def replace(self, **kw) -> "TrainConfig":

@@ -44,7 +44,9 @@ def config_from_env(base: TrainConfig | None = None) -> TrainConfig:
     resilience knobs (train/resilience.py): DTF_CHECKPOINT (checkpoint
     dir — what a pod scheduler sets so a preempted run can resume),
     DTF_KEEP_LAST (checkpoint retention), DTF_MAX_ROLLBACKS (anomaly
-    guard budget)."""
+    guard budget), and the elastic knobs (train/elastic.py):
+    DTF_MAX_RESTARTS (gang-restart budget), DTF_STALL_TIMEOUT_MS
+    (live-but-stalled detection window)."""
     import os
 
     cfg = base or TrainConfig()
@@ -55,6 +57,10 @@ def config_from_env(base: TrainConfig | None = None) -> TrainConfig:
         kw["keep_last_n"] = int(os.environ["DTF_KEEP_LAST"]) or None
     if "DTF_MAX_ROLLBACKS" in os.environ:
         kw["max_rollbacks"] = int(os.environ["DTF_MAX_ROLLBACKS"])
+    if "DTF_MAX_RESTARTS" in os.environ:
+        kw["max_restarts"] = int(os.environ["DTF_MAX_RESTARTS"])
+    if "DTF_STALL_TIMEOUT_MS" in os.environ:
+        kw["stall_timeout_ms"] = int(os.environ["DTF_STALL_TIMEOUT_MS"])
     if "DTF_MODEL" in os.environ:
         kw["model"] = os.environ["DTF_MODEL"]
     if "DTF_EPOCHS" in os.environ:
@@ -70,6 +76,31 @@ def config_from_env(base: TrainConfig | None = None) -> TrainConfig:
     if "DTF_LOGS" in os.environ:
         kw["logs_path"] = os.environ["DTF_LOGS"]
     return cfg.replace(**kw) if kw else cfg
+
+
+def cluster_from_env(base: ClusterConfig | None = None) -> ClusterConfig:
+    """Apply environment overrides to a ClusterConfig — the detector half
+    of the pod-scheduler surface (the trainer half is
+    :func:`config_from_env`). Recognized: DTF_HEARTBEAT_PORT (UDP failure
+    detector port; empty/0 disables), DTF_HEARTBEAT_TIMEOUT_MS (silence
+    window), DTF_HEARTBEAT_HOST (set by an elastic agent —
+    train/elastic.py — that hosts the detector out-of-band; every task
+    then sends beats there instead of the chief hosting). ``launch.run``
+    applies this, so a scheduler arms failure detection without code
+    changes, mirroring DTF_CHECKPOINT/DTF_MAX_ROLLBACKS."""
+    import dataclasses
+    import os
+
+    cluster = base or ClusterConfig()
+    kw = {}
+    if "DTF_HEARTBEAT_PORT" in os.environ:
+        raw = os.environ["DTF_HEARTBEAT_PORT"]
+        kw["heartbeat_port"] = int(raw) if raw and int(raw) else None
+    if "DTF_HEARTBEAT_TIMEOUT_MS" in os.environ:
+        kw["heartbeat_timeout_ms"] = int(os.environ["DTF_HEARTBEAT_TIMEOUT_MS"])
+    if "DTF_HEARTBEAT_HOST" in os.environ:
+        kw["heartbeat_host"] = os.environ["DTF_HEARTBEAT_HOST"] or None
+    return dataclasses.replace(cluster, **kw) if kw else cluster
 
 
 def build_strategy(config: TrainConfig, *, devices=None, mesh=None):
@@ -209,13 +240,33 @@ def build_trainer(
     )
     # Failure-reactive stop: a chief with an armed heartbeat coordinator
     # (cluster.bootstrap(heartbeat_port=...)) stops cleanly when a worker
-    # dies instead of hanging in a collective (train/supervisor.py).
-    if context is not None and context.heartbeat is not None and is_chief:
-        if trainer.supervisor is None:
-            from distributed_tensorflow_tpu.train import Supervisor
+    # dies — or, with stall_timeout_ms set, stalls — instead of hanging in
+    # a collective (train/supervisor.py). In elastic mode
+    # (heartbeat_host set) the detector lives in the agent and
+    # context.heartbeat is a plain SENDER even on the chief — nothing to
+    # attach, hence the coordinator-shape check.
+    if context is not None:
+        has_coordinator = context.heartbeat is not None and hasattr(
+            context.heartbeat, "failed_count"
+        )
+        has_sender = any(
+            h is not None and hasattr(h, "set_progress")
+            for h in (context.heartbeat_sender, context.heartbeat)
+        )
+        if (has_coordinator and is_chief) or has_sender:
+            if trainer.supervisor is None:
+                from distributed_tensorflow_tpu.train import Supervisor
 
-            trainer.supervisor = Supervisor(is_chief=True)
-        trainer.supervisor.attach_heartbeat(context.heartbeat)
+                trainer.supervisor = Supervisor(is_chief=is_chief)
+            if has_coordinator and is_chief:
+                trainer.supervisor.attach_heartbeat(
+                    context.heartbeat,
+                    stall_timeout_ms=config.stall_timeout_ms,
+                )
+            if has_sender:
+                # Progress-aware health: the trainer bumps the counter at
+                # epoch boundaries; the beats carry it to the detector.
+                trainer.supervisor.attach_progress(context.report_progress)
     return trainer
 
 
@@ -229,7 +280,11 @@ def run(
     metrics dict (or None for a ps no-op process)."""
     from distributed_tensorflow_tpu.cluster import bootstrap_from_argv
 
-    cluster = cluster or ClusterConfig()
+    # Env overrides (pod-scheduler surface): heartbeat/elastic knobs ride
+    # DTF_* like the resilience knobs; bootstrap_from_argv then threads the
+    # cluster-level heartbeat settings into bootstrap, so the documented
+    # launch.run(cluster) entry gets failure detection too.
+    cluster = cluster_from_env(cluster or ClusterConfig())
     ctx = bootstrap_from_argv(cluster, argv)
     if ctx.should_exit:
         return None

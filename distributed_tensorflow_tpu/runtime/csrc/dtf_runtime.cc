@@ -160,6 +160,12 @@ struct Coordinator {
   std::atomic<bool> stop{false};
   std::mutex mu;
   std::vector<int64_t> last_seen;  // 0 = never
+  // Progress-aware health (elastic layer, train/elastic.py): the payload's
+  // monotonic counter distinguishes DEAD (beats stopped) from LIVE-BUT-
+  // STALLED (the native sender thread keeps beating while the main thread
+  // hangs in a collective — the silence timeout alone can never see that).
+  std::vector<long> progress;          // last reported value; -1 = never
+  std::vector<int64_t> progress_ms;    // when it last CHANGED; 0 = never
 
   void loop() {
     char buf[64];
@@ -170,9 +176,17 @@ struct Coordinator {
       if (r > 0) {
         buf[r] = 0;
         int id = -1;
-        if (std::sscanf(buf, "HB %d", &id) == 1 && id >= 0 && id < expected) {
+        long p = 0;
+        // "HB <id> <progress>" (round 7) or the bare "HB <id>" payload
+        // older senders emit — both keep counting as beats.
+        int n = std::sscanf(buf, "HB %d %ld", &id, &p);
+        if (n >= 1 && id >= 0 && id < expected) {
           std::lock_guard<std::mutex> lock(mu);
           last_seen[(size_t)id] = now_ms();
+          if (n == 2 && p != progress[(size_t)id]) {
+            progress[(size_t)id] = p;
+            progress_ms[(size_t)id] = now_ms();
+          }
         }
       }
     }
@@ -195,6 +209,8 @@ void* dtf_coord_start2(int port, int expected_workers, int timeout_ms,
   c->grace_ms = grace_ms;
   c->start_ms = now_ms();
   c->last_seen.assign((size_t)expected_workers, 0);
+  c->progress.assign((size_t)expected_workers, -1);
+  c->progress_ms.assign((size_t)expected_workers, 0);
   c->fd = socket(AF_INET, SOCK_DGRAM, 0);
   if (c->fd < 0) {
     delete c;
@@ -253,6 +269,44 @@ long dtf_coord_ms_since_seen(void* h, int id) {
   return (long)(now_ms() - c->last_seen[(size_t)id]);
 }
 
+// Last progress value reported by worker `id`; -1 if it never reported one
+// (dead, not yet up, or a pre-progress sender).
+long dtf_coord_progress(void* h, int id) {
+  auto* c = (Coordinator*)h;
+  std::lock_guard<std::mutex> lock(c->mu);
+  if (id < 0 || id >= c->expected) return -1;
+  return c->progress[(size_t)id];
+}
+
+// Milliseconds since worker `id`'s progress counter last CHANGED (the first
+// report counts as a change); -1 if it never reported progress.
+long dtf_coord_ms_since_progress(void* h, int id) {
+  auto* c = (Coordinator*)h;
+  std::lock_guard<std::mutex> lock(c->mu);
+  if (id < 0 || id >= c->expected || c->progress_ms[(size_t)id] == 0) return -1;
+  return (long)(now_ms() - c->progress_ms[(size_t)id]);
+}
+
+// Workers that are ALIVE (beating within timeout_ms) but whose progress
+// counter has not moved for more than `stall_ms` — the live-but-stalled
+// class the elastic agent recovers from (a rank hung in a collective keeps
+// its sender thread beating forever; without this the job hangs). Workers
+// that never reported progress are not counted: a pre-progress sender must
+// not read as stalled, and startup (import + compile) is covered by sizing
+// stall_ms above the worst-case first-epoch latency.
+int dtf_coord_stalled_count(void* h, long stall_ms) {
+  auto* c = (Coordinator*)h;
+  int64_t now = now_ms();
+  std::lock_guard<std::mutex> lock(c->mu);
+  int stalled = 0;
+  for (size_t i = 0; i < c->last_seen.size(); ++i) {
+    bool alive = c->last_seen[i] != 0 && now - c->last_seen[i] <= c->timeout_ms;
+    if (alive && c->progress_ms[i] != 0 && now - c->progress_ms[i] > stall_ms)
+      ++stalled;
+  }
+  return stalled;
+}
+
 void dtf_coord_stop(void* h) {
   auto* c = (Coordinator*)h;
   c->stop.store(true);
@@ -268,11 +322,23 @@ struct Worker {
   int interval_ms = 0;
   std::thread thread;
   std::atomic<bool> stop{false};
+  // Monotonic progress counter included in beats once set from Python
+  // (epoch boundaries, train/supervisor.py::report_progress). Read by the
+  // sender thread — atomic, never locked, so a hung interpreter cannot
+  // block the beat (which is the whole point: beats survive a stall).
+  // Starts at the -1 sentinel: until the first set_progress the payload
+  // stays the bare "HB <id>", so the coordinator's never-reported-progress
+  // carve-out really does cover startup (import + first compile) — a
+  // counter sent as 0 from beat one would start the stall clock at
+  // bootstrap and verdict every slow-compiling incarnation "stalled".
+  std::atomic<long> progress{-1};
 
   void loop() {
-    char msg[32];
-    int len = std::snprintf(msg, sizeof(msg), "HB %d", id);
+    char msg[48];
     while (!stop.load()) {
+      long p = progress.load(std::memory_order_relaxed);
+      int len = p < 0 ? std::snprintf(msg, sizeof(msg), "HB %d", id)
+                      : std::snprintf(msg, sizeof(msg), "HB %d %ld", id, p);
       sendto(fd, msg, (size_t)len, 0, (sockaddr*)&addr, sizeof(addr));
       std::this_thread::sleep_for(std::chrono::milliseconds(interval_ms));
     }
@@ -300,6 +366,12 @@ void* dtf_worker_start(const char* host, int port, int worker_id,
   }
   w->thread = std::thread([w] { w->loop(); });
   return w;
+}
+
+// Advance the monotonic progress counter carried by this worker's beats.
+void dtf_worker_set_progress(void* h, long p) {
+  auto* w = (Worker*)h;
+  w->progress.store(p, std::memory_order_relaxed);
 }
 
 void dtf_worker_stop(void* h) {

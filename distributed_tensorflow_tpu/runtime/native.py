@@ -122,6 +122,12 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.dtf_coord_failed_count.argtypes = [ctypes.c_void_p]
     lib.dtf_coord_ms_since_seen.restype = ctypes.c_long
     lib.dtf_coord_ms_since_seen.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.dtf_coord_progress.restype = ctypes.c_long
+    lib.dtf_coord_progress.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.dtf_coord_ms_since_progress.restype = ctypes.c_long
+    lib.dtf_coord_ms_since_progress.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.dtf_coord_stalled_count.restype = ctypes.c_int
+    lib.dtf_coord_stalled_count.argtypes = [ctypes.c_void_p, ctypes.c_long]
     lib.dtf_coord_stop.restype = None
     lib.dtf_coord_stop.argtypes = [ctypes.c_void_p]
     lib.dtf_worker_start.restype = ctypes.c_void_p
@@ -131,6 +137,8 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.c_int,
         ctypes.c_int,
     ]
+    lib.dtf_worker_set_progress.restype = None
+    lib.dtf_worker_set_progress.argtypes = [ctypes.c_void_p, ctypes.c_long]
     lib.dtf_worker_stop.restype = None
     lib.dtf_worker_stop.argtypes = [ctypes.c_void_p]
     lib.dtf_crc32c.restype = ctypes.c_uint32
@@ -343,6 +351,24 @@ class HeartbeatCoordinator:
     def ms_since_seen(self, worker_id: int) -> int:
         return self._lib.dtf_coord_ms_since_seen(self._h, worker_id)
 
+    def progress(self, worker_id: int) -> int:
+        """Last progress-counter value in ``worker_id``'s beats; -1 if it
+        never reported one (round 7: the payload is ``HB <id> <progress>``,
+        bumped by trainers at epoch boundaries)."""
+        return self._lib.dtf_coord_progress(self._h, worker_id)
+
+    def ms_since_progress(self, worker_id: int) -> int:
+        """Milliseconds since ``worker_id``'s progress counter last changed
+        (first report counts); -1 if it never reported progress."""
+        return self._lib.dtf_coord_ms_since_progress(self._h, worker_id)
+
+    def stalled_count(self, stall_timeout_ms: int) -> int:
+        """Workers ALIVE (beating within timeout) whose progress counter has
+        not moved for more than ``stall_timeout_ms`` — the live-but-stalled
+        class (a rank hung in a collective keeps beating; only the progress
+        payload can expose it). Never-progressed workers are not counted."""
+        return self._lib.dtf_coord_stalled_count(self._h, stall_timeout_ms)
+
     def stop(self) -> None:
         if self._h:
             self._lib.dtf_coord_stop(self._h)
@@ -356,13 +382,25 @@ class HeartbeatCoordinator:
 
 
 class HeartbeatWorker:
-    """Worker-side heartbeat sender."""
+    """Worker-side heartbeat sender. Every beat carries the monotonic
+    progress counter last handed to :meth:`set_progress` — the sender runs
+    on a native thread, so beats (and the frozen counter) keep flowing even
+    while the Python main thread hangs in a collective, which is exactly
+    what lets the coordinator tell *stalled* from *dead*."""
 
     def __init__(self, host: str, port: int, worker_id: int, interval_ms: int = 1000):
         self._lib = load_library()
         self._h = self._lib.dtf_worker_start(host.encode(), port, worker_id, interval_ms)
         if not self._h:
             raise OSError(f"failed to start heartbeat worker to {host}:{port}")
+
+    def set_progress(self, progress: int) -> None:
+        """Advance the monotonic progress counter carried by each beat
+        (trainers call this at epoch boundaries with the global step).
+        Until the first call, beats carry NO counter — the detector's
+        never-reported-progress carve-out covers startup import/compile."""
+        if self._h:
+            self._lib.dtf_worker_set_progress(self._h, max(0, int(progress)))
 
     def stop(self) -> None:
         if self._h:

@@ -1,4 +1,5 @@
-"""Local cluster launcher — the reference's nohup-per-task workflow, automated.
+"""Local cluster launcher — the reference's nohup-per-task workflow, automated
+and (round 7) supervised.
 
 The reference ran every topology by hand-launching one process per task::
 
@@ -15,6 +16,20 @@ same thing in one command, against any script that accepts the standard
 One OS process per task, stdout/stderr redirected to ``<logdir>/<role><i>.log``
 exactly like the nohup recipe, non-zero exit if any worker fails. ps tasks
 are launched too (they no-op and exit, preserving launcher compatibility).
+
+``--max-restarts N`` (round 7) upgrades the one-shot spawner into the
+elastic agent's driver (train/elastic.py): each worker gets a supervising
+:class:`ElasticAgent`; a member that exits non-zero — or, with
+``--heartbeat-port``, goes heartbeat-dead or live-but-stalled past
+``--stall-timeout-ms`` — triggers a GANG restart: every worker is killed
+and relaunched after a jittered exponential backoff, at most N times, with
+a structured ``Restart:`` line and a ``restart`` tfevents scalar per event.
+Relaunched workers re-bootstrap ``jax.distributed`` (bounded retried
+initialize, ``cluster.bounded_initialize``) and resume from the newest
+valid checkpoint — arm ``DTF_CHECKPOINT`` so there is something to resume.
+The driver hosts the heartbeat detector itself (out-of-band of the job)
+and points the workers at it via ``DTF_HEARTBEAT_HOST``/``_PORT``;
+``max_restarts=0`` (default) preserves the old fail-stop behavior exactly.
 """
 
 from __future__ import annotations
@@ -25,6 +40,126 @@ import subprocess
 import sys
 
 
+def _spawn_task(
+    command: list[str],
+    role: str,
+    index: int,
+    logdir: str,
+    env: dict,
+    mode: str = "wb",
+):
+    """One task process, stdout+stderr to ``<logdir>/<role><i>.log``. The
+    first incarnation truncates (the pre-round-7 behavior, unchanged); a
+    gang RELAUNCH passes ``mode="ab"`` so the restarted incarnation's log
+    continues the same file instead of erasing the failure it is
+    recovering from."""
+    log_path = os.path.join(logdir, f"{role}{index}.log")
+    f = open(log_path, mode)
+    try:
+        return subprocess.Popen(
+            command + [f"--job_name={role}", f"--task_index={index}"],
+            stdout=f,
+            stderr=subprocess.STDOUT,
+            env=env,
+        )
+    finally:
+        # Popen inherited the descriptor; closing ours leaks nothing and a
+        # relaunch reopens fresh (no shared offsets across incarnations).
+        f.close()
+
+
+def _launch_elastic(
+    command: list[str],
+    num_workers: int,
+    logdir: str,
+    base_env: dict,
+    *,
+    max_restarts: int,
+    heartbeat_port: int | None,
+    heartbeat_timeout_ms: int,
+    heartbeat_grace_ms: int | None,
+    stall_timeout_ms: int,
+    backoff: float,
+    poll_interval: float,
+    print_fn=print,
+) -> int:
+    from distributed_tensorflow_tpu.train.elastic import (
+        ElasticAgent,
+        ElasticGang,
+        HeartbeatHealth,
+    )
+
+    env = dict(base_env)
+    health_factory = None
+    summary_writer = None
+    if heartbeat_port:
+        # The driver hosts the detector (out-of-band of the job); workers
+        # learn where to beat from the env, chief included
+        # (cluster.bootstrap heartbeat_host mode).
+        env["DTF_HEARTBEAT_HOST"] = "127.0.0.1"
+        env["DTF_HEARTBEAT_PORT"] = str(heartbeat_port)
+        env["DTF_HEARTBEAT_TIMEOUT_MS"] = str(heartbeat_timeout_ms)
+        try:
+            from distributed_tensorflow_tpu.runtime import native
+
+            native.load_library()
+
+            def health_factory():
+                return HeartbeatHealth(
+                    heartbeat_port,
+                    num_workers,
+                    timeout_ms=heartbeat_timeout_ms,
+                    stall_timeout_ms=stall_timeout_ms,
+                    grace_ms=heartbeat_grace_ms,
+                )
+
+        except (ImportError, OSError) as exc:
+            # Same degrade set as cluster.bootstrap: a corrupt/wrong-arch
+            # .so raises OSError from ctypes, not ImportError.
+            print_fn(
+                f"elastic: heartbeat detector unavailable ({exc}); "
+                "supervising exit codes only"
+            )
+            env.pop("DTF_HEARTBEAT_HOST")
+            env.pop("DTF_HEARTBEAT_PORT")
+            env.pop("DTF_HEARTBEAT_TIMEOUT_MS")
+    try:
+        from distributed_tensorflow_tpu.utils.summary import SummaryWriter
+
+        summary_writer = SummaryWriter(logdir, filename_suffix=".elastic")
+    except OSError:  # pragma: no cover — unwritable logdir already raised
+        summary_writer = None
+
+    launched: set[int] = set()
+
+    def _make_spawn(i: int):
+        def _spawn():
+            mode = "ab" if i in launched else "wb"
+            launched.add(i)
+            return _spawn_task(command, "worker", i, logdir, env, mode=mode)
+
+        return _spawn
+
+    agents = [
+        ElasticAgent(f"worker{i}", _make_spawn(i), worker_id=i)
+        for i in range(num_workers)
+    ]
+    gang = ElasticGang(
+        agents,
+        max_restarts=max_restarts,
+        backoff=backoff,
+        health_factory=health_factory,
+        poll_interval=poll_interval,
+        print_fn=print_fn,
+        summary_writer=summary_writer,
+    )
+    rc = gang.run()
+    for agent in agents:
+        code = agent.poll()
+        print_fn(f"{agent.name}: exit {code}")
+    return rc
+
+
 def launch(
     command: list[str],
     num_workers: int,
@@ -32,29 +167,67 @@ def launch(
     logdir: str = "./task_logs",
     env: dict | None = None,
     wait: bool = True,
+    *,
+    max_restarts: int = 0,
+    heartbeat_port: int | None = None,
+    heartbeat_timeout_ms: int = 5000,
+    # Never-beaten grace before a worker reads as dead. The default (5x
+    # timeout via HeartbeatHealth) is 25 s at the default timeout — on a
+    # loaded host a cold Python+jax import can exceed that, so raise this
+    # (or the timeout) when startup is slow; the integration test uses a
+    # 30 s timeout for a 150 s grace.
+    heartbeat_grace_ms: int | None = None,
+    stall_timeout_ms: int = 0,
+    backoff: float = 1.0,
+    poll_interval: float = 0.5,
+    print_fn=print,
 ) -> int:
+    if max_restarts > 0 and not wait:
+        # Supervision IS waiting: silently spawning unsupervised workers
+        # would drop the requested restart budget on the floor.
+        raise ValueError("max_restarts > 0 requires wait=True (the elastic "
+                         "agent supervises the gang to completion)")
     os.makedirs(logdir, exist_ok=True)
-    procs: list[tuple[str, subprocess.Popen]] = []
     base_env = dict(os.environ)
     if env:
         base_env.update(env)
-    for role, count in (("ps", num_ps), ("worker", num_workers)):
-        for i in range(count):
-            log_path = os.path.join(logdir, f"{role}{i}.log")
-            f = open(log_path, "w")
-            p = subprocess.Popen(
-                command + [f"--job_name={role}", f"--task_index={i}"],
-                stdout=f,
-                stderr=subprocess.STDOUT,
-                env=base_env,
-            )
-            procs.append((f"{role}{i}", p))
+    # ps tasks no-op and exit on TPU: launch one-shot, never supervised —
+    # a clean ps exit must not read as a gang failure, and a gang restart
+    # must not respawn them.
+    ps_procs = [
+        ("ps%d" % i, _spawn_task(command, "ps", i, logdir, base_env))
+        for i in range(num_ps)
+    ]
+    if max_restarts > 0:
+        rc = _launch_elastic(
+            command,
+            num_workers,
+            logdir,
+            base_env,
+            max_restarts=max_restarts,
+            heartbeat_port=heartbeat_port,
+            heartbeat_timeout_ms=heartbeat_timeout_ms,
+            heartbeat_grace_ms=heartbeat_grace_ms,
+            stall_timeout_ms=stall_timeout_ms,
+            backoff=backoff,
+            poll_interval=poll_interval,
+            print_fn=print_fn,
+        )
+        for name, p in ps_procs:
+            print_fn(f"{name}: exit {p.wait()}")
+        return rc
+    # Fail-stop path (max_restarts=0): the pre-round-7 behavior, unchanged —
+    # wait for every task, non-zero if any worker failed.
+    procs = ps_procs + [
+        ("worker%d" % i, _spawn_task(command, "worker", i, logdir, base_env))
+        for i in range(num_workers)
+    ]
     if not wait:
         return 0
     rc = 0
     for name, p in procs:
         code = p.wait()
-        print(f"{name}: exit {code}")
+        print_fn(f"{name}: exit {code}")
         if code != 0 and name.startswith("worker"):
             rc = 1
     return rc
@@ -65,6 +238,46 @@ def main(argv=None) -> int:
     parser.add_argument("--workers", type=int, required=True)
     parser.add_argument("--ps", type=int, default=0)
     parser.add_argument("--logdir", type=str, default="./task_logs")
+    # CLI defaults come from the DTF_* env knobs (launch.config_from_env /
+    # cluster_from_env's pod-scheduler surface): a scheduler that sets
+    # DTF_MAX_RESTARTS=3 arms the elastic driver with no flag changes.
+    parser.add_argument(
+        "--max-restarts",
+        type=int,
+        default=int(os.environ.get("DTF_MAX_RESTARTS", "0") or 0),
+        help="elastic gang-restart budget (train/elastic.py); 0 = the "
+        "one-shot fail-stop launcher (default: $DTF_MAX_RESTARTS or 0)",
+    )
+    parser.add_argument(
+        "--heartbeat-port",
+        type=int,
+        default=int(os.environ.get("DTF_HEARTBEAT_PORT", "0") or 0) or None,
+        help="driver-hosted UDP failure detector port (workers are pointed "
+        "at it via DTF_HEARTBEAT_HOST/_PORT); only used with --max-restarts "
+        "(default: $DTF_HEARTBEAT_PORT)",
+    )
+    parser.add_argument(
+        "--heartbeat-timeout-ms",
+        type=int,
+        default=int(os.environ.get("DTF_HEARTBEAT_TIMEOUT_MS", "5000") or 5000),
+    )
+    parser.add_argument(
+        "--heartbeat-grace-ms",
+        type=int,
+        default=None,
+        help="never-beaten grace before a worker reads as dead (default: "
+        "5x the timeout); raise it when cold startup — imports, jax "
+        "rendezvous, first compile — outlasts that window",
+    )
+    parser.add_argument(
+        "--stall-timeout-ms",
+        type=int,
+        default=int(os.environ.get("DTF_STALL_TIMEOUT_MS", "0") or 0),
+        help="recover a worker whose heartbeats flow but whose progress "
+        "counter is frozen past this window (0 disables; default: "
+        "$DTF_STALL_TIMEOUT_MS)",
+    )
+    parser.add_argument("--backoff", type=float, default=1.0)
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="-- command to launch per task")
     args = parser.parse_args(argv)
@@ -73,7 +286,18 @@ def main(argv=None) -> int:
         command = command[1:]
     if not command:
         parser.error("missing command after --")
-    return launch(command, args.workers, args.ps, args.logdir)
+    return launch(
+        command,
+        args.workers,
+        args.ps,
+        args.logdir,
+        max_restarts=args.max_restarts,
+        heartbeat_port=args.heartbeat_port,
+        heartbeat_timeout_ms=args.heartbeat_timeout_ms,
+        heartbeat_grace_ms=args.heartbeat_grace_ms,
+        stall_timeout_ms=args.stall_timeout_ms,
+        backoff=args.backoff,
+    )
 
 
 if __name__ == "__main__":

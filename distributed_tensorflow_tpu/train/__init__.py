@@ -1,3 +1,8 @@
 from distributed_tensorflow_tpu.train.trainer import Trainer  # noqa: F401
 from distributed_tensorflow_tpu.train.lm_trainer import LMTrainer  # noqa: F401
 from distributed_tensorflow_tpu.train.supervisor import Supervisor  # noqa: F401
+from distributed_tensorflow_tpu.train.elastic import (  # noqa: F401
+    ElasticAgent,
+    ElasticGang,
+    HeartbeatHealth,
+)

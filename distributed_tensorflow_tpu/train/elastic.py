@@ -1,0 +1,323 @@
+"""Elastic gang-restart: supervised multi-host recovery (round 7).
+
+The reference's only failure behavior was gRPC blocking forever (SURVEY.md
+§5 "Failure detection"); round 6 upgraded that to *fail-stop* — durable
+CRC-verified checkpoints, preemption exit, anomaly rollback, and a chief
+that detects a dead worker and ends the job cleanly (docs/multihost.md).
+This module closes the loop from fail-stop to **fail-recover**: production
+TPU training treats worker death as routine (PaLM-style runs restart the
+gang from the newest checkpoint automatically; TorchElastic-style agents
+supervise each rank under a restart budget), and round 6's durable
+checkpoints are exactly the substrate that makes automatic restart correct.
+
+Topology
+--------
+One :class:`ElasticAgent` per gang member, held by a driver (an
+:class:`ElasticGang`): the agent spawns its worker process and watches two
+signals —
+
+- the **exit code** (a non-zero or premature exit is a death), and
+- the **heartbeat verdict** from an agent-hosted detector
+  (:class:`HeartbeatHealth` over ``runtime/native.py``'s UDP coordinator):
+  beats stopped past ``timeout_ms`` is *dead*; beats flowing but the
+  payload's monotonic progress counter frozen past ``stall_timeout_ms`` is
+  *live-but-stalled* — the failure mode an exit code can never show (a rank
+  hung in a collective keeps its native sender thread beating forever, and
+  before round 7 the job simply hung with it).
+
+On any failure the gang is restarted as a unit: every member is killed
+(checkpoint state is durable; the dead epoch is repaid, not lost), the
+restart budget (``TrainConfig.max_restarts``) is charged, the gang waits an
+exponentially backed-off, jittered delay (``resilience.retry`` — the same
+state machine checkpoint I/O uses), and every member is relaunched. The
+relaunched processes re-bootstrap ``jax.distributed`` under
+``cluster.bounded_initialize`` (bounded timeout + retry, so members that
+come up before their coordinator get retried attempts instead of an
+indefinite hang) and resume from the newest VALID checkpoint via
+``Supervisor.prepare_or_restore``. Each restart emits a structured
+``Restart:`` line and a ``restart`` tfevents scalar; an exhausted budget
+falls back to round 6's fail-stop (non-zero driver exit, checkpoints
+intact).
+
+The detector is hosted by the AGENT, out-of-band of the job
+(``cluster.bootstrap(heartbeat_host=...)``: every task, chief included,
+becomes a plain sender) — in-band detection cannot recover a stall, because
+the chief is stuck in the same collective as the stalled rank.
+
+``tools/launch_local.py --max-restarts N`` is this module's multi-process
+driver (the reference's nohup-per-task workflow, now supervised);
+``tests/test_elastic.py`` pins the state machine on a fake process table
+and ``tests/integration/test_fault_injection.py`` proves the SIGKILL →
+gang-restart → resume → rc 0 path end to end.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Sequence
+
+from distributed_tensorflow_tpu.train import resilience
+
+
+class WorkerFailure(RuntimeError):
+    """One or more gang members died or stalled. ``verdicts`` maps member
+    name → verdict string (``rc=N``, ``dead``, ``stalled``, or
+    ``straggler`` — still running past ``drain_timeout`` after a peer
+    finished)."""
+
+    def __init__(self, verdicts: dict):
+        self.verdicts = dict(verdicts)
+        super().__init__(
+            " ".join(f"{n}={v}" for n, v in sorted(self.verdicts.items()))
+        )
+
+
+class HeartbeatHealth:
+    """Progress-aware health verdicts over the agent-hosted UDP detector.
+
+    Owns a fresh ``HeartbeatCoordinator`` (one per gang incarnation — the
+    gang recreates this each cycle so a relaunch never inherits the killed
+    incarnation's stale last-seen clocks). ``classify(worker_id)`` returns:
+
+    - ``"dead"`` — reported once then silent past ``timeout_ms``, or never
+      reported and the grace window (default 5× timeout) has elapsed;
+    - ``"stalled"`` — beating, but the payload's progress counter frozen
+      past ``stall_timeout_ms`` (0 disables stall detection). Workers that
+      never reported progress are not judged — startup import/compile must
+      not read as a stall;
+    - ``"ok"`` — otherwise.
+    """
+
+    def __init__(
+        self,
+        port: int,
+        expected_workers: int,
+        *,
+        timeout_ms: int = 5000,
+        stall_timeout_ms: int = 0,
+        grace_ms: int | None = None,
+        clock=time.monotonic,
+    ):
+        from distributed_tensorflow_tpu.runtime import native
+
+        self._coord = native.HeartbeatCoordinator(
+            port, expected_workers, timeout_ms=timeout_ms, grace_ms=grace_ms
+        )
+        self._timeout_ms = int(timeout_ms)
+        self._stall_ms = int(stall_timeout_ms)
+        self._grace_ms = int(grace_ms if grace_ms is not None else 5 * timeout_ms)
+        self._clock = clock
+        self._start = clock()
+
+    def classify(self, worker_id: int) -> str:
+        since = self._coord.ms_since_seen(worker_id)
+        if since < 0:  # never reported
+            elapsed_ms = (self._clock() - self._start) * 1000.0
+            return "dead" if elapsed_ms > self._grace_ms else "ok"
+        if since > self._timeout_ms:
+            return "dead"
+        if self._stall_ms > 0:
+            since_progress = self._coord.ms_since_progress(worker_id)
+            if since_progress > self._stall_ms:
+                return "stalled"
+        return "ok"
+
+    def stop(self) -> None:
+        self._coord.stop()
+
+
+class ElasticAgent:
+    """Supervises ONE gang member: spawn, poll the exit code, kill.
+
+    ``spawn_fn()`` returns a process handle exposing ``poll() -> rc|None``
+    and ``kill()`` (``subprocess.Popen`` satisfies it; the fast-tier tests
+    drive the whole machine with a fake process table). ``worker_id`` is
+    the member's slot in the heartbeat detector."""
+
+    def __init__(self, name: str, spawn_fn: Callable, *, worker_id: int | None = None):
+        self.name = name
+        self.worker_id = worker_id
+        self._spawn_fn = spawn_fn
+        self.handle = None
+
+    def start(self):
+        self.handle = self._spawn_fn()
+        return self.handle
+
+    def poll(self):
+        """Exit code, or None (running / not yet started)."""
+        return None if self.handle is None else self.handle.poll()
+
+    def kill(self) -> None:
+        """Hard-kill a live member (SIGKILL semantics — a rank hung in a
+        collective ignores SIGTERM forever; its state is durable in the
+        checkpoint, so the restart repays at most one epoch)."""
+        if self.handle is None or self.handle.poll() is not None:
+            return
+        self.handle.kill()
+        wait = getattr(self.handle, "wait", None)
+        if wait is not None:  # reap, so the driver never accumulates zombies
+            try:
+                wait(timeout=30)
+            except Exception:  # noqa: BLE001 — unkillable is the OS's problem
+                pass
+
+
+class ElasticGang:
+    """The driver: N agents supervised as one gang under a restart budget.
+
+    ``run()`` starts every member and polls until either every member has
+    exited 0 (return 0) or a failure verdict lands — non-zero exit, dead,
+    or stalled — at which point every live member is killed and the gang is
+    relaunched after an exponentially backed-off, jittered delay, at most
+    ``max_restarts`` times (``resilience.retry`` is the backoff state
+    machine; ``max_restarts=0`` preserves round 6's fail-stop exactly:
+    first failure → kill survivors → return 1). Each restart emits a
+    structured ``Restart:`` line and, when a ``summary_writer`` is given, a
+    ``restart`` tfevents scalar (value = restart ordinal).
+
+    ``health_factory`` builds a fresh :class:`HeartbeatHealth` per gang
+    incarnation (fresh detector state — a relaunch must not inherit the
+    killed incarnation's silence). Once the first member exits 0, the rest
+    must finish within ``drain_timeout`` seconds or the still-running
+    members are verdicted ``straggler`` (a peer wedged in a collective the
+    finished member will never rejoin beats forever — without the drain
+    window the gang would hang with no verdict). ``sleep``/``clock``/
+    ``poll_interval`` are injectable so the fast-tier tests run the whole
+    machine without wall time or real processes."""
+
+    def __init__(
+        self,
+        agents: Sequence[ElasticAgent],
+        *,
+        max_restarts: int = 0,
+        backoff: float = 1.0,
+        max_backoff: float = 30.0,
+        jitter: float = 0.25,
+        health_factory: Callable[[], HeartbeatHealth] | None = None,
+        poll_interval: float = 0.5,
+        drain_timeout: float = 300.0,
+        print_fn=print,
+        summary_writer=None,
+        sleep=time.sleep,
+        clock=time.monotonic,
+        rng=None,
+    ):
+        self.agents = list(agents)
+        self.max_restarts = int(max_restarts)
+        self.backoff = float(backoff)
+        self.max_backoff = float(max_backoff)
+        self.jitter = float(jitter)
+        self.health_factory = health_factory
+        self.poll_interval = float(poll_interval)
+        self.drain_timeout = float(drain_timeout)
+        self.print_fn = print_fn
+        self.summary_writer = summary_writer
+        self.sleep = sleep
+        self.clock = clock
+        self.rng = rng
+        self.restarts = 0  # restarts actually performed
+
+    # -- one gang incarnation --------------------------------------------
+
+    def _cycle(self) -> int:
+        health = None
+        first_done = None  # clock() when the first member exited 0
+        try:
+            for agent in self.agents:
+                agent.start()
+            health = self.health_factory() if self.health_factory else None
+            while True:
+                rcs = {a.name: a.poll() for a in self.agents}
+                verdicts = {
+                    name: f"rc={rc}"
+                    for name, rc in rcs.items()
+                    if rc is not None and rc != 0
+                }
+                if health is not None:
+                    for a in self.agents:
+                        if rcs[a.name] is None and a.worker_id is not None:
+                            v = health.classify(a.worker_id)
+                            if v != "ok":
+                                verdicts[a.name] = v
+                # Premature-exit guard: once any member finishes (rc 0),
+                # the rest must drain within drain_timeout — a peer blocked
+                # in a collective the finished member will never rejoin
+                # would otherwise beat forever ("ok" to health) and hang
+                # the gang with no verdict at all. Staggered-but-honest
+                # completion finishes well inside the window.
+                if not verdicts and any(rc == 0 for rc in rcs.values()):
+                    if first_done is None:
+                        first_done = self.clock()
+                    elif self.clock() - first_done > self.drain_timeout:
+                        verdicts = {
+                            name: "straggler"
+                            for name, rc in rcs.items()
+                            if rc is None
+                        }
+                if verdicts:
+                    # Gang semantics: one bad member poisons the incarnation
+                    # (its peers are blocked in collectives it will never
+                    # join) — kill every survivor and hand the verdicts up.
+                    for a in self.agents:
+                        a.kill()
+                    raise WorkerFailure(verdicts)
+                if all(rc == 0 for rc in rcs.values()):
+                    return 0
+                self.sleep(self.poll_interval)
+        except WorkerFailure:
+            raise
+        except BaseException:
+            # Not a gang verdict: spawn/detector failure (e.g. the
+            # heartbeat port got grabbed between incarnations) or a driver
+            # bug. The already-started members must not outlive the driver
+            # as orphans holding the checkpoint dir.
+            for agent in self.agents:
+                agent.kill()
+            raise
+        finally:
+            if health is not None:
+                health.stop()
+
+    def _on_retry(self, exc: WorkerFailure, attempt: int, delay: float) -> None:
+        self.restarts = attempt + 1
+        # Structured, greppable — same key=value shape as Preemption:/Rollback:.
+        self.print_fn(
+            f"Restart: restart={self.restarts}/{self.max_restarts} "
+            f"cause[{exc}] backoff_s={delay:.1f}"
+        )
+        if self.summary_writer is not None:
+            self.summary_writer.add_scalar(
+                "restart", float(self.restarts), self.restarts
+            )
+
+    def run(self) -> int:
+        """Supervise to completion: 0 when every member exited 0 (possibly
+        after restarts), 1 when the budget is exhausted (fail-stop, with a
+        final ``Restart: budget exhausted`` line; checkpoints intact)."""
+        try:
+            return resilience.retry(
+                self._cycle,
+                attempts=self.max_restarts + 1,
+                backoff=self.backoff,
+                max_backoff=self.max_backoff,
+                jitter=self.jitter,
+                retry_on=(WorkerFailure,),
+                describe="gang restart",
+                on_retry=self._on_retry,
+                sleep=self.sleep,
+                rng=self.rng,
+            )
+        except WorkerFailure as exc:
+            self.print_fn(
+                f"Restart: budget exhausted restarts={self.restarts}/"
+                f"{self.max_restarts} cause[{exc}] — failing stop "
+                "(checkpoints intact; newest valid step restores on the "
+                "next launch)"
+            )
+            if self.summary_writer is not None:
+                self.summary_writer.flush()
+            return 1
+        finally:
+            if self.summary_writer is not None and self.restarts:
+                self.summary_writer.flush()

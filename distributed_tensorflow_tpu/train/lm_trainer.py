@@ -1026,6 +1026,7 @@ class LMTrainer:
                     }
                 )
         if self.supervisor is not None:
+            self.supervisor.report_progress(self.global_step)
             if cfg.max_rollbacks and costs.size and not np.isfinite(costs).all():
                 # One compiled dispatch cannot roll back mid-program; the
                 # guard's durability half still holds — never commit a
@@ -1318,6 +1319,10 @@ class LMTrainer:
                     }
                 )
             if self.supervisor is not None:
+                # Epoch boundary = demonstrable progress: bump the heartbeat
+                # progress counter before the (possibly slow) save so the
+                # elastic agent's stall clock resets on real forward motion.
+                self.supervisor.report_progress(self.global_step)
                 self.supervisor.save(
                     self.state, self.global_step, layout=self._layout_meta()
                 )

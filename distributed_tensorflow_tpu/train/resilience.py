@@ -250,8 +250,58 @@ def verify_leaves(state, manifest: dict) -> bool:
 
 
 # ---------------------------------------------------------------------------
-# Bounded retry around checkpoint I/O.
+# Bounded retry with exponential backoff — the ONE retry implementation.
+# Checkpoint I/O (retry_io), the elastic gang-restart cycle
+# (train/elastic.py), and the bounded jax.distributed bootstrap
+# (cluster.bounded_initialize) all go through here: one backoff state
+# machine to test, not three near-copies to drift.
 # ---------------------------------------------------------------------------
+
+
+def retry(
+    fn,
+    *,
+    attempts: int = 3,
+    backoff: float = 0.25,
+    max_backoff: float = 30.0,
+    jitter: float = 0.0,
+    retry_on: tuple = (OSError,),
+    describe: str = "operation",
+    on_retry=None,
+    sleep=time.sleep,
+    rng=None,
+):
+    """Run ``fn`` with bounded retry + exponential backoff. The last failure
+    re-raises — resilience means surviving a hiccup, not silently swallowing
+    a dead disk (or a gang that can never come up).
+
+    Delay before attempt ``k+1`` is ``min(backoff * 2**k, max_backoff)``,
+    multiplied by ``1 + jitter*u`` with ``u`` uniform in [0, 1) — jitter
+    de-synchronizes a gang of agents all restarting off the same failure so
+    their rendezvous attempts don't thundering-herd the coordinator.
+    ``on_retry(exc, attempt, delay)`` fires before each sleep (the elastic
+    agent's ``Restart:`` line + tfevents scalar hang off it); ``sleep`` and
+    ``rng`` are injectable so the state machine tests run without wall time.
+    """
+    if rng is None:
+        import random as _random
+
+        rng = _random
+    last = None
+    for attempt in range(max(1, attempts)):
+        try:
+            return fn()
+        except retry_on as exc:  # noqa: PERF203 — retry loop by design
+            last = exc
+            if attempt + 1 >= attempts:
+                raise
+            delay = min(backoff * (2**attempt), max_backoff)
+            if jitter:
+                delay *= 1.0 + jitter * rng.random()
+            if on_retry is not None:
+                on_retry(exc, attempt, delay)
+            sleep(delay)
+    raise last  # pragma: no cover — unreachable (loop raises)
 
 
 def retry_io(
@@ -262,19 +312,16 @@ def retry_io(
     retry_on: tuple = (OSError,),
     describe: str = "checkpoint I/O",
 ):
-    """Run ``fn`` with bounded retry + exponential backoff on transient
-    I/O errors. The last failure re-raises — durability means surviving a
-    hiccup, not silently swallowing a dead disk."""
-    last = None
-    for attempt in range(max(1, attempts)):
-        try:
-            return fn()
-        except retry_on as exc:  # noqa: PERF203 — retry loop by design
-            last = exc
-            if attempt + 1 >= attempts:
-                raise
-            time.sleep(backoff * (2**attempt))
-    raise last  # pragma: no cover — unreachable (loop raises)
+    """Checkpoint-I/O flavor of :func:`retry` (kept as the narrow public
+    surface Supervisor uses; no jitter — a single process retrying its own
+    disk has nothing to de-synchronize from)."""
+    return retry(
+        fn,
+        attempts=attempts,
+        backoff=backoff,
+        retry_on=retry_on,
+        describe=describe,
+    )
 
 
 # ---------------------------------------------------------------------------

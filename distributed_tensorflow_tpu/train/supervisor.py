@@ -92,19 +92,41 @@ class Supervisor:
         self.io_backoff = float(io_backoff)
         self._stop_requested = False
         self._heartbeat = None
+        self._stall_timeout_ms = 0
+        self._progress_fn = None
         self._ckptr = None
         if self.checkpoint_dir and _HAVE_ORBAX:
             os.makedirs(self.checkpoint_dir, exist_ok=True)
             self._ckptr = ocp.StandardCheckpointer()
 
-    def attach_heartbeat(self, heartbeat) -> None:
+    def attach_heartbeat(self, heartbeat, *, stall_timeout_ms: int = 0) -> None:
         """Arm failure-reactive stopping: when the attached
         HeartbeatCoordinator (runtime/native.py) reports a failed worker,
         ``should_stop`` turns true — so the chief's training loop exits at
         the next epoch boundary with checkpoints intact, instead of hanging
         in a collective the dead worker will never join (the reference's
-        failure mode: gRPC calls blocking forever, SURVEY.md §5)."""
+        failure mode: gRPC calls blocking forever, SURVEY.md §5).
+
+        ``stall_timeout_ms > 0`` (round 7) additionally trips the stop when
+        a worker is LIVE-BUT-STALLED — beating, but its progress counter
+        frozen past the window (``HeartbeatCoordinator.stalled_count``) —
+        the failure mode silence timeouts can never see."""
         self._heartbeat = heartbeat
+        self._stall_timeout_ms = int(stall_timeout_ms)
+
+    def attach_progress(self, progress_fn) -> None:
+        """Wire the heartbeat progress reporter (typically
+        ``ProcessContext.report_progress``): trainers call
+        :meth:`report_progress` with the global step at epoch boundaries,
+        and the counter rides every outgoing beat so the detector — chief-
+        or agent-hosted — can tell stalled from dead."""
+        self._progress_fn = progress_fn
+
+    def report_progress(self, progress: int) -> None:
+        """Advance the attached heartbeat progress counter; no-op when no
+        reporter is wired (single process, heartbeat unavailable)."""
+        if self._progress_fn is not None:
+            self._progress_fn(int(progress))
 
     # -- checkpoint/restore (upgrade over the reference's nothing) --------
 
@@ -332,8 +354,18 @@ class Supervisor:
     def should_stop(self) -> bool:
         if self._stop_requested:
             return True
-        if self._heartbeat is not None and self._heartbeat.failed_count() > 0:
-            self._stop_requested = True
+        if self._heartbeat is not None:
+            if self._heartbeat.failed_count() > 0:
+                self._stop_requested = True
+            elif (
+                self._stall_timeout_ms > 0
+                and hasattr(self._heartbeat, "stalled_count")
+                and self._heartbeat.stalled_count(self._stall_timeout_ms) > 0
+            ):
+                # Live-but-stalled worker (beating, progress frozen): same
+                # exit as a dead one — stop at the boundary with the
+                # checkpoints intact rather than hanging forever.
+                self._stop_requested = True
         return self._stop_requested
 
     def stop(self) -> None:

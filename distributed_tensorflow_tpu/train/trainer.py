@@ -543,6 +543,7 @@ class Trainer:
         if self.supervisor is not None:
             import numpy as _np
 
+            self.supervisor.report_progress(self.strategy.global_step(self.state))
             if cfg.max_rollbacks and costs.size and not _np.isfinite(costs).all():
                 # A single compiled dispatch cannot roll back mid-program;
                 # the anomaly guard's durability half still holds — never
@@ -861,6 +862,13 @@ class Trainer:
                     }
                 )
             if self.supervisor is not None:
+                # Epoch boundary = demonstrable progress: bump the heartbeat
+                # progress counter BEFORE the save (the save itself can be
+                # slow; the work it persists is already done), so the
+                # elastic agent's stall clock resets on real forward motion.
+                self.supervisor.report_progress(
+                    self.strategy.global_step(self.state)
+                )
                 self.supervisor.save(
                     self.state,
                     self.strategy.global_step(self.state),

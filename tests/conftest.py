@@ -77,6 +77,10 @@ def pytest_configure(config):
 # rest keeps the ~9x warm-compile win. (RUN_SLOW runs with the cache off
 # entirely — all-fresh compiles have never aborted — so order is
 # irrelevant there.)
+# Keep any NEW cache-opted-out module in this list (round-7 audit:
+# test_elastic.py compiles nothing — fake process tables, no jax programs —
+# and the fault-injection integration cases compile only in their own
+# subprocesses, so neither needs a slot here).
 _CACHE_OPT_OUT_FIRST = ("test_lm_trainer.py", "test_cross_topology_restore.py")
 
 

@@ -104,6 +104,86 @@ time.sleep(600)  # "training" until killed
 """
 
 
+_ELASTIC_WORKER = r"""
+import os, signal, sys, time, warnings
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from distributed_tensorflow_tpu.cluster import bootstrap
+from distributed_tensorflow_tpu.config import ClusterConfig, TrainConfig
+from distributed_tensorflow_tpu.launch import cluster_from_env
+
+ckpt, workdir = sys.argv[1], sys.argv[2]
+task = int([a.split("=")[1] for a in sys.argv if a.startswith("--task_index")][0])
+# The elastic driver (tools/launch_local.py --max-restarts) hosts the
+# detector and points the gang at it via DTF_HEARTBEAT_*; cluster_from_env
+# is the documented wiring (the pod-scheduler surface).
+cluster = cluster_from_env(
+    ClusterConfig.from_lists(["127.0.0.1:29795", "127.0.0.1:29796"])
+)
+ctx = bootstrap(cluster, "worker", task, initialize_distributed=False)
+if os.environ.get("DTF_HEARTBEAT_HOST"):
+    assert ctx.heartbeat is not None, "elastic sender did not arm"
+done = os.path.join(workdir, "DONE")
+
+if task == 1:
+    # Gang peer: beats + moving progress until the trainer finishes.
+    print("PEER_UP", flush=True)
+    deadline = time.time() + 240
+    step = 0
+    while not os.path.exists(done) and time.time() < deadline:
+        step += 1
+        ctx.report_progress(step)
+        time.sleep(0.2)
+    ctx.close()
+    sys.exit(0 if os.path.exists(done) else 3)
+
+# task 0: the trainer. Restores must be clean — a RuntimeWarning from the
+# checkpoint fallback path (corrupt/partial step skipped) fails the run.
+warnings.filterwarnings("error", message=".*checkpoint step_.*")
+from distributed_tensorflow_tpu.data.mnist import DataSet, Datasets
+from distributed_tensorflow_tpu.models import MLP
+from distributed_tensorflow_tpu.train import Trainer
+from distributed_tensorflow_tpu.utils.logging import StepLogger
+
+rng = np.random.default_rng(0)
+imgs = rng.random((2000, 784), dtype=np.float32)
+labs = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 2000)]
+ds = Datasets(train=DataSet(imgs, labs, seed=1), validation=None,
+              test=DataSet(imgs[:200], labs[:200], seed=2))
+tr = Trainer(MLP(hidden_dim=16, compute_dtype=jax.numpy.float32), ds,
+             TrainConfig(epochs=6, scan_epoch=True, log_frequency=10**9,
+                         logs_path="", checkpoint_dir=ckpt),
+             print_fn=lambda *a: None)
+tr.supervisor.attach_progress(ctx.report_progress)
+spe = 2000 // 100  # steps per epoch
+marker = os.path.join(workdir, "killed_once")
+if not os.path.exists(marker):
+    # First incarnation: fresh start, 3 checkpointed epochs, then die hard
+    # mid-run (SIGKILL: no handler, no final save — the crash case).
+    assert tr.start_step == 0, tr.start_step
+    logger = StepLogger(freq=10**9, print_fn=lambda *a: None)
+    for epoch in range(3):
+        tr.run_epoch(epoch, logger)
+        step = tr.strategy.global_step(tr.state)
+        tr.supervisor.report_progress(step)
+        tr.supervisor.save(tr.state, step, layout=tr.strategy.layout_meta())
+    print("TRAINER_DYING", flush=True)
+    open(marker, "w").close()
+    os.kill(os.getpid(), signal.SIGKILL)
+# Relaunched incarnation: resumed EXACTLY at the killed boundary (newest
+# valid checkpoint, warning-free restore), then trains to the target.
+assert tr.start_step == 3 * spe, tr.start_step
+res = tr.run(epochs=3)
+assert res["global_step"] == 6 * spe, res
+open(done, "w").close()
+print("TRAINER_DONE", res["global_step"], flush=True)
+ctx.close()
+"""
+
+
 _PREEMPTED = r"""
 import os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -314,3 +394,96 @@ def test_worker_kill_stops_chief_with_restorable_checkpoint(tmp_path):
     assert tr.start_step == step  # restored, not re-initialized
     res = tr.run(epochs=1)  # restarted worker re-attaches and continues
     assert res["global_step"] > step
+
+
+def test_elastic_agent_gang_restarts_after_sigkill(tmp_path):
+    """Round 7 acceptance: a 2-process gang under the elastic agent
+    (tools/launch_local.py --max-restarts) whose trainer is SIGKILLed
+    mid-run RESTARTS — both members killed and relaunched after backoff —
+    resumes from the newest CRC-verified checkpoint with a
+    RuntimeWarning-free restore (the worker script turns restore-fallback
+    warnings into errors), and finishes rc 0 at the expected step count.
+    Supervision is exit-code + agent-hosted heartbeat (the driver hosts
+    the detector; a generous timeout so a loaded host's slow jax import
+    can't read as death — the kill is detected via the exit code
+    instantly either way)."""
+    from distributed_tensorflow_tpu.tools.launch_local import launch
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = env.get("PYTHONPATH", "") + os.pathsep + _REPO
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    ckpt = str(tmp_path / "ck")
+    workdir = str(tmp_path / "wd")
+    os.makedirs(workdir)
+    lines: list = []
+    rc = launch(
+        [sys.executable, "-c", _ELASTIC_WORKER, ckpt, workdir],
+        num_workers=2,
+        logdir=str(tmp_path / "logs"),
+        env=env,
+        max_restarts=2,
+        heartbeat_port=19481,
+        heartbeat_timeout_ms=30_000,  # grace 150 s > worst-case jax import
+        backoff=0.5,
+        poll_interval=0.3,
+        print_fn=lambda *a: lines.append(" ".join(str(x) for x in a)),
+    )
+    out = "\n".join(lines)
+    assert rc == 0, f"gang did not recover (rc={rc}):\n{out}"
+    restart_lines = [l for l in lines if l.startswith("Restart: restart=")]
+    assert len(restart_lines) == 1, out
+    assert "worker0=rc=-9" in restart_lines[0], restart_lines[0]
+
+    # Both incarnations of the trainer are in the (appended) log.
+    with open(tmp_path / "logs" / "worker0.log") as f:
+        w0 = f.read()
+    assert "TRAINER_DYING" in w0 and "TRAINER_DONE 120" in w0, w0
+
+    # The final checkpoint is CRC-verified at the target step: 6 epochs ×
+    # 20 steps, across a death at step 60.
+    from distributed_tensorflow_tpu.train.supervisor import (
+        latest_checkpoint_step,
+    )
+
+    assert latest_checkpoint_step(ckpt, verify=True) == 120
+
+    # The driver wrote the restart tfevents scalar sidecar.
+    assert any(
+        ".elastic" in name for name in os.listdir(tmp_path / "logs")
+    )
+
+
+def test_elastic_max_restarts_zero_keeps_fail_stop(tmp_path):
+    """max_restarts=0 preserves round 6's fail-stop bit-for-bit: the same
+    SIGKILL ends the job non-zero after ONE incarnation — no restart, no
+    Restart: line — with the pre-kill checkpoints intact and verified."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = env.get("PYTHONPATH", "") + os.pathsep + _REPO
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    ckpt = str(tmp_path / "ck")
+    workdir = str(tmp_path / "wd")
+    os.makedirs(workdir)
+
+    from distributed_tensorflow_tpu.tools.launch_local import launch
+
+    lines: list = []
+    rc = launch(
+        [sys.executable, "-c", _ELASTIC_WORKER, ckpt, workdir],
+        num_workers=1,  # just the trainer: the peer would (rightly) wait
+        logdir=str(tmp_path / "logs"),
+        env=env,
+        max_restarts=0,
+        print_fn=lambda *a: lines.append(" ".join(str(x) for x in a)),
+    )
+    out = "\n".join(lines)
+    assert rc == 1, f"fail-stop must propagate the failure:\n{out}"
+    assert not any("Restart" in l for l in lines), out
+    # One incarnation only: it died, nothing relaunched it.
+    assert os.path.exists(os.path.join(workdir, "killed_once"))
+    assert not os.path.exists(os.path.join(workdir, "DONE"))
+
+    from distributed_tensorflow_tpu.train.supervisor import (
+        latest_checkpoint_step,
+    )
+
+    assert latest_checkpoint_step(ckpt, verify=True) == 60

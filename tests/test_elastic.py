@@ -1,0 +1,723 @@
+"""Elastic gang-restart state machine (train/elastic.py) — fast tier.
+
+Everything here runs WITHOUT real worker processes or wall time: the gang
+is driven over a fake process table with injected ``sleep``/rng, stall vs
+dead classification over a fake coordinator, and the bounded
+``jax.distributed.initialize`` wrapper over a fake initialize_fn — the
+RUN_SLOW end-to-end proof (real subprocesses, real SIGKILL, real UDP
+detector) lives in tests/integration/test_fault_injection.py and the
+native payload tests in tests/test_runtime_native.py. No jax computation
+happens in this module (nothing compiles), so it needs no persistent-cache
+opt-out and no slot in conftest's ``_CACHE_OPT_OUT_FIRST``.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+elastic = pytest.importorskip(
+    "distributed_tensorflow_tpu.train.elastic",
+    reason="train package unavailable (jax too old for parallel/mesh)",
+)
+
+from distributed_tensorflow_tpu.cluster import (  # noqa: E402
+    BootstrapError,
+    bounded_initialize,
+)
+from distributed_tensorflow_tpu.config import ClusterConfig  # noqa: E402
+from distributed_tensorflow_tpu.train import resilience  # noqa: E402
+from distributed_tensorflow_tpu.train.elastic import (  # noqa: E402
+    ElasticAgent,
+    ElasticGang,
+    HeartbeatHealth,
+)
+
+
+# ---------------------------------------------------------------------------
+# resilience.retry — the one backoff state machine everything reuses.
+# ---------------------------------------------------------------------------
+
+
+class _FixedRng:
+    def __init__(self, u: float):
+        self.u = u
+
+    def random(self) -> float:
+        return self.u
+
+
+def test_retry_backoff_jitter_and_on_retry():
+    sleeps, events, calls = [], [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError(f"boom {len(calls)}")
+        return "done"
+
+    out = resilience.retry(
+        flaky,
+        attempts=5,
+        backoff=1.0,
+        jitter=0.2,
+        on_retry=lambda exc, attempt, delay: events.append((attempt, delay)),
+        sleep=sleeps.append,
+        rng=_FixedRng(0.5),
+    )
+    assert out == "done" and len(calls) == 3
+    # exponential 1.0, 2.0 × (1 + 0.2·0.5)
+    assert sleeps == [1.1, 2.2]
+    assert [a for a, _ in events] == [0, 1]
+    assert sleeps == [d for _, d in events]
+
+
+def test_retry_max_backoff_cap_and_reraise():
+    sleeps = []
+    with pytest.raises(OSError, match="nope"):
+        resilience.retry(
+            lambda: (_ for _ in ()).throw(OSError("nope")),
+            attempts=6,
+            backoff=1.0,
+            max_backoff=4.0,
+            sleep=sleeps.append,
+        )
+    assert sleeps == [1.0, 2.0, 4.0, 4.0, 4.0]
+
+
+def test_retry_io_delegates():
+    assert resilience.retry_io(lambda: 42) == 42
+
+
+# ---------------------------------------------------------------------------
+# Fake process table: poll() scripts per incarnation, kill tracking.
+# ---------------------------------------------------------------------------
+
+
+class FakeProc:
+    """poll() pops a scripted sequence (last value repeats); kill() pins -9."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.killed = False
+        self.reaped = False
+
+    def poll(self):
+        if self.killed:
+            return -9
+        if len(self.script) > 1:
+            return self.script.pop(0)
+        return self.script[0]
+
+    def kill(self):
+        self.killed = True
+
+    def wait(self, timeout=None):
+        self.reaped = True
+        return -9
+
+
+class FakeTable:
+    """scripts[worker] = [incarnation0 script, incarnation1 script, ...]."""
+
+    def __init__(self, scripts):
+        self.scripts = scripts
+        self.spawned: list[tuple[int, int]] = []  # (worker, incarnation)
+        self.procs: dict[tuple[int, int], FakeProc] = {}
+
+    def spawner(self, i):
+        def _spawn():
+            inc = sum(1 for w, _ in self.spawned if w == i)
+            self.spawned.append((i, inc))
+            p = FakeProc(self.scripts[i][min(inc, len(self.scripts[i]) - 1)])
+            self.procs[(i, inc)] = p
+            return p
+
+        return _spawn
+
+    def gang(self, n, **kw):
+        kw.setdefault("sleep", lambda s: None)
+        kw.setdefault("jitter", 0.0)
+        agents = [
+            ElasticAgent(f"worker{i}", self.spawner(i), worker_id=i)
+            for i in range(n)
+        ]
+        return ElasticGang(agents, **kw)
+
+
+class FakeWriter:
+    def __init__(self):
+        self.scalars = []
+        self.flushed = 0
+
+    def add_scalar(self, tag, value, step):
+        self.scalars.append((tag, value, step))
+
+    def flush(self):
+        self.flushed += 1
+
+
+def test_gang_clean_run_no_restart():
+    t = FakeTable({0: [[None, 0]], 1: [[None, None, 0]]})
+    lines = []
+    gang = t.gang(2, max_restarts=3, print_fn=lines.append)
+    assert gang.run() == 0
+    assert gang.restarts == 0 and lines == []
+    assert t.spawned == [(0, 0), (1, 0)]
+
+
+def test_gang_restart_recovers_and_logs():
+    # worker1 dies rc=9 in incarnation 0; incarnation 1 both exit 0.
+    t = FakeTable({0: [[None, None], [None, 0]], 1: [[None, 9], [None, 0]]})
+    lines, writer = [], FakeWriter()
+    gang = t.gang(
+        2, max_restarts=2, backoff=0.5, print_fn=lines.append,
+        summary_writer=writer,
+    )
+    assert gang.run() == 0
+    assert gang.restarts == 1
+    # gang semantics: the survivor was killed and reaped, BOTH relaunched
+    assert t.procs[(0, 0)].killed and t.procs[(0, 0)].reaped
+    assert t.spawned == [(0, 0), (1, 0), (0, 1), (1, 1)]
+    # structured Restart: line + restart tfevents scalar
+    (line,) = [l for l in lines if l.startswith("Restart: restart=")]
+    assert "restart=1/2" in line and "worker1=rc=9" in line
+    assert writer.scalars == [("restart", 1.0, 1)]
+
+
+def test_gang_budget_exhausted_fails_stop():
+    t = FakeTable({0: [[None, 3]]})
+    lines = []
+    gang = t.gang(1, max_restarts=1, print_fn=lines.append)
+    assert gang.run() == 1
+    assert gang.restarts == 1
+    assert any("budget exhausted restarts=1/1" in l for l in lines)
+    assert t.spawned == [(0, 0), (0, 1)]  # budget spent, then stop
+
+
+def test_gang_max_restarts_zero_preserves_fail_stop():
+    """max_restarts=0 = round 6's fail-stop: first failure kills the
+    survivors and returns 1 — one incarnation, no Restart: line."""
+    t = FakeTable({0: [[None, 5]], 1: [[None, None, None]]})
+    lines = []
+    gang = t.gang(2, max_restarts=0, print_fn=lines.append)
+    assert gang.run() == 1
+    assert gang.restarts == 0
+    assert t.spawned == [(0, 0), (1, 0)]
+    assert t.procs[(1, 0)].killed
+    assert not any(l.startswith("Restart: restart=") for l in lines)
+
+
+def test_gang_straggler_after_drain_timeout():
+    """Premature-exit guard: a member wedged in a collective after a peer
+    finished beats forever ('ok' to health) — the drain window is the only
+    verdict that can fire, and it must (no-hang contract)."""
+    # worker0 exits 0 immediately; worker1 never exits in incarnation 0,
+    # both finish in incarnation 1.
+    t = FakeTable({0: [[0], [0]], 1: [[None], [0]]})
+    now = {"t": 0.0}
+    gang = t.gang(
+        2, max_restarts=1, poll_interval=1.0, drain_timeout=30.0,
+        clock=lambda: now["t"], print_fn=lambda *a: None,
+    )
+    gang.sleep = lambda s: now.__setitem__("t", now["t"] + max(s, 1.0))
+    assert gang.run() == 0
+    assert gang.restarts == 1
+    assert t.procs[(1, 0)].killed  # the straggler was killed, gang restarted
+
+
+def test_gang_staggered_completion_inside_drain_window_is_clean():
+    t = FakeTable({0: [[0]], 1: [[None, None, 0]]})
+    now = {"t": 0.0}
+    gang = t.gang(
+        2, max_restarts=1, poll_interval=1.0, drain_timeout=30.0,
+        clock=lambda: now["t"],
+    )
+    gang.sleep = lambda s: now.__setitem__("t", now["t"] + max(s, 1.0))
+    assert gang.run() == 0
+    assert gang.restarts == 0
+
+
+def test_gang_kills_workers_when_detector_setup_fails():
+    """A non-verdict failure (detector port grabbed between incarnations,
+    spawn raising) must not orphan already-started workers: they hold the
+    checkpoint dir and would outlive the dead driver."""
+    t = FakeTable({0: [[None]], 1: [[None]]})
+
+    def bad_factory():
+        raise OSError("heartbeat port in use")
+
+    gang = t.gang(2, max_restarts=1, health_factory=bad_factory)
+    with pytest.raises(OSError, match="port in use"):
+        gang.run()
+    assert t.procs[(0, 0)].killed and t.procs[(1, 0)].killed
+
+
+def test_gang_backoff_doubles_across_restarts():
+    t = FakeTable({0: [[None, 1], [None, 1], [None, 1], [None, 0]]})
+    sleeps = []
+    gang = t.gang(
+        1, max_restarts=3, backoff=1.0,
+        poll_interval=0.0, sleep=sleeps.append,
+    )
+    assert gang.run() == 0
+    assert gang.restarts == 3
+    assert [s for s in sleeps if s > 0] == [1.0, 2.0, 4.0]
+
+
+# ---------------------------------------------------------------------------
+# Stall vs dead classification (injected progress counters — no sockets).
+# ---------------------------------------------------------------------------
+
+
+class FakeCoordinator:
+    def __init__(self, seen, prog):
+        self.seen, self.prog = seen, prog
+        self.stopped = False
+
+    def ms_since_seen(self, i):
+        return self.seen[i]
+
+    def ms_since_progress(self, i):
+        return self.prog[i]
+
+    def stop(self):
+        self.stopped = True
+
+
+def _health(seen, prog, *, timeout_ms=5000, stall_timeout_ms=10_000,
+            grace_ms=25_000, now=1.0):
+    h = HeartbeatHealth.__new__(HeartbeatHealth)
+    h._coord = FakeCoordinator(seen, prog)
+    h._timeout_ms = timeout_ms
+    h._stall_ms = stall_timeout_ms
+    h._grace_ms = grace_ms
+    clock = {"t": now}
+    h._clock = lambda: clock["t"]
+    h._start = 0.0
+    h._clock_box = clock
+    return h
+
+
+def test_classify_stall_vs_dead_matrix():
+    h = _health(
+        seen={0: 100, 1: 100, 2: 9_999_999, 3: -1},
+        prog={0: 500, 1: 60_000, 2: 100, 3: -1},
+    )
+    assert h.classify(0) == "ok"  # beating, progressing
+    assert h.classify(1) == "stalled"  # beating, progress frozen 60s
+    assert h.classify(2) == "dead"  # silence past timeout
+    assert h.classify(3) == "ok"  # never seen, inside grace
+    h._clock_box["t"] = 30.0  # 30 s > 25 s grace
+    assert h.classify(3) == "dead"  # never came up
+
+
+def test_classify_never_progressed_is_not_stalled():
+    # A sender that never reported progress (startup import/compile, or an
+    # old payload) must not read as a stall.
+    h = _health(seen={0: 100}, prog={0: -1})
+    assert h.classify(0) == "ok"
+
+
+def test_classify_stall_detection_disabled():
+    h = _health(seen={0: 100}, prog={0: 999_999}, stall_timeout_ms=0)
+    assert h.classify(0) == "ok"
+
+
+def test_gang_recovers_from_injected_stall():
+    """A live-but-stalled verdict (injected progress counter) triggers the
+    same kill + gang-restart path as a death — the acceptance case."""
+    t = FakeTable({0: [[None, None], [0]], 1: [[None, None], [0]]})
+    incarnations = []
+
+    class InjectedHealth:
+        def __init__(self, verdicts):
+            self.verdicts = verdicts
+            self.stopped = False
+
+        def classify(self, wid):
+            return self.verdicts.get(wid, "ok")
+
+        def stop(self):
+            self.stopped = True
+
+    def health_factory():
+        # incarnation 0: worker1 beats but its progress counter is frozen;
+        # incarnation 1: healthy.
+        h = InjectedHealth({1: "stalled"} if not incarnations else {})
+        incarnations.append(h)
+        return h
+
+    lines = []
+    gang = t.gang(
+        2, max_restarts=1, print_fn=lines.append,
+        health_factory=health_factory,
+    )
+    assert gang.run() == 0
+    assert gang.restarts == 1
+    assert any("worker1=stalled" in l for l in lines)
+    assert t.procs[(1, 0)].killed  # the stalled member was killed, not waited on
+    # a fresh detector per incarnation, each torn down afterwards
+    assert len(incarnations) == 2 and all(h.stopped for h in incarnations)
+
+
+# ---------------------------------------------------------------------------
+# Bounded jax.distributed.initialize (cluster.bounded_initialize).
+# ---------------------------------------------------------------------------
+
+_CLUSTER = ClusterConfig.from_lists(["127.0.0.1:29001", "127.0.0.1:29002"])
+
+
+def test_bounded_initialize_retries_then_succeeds():
+    attempts, msgs = [], []
+
+    def flaky_init(**kw):
+        attempts.append(kw)
+        if len(attempts) < 3:
+            raise RuntimeError("barrier timed out")
+
+    bounded_initialize(
+        _CLUSTER, 1, timeout_s=7, attempts=3, backoff=0.0,
+        initialize_fn=flaky_init, sleep=lambda s: None, print_fn=msgs.append,
+    )
+    assert len(attempts) == 3
+    assert attempts[0] == dict(
+        coordinator_address="127.0.0.1:29001",
+        num_processes=2,
+        process_id=1,
+        initialization_timeout=7,
+    )
+    assert any("attempt 1/3" in m for m in msgs)
+
+
+def test_bounded_initialize_shuts_down_between_attempts():
+    """jax assigns its global distributed client BEFORE connect(), so a
+    timed-out attempt leaves half-initialized state and a bare re-call
+    dies with 'initialize should only be called once' — the wrapper must
+    tear down between attempts for the retry to be real."""
+    events = []
+
+    def flaky_init(**kw):
+        events.append("init")
+        if events.count("init") < 2:
+            raise RuntimeError("barrier timed out")
+
+    def shutdown():
+        events.append("shutdown")
+
+    bounded_initialize(
+        _CLUSTER, 0, timeout_s=5, attempts=3, backoff=0.0,
+        initialize_fn=flaky_init, shutdown_fn=shutdown,
+        sleep=lambda s: None, print_fn=lambda *a: None,
+    )
+    assert events == ["init", "shutdown", "init"]
+
+
+def test_bounded_initialize_exhausts_with_clear_error():
+    attempts, shutdowns = [], []
+
+    def dead_init(**kw):
+        attempts.append(kw)
+        raise TimeoutError("no coordinator")
+
+    with pytest.raises(BootstrapError) as exc:
+        bounded_initialize(
+            _CLUSTER, 0, timeout_s=5, attempts=2, backoff=0.0,
+            initialize_fn=dead_init, shutdown_fn=lambda: shutdowns.append(1),
+            sleep=lambda s: None, print_fn=lambda *a: None,
+        )
+    assert len(attempts) == 2
+    assert "127.0.0.1:29001" in str(exc.value) and "2 attempt(s)" in str(exc.value)
+    # torn down between attempts AND after the final failure — a later
+    # bootstrap in the same process must not inherit the half-initialized
+    # global client.
+    assert len(shutdowns) == 2
+
+
+def test_bounded_initialize_defaults_from_cluster_config():
+    attempts = []
+
+    def dead_init(**kw):
+        attempts.append(kw)
+        raise RuntimeError("down")
+
+    cluster = ClusterConfig(
+        worker_svrs=("h:1", "h:2"), connect_timeout_s=11, connect_attempts=1
+    )
+    with pytest.raises(BootstrapError):
+        bounded_initialize(
+            cluster, 0, initialize_fn=dead_init, sleep=lambda s: None,
+            print_fn=lambda *a: None,
+        )
+    assert len(attempts) == 1
+    assert attempts[0]["initialization_timeout"] == 11
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: stall trips should_stop; progress reporting plumbing.
+# ---------------------------------------------------------------------------
+
+
+class FakeHeartbeatCoordinator:
+    def __init__(self, failed=0, stalled=0):
+        self._failed, self._stalled = failed, stalled
+
+    def failed_count(self):
+        return self._failed
+
+    def stalled_count(self, stall_timeout_ms):
+        return self._stalled
+
+
+def test_supervisor_stall_trips_should_stop():
+    from distributed_tensorflow_tpu.train import Supervisor
+
+    sup = Supervisor(is_chief=True)
+    sup.attach_heartbeat(FakeHeartbeatCoordinator(stalled=1), stall_timeout_ms=5000)
+    assert sup.should_stop
+
+    sup2 = Supervisor(is_chief=True)
+    sup2.attach_heartbeat(FakeHeartbeatCoordinator(stalled=1))  # detection off
+    assert not sup2.should_stop
+
+    sup3 = Supervisor(is_chief=True)
+    sup3.attach_heartbeat(FakeHeartbeatCoordinator(failed=1), stall_timeout_ms=5000)
+    assert sup3.should_stop
+
+
+def test_supervisor_report_progress_forwards():
+    from distributed_tensorflow_tpu.train import Supervisor
+
+    sup = Supervisor(is_chief=True)
+    sup.report_progress(5)  # no reporter attached: no-op
+    seen = []
+    sup.attach_progress(seen.append)
+    sup.report_progress(7)
+    sup.report_progress(21)
+    assert seen == [7, 21]
+
+
+def test_process_context_report_progress_targets_sender():
+    from distributed_tensorflow_tpu.cluster import ProcessContext
+
+    class Sender:
+        def __init__(self):
+            self.values = []
+
+        def set_progress(self, p):
+            self.values.append(p)
+
+    class CoordinatorOnly:
+        pass  # no set_progress: a chief-side coordinator, not a sender
+
+    sender = Sender()
+    ctx = ProcessContext(
+        job_name="worker", task_index=1, num_processes=2,
+        is_chief=False, is_ps=False, heartbeat=sender,
+    )
+    ctx.report_progress(3)
+    assert sender.values == [3]
+
+    chief_sender = Sender()
+    ctx2 = ProcessContext(
+        job_name="worker", task_index=0, num_processes=2,
+        is_chief=True, is_ps=False,
+        heartbeat=CoordinatorOnly(), heartbeat_sender=chief_sender,
+    )
+    ctx2.report_progress(9)
+    assert chief_sender.values == [9]
+
+    ctx3 = ProcessContext(
+        job_name="worker", task_index=0, num_processes=1,
+        is_chief=True, is_ps=False,
+    )
+    ctx3.report_progress(1)  # nothing armed: no-op
+
+
+# ---------------------------------------------------------------------------
+# Env knobs + bootstrap threading (the two wiring satellites).
+# ---------------------------------------------------------------------------
+
+
+def test_config_from_env_elastic_knobs(monkeypatch):
+    from distributed_tensorflow_tpu.launch import config_from_env
+
+    monkeypatch.setenv("DTF_MAX_RESTARTS", "4")
+    monkeypatch.setenv("DTF_STALL_TIMEOUT_MS", "45000")
+    cfg = config_from_env()
+    assert cfg.max_restarts == 4
+    assert cfg.stall_timeout_ms == 45000
+
+
+def test_cluster_from_env_heartbeat_knobs(monkeypatch):
+    from distributed_tensorflow_tpu.launch import cluster_from_env
+
+    monkeypatch.setenv("DTF_HEARTBEAT_PORT", "7777")
+    monkeypatch.setenv("DTF_HEARTBEAT_TIMEOUT_MS", "2500")
+    monkeypatch.setenv("DTF_HEARTBEAT_HOST", "10.0.0.9")
+    cluster = cluster_from_env(_CLUSTER)
+    assert cluster.heartbeat_port == 7777
+    assert cluster.heartbeat_timeout_ms == 2500
+    assert cluster.heartbeat_host == "10.0.0.9"
+    assert cluster.worker_svrs == _CLUSTER.worker_svrs  # base preserved
+
+    monkeypatch.setenv("DTF_HEARTBEAT_PORT", "0")  # explicit disable
+    monkeypatch.delenv("DTF_HEARTBEAT_HOST")
+    assert cluster_from_env(_CLUSTER).heartbeat_port is None
+    for var in ("DTF_HEARTBEAT_PORT", "DTF_HEARTBEAT_TIMEOUT_MS"):
+        monkeypatch.delenv(var)
+    assert cluster_from_env(_CLUSTER) is _CLUSTER  # no overrides: untouched
+
+
+def test_bootstrap_from_argv_threads_cluster_heartbeat(monkeypatch):
+    """The round-7 wiring fix: launch.run's bootstrap_from_argv path must
+    arm the detector from ClusterConfig — no caller-built context needed.
+    Proven by recording what bootstrap hands the native sender."""
+    from distributed_tensorflow_tpu.runtime import native
+
+    created = []
+
+    class RecordingWorker:
+        def __init__(self, host, port, worker_id, interval_ms=1000):
+            created.append((host, port, worker_id, interval_ms))
+
+        def set_progress(self, p):
+            pass
+
+        def stop(self):
+            pass
+
+    monkeypatch.setattr(native, "HeartbeatWorker", RecordingWorker)
+    from distributed_tensorflow_tpu.cluster import bootstrap_from_argv
+
+    cluster = ClusterConfig(
+        worker_svrs=("127.0.0.1:29001", "127.0.0.1:29002"),
+        heartbeat_port=7311,
+        heartbeat_timeout_ms=2000,
+        heartbeat_host="127.0.0.1",  # agent-hosted: every task a sender
+    )
+    ctx = bootstrap_from_argv(
+        cluster,
+        ["--job_name=worker", "--task_index=1"],
+        initialize_distributed=False,
+        print_fn=lambda *a: None,
+    )
+    assert created == [("127.0.0.1", 7311, 1, 400)]  # interval = timeout//5
+    assert ctx.heartbeat is not None
+    ctx.close()
+
+
+def test_bootstrap_without_heartbeat_unchanged(monkeypatch):
+    from distributed_tensorflow_tpu.cluster import bootstrap_from_argv
+
+    ctx = bootstrap_from_argv(
+        _CLUSTER,
+        ["--job_name=worker", "--task_index=1"],
+        initialize_distributed=False,
+        print_fn=lambda *a: None,
+    )
+    assert ctx.heartbeat is None and ctx.heartbeat_sender is None
+
+
+# ---------------------------------------------------------------------------
+# launch_local: elastic driver over real (trivial) subprocesses.
+# ---------------------------------------------------------------------------
+
+
+def test_launch_local_elastic_clean_gang(tmp_path):
+    import sys
+
+    from distributed_tensorflow_tpu.tools.launch_local import launch
+
+    lines = []
+    rc = launch(
+        [sys.executable, "-c", "import sys; sys.exit(0)"],
+        num_workers=2,
+        logdir=str(tmp_path),
+        max_restarts=2,
+        poll_interval=0.05,
+        print_fn=lines.append,
+    )
+    assert rc == 0
+    assert not any(str(l).startswith("Restart: restart=") for l in lines)
+
+
+def test_launch_local_elastic_exhausts_budget(tmp_path):
+    import sys
+
+    from distributed_tensorflow_tpu.tools.launch_local import launch
+
+    lines = []
+    rc = launch(
+        [sys.executable, "-c", "import sys; sys.exit(3)"],
+        num_workers=1,
+        logdir=str(tmp_path),
+        max_restarts=1,
+        backoff=0.05,
+        poll_interval=0.05,
+        print_fn=lines.append,
+    )
+    assert rc == 1
+    assert any("restart=1/1" in str(l) for l in lines)
+    assert any("budget exhausted" in str(l) for l in lines)
+    # relaunch appended to the same log (the failure is not erased)
+    assert (tmp_path / "worker0.log").exists()
+    # restart tfevents sidecar written by the driver
+    assert any(".elastic" in f.name for f in tmp_path.iterdir())
+
+
+def test_launch_local_rejects_unsupervised_elastic(tmp_path):
+    import sys
+
+    from distributed_tensorflow_tpu.tools.launch_local import launch
+
+    with pytest.raises(ValueError, match="wait=True"):
+        launch(
+            [sys.executable, "-c", "pass"],
+            num_workers=1,
+            logdir=str(tmp_path),
+            max_restarts=2,
+            wait=False,
+        )
+
+
+def test_launch_local_cli_defaults_from_env(monkeypatch):
+    """A pod scheduler's DTF_* env arms the elastic driver with no flag
+    changes (the TrainConfig.max_restarts / config_from_env mirror)."""
+    import argparse
+
+    from distributed_tensorflow_tpu.tools import launch_local
+
+    monkeypatch.setenv("DTF_MAX_RESTARTS", "3")
+    monkeypatch.setenv("DTF_HEARTBEAT_PORT", "7411")
+    monkeypatch.setenv("DTF_STALL_TIMEOUT_MS", "60000")
+    seen = {}
+
+    def fake_launch(command, workers, ps, logdir, **kw):
+        seen.update(kw, workers=workers)
+        return 0
+
+    monkeypatch.setattr(launch_local, "launch", fake_launch)
+    assert launch_local.main(["--workers", "2", "--", "echo", "hi"]) == 0
+    assert seen["max_restarts"] == 3
+    assert seen["heartbeat_port"] == 7411
+    assert seen["stall_timeout_ms"] == 60000
+    assert seen["heartbeat_grace_ms"] is None  # default: 5x timeout
+
+
+def test_launch_local_fail_stop_path_unchanged(tmp_path):
+    """max_restarts=0 keeps the pre-round-7 one-shot semantics: every task
+    runs to completion exactly once, non-zero rc if any worker failed."""
+    import sys
+
+    from distributed_tensorflow_tpu.tools.launch_local import launch
+
+    lines = []
+    rc = launch(
+        [sys.executable, "-c", "import sys; sys.exit(1)"],
+        num_workers=1,
+        logdir=str(tmp_path),
+        print_fn=lines.append,
+    )
+    assert rc == 1
+    assert any("worker0: exit 1" in str(l) for l in lines)
+    assert not any("Restart" in str(l) for l in lines)

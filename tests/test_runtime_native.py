@@ -136,6 +136,52 @@ def test_heartbeat_failure_detection():
         w0.stop()
 
 
+def test_heartbeat_progress_payload():
+    """Round 7: every beat carries a monotonic progress counter
+    ("HB <id> <progress>") so the detector can tell LIVE-BUT-STALLED
+    (beating, counter frozen) from dead (beats stopped) — the verdict the
+    elastic agent (train/elastic.py) recovers from."""
+    port = 19437
+    with native.HeartbeatCoordinator(port, expected_workers=2, timeout_ms=600) as coord:
+        w0 = native.HeartbeatWorker("127.0.0.1", port, worker_id=0, interval_ms=100)
+        w1 = native.HeartbeatWorker("127.0.0.1", port, worker_id=1, interval_ms=100)
+        try:
+            time.sleep(0.4)
+            # Until the first set_progress, beats carry NO counter: the
+            # startup carve-out — a beating-but-never-progressed worker
+            # (import, first compile) must not be judged stalled.
+            assert coord.alive_count() == 2
+            assert coord.progress(0) == -1 and coord.progress(1) == -1
+            assert coord.ms_since_progress(0) == -1
+            assert coord.stalled_count(100) == 0
+            assert coord.progress(5) == -1  # out of range: never
+            w0.set_progress(7)
+            w1.set_progress(1)
+            time.sleep(0.3)
+            assert coord.progress(0) == 7 and coord.progress(1) == 1
+            # stamped when the coordinator SAW the post-update beat — recent
+            # relative to any realistic stall window, not to the sleep
+            assert coord.ms_since_progress(0) <= 450
+            # w1's counter now freezes: after the stall window it is
+            # stalled; a fresh UPDATE resets w0's clock.
+            time.sleep(0.5)
+            w0.set_progress(8)
+            time.sleep(0.3)
+            assert coord.progress(0) == 8
+            assert coord.ms_since_progress(0) <= 450
+            assert coord.ms_since_progress(1) >= 700
+            assert coord.stalled_count(700) == 1  # w1 only
+            assert coord.stalled_count(60_000) == 0
+        finally:
+            w0.stop()
+            w1.stop()
+        # Dead workers (beats stopped) are NOT stalled — they are failed;
+        # stall is strictly the live-and-frozen class.
+        time.sleep(0.8)
+        assert coord.failed_count() == 2
+        assert coord.stalled_count(100) == 0
+
+
 def test_stale_library_missing_symbols_raises_importerror(tmp_path, monkeypatch):
     """A .so built from older sources (missing newer symbols) must surface as
     ImportError — so `except (ImportError, OSError)` fallbacks engage — and a
