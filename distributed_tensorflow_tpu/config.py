@@ -86,6 +86,28 @@ class ClusterConfig:
     ) -> "ClusterConfig":
         return cls(worker_svrs=tuple(worker_svrs), ps_svrs=tuple(ps_svrs))
 
+    def subset(self, ranks: Sequence[int]) -> "ClusterConfig":
+        """The surviving sub-cluster after an elastic resize
+        (train/elastic.py, round 8): new rank ``r`` is served by the host
+        that held original rank ``ranks[r]``, and ``ranks[0]``'s address
+        becomes the coordinator. The full ``worker_svrs`` list stays the
+        roster of POTENTIAL hosts (a regrown gang selects a superset);
+        everything else (heartbeat, bootstrap bounds) carries over."""
+        ranks = tuple(int(r) for r in ranks)
+        if not ranks:
+            raise ValueError("subset needs at least one rank")
+        if len(set(ranks)) != len(ranks):
+            raise ValueError(f"subset ranks must be unique, got {ranks}")
+        bad = [r for r in ranks if not 0 <= r < len(self.worker_svrs)]
+        if bad:
+            raise ValueError(
+                f"subset ranks {bad} out of range for "
+                f"{len(self.worker_svrs)} worker_svrs entries"
+            )
+        return dataclasses.replace(
+            self, worker_svrs=tuple(self.worker_svrs[r] for r in ranks)
+        )
+
 
 @dataclasses.dataclass(frozen=True)
 class TrainConfig:
@@ -164,6 +186,20 @@ class TrainConfig:
     # silence timeouts alone never fire). 0 disables stall detection.
     # Size it above the worst-case epoch + first-compile latency.
     stall_timeout_ms: int = 0
+    # Shrink-to-fit floor (round 8, train/elastic.py): when a gang member
+    # dies and no replacement registers within rejoin_timeout_s, the
+    # elastic agent relaunches only the survivors at the reduced world
+    # size — down to this floor; below it the gang fail-stops (round 6
+    # semantics). 0 (default) disables resizing entirely: round 7's
+    # fixed-size gang restart. Like max_restarts, consumed OUTSIDE the
+    # trainer by the elastic driver (DTF_MIN_WORKERS →
+    # tools/launch_local --min-workers); kept on TrainConfig so
+    # config_from_env stays the single config surface.
+    min_workers: int = 0
+    # How long a failed member's slot may stay vacant before the gang
+    # gives up on a replacement and resizes without it (only meaningful
+    # with min_workers > 0). 0 decides from one availability probe.
+    rejoin_timeout_s: float = 30.0
     sync: bool = True  # sync DP (pmean all-reduce) vs async emulation
     async_avg_every: int = 0  # async mode: average params every N steps (0 = never)
     # Sync parameter layout: "replicated" (params on every chip, gradient
@@ -253,6 +289,15 @@ class TrainConfig:
         if self.stall_timeout_ms < 0:
             raise ValueError(
                 f"stall_timeout_ms must be >= 0 (0 disables), got {self.stall_timeout_ms}"
+            )
+        if self.min_workers < 0:
+            raise ValueError(
+                f"min_workers must be >= 0 (0 disables resizing), "
+                f"got {self.min_workers}"
+            )
+        if self.rejoin_timeout_s < 0:
+            raise ValueError(
+                f"rejoin_timeout_s must be >= 0, got {self.rejoin_timeout_s}"
             )
 
     def replace(self, **kw) -> "TrainConfig":

@@ -17,21 +17,21 @@ reference tfdist_between.py:32-113) collapses into :func:`build_trainer`.
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
+from typing import TYPE_CHECKING
 
-from distributed_tensorflow_tpu.cluster import ProcessContext
 from distributed_tensorflow_tpu.config import ClusterConfig, TrainConfig
-from distributed_tensorflow_tpu.data import read_data_sets
-from distributed_tensorflow_tpu.ops import optim as optim_lib
-from distributed_tensorflow_tpu.parallel import (
-    AsyncDataParallel,
-    SingleDevice,
-    SyncDataParallel,
-    make_mesh,
-)
-from distributed_tensorflow_tpu.train import Trainer
-from distributed_tensorflow_tpu.utils.summary import SummaryWriter
+
+if TYPE_CHECKING:  # jax-backed types only; see the lazy imports below
+    from distributed_tensorflow_tpu.cluster import ProcessContext
+    from distributed_tensorflow_tpu.train.trainer import Trainer
+    from distributed_tensorflow_tpu.utils.summary import SummaryWriter
+
+# The jax-backed stack (strategies, models, data, Trainer) is imported
+# inside build_strategy/build_trainer/run: the config surface of this
+# module (config_from_env / cluster_from_env) is also the elastic
+# driver's — a lean supervisor process, or a degraded container, must be
+# able to parse the DTF_* env without a working jax (same rationale as
+# the lazy train/__init__).
 
 
 def config_from_env(base: TrainConfig | None = None) -> TrainConfig:
@@ -46,29 +46,45 @@ def config_from_env(base: TrainConfig | None = None) -> TrainConfig:
     DTF_KEEP_LAST (checkpoint retention), DTF_MAX_ROLLBACKS (anomaly
     guard budget), and the elastic knobs (train/elastic.py):
     DTF_MAX_RESTARTS (gang-restart budget), DTF_STALL_TIMEOUT_MS
-    (live-but-stalled detection window)."""
+    (live-but-stalled detection window), DTF_MIN_WORKERS (shrink-to-fit
+    floor, round 8; 0 disables resizing) and DTF_REJOIN_TIMEOUT_S
+    (replacement-registration window before a resize). Invalid values
+    raise ValueError naming the knob — a scheduler typo must fail the
+    launch, not silently train with defaults."""
     import os
+
+    def _parse(var: str, conv):
+        try:
+            return conv(os.environ[var])
+        except ValueError as exc:
+            raise ValueError(
+                f"invalid {var}={os.environ[var]!r}: {exc}"
+            ) from None
 
     cfg = base or TrainConfig()
     kw = {}
     if "DTF_CHECKPOINT" in os.environ:
         kw["checkpoint_dir"] = os.environ["DTF_CHECKPOINT"] or None
     if "DTF_KEEP_LAST" in os.environ:
-        kw["keep_last_n"] = int(os.environ["DTF_KEEP_LAST"]) or None
+        kw["keep_last_n"] = _parse("DTF_KEEP_LAST", int) or None
     if "DTF_MAX_ROLLBACKS" in os.environ:
-        kw["max_rollbacks"] = int(os.environ["DTF_MAX_ROLLBACKS"])
+        kw["max_rollbacks"] = _parse("DTF_MAX_ROLLBACKS", int)
     if "DTF_MAX_RESTARTS" in os.environ:
-        kw["max_restarts"] = int(os.environ["DTF_MAX_RESTARTS"])
+        kw["max_restarts"] = _parse("DTF_MAX_RESTARTS", int)
     if "DTF_STALL_TIMEOUT_MS" in os.environ:
-        kw["stall_timeout_ms"] = int(os.environ["DTF_STALL_TIMEOUT_MS"])
+        kw["stall_timeout_ms"] = _parse("DTF_STALL_TIMEOUT_MS", int)
+    if "DTF_MIN_WORKERS" in os.environ:
+        kw["min_workers"] = _parse("DTF_MIN_WORKERS", int)
+    if "DTF_REJOIN_TIMEOUT_S" in os.environ:
+        kw["rejoin_timeout_s"] = _parse("DTF_REJOIN_TIMEOUT_S", float)
     if "DTF_MODEL" in os.environ:
         kw["model"] = os.environ["DTF_MODEL"]
     if "DTF_EPOCHS" in os.environ:
-        kw["epochs"] = int(os.environ["DTF_EPOCHS"])
+        kw["epochs"] = _parse("DTF_EPOCHS", int)
     if "DTF_BATCH_SIZE" in os.environ:
-        kw["batch_size"] = int(os.environ["DTF_BATCH_SIZE"])
+        kw["batch_size"] = _parse("DTF_BATCH_SIZE", int)
     if "DTF_LR" in os.environ:
-        kw["learning_rate"] = float(os.environ["DTF_LR"])
+        kw["learning_rate"] = _parse("DTF_LR", float)
     if "DTF_SCAN" in os.environ:
         kw["scan_epoch"] = os.environ["DTF_SCAN"] == "1"
     if "DTF_COMPILED" in os.environ:
@@ -87,20 +103,76 @@ def cluster_from_env(base: ClusterConfig | None = None) -> ClusterConfig:
     train/elastic.py — that hosts the detector out-of-band; every task
     then sends beats there instead of the chief hosting). ``launch.run``
     applies this, so a scheduler arms failure detection without code
-    changes, mirroring DTF_CHECKPOINT/DTF_MAX_ROLLBACKS."""
+    changes, mirroring DTF_CHECKPOINT/DTF_MAX_ROLLBACKS.
+
+    Resize topology (round 8; set by the elastic driver on a relaunch at
+    a non-original world size): DTF_WORKER_RANKS — comma-separated
+    ORIGINAL ranks in new-rank order, resolved via
+    ``ClusterConfig.subset`` (the worker re-bootstraps
+    ``jax.distributed`` at ``len(ranks)`` processes with ``ranks[0]``'s
+    host as coordinator); DTF_WORLD_SIZE — shorthand for the first-N
+    prefix when the survivor set IS a prefix, and a cross-check
+    (``len(ranks)`` must match) when both are set. Invalid values raise
+    ValueError naming the knob."""
     import dataclasses
     import os
+
+    def _parse(var: str, conv):
+        try:
+            return conv(os.environ[var])
+        except ValueError as exc:
+            raise ValueError(
+                f"invalid {var}={os.environ[var]!r}: {exc}"
+            ) from None
 
     cluster = base or ClusterConfig()
     kw = {}
     if "DTF_HEARTBEAT_PORT" in os.environ:
         raw = os.environ["DTF_HEARTBEAT_PORT"]
-        kw["heartbeat_port"] = int(raw) if raw and int(raw) else None
+        kw["heartbeat_port"] = _parse("DTF_HEARTBEAT_PORT", int) if raw else None
+        if kw["heartbeat_port"] == 0:
+            kw["heartbeat_port"] = None
     if "DTF_HEARTBEAT_TIMEOUT_MS" in os.environ:
-        kw["heartbeat_timeout_ms"] = int(os.environ["DTF_HEARTBEAT_TIMEOUT_MS"])
+        kw["heartbeat_timeout_ms"] = _parse("DTF_HEARTBEAT_TIMEOUT_MS", int)
     if "DTF_HEARTBEAT_HOST" in os.environ:
         kw["heartbeat_host"] = os.environ["DTF_HEARTBEAT_HOST"] or None
-    return dataclasses.replace(cluster, **kw) if kw else cluster
+    cluster = dataclasses.replace(cluster, **kw) if kw else cluster
+
+    ranks = None
+    if os.environ.get("DTF_WORKER_RANKS"):
+        raw = os.environ["DTF_WORKER_RANKS"]
+        try:
+            ranks = tuple(int(r) for r in raw.split(","))
+        except ValueError:
+            raise ValueError(
+                f"invalid DTF_WORKER_RANKS={raw!r}: must be comma-separated "
+                "integers (original ranks in new-rank order)"
+            ) from None
+    if os.environ.get("DTF_WORLD_SIZE"):
+        raw = os.environ["DTF_WORLD_SIZE"]
+        try:
+            world = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"invalid DTF_WORLD_SIZE={raw!r}: must be an integer"
+            ) from None
+        if world < 1:
+            raise ValueError(f"invalid DTF_WORLD_SIZE={world}: must be >= 1")
+        if ranks is None:
+            ranks = tuple(range(world))
+        elif len(ranks) != world:
+            raise ValueError(
+                f"DTF_WORLD_SIZE={world} contradicts DTF_WORKER_RANKS="
+                f"{ranks} (length {len(ranks)})"
+            )
+    if ranks is not None:
+        if not cluster.worker_svrs:
+            raise ValueError(
+                "DTF_WORLD_SIZE/DTF_WORKER_RANKS set but the base "
+                "ClusterConfig lists no worker_svrs to select from"
+            )
+        cluster = cluster.subset(ranks)
+    return cluster
 
 
 def build_strategy(config: TrainConfig, *, devices=None, mesh=None):
@@ -112,6 +184,15 @@ def build_strategy(config: TrainConfig, *, devices=None, mesh=None):
         )
     if config.dp_mode == "zero" and not config.sync:
         raise ValueError("dp_mode='zero' requires sync=True (async keeps per-chip copies)")
+    import jax
+
+    from distributed_tensorflow_tpu.parallel import (
+        AsyncDataParallel,
+        SingleDevice,
+        SyncDataParallel,
+        make_mesh,
+    )
+
     devices = list(devices if devices is not None else jax.devices())
     if mesh is None and len(devices) == 1:
         return SingleDevice()
@@ -134,6 +215,8 @@ class _RematAdapter:
     (TF1 stored everything)."""
 
     def __init__(self, model):
+        import jax
+
         self._model = model
         self._apply = jax.checkpoint(model.apply)
         if hasattr(model, "apply_logits"):
@@ -175,6 +258,13 @@ def build_trainer(
     summary_writer: SummaryWriter | None = None,
     print_fn=print,
 ) -> Trainer:
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.data import read_data_sets
+    from distributed_tensorflow_tpu.ops import optim as optim_lib
+    from distributed_tensorflow_tpu.train.trainer import Trainer
+    from distributed_tensorflow_tpu.utils.summary import SummaryWriter
+
     config = config or TrainConfig()
     is_chief = context.is_chief if context is not None else True
     if model is None:

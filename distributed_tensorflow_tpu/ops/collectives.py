@@ -58,7 +58,12 @@ def to_varying(a, axis_name):
         return a
     if hasattr(lax, "pcast"):
         return lax.pcast(a, axes, to="varying")
-    return lax.pvary(a, axes)
+    if hasattr(lax, "pvary"):
+        return lax.pvary(a, axes)
+    # Pre-vma vintage (no pcast, no pvary): every value is implicitly
+    # varying under shard_map and there is no rep/vma checker to satisfy —
+    # the cast is an identity.
+    return a
 
 
 def ring_all_reduce(x: jax.Array, axis_name: str) -> jax.Array:

@@ -1,0 +1,114 @@
+"""Fetch the real MNIST IDX files so the convergence oracle can run.
+
+The reference trained on the real MNIST bytes
+(``input_data.read_data_sets("MNIST_data/", ...)``, reference
+tfsingle.py:13-14) and its headline numbers — 0.72 single/sync, 0.80
+async — are accuracies on that data. This repo's development containers
+are zero-egress, so the suite trains on the deterministic synthetic
+MNIST and `tests/integration/test_oracles.py::test_real_mnist_convergence_oracle`
+auto-skips until the IDX quartet exists. On ANY egress-capable machine,
+one line closes that gap::
+
+    python -m distributed_tensorflow_tpu.tools.fetch_mnist
+
+then::
+
+    RUN_SLOW=1 python -m pytest tests/integration/test_oracles.py \
+        -k real_mnist -q
+
+Downloads the four gzipped IDX files into ``MNIST_data/`` (or
+``--data-dir``/``$MNIST_DATA_DIR``), tries several long-lived mirrors in
+order, validates each file's IDX magic number and item count before
+keeping it, and is idempotent (present-and-valid files are skipped).
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import os
+import struct
+import sys
+import urllib.request
+
+# (filename, expected magic, expected item count)
+_FILES = (
+    ("train-images-idx3-ubyte", 2051, 60_000),
+    ("train-labels-idx1-ubyte", 2049, 60_000),
+    ("t10k-images-idx3-ubyte", 2051, 10_000),
+    ("t10k-labels-idx1-ubyte", 2049, 10_000),
+)
+
+# Mirrors in preference order. The canonical yann.lecun.com host has been
+# intermittently 403 for years; the GCS CVDF mirror is the stable one.
+_MIRRORS = (
+    "https://storage.googleapis.com/cvdf-datasets/mnist/",
+    "https://ossci-datasets.s3.amazonaws.com/mnist/",
+    "http://yann.lecun.com/exdb/mnist/",
+)
+
+
+def _valid(path: str, magic: int, count: int) -> bool:
+    try:
+        with open(path, "rb") as f:
+            got_magic, got_count = struct.unpack(">II", f.read(8))
+        return got_magic == magic and got_count == count
+    except (OSError, struct.error):
+        return False
+
+
+def fetch(data_dir: str = "MNIST_data", print_fn=print) -> bool:
+    """Download any missing/invalid IDX files into ``data_dir``. Returns
+    True when all four are present and valid afterwards."""
+    os.makedirs(data_dir, exist_ok=True)
+    ok = True
+    for name, magic, count in _FILES:
+        dest = os.path.join(data_dir, name)
+        if _valid(dest, magic, count):
+            print_fn(f"{name}: present and valid, skipping")
+            continue
+        done = False
+        for mirror in _MIRRORS:
+            url = mirror + name + ".gz"
+            try:
+                print_fn(f"{name}: fetching {url}")
+                with urllib.request.urlopen(url, timeout=60) as resp:
+                    raw = gzip.decompress(resp.read())
+                tmp = dest + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(raw)
+                if not _valid(tmp, magic, count):
+                    os.remove(tmp)
+                    print_fn(f"{name}: {mirror} served invalid bytes")
+                    continue
+                os.replace(tmp, dest)
+                print_fn(f"{name}: ok ({len(raw)} bytes)")
+                done = True
+                break
+            except Exception as exc:  # noqa: BLE001 — try the next mirror
+                print_fn(f"{name}: {mirror} failed ({exc})")
+        if not done:
+            ok = False
+    return ok
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--data-dir",
+        default=os.environ.get("MNIST_DATA_DIR", "MNIST_data"),
+        help="target directory (default: $MNIST_DATA_DIR or MNIST_data)",
+    )
+    args = parser.parse_args(argv)
+    if fetch(args.data_dir):
+        print(
+            "all four IDX files ready — run: RUN_SLOW=1 python -m pytest "
+            "tests/integration/test_oracles.py -k real_mnist -q"
+        )
+        return 0
+    print("some files could not be fetched; see messages above", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

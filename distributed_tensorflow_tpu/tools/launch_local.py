@@ -30,6 +30,24 @@ valid checkpoint — arm ``DTF_CHECKPOINT`` so there is something to resume.
 The driver hosts the heartbeat detector itself (out-of-band of the job)
 and points the workers at it via ``DTF_HEARTBEAT_HOST``/``_PORT``;
 ``max_restarts=0`` (default) preserves the old fail-stop behavior exactly.
+
+``--min-workers M`` (round 8) arms shrink-to-fit resize on top: a worker
+whose slot is LOST — marker file ``<logdir>/worker<i>.lost`` present, the
+driver's host-availability probe — and not replaced within
+``--rejoin-timeout-s`` is benched, and the survivors relaunch alone at
+the reduced world size (down to M; below: fail-stop). Resized
+incarnations are spawned with compact ``--task_index`` ranks and
+``DTF_WORLD_SIZE``/``DTF_WORKER_RANKS`` in the env, which
+``launch.cluster_from_env`` resolves to the surviving sub-cluster
+(``ClusterConfig.subset``) so the workers re-bootstrap
+``jax.distributed`` at the new ``num_processes`` and cross-restore the
+old-world checkpoint. Deleting the ``.lost`` marker registers a
+replacement: the gang grows back at the next poll. An external scheduler
+manages the markers in production; ``--drive-mode
+kill-without-replace|kill-then-replace`` makes the driver itself stage
+the scenario (SIGKILL the highest worker after ``--drive-after-s``, mark
+it lost, and — in then-replace mode — clear the marker after
+``--drive-replace-after-s``) for demos and the integration tests.
 """
 
 from __future__ import annotations
@@ -38,6 +56,8 @@ import argparse
 import os
 import subprocess
 import sys
+import threading
+import time
 
 
 def _spawn_task(
@@ -47,13 +67,18 @@ def _spawn_task(
     logdir: str,
     env: dict,
     mode: str = "wb",
+    log_index: int | None = None,
 ):
     """One task process, stdout+stderr to ``<logdir>/<role><i>.log``. The
     first incarnation truncates (the pre-round-7 behavior, unchanged); a
     gang RELAUNCH passes ``mode="ab"`` so the restarted incarnation's log
     continues the same file instead of erasing the failure it is
-    recovering from."""
-    log_path = os.path.join(logdir, f"{role}{index}.log")
+    recovering from. ``log_index`` keeps the log under the member's
+    ORIGINAL id when a resize remaps ``index`` to a compact rank (one
+    member, one log file, across every topology it serves in)."""
+    log_path = os.path.join(
+        logdir, f"{role}{index if log_index is None else log_index}.log"
+    )
     f = open(log_path, mode)
     try:
         return subprocess.Popen(
@@ -66,6 +91,14 @@ def _spawn_task(
         # Popen inherited the descriptor; closing ours leaks nothing and a
         # relaunch reopens fresh (no shared offsets across incarnations).
         f.close()
+
+
+def lost_marker(logdir: str, worker: int) -> str:
+    """Path of worker ``i``'s host-lost marker: present = no host backs
+    the slot (the driver's availability probe); deleting it registers a
+    replacement. The file-based contract keeps the probe scriptable by
+    any external scheduler."""
+    return os.path.join(logdir, f"worker{worker}.lost")
 
 
 def _launch_elastic(
@@ -81,6 +114,11 @@ def _launch_elastic(
     stall_timeout_ms: int,
     backoff: float,
     poll_interval: float,
+    min_workers: int | None = None,
+    rejoin_timeout_s: float = 30.0,
+    drive_mode: str | None = None,
+    drive_after_s: float = 8.0,
+    drive_replace_after_s: float = 10.0,
     print_fn=print,
 ) -> int:
     from distributed_tensorflow_tpu.train.elastic import (
@@ -104,10 +142,12 @@ def _launch_elastic(
 
             native.load_library()
 
-            def health_factory():
+            def health_factory(world=num_workers):
+                # world: the incarnation's member count — a shrunk gang's
+                # detector must expect M compact ranks, not N.
                 return HeartbeatHealth(
                     heartbeat_port,
-                    num_workers,
+                    world,
                     timeout_ms=heartbeat_timeout_ms,
                     stall_timeout_ms=stall_timeout_ms,
                     grace_ms=heartbeat_grace_ms,
@@ -140,8 +180,36 @@ def _launch_elastic(
 
         return _spawn
 
+    def _make_topo_spawn(i: int):
+        def _spawn(rank: int, world: int, ranks):
+            # A resized incarnation: compact --task_index, the topology in
+            # the env (launch.cluster_from_env → ClusterConfig.subset), the
+            # log continuing under the member's ORIGINAL id.
+            launched.add(i)
+            tenv = dict(env)
+            tenv["DTF_WORLD_SIZE"] = str(world)
+            tenv["DTF_WORKER_RANKS"] = ",".join(str(r) for r in ranks)
+            return _spawn_task(
+                command, "worker", rank, logdir, tenv, mode="ab", log_index=i
+            )
+
+        return _spawn
+
+    def _make_available(i: int):
+        def _available():
+            return not os.path.exists(lost_marker(logdir, i))
+
+        return _available
+
+    elastic_resize = min_workers is not None and 0 < min_workers < num_workers
     agents = [
-        ElasticAgent(f"worker{i}", _make_spawn(i), worker_id=i)
+        ElasticAgent(
+            f"worker{i}",
+            _make_spawn(i),
+            worker_id=i,
+            available_fn=_make_available(i) if elastic_resize else None,
+            topo_spawn_fn=_make_topo_spawn(i) if elastic_resize else None,
+        )
         for i in range(num_workers)
     ]
     gang = ElasticGang(
@@ -150,9 +218,35 @@ def _launch_elastic(
         backoff=backoff,
         health_factory=health_factory,
         poll_interval=poll_interval,
+        min_workers=min_workers if elastic_resize else None,
+        rejoin_timeout_s=rejoin_timeout_s,
         print_fn=print_fn,
         summary_writer=summary_writer,
     )
+    if drive_mode:
+        # Scenario driver (demos + integration tests): SIGKILL the highest
+        # worker after a delay and mark its host lost; then-replace mode
+        # later clears the marker, which the gang reads as a replacement
+        # registering (grow trigger).
+        victim = num_workers - 1
+
+        def _drive():
+            time.sleep(drive_after_s)
+            open(lost_marker(logdir, victim), "w").close()
+            handle = agents[victim].handle
+            if handle is not None:
+                try:
+                    handle.kill()
+                except Exception:  # noqa: BLE001 — already exited
+                    pass
+            if drive_mode == "kill-then-replace":
+                time.sleep(drive_replace_after_s)
+                try:
+                    os.remove(lost_marker(logdir, victim))
+                except OSError:
+                    pass
+
+        threading.Thread(target=_drive, daemon=True).start()
     rc = gang.run()
     for agent in agents:
         code = agent.poll()
@@ -180,6 +274,13 @@ def launch(
     stall_timeout_ms: int = 0,
     backoff: float = 1.0,
     poll_interval: float = 0.5,
+    # Shrink-to-fit resize (round 8; only with max_restarts > 0). None/0
+    # disables: the round-7 fixed-size gang.
+    min_workers: int | None = None,
+    rejoin_timeout_s: float = 30.0,
+    drive_mode: str | None = None,
+    drive_after_s: float = 8.0,
+    drive_replace_after_s: float = 10.0,
     print_fn=print,
 ) -> int:
     if max_restarts > 0 and not wait:
@@ -187,6 +288,23 @@ def launch(
         # would drop the requested restart budget on the floor.
         raise ValueError("max_restarts > 0 requires wait=True (the elastic "
                          "agent supervises the gang to completion)")
+    if min_workers and min_workers > num_workers:
+        raise ValueError(
+            f"min_workers={min_workers} exceeds num_workers={num_workers}"
+        )
+    if min_workers and not max_restarts:
+        raise ValueError(
+            "min_workers needs max_restarts > 0 (resizing is a relaunch — "
+            "a one-shot gang has no budget to relaunch with)"
+        )
+    if drive_mode not in (None, "", "none", "kill-without-replace",
+                          "kill-then-replace"):
+        raise ValueError(
+            f"unknown drive_mode {drive_mode!r}; use "
+            "kill-without-replace or kill-then-replace"
+        )
+    if drive_mode in ("", "none"):
+        drive_mode = None
     os.makedirs(logdir, exist_ok=True)
     base_env = dict(os.environ)
     if env:
@@ -211,6 +329,11 @@ def launch(
             stall_timeout_ms=stall_timeout_ms,
             backoff=backoff,
             poll_interval=poll_interval,
+            min_workers=min_workers,
+            rejoin_timeout_s=rejoin_timeout_s,
+            drive_mode=drive_mode,
+            drive_after_s=drive_after_s,
+            drive_replace_after_s=drive_replace_after_s,
             print_fn=print_fn,
         )
         for name, p in ps_procs:
@@ -278,6 +401,32 @@ def main(argv=None) -> int:
         "$DTF_STALL_TIMEOUT_MS)",
     )
     parser.add_argument("--backoff", type=float, default=1.0)
+    parser.add_argument(
+        "--min-workers",
+        type=int,
+        default=int(os.environ.get("DTF_MIN_WORKERS", "0") or 0),
+        help="shrink-to-fit floor (round 8): a lost-and-unreplaced worker "
+        "shrinks the gang down to this size instead of restart-looping; "
+        "0 disables resizing (default: $DTF_MIN_WORKERS or 0)",
+    )
+    parser.add_argument(
+        "--rejoin-timeout-s",
+        type=float,
+        default=float(os.environ.get("DTF_REJOIN_TIMEOUT_S", "30") or 30),
+        help="how long a failed worker's slot may wait for a replacement "
+        "(delete <logdir>/worker<i>.lost to register one) before the gang "
+        "resizes without it (default: $DTF_REJOIN_TIMEOUT_S or 30)",
+    )
+    parser.add_argument(
+        "--drive-mode",
+        choices=("none", "kill-without-replace", "kill-then-replace"),
+        default="none",
+        help="scenario driver: SIGKILL the highest worker after "
+        "--drive-after-s and mark its host lost; then-replace clears the "
+        "marker after --drive-replace-after-s so the gang regrows",
+    )
+    parser.add_argument("--drive-after-s", type=float, default=8.0)
+    parser.add_argument("--drive-replace-after-s", type=float, default=10.0)
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="-- command to launch per task")
     args = parser.parse_args(argv)
@@ -297,6 +446,11 @@ def main(argv=None) -> int:
         heartbeat_grace_ms=args.heartbeat_grace_ms,
         stall_timeout_ms=args.stall_timeout_ms,
         backoff=args.backoff,
+        min_workers=args.min_workers or None,
+        rejoin_timeout_s=args.rejoin_timeout_s,
+        drive_mode=args.drive_mode,
+        drive_after_s=args.drive_after_s,
+        drive_replace_after_s=args.drive_replace_after_s,
     )
 
 

@@ -1,8 +1,35 @@
-from distributed_tensorflow_tpu.train.trainer import Trainer  # noqa: F401
-from distributed_tensorflow_tpu.train.lm_trainer import LMTrainer  # noqa: F401
-from distributed_tensorflow_tpu.train.supervisor import Supervisor  # noqa: F401
-from distributed_tensorflow_tpu.train.elastic import (  # noqa: F401
-    ElasticAgent,
-    ElasticGang,
-    HeartbeatHealth,
-)
+"""Training layer: loop, supervisor, resilience, elastic agents.
+
+Lazy exports (PEP 562, same pattern as the package root): the elastic
+agent/driver half of this package (`elastic.py`, consumed by
+`tools/launch_local.py`) supervises OS processes and must stay importable
+in a lean supervisor process — or a degraded container — that has no
+working jax; only touching `Trainer`/`LMTrainer`/`Supervisor` pulls the
+jax-backed training stack in.
+"""
+
+_LAZY_EXPORTS = {
+    "Trainer": ("distributed_tensorflow_tpu.train.trainer", "Trainer"),
+    "LMTrainer": ("distributed_tensorflow_tpu.train.lm_trainer", "LMTrainer"),
+    "Supervisor": ("distributed_tensorflow_tpu.train.supervisor", "Supervisor"),
+    "ElasticAgent": ("distributed_tensorflow_tpu.train.elastic", "ElasticAgent"),
+    "ElasticGang": ("distributed_tensorflow_tpu.train.elastic", "ElasticGang"),
+    "HeartbeatHealth": (
+        "distributed_tensorflow_tpu.train.elastic",
+        "HeartbeatHealth",
+    ),
+}
+
+__all__ = list(_LAZY_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        module, attr = _LAZY_EXPORTS[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(module), attr)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value

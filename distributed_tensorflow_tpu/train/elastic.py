@@ -49,6 +49,32 @@ driver (the reference's nohup-per-task workflow, now supervised);
 ``tests/test_elastic.py`` pins the state machine on a fake process table
 and ``tests/integration/test_fault_injection.py`` proves the SIGKILL →
 gang-restart → resume → rc 0 path end to end.
+
+Shrink-to-fit resize (round 8)
+------------------------------
+Round 7 only ever relaunched at the ORIGINAL world size: a permanently
+lost host meant an infinite restart loop until the budget burned out.
+With ``min_workers < len(agents)`` the gang **resizes instead of merely
+restarting**: after a failure verdict, each failed member's slot gets up
+to ``rejoin_timeout_s`` for a replacement to register
+(``ElasticAgent.available``); slots still vacant at the deadline are
+BENCHED and the surviving members relaunch alone at the reduced world
+size — down to the ``min_workers`` floor, below which the gang fail-stops
+(round 6 semantics). Relaunched members get compact ranks ``0..M-1`` via
+``topo_spawn_fn(rank, world, ranks)``; the workers re-bootstrap
+``jax.distributed`` at the new ``num_processes``
+(``launch.cluster_from_env`` reads the driver-set ``DTF_WORLD_SIZE`` /
+``DTF_WORKER_RANKS``) and ``Supervisor.prepare_or_restore`` restores the
+old-world checkpoint onto the new mesh through the round-5 canonical
+layer. While degraded, every poll also probes the benched slots: a
+replacement registering triggers a GROW — the same save→kill→relaunch→
+cross-restore cycle back toward the original world. Every resize
+(either direction) charges the restart budget once and emits a
+structured ``Resize:`` line plus a ``world_size`` tfevents scalar; a
+replacement that registers INSIDE the rejoin window keeps round 7's
+fixed-size restart path bit-for-bit (identical spawns, no ``Resize:``
+line). ``min_workers`` defaults to the full gang size, which disables
+resizing entirely — the round-7 machine, unchanged.
 """
 
 from __future__ import annotations
@@ -61,15 +87,22 @@ from distributed_tensorflow_tpu.train import resilience
 
 class WorkerFailure(RuntimeError):
     """One or more gang members died or stalled. ``verdicts`` maps member
-    name → verdict string (``rc=N``, ``dead``, ``stalled``, or
-    ``straggler`` — still running past ``drain_timeout`` after a peer
-    finished)."""
+    name → verdict string (``rc=N``, ``dead``, ``stalled``, ``straggler``
+    — still running past ``drain_timeout`` after a peer finished — or
+    ``rejoined``: a benched member's replacement registered while the
+    gang ran degraded, so the incarnation is retired to grow back)."""
 
     def __init__(self, verdicts: dict):
         self.verdicts = dict(verdicts)
         super().__init__(
             " ".join(f"{n}={v}" for n, v in sorted(self.verdicts.items()))
         )
+
+
+class GangBelowFloor(WorkerFailure):
+    """Resize planning left fewer than ``min_workers`` survivors: the gang
+    fail-stops (round 6 semantics) instead of training on a mesh smaller
+    than the operator said the job tolerates."""
 
 
 class HeartbeatHealth:
@@ -132,16 +165,56 @@ class ElasticAgent:
     ``spawn_fn()`` returns a process handle exposing ``poll() -> rc|None``
     and ``kill()`` (``subprocess.Popen`` satisfies it; the fast-tier tests
     drive the whole machine with a fake process table). ``worker_id`` is
-    the member's slot in the heartbeat detector."""
+    the member's slot in the heartbeat detector.
 
-    def __init__(self, name: str, spawn_fn: Callable, *, worker_id: int | None = None):
+    Resize hooks (round 8; both optional — absent, the agent is the
+    round-7 fixed-slot member):
+
+    - ``available_fn() -> bool`` — is this member's slot backed by a live
+      host right now? Polled after a death (the rejoin window) and while
+      the member sits benched (the grow trigger). ``None`` means always
+      available — a dead member can always be relaunched in place, which
+      is exactly round 7's fixed-size restart.
+    - ``topo_spawn_fn(rank, world, ranks)`` — spawn this member at a
+      NON-original topology: compact rank ``rank`` of ``world``, where
+      ``ranks[r]`` is the original worker_id holding rank ``r`` (the
+      driver exports it so workers can re-derive their cluster subset).
+      Only consulted when the gang's current roster differs from the
+      original; the original roster always spawns via ``spawn_fn()`` so a
+      fully regrown gang is byte-identical to a fresh launch."""
+
+    def __init__(
+        self,
+        name: str,
+        spawn_fn: Callable,
+        *,
+        worker_id: int | None = None,
+        available_fn: Callable[[], bool] | None = None,
+        topo_spawn_fn: Callable | None = None,
+    ):
         self.name = name
         self.worker_id = worker_id
         self._spawn_fn = spawn_fn
+        self.available_fn = available_fn
+        self.topo_spawn_fn = topo_spawn_fn
         self.handle = None
 
-    def start(self):
-        self.handle = self._spawn_fn()
+    def available(self) -> bool:
+        """Is this member's slot backed by a live host? (See class doc.)"""
+        return True if self.available_fn is None else bool(self.available_fn())
+
+    def start(self, rank: int | None = None, world: int | None = None,
+              ranks: tuple | None = None):
+        if rank is None:
+            self.handle = self._spawn_fn()
+        else:
+            if self.topo_spawn_fn is None:
+                raise RuntimeError(
+                    f"{self.name}: gang resized to world={world} but this "
+                    "agent has no topo_spawn_fn — pass one (or keep "
+                    "min_workers at the full gang size to disable resizing)"
+                )
+            self.handle = self.topo_spawn_fn(rank, world, ranks)
         return self.handle
 
     def poll(self):
@@ -178,13 +251,27 @@ class ElasticGang:
 
     ``health_factory`` builds a fresh :class:`HeartbeatHealth` per gang
     incarnation (fresh detector state — a relaunch must not inherit the
-    killed incarnation's silence). Once the first member exits 0, the rest
+    killed incarnation's silence); it may take one positional argument
+    (the incarnation's world size) so a resized gang's detector expects
+    the right member count. Once the first member exits 0, the rest
     must finish within ``drain_timeout`` seconds or the still-running
     members are verdicted ``straggler`` (a peer wedged in a collective the
     finished member will never rejoin beats forever — without the drain
     window the gang would hang with no verdict). ``sleep``/``clock``/
     ``poll_interval`` are injectable so the fast-tier tests run the whole
-    machine without wall time or real processes."""
+    machine without wall time or real processes.
+
+    Resize (round 8): ``min_workers < len(agents)`` arms shrink-to-fit —
+    see the module docstring for the full state machine. ``min_workers``
+    defaults to the full gang size (resizing disabled: the round-7
+    machine bit-for-bit). ``rejoin_timeout_s`` is how long a failed
+    member's slot may stay vacant before the gang gives up on a
+    replacement and relaunches without it; 0 decides immediately from
+    one ``available()`` probe. The current roster is ``active`` (rank
+    order); benched members are probed every poll and re-admitted — the
+    grow path — by the same kill→relaunch→restore cycle. Every resize,
+    either direction, charges one unit of the restart budget: a
+    flapping host cannot spin the gang for free."""
 
     def __init__(
         self,
@@ -194,9 +281,11 @@ class ElasticGang:
         backoff: float = 1.0,
         max_backoff: float = 30.0,
         jitter: float = 0.25,
-        health_factory: Callable[[], HeartbeatHealth] | None = None,
+        health_factory: Callable[..., HeartbeatHealth] | None = None,
         poll_interval: float = 0.5,
         drain_timeout: float = 300.0,
+        min_workers: int | None = None,
+        rejoin_timeout_s: float = 0.0,
         print_fn=print,
         summary_writer=None,
         sleep=time.sleep,
@@ -211,35 +300,112 @@ class ElasticGang:
         self.health_factory = health_factory
         self.poll_interval = float(poll_interval)
         self.drain_timeout = float(drain_timeout)
+        self.min_workers = (
+            len(self.agents) if min_workers is None else int(min_workers)
+        )
+        if not 1 <= self.min_workers <= len(self.agents):
+            raise ValueError(
+                f"min_workers must be in [1, {len(self.agents)}] "
+                f"(= gang size), got {self.min_workers}"
+            )
+        self.rejoin_timeout_s = float(rejoin_timeout_s)
+        if self.rejoin_timeout_s < 0:
+            raise ValueError(
+                f"rejoin_timeout_s must be >= 0, got {self.rejoin_timeout_s}"
+            )
         self.print_fn = print_fn
         self.summary_writer = summary_writer
         self.sleep = sleep
         self.clock = clock
         self.rng = rng
         self.restarts = 0  # restarts actually performed
+        self.resizes = 0  # topology changes actually performed
+        # Roster state: active members in rank order; benched members are
+        # slots whose host did not come back inside the rejoin window.
+        self.active: list[ElasticAgent] = list(self.agents)
+        self.benched: list[ElasticAgent] = []
+
+    @property
+    def world_size(self) -> int:
+        return len(self.active)
+
+    @property
+    def _elastic(self) -> bool:
+        return self.min_workers < len(self.agents)
 
     # -- one gang incarnation --------------------------------------------
+
+    def _make_health(self, world: int):
+        """health_factory, passing the incarnation's world size when the
+        factory takes a positional argument (round-7 zero-arg factories
+        keep working unchanged)."""
+        if self.health_factory is None:
+            return None
+        import inspect
+
+        try:
+            params = inspect.signature(self.health_factory).parameters.values()
+            takes_world = any(
+                p.kind
+                in (
+                    inspect.Parameter.POSITIONAL_ONLY,
+                    inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                    inspect.Parameter.VAR_POSITIONAL,
+                )
+                for p in params
+            )
+        except (TypeError, ValueError):  # builtins without signatures
+            takes_world = False
+        return self.health_factory(world) if takes_world else self.health_factory()
 
     def _cycle(self) -> int:
         health = None
         first_done = None  # clock() when the first member exited 0
+        # Identity roster (never resized, or fully regrown): the round-7
+        # spawn path byte-for-byte — agents spawn via spawn_fn() with
+        # their original worker_id as the detector slot. A resized roster
+        # spawns with compact ranks 0..M-1 (topo_spawn_fn) and the
+        # detector tracks those ranks (workers report worker_id =
+        # task_index, which IS the compact rank after a resize).
+        identity = self.active == self.agents
+        ranks = tuple(
+            a.worker_id if a.worker_id is not None else self.agents.index(a)
+            for a in self.active
+        )
         try:
-            for agent in self.agents:
-                agent.start()
-            health = self.health_factory() if self.health_factory else None
+            for rank, agent in enumerate(self.active):
+                if identity:
+                    agent.start()
+                else:
+                    agent.start(rank, len(self.active), ranks)
+            health = self._make_health(len(self.active))
             while True:
-                rcs = {a.name: a.poll() for a in self.agents}
+                rcs = {a.name: a.poll() for a in self.active}
                 verdicts = {
                     name: f"rc={rc}"
                     for name, rc in rcs.items()
                     if rc is not None and rc != 0
                 }
                 if health is not None:
-                    for a in self.agents:
-                        if rcs[a.name] is None and a.worker_id is not None:
-                            v = health.classify(a.worker_id)
+                    for rank, a in enumerate(self.active):
+                        wid = a.worker_id if identity else rank
+                        if rcs[a.name] is None and wid is not None:
+                            v = health.classify(wid)
                             if v != "ok":
                                 verdicts[a.name] = v
+                # Grow trigger: a benched slot's replacement registered
+                # while the gang ran degraded. Retire the incarnation
+                # (kill + relaunch at the bigger world) — unless someone
+                # already finished cleanly, in which case the gang is
+                # draining and growing would restart a completed job.
+                if (
+                    not verdicts
+                    and self.benched
+                    and not any(rc == 0 for rc in rcs.values())
+                ):
+                    back = [a for a in self.benched if a.available()]
+                    if back:
+                        verdicts = {a.name: "rejoined" for a in back}
                 # Premature-exit guard: once any member finishes (rc 0),
                 # the rest must drain within drain_timeout — a peer blocked
                 # in a collective the finished member will never rejoin
@@ -279,6 +445,72 @@ class ElasticGang:
             if health is not None:
                 health.stop()
 
+    def _plan_topology(self, exc: WorkerFailure) -> None:
+        """Recompute the roster after a failure verdict (no-op unless
+        ``min_workers < len(agents)``): give each failed member's slot up
+        to ``rejoin_timeout_s`` to come back (``available()``), bench the
+        slots that did not, re-admit benched slots that did — then either
+        raise :class:`GangBelowFloor` (fewer than ``min_workers`` left) or
+        record the resize with a structured ``Resize:`` line and a
+        ``world_size`` tfevents scalar. Rosters rebuild in ORIGINAL agent
+        order, so a fully regrown gang restores the original ranks (and
+        spawns via the original, pre-resize path)."""
+        if not self._elastic:
+            return
+        prev = list(self.active)
+        failed = [
+            a
+            for a in self.active
+            if exc.verdicts.get(a.name) not in (None, "rejoined")
+        ]
+        # Rejoin window: poll the failed slots until each has a
+        # replacement or the budget runs out. available_fn=None (always
+        # available) resolves instantly — the fixed-size restart.
+        missing = [a for a in failed if not a.available()]
+        if missing and self.rejoin_timeout_s > 0:
+            deadline = self.clock() + self.rejoin_timeout_s
+            wait = min(self.poll_interval, self.rejoin_timeout_s) or (
+                self.rejoin_timeout_s
+            )
+            while missing and self.clock() < deadline:
+                self.sleep(wait)
+                missing = [a for a in missing if not a.available()]
+        bench = set(missing)
+        roster = []
+        for a in self.agents:  # original order: a regrow restores ranks
+            if a in bench:
+                continue
+            if a in self.benched and not a.available():
+                continue
+            roster.append(a)
+        if roster == prev:
+            return  # replacement(s) arrived in time: fixed-size restart
+        if len(roster) < self.min_workers:
+            floor = GangBelowFloor(exc.verdicts)
+            floor.world = len(roster)
+            raise floor
+        dropped = [a.name for a in prev if a not in roster]
+        rejoined = [a.name for a in roster if a not in prev]
+        self.active = roster
+        self.benched = [a for a in self.agents if a not in roster]
+        self.resizes += 1
+        direction = (
+            "shrink"
+            if len(roster) < len(prev)
+            else ("grow" if len(roster) > len(prev) else "swap")
+        )
+        # Structured, greppable — same key=value shape as Restart:.
+        self.print_fn(
+            f"Resize: world={len(roster)} from={len(prev)} "
+            f"min_workers={self.min_workers} direction={direction} "
+            f"dropped=[{','.join(dropped)}] rejoined=[{','.join(rejoined)}] "
+            f"restart={self.restarts}/{self.max_restarts}"
+        )
+        if self.summary_writer is not None:
+            self.summary_writer.add_scalar(
+                "world_size", float(len(roster)), self.restarts
+            )
+
     def _on_retry(self, exc: WorkerFailure, attempt: int, delay: float) -> None:
         self.restarts = attempt + 1
         # Structured, greppable — same key=value shape as Preemption:/Rollback:.
@@ -290,11 +522,24 @@ class ElasticGang:
             self.summary_writer.add_scalar(
                 "restart", float(self.restarts), self.restarts
             )
+        # After the Restart bookkeeping: decide WHAT relaunches (may wait
+        # the rejoin window, may shrink/grow, may raise GangBelowFloor —
+        # which aborts the retry loop into run()'s fail-stop).
+        self._plan_topology(exc)
 
     def run(self) -> int:
         """Supervise to completion: 0 when every member exited 0 (possibly
-        after restarts), 1 when the budget is exhausted (fail-stop, with a
-        final ``Restart: budget exhausted`` line; checkpoints intact)."""
+        after restarts and resizes), 1 when the budget is exhausted or the
+        roster fell below ``min_workers`` (fail-stop, with a final
+        structured line; checkpoints intact)."""
+        if self.summary_writer is not None and self._elastic:
+            # Initial world size, so the scalar stream starts at the
+            # launched topology (resizes append to it at their restart
+            # ordinal). Only in elastic mode: a fixed-size gang's tfevents
+            # stay byte-identical to round 7.
+            self.summary_writer.add_scalar(
+                "world_size", float(len(self.active)), 0
+            )
         try:
             return resilience.retry(
                 self._cycle,
@@ -308,6 +553,17 @@ class ElasticGang:
                 sleep=self.sleep,
                 rng=self.rng,
             )
+        except GangBelowFloor as exc:
+            self.print_fn(
+                f"Resize: denied world={exc.world} "
+                f"min_workers={self.min_workers} restarts={self.restarts}/"
+                f"{self.max_restarts} cause[{exc}] — failing stop "
+                "(checkpoints intact; newest valid step restores on the "
+                "next launch)"
+            )
+            if self.summary_writer is not None:
+                self.summary_writer.flush()
+            return 1
         except WorkerFailure as exc:
             self.print_fn(
                 f"Restart: budget exhausted restarts={self.restarts}/"

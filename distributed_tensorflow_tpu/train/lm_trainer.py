@@ -211,12 +211,33 @@ class LMTrainer:
                     )
                 )
                 self.state = self._place_state(self.state)
+            # Global-batch policy across an elastic resize (round 8,
+            # docs/resilience.md): the LM batch_size IS the global batch,
+            # so a world-size change needs no adoption — each shard just
+            # grows — but the CONFIG must carry the same value, or the
+            # step→data-stream mapping (and the trajectory) silently
+            # changes. Asserted, not adopted: the divisibility checks in
+            # _resolve_mode already ran against config.batch_size.
+            if src is not None and src.get("global_batch") is not None:
+                saved_gb = int(src["global_batch"])
+                if saved_gb != int(self.config.batch_size):
+                    raise ValueError(
+                        f"checkpoint was trained with global batch "
+                        f"{saved_gb} (world={src.get('world')}) but this "
+                        f"config says batch_size={self.config.batch_size}"
+                        "; the LM batch is GLOBAL — resume with the same "
+                        "batch_size (the per-shard batch grows with the "
+                        "smaller mesh) to preserve the trajectory and "
+                        "data-stream position"
+                    )
             # Fast-forward the host-side index stream so a resumed run
             # draws exactly the batches the uninterrupted run would (the
             # reference resumed against live PS state; the TPU-native
             # analog restores the state pytree and replays the
             # deterministic data stream up to it — proven bitwise in
-            # test_lm_trainer.py::test_supervisor_resume_bitwise).
+            # test_lm_trainer.py::test_supervisor_resume_bitwise; the
+            # draw is world-invariant because batch_size is global, so
+            # the position is preserved across a resize too).
             for _ in range(self.start_step):
                 datasets.train.next_indices(self.config.batch_size)
 
@@ -552,24 +573,35 @@ class LMTrainer:
     _DENSE_LAYOUTS = frozenset({"single", "dp", "zero", "tp", "ep", "sp"})
 
     def _layout_meta(self) -> dict:
-        """Topology descriptor saved alongside each checkpoint."""
+        """Topology descriptor saved alongside each checkpoint — shape
+        keys (mode/stages/replicas) plus the round-8 restore policy: the
+        world size (device count) and the GLOBAL batch, which a resized
+        gang's restore must find unchanged (the LM ``batch_size`` is
+        already global — docs/resilience.md, batch policy)."""
         meta: dict = {"mode": self.mode}
         if self.mode == "pp":
             meta["stages"] = int(self.mesh.shape[self.stage_axis])
         if self.mode == "async":
             meta["replicas"] = int(self.mesh.shape[self.data_axis])
+        meta["world"] = int(
+            1 if self.mesh is None else self.mesh.size
+        )
+        meta["global_batch"] = int(self.config.batch_size)
         return meta
 
     def _layout_compatible(self, src: dict) -> bool:
         """True when the saved state's SHAPES match this trainer's (the
-        bitwise same-layout resume path applies)."""
+        bitwise same-layout resume path applies). Compared on the shape
+        keys only (supervisor.layout_shape): the round-8 policy keys
+        (world/global_batch) ride the same sidecar but a world-size
+        change alone is a pure re-shard for every dense-family mode."""
+        from distributed_tensorflow_tpu.train.supervisor import layout_shape
+
         m = src.get("mode")
         if self.mode in self._DENSE_LAYOUTS:
             return m in self._DENSE_LAYOUTS
-        return m == self.mode and all(
-            src.get(k) == v
-            for k, v in self._layout_meta().items()
-            if k != "mode"
+        return m == self.mode and layout_shape(src) == layout_shape(
+            self._layout_meta()
         )
 
     def _map_params_like(self, fn, tree_):

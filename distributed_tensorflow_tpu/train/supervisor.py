@@ -30,10 +30,12 @@ import re
 import shutil
 import warnings
 
-import jax
+from typing import TYPE_CHECKING
 
-from distributed_tensorflow_tpu.parallel.strategy import TrainState
 from distributed_tensorflow_tpu.train import resilience
+
+if TYPE_CHECKING:  # jax-backed; the probe half of this module is file I/O
+    from distributed_tensorflow_tpu.parallel.strategy import TrainState
 
 try:
     import orbax.checkpoint as ocp
@@ -43,6 +45,24 @@ except Exception:  # pragma: no cover
     _HAVE_ORBAX = False
 
 _STEP_DIR = re.compile(r"^step_(\d+)$")
+
+# Layout-sidecar keys that describe the saved state's SHAPES (which
+# canonicalization a cross-topology restore needs). Everything else in the
+# sidecar is restore POLICY — e.g. round 8's "world"/"global_batch", which
+# the elastic resize path reads to preserve the global batch across a
+# world-size change — and must not break same-layout compatibility checks.
+LAYOUT_SHAPE_KEYS = ("mode", "replicas", "stages")
+
+
+def layout_shape(layout: dict | None) -> dict:
+    """The shape-determining slice of a checkpoint layout sidecar (see
+    :data:`LAYOUT_SHAPE_KEYS`): what trainers compare to decide between
+    the bitwise same-layout restore and the canonical cross-topology
+    path. An old sidecar (no policy keys) and a round-8 one with
+    identical topology compare equal here by construction."""
+    return {
+        k: v for k, v in (layout or {}).items() if k in LAYOUT_SHAPE_KEYS
+    }
 
 
 def checkpoint_steps(checkpoint_dir: str | None) -> list[int]:
@@ -258,6 +278,8 @@ class Supervisor:
         state's shapes the way :meth:`prepare_or_restore` does."""
         if self._ckptr is None:
             raise RuntimeError("no checkpointer (orbax unavailable or no dir)")
+        import jax
+
         path = os.path.join(self.checkpoint_dir, f"step_{step}")
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, abstract)
         return self._retry(
@@ -291,6 +313,8 @@ class Supervisor:
         re-read+CRC pass for it."""
         if self._ckptr is None:
             return state, 0
+        import jax
+
         candidates = list(reversed(checkpoint_steps(self.checkpoint_dir)))
         for step in candidates:
             if (

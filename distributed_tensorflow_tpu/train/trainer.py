@@ -69,6 +69,20 @@ class Trainer:
         self._exchange = None
         if isinstance(self.strategy, AsyncDataParallel) and self.strategy.avg_every:
             self._exchange = self.strategy.make_exchange_fn()
+        # Global-batch policy (round 8): the effective global batch this
+        # run consumes per optimizer step. Derived from the config
+        # (reference convention: batch_size per worker × replicas), but a
+        # restore across a WORLD-SIZE change adopts the checkpoint's
+        # recorded global batch instead — the resized gang keeps the same
+        # optimization trajectory (steps/epoch, effective batch), each
+        # surviving replica's shard just grows. See _adopt_batch_policy.
+        self.global_batch = self.config.batch_size * self.strategy.num_replicas
+        # Completed-epoch counter, persisted in the layout sidecar: the
+        # step counter alone cannot recover it once incarnations at
+        # DIFFERENT world sizes mixed their per-batch increments (async
+        # advances num_replicas per global batch), and the cross-world
+        # permutation fast-forward needs the true epoch count.
+        self.epochs_completed = 0
 
         # Supervisor duties (C13): restore-or-init against checkpoint_dir.
         self.supervisor = supervisor
@@ -111,6 +125,10 @@ class Trainer:
                         self.state, verified_step=step
                     )
                 )
+            if src is not None:
+                self._adopt_batch_policy(src)
+            self._restore_src = src
+            self.epochs_completed = self._epochs_from_restore(src)
 
         # Scanned-epoch fast path (config.scan_epoch): one dispatch per epoch.
         # config.scan_epoch=None resolves by backend: on an accelerator the
@@ -157,6 +175,12 @@ class Trainer:
             import numpy as _np
 
             self._scan_rng = _np.random.default_rng(self.config.seed)
+            if (
+                self.start_step
+                and getattr(self, "_world_changed", False)
+                and not self.config.per_worker_epoch
+            ):
+                self._fast_forward_permutations(self._restore_src or {})
 
         self.last_cost: jax.Array | None = None
         self._epoch_costs = None  # per-step costs of the last scanned epoch
@@ -176,11 +200,109 @@ class Trainer:
         """True when the saved state's SHAPES match this strategy's (the
         ordinary bitwise prepare_or_restore applies). All sync-family
         strategies share the canonical dense shapes; async matches only
-        async at the same replica count."""
+        async at the same replica count. Compared on the sidecar's SHAPE
+        keys only (supervisor.layout_shape): round-8 policy keys
+        (world/global_batch) ride the same sidecar but must not force a
+        same-layout resume onto the cross-topology path."""
+        from distributed_tensorflow_tpu.train.supervisor import layout_shape
+
         mine = self.strategy.layout_meta()
         if mine["mode"] != "async":
             return src.get("mode") != "async"
-        return src == mine
+        return layout_shape(src) == layout_shape(mine)
+
+    def _layout_meta(self) -> dict:
+        """The checkpoint layout sidecar: the strategy's shape topology
+        plus the round-8 restore policy — the world size and effective
+        global batch this run trained with, which a resized gang's
+        restore preserves (_adopt_batch_policy)."""
+        meta = dict(self.strategy.layout_meta())
+        meta["world"] = int(self.strategy.num_replicas)
+        meta["global_batch"] = int(self.global_batch)
+        meta["epochs"] = int(self.epochs_completed)
+        return meta
+
+    def _epochs_from_restore(self, src: dict | None) -> int:
+        """Completed epochs at the restored step. The round-8 sidecar
+        records it exactly; older sidecars fall back to deriving it from
+        the step counter — correct for a single-world history, but a
+        counter spanning incarnations at different ASYNC replica counts
+        mixes increments, which is precisely why the sidecar now carries
+        the count."""
+        if not self.start_step:
+            return 0
+        if src is not None and src.get("epochs") is not None:
+            return int(src["epochs"])
+        spe = self.datasets.train.num_examples // max(1, self.global_batch)
+        incr = 1
+        if src is not None and src.get("mode") == "async":
+            incr = int(src.get("replicas", src.get("world", 1)))
+        return self.start_step // max(1, spe * incr)
+
+    def _adopt_batch_policy(self, src: dict) -> None:
+        """Global-batch policy across an elastic resize (round 8,
+        docs/resilience.md): the checkpoint records the run's effective
+        global batch; a restore onto a DIFFERENT world size keeps it —
+        same steps/epoch, same effective batch, same optimization
+        trajectory — by growing each surviving replica's shard, rather
+        than silently shrinking the global batch with the gang (which
+        would change what the remaining epochs optimize). Asserted
+        shardable; the reference's per-worker epoch convention ties batch
+        to worker count by definition, so it refuses a world change
+        loudly instead."""
+        saved = src.get("global_batch")
+        saved_world = src.get("world")
+        self._world_changed = (
+            saved_world is not None
+            and int(saved_world) != self.strategy.num_replicas
+        )
+        if saved is None or int(saved) == self.global_batch:
+            return
+        saved = int(saved)
+        n = self.strategy.num_replicas
+        if self.config.per_worker_epoch:
+            raise ValueError(
+                f"checkpoint was written with global_batch={saved} "
+                f"(world={saved_world}) but per_worker_epoch ties the "
+                f"effective batch to the worker count (now {n}); the "
+                "reference convention cannot preserve the global batch "
+                "across a resize — resume with per_worker_epoch=False or "
+                "restore onto the original world size"
+            )
+        if saved % n:
+            raise ValueError(
+                f"checkpoint global_batch={saved} does not shard over "
+                f"{n} replicas; resume on a world size dividing it (or "
+                "accept a new trajectory by clearing the sidecar)"
+            )
+        if self.is_chief:
+            # Structured, greppable — the trainer-side half of the gang's
+            # Resize: line.
+            self.print_fn(
+                f"Restore: global_batch={saved} preserved "
+                f"(world={saved_world}->{n}, config batch "
+                f"{self.config.batch_size}x{n}={self.global_batch} "
+                f"overridden, per-replica batch {saved // n})"
+            )
+        self.global_batch = saved
+
+    def _fast_forward_permutations(self, src: dict) -> None:
+        """Replay the host permutation stream up to the restored epoch so
+        a resumed-after-resize run draws the batches the uninterrupted
+        run would have (the classifier analog of LMTrainer's
+        next_indices fast-forward; with the global batch preserved,
+        steps/epoch — and therefore the step→epoch mapping — is
+        world-invariant). Only runs on a cross-world restore: same-world
+        resumes keep their round-5 pinned behavior unchanged. The epoch
+        count comes from the sidecar (``_epochs_from_restore``) — the
+        step counter alone cannot recover it across mixed-world async
+        histories."""
+        train = self.datasets.train
+        spe = train.num_examples // self.global_batch
+        need = spe * self.global_batch
+        draws_per_epoch = max(1, -(-need // train.num_examples))
+        for _ in range(self.epochs_completed * draws_per_epoch):
+            self._scan_rng.permutation(train.num_examples)
 
     def _abstract_for_layout(self, src: dict):
         """ShapeDtypeStructs of a checkpoint written under layout ``src``
@@ -274,8 +396,10 @@ class Trainer:
         cfg = self.config
         train = self.datasets.train
         # Global batch: the reference gave each of N workers a batch of 100
-        # (reference tfdist_between.py:19,91), so N replicas consume N×100.
-        global_batch = cfg.batch_size * self.strategy.num_replicas
+        # (reference tfdist_between.py:19,91), so N replicas consume N×100 —
+        # unless a resize-restore adopted the checkpoint's recorded value
+        # (self.global_batch, _adopt_batch_policy).
+        global_batch = self.global_batch
         if cfg.per_worker_epoch:
             # Reference convention: each worker passes over the full dataset
             # per epoch; next_batch wraps across the shuffled permutations.
@@ -335,7 +459,7 @@ class Trainer:
         stage the shuffled epoch and ship it whole."""
         cfg = self.config
         train = self.datasets.train
-        global_batch = cfg.batch_size * self.strategy.num_replicas
+        global_batch = self.global_batch
         if self._indexed_fn is not None:
             import numpy as _np
 
@@ -419,7 +543,7 @@ class Trainer:
                 f"compiled run unsupported for {type(self.strategy).__name__}"
             )
         train, test = self.datasets.train, self.datasets.test
-        global_batch = cfg.batch_size * self.strategy.num_replicas
+        global_batch = self.global_batch
         # per_worker_epoch (reference convention, tfdist_between.py:87): each
         # worker runs num_examples/batch_size steps per epoch; the compiled
         # program wraps its index stream across fresh permutations.
@@ -540,6 +664,7 @@ class Trainer:
                         "step": step_now,
                     }
                 )
+        self.epochs_completed += epochs
         if self.supervisor is not None:
             import numpy as _np
 
@@ -558,7 +683,7 @@ class Trainer:
                 self.supervisor.save(
                     self.state,
                     self.strategy.global_step(self.state),
-                    layout=self.strategy.layout_meta(),
+                    layout=self._layout_meta(),
                 )
         final_cost = float(costs[-1, -1]) if costs.size else float("nan")
         if finalize and self.is_chief:
@@ -607,7 +732,10 @@ class Trainer:
                 # (NaN-only here: the spike baseline needs the per-epoch
                 # history the per-epoch run() path keeps). The
                 # global_step guard keeps an empty dispatch's nan
-                # placeholder from reading as an anomaly.
+                # placeholder from reading as an anomaly. The poisoned
+                # chunk's epochs never landed in a checkpoint — uncount
+                # them (run_compiled counted before skipping its save).
+                self.epochs_completed = max(0, self.epochs_completed - n)
                 self._anomaly_rollback(guard, "nan", done)
                 continue
             done += n
@@ -740,7 +868,7 @@ class Trainer:
         import numpy as np
 
         train = self.datasets.train
-        global_batch = self.config.batch_size * self.strategy.num_replicas
+        global_batch = self.global_batch
         bx, by = self.strategy.prepare_batch(
             np.zeros((global_batch,) + train.images.shape[1:], np.float32),
             np.zeros((global_batch,) + train.labels.shape[1:], np.float32),
@@ -774,6 +902,14 @@ class Trainer:
         )
         self.state, restored_step = self.supervisor.prepare_or_restore(fresh)
         self.last_cost = None
+        # Resync the completed-epoch counter with the state we restored to
+        # (a fallback restore can land more than one epoch back).
+        try:
+            side = self.supervisor.saved_layout(restored_step)
+        except ValueError:
+            side = None
+        if side is not None and side.get("epochs") is not None:
+            self.epochs_completed = int(side["epochs"])
         if self.is_chief:
             # Structured, greppable — same key=value shape as Preemption:.
             self.print_fn(
@@ -842,6 +978,7 @@ class Trainer:
                     self._anomaly_rollback(guard, kind, epoch)
                     continue  # retry this epoch index on the next window
                 guard.record(cost)
+            self.epochs_completed += 1  # a good epoch: the sidecar's count
             # EVERY process runs the eval — it is a global-mesh computation
             # (sharded-param strategies gather over collectives), so a
             # chief-only dispatch would hang or die once non-chief
@@ -872,7 +1009,7 @@ class Trainer:
                 self.supervisor.save(
                     self.state,
                     self.strategy.global_step(self.state),
-                    layout=self.strategy.layout_meta(),
+                    layout=self._layout_meta(),
                 )
                 if self.supervisor.should_stop:
                     break

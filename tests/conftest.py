@@ -8,6 +8,7 @@ slice. Must run before the first jax import anywhere.
 """
 
 import os
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
@@ -62,6 +63,71 @@ def pytest_configure(config):
         "heavy: compile-heavy tail — skipped unless RUN_SLOW=1 (the fast "
         "tier keeps a representative test per surface; RUN_SLOW runs all)",
     )
+
+
+# -- truncation sentinel (round 8, VERDICT r7 weak #1) ----------------------
+# jaxlib 0.9.0's XLA:CPU can abort the whole process SILENTLY (bare `Fatal
+# Python error`, often no traceback, sometimes no output at all) in the
+# collective-rendezvous path — see docs/known_issues.md for the minimal-
+# repro characterization. A truncated run can masquerade as green to a
+# piped/CI harness (the summary line never prints, but neither does a
+# failure). These hooks make truncation detectable: sessionstart drops a
+# sentinel file, sessionfinish replaces it with a completion record
+# carrying the collected-vs-ran counts. A hard abort never reaches
+# sessionfinish, so the sentinel survives it. `python tests/check_complete.py`
+# (run it right after pytest — the verify skill's tier-1 recipe does) fails
+# loudly when the sentinel is still there or the counts disagree.
+
+_SENTINEL = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".pytest_run_incomplete")
+)
+_COMPLETE = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".pytest_run_complete.json")
+)
+_RUN_STATS = {"collected": 0, "ran": 0}
+
+
+def pytest_sessionstart(session):
+    import json
+
+    for stale in (_COMPLETE,):
+        try:
+            os.remove(stale)
+        except OSError:
+            pass
+    with open(_SENTINEL, "w") as f:
+        json.dump({"pid": os.getpid(), "argv": list(sys.argv)}, f)
+
+
+def pytest_runtest_logreport(report):
+    # Count each test once (its call phase; setup counts only when it
+    # skipped/failed there and call never ran).
+    if report.when == "call" or (
+        report.when == "setup" and report.outcome != "passed"
+    ):
+        _RUN_STATS["ran"] += 1
+
+
+def pytest_sessionfinish(session, exitstatus):
+    import json
+
+    _RUN_STATS["collected"] = session.testscollected
+    # Collect-only sessions legitimately run nothing — not a truncation.
+    collect_only = bool(getattr(session.config.option, "collectonly", False))
+    record = {
+        "collected": session.testscollected,
+        "ran": _RUN_STATS["ran"],
+        "exitstatus": int(exitstatus),
+        "truncated": not collect_only
+        and _RUN_STATS["ran"] < session.testscollected
+        and int(exitstatus) == 0,
+    }
+    with open(_COMPLETE, "w") as f:
+        json.dump(record, f)
+    try:
+        os.remove(_SENTINEL)
+    except OSError:
+        pass
 
 
 # Round 6 (fast-tier hardening, VERDICT round 5): the warm-cache abort is

@@ -389,6 +389,73 @@ def test_two_process_lm_trainer():
         assert f"MULTIHOST_LM_OK {i}" in out, out
 
 
+_LM_SP_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from distributed_tensorflow_tpu.cluster import bootstrap
+from distributed_tensorflow_tpu.config import ClusterConfig, TrainConfig
+from distributed_tensorflow_tpu.data import copy_corpus
+from distributed_tensorflow_tpu.models.gpt import GPTLM
+from distributed_tensorflow_tpu.parallel import make_mesh
+from distributed_tensorflow_tpu.train import LMTrainer
+
+task = int(sys.argv[1])
+cluster = ClusterConfig.from_lists(["127.0.0.1:29783", "127.0.0.1:29784"])
+ctx = bootstrap(cluster, "worker", task)
+assert jax.process_count() == 2 and len(jax.devices()) == 8
+
+# The SEQ axis spans the process boundary (transposed device order, as in
+# the tp/pp workers): every causal-ring ppermute hop — including the sp
+# loss's boundary-target hop — crosses processes, upgrading
+# docs/multihost.md's "same XLA primitives" argument to a live test.
+devs = np.array(jax.devices()).reshape(2, 4).T.reshape(-1)
+mesh = make_mesh((4, 2), ("data", "seq"), devices=list(devs))
+mkds = lambda: copy_corpus(num=384, half_len=8, vocab=61, n_val=64, n_test=64, seed=0)
+mkmodel = lambda: GPTLM(vocab_size=61, max_len=16, model_dim=32, num_heads=4,
+                        num_layers=2, compute_dtype=jax.numpy.float32)
+mkcfg = lambda **kw: TrainConfig(epochs=2, batch_size=32, optimizer="adam",
+                                 learning_rate=3e-3, scan_epoch=True,
+                                 log_frequency=10**9, **kw)
+tr = LMTrainer(
+    mkmodel(), mkds(), mkcfg(dp_mode="sp"), mesh=mesh,
+    is_chief=ctx.is_chief, print_fn=lambda *a: None,
+)
+assert tr.mode == "sp"
+res = tr.run()
+assert res["global_step"] == 2 * (256 // 32), res
+assert np.isfinite(res["perplexity"]) and res["perplexity"] < 61, res
+
+# sp computes the EXACT global masked CE — a purely-local single-device
+# reference over the identical corpus/seed must land on the same
+# perplexity.
+ref = LMTrainer(
+    mkmodel(), mkds(), mkcfg(), mesh=None, print_fn=lambda *a: None,
+)
+ref_res = ref.run()
+assert np.isclose(res["perplexity"], ref_res["perplexity"], rtol=1e-3), (
+    res["perplexity"], ref_res["perplexity"])
+print("MULTIHOST_LM_SP_OK", task, res["global_step"], flush=True)
+"""
+
+
+def test_two_process_lm_sequence_parallel():
+    """dp×sp with the SEQ axis spanning the process boundary (round 8,
+    VERDICT r5 weak #3, sp half): every causal-ring ppermute hop is a
+    cross-process transfer, through the full LMTrainer lifecycle, equal
+    to a local single-device reference run."""
+    procs, outs = _run_two(_LM_SP_WORKER)
+    for i, out in enumerate(outs):
+        assert procs[i].returncode == 0, f"task {i} failed:\n{out}"
+        assert f"MULTIHOST_LM_SP_OK {i}" in out, out
+
+
 def test_two_process_lm_tensor_parallel():
     """dp×tp with the MODEL axis spanning the process boundary (round 5,
     VERDICT r4 weak #6): every Megatron collective crosses processes —

@@ -207,6 +207,13 @@ def test_layout_sidecar_written_and_read(tmp_path):
     sup = tr.supervisor
     step = sup.latest_step()
     meta = sup.saved_layout(step)
-    assert meta == {"mode": "pp", "stages": 4}
+    # Shape keys (round 5) + the round-8 restore-policy keys: world size
+    # and global batch, which an elastic resize-restore preserves.
+    assert meta == {
+        "mode": "pp",
+        "stages": 4,
+        "world": 8,
+        "global_batch": 64,
+    }
     # Unknown step → None, never raises.
     assert sup.saved_layout(10**9) is None

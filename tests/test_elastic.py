@@ -721,3 +721,384 @@ def test_launch_local_fail_stop_path_unchanged(tmp_path):
     assert rc == 1
     assert any("worker0: exit 1" in str(l) for l in lines)
     assert not any("Restart" in str(l) for l in lines)
+
+
+# ---------------------------------------------------------------------------
+# Round 8: shrink-to-fit resize (min_workers / rejoin_timeout_s).
+# ---------------------------------------------------------------------------
+
+
+class ResizeTable:
+    """Fake process table for resize scenarios: per-worker incarnation
+    scripts (as FakeTable), plus an injectable availability flag and a
+    record of every spawn's topology ((worker,) for the original path,
+    (worker, rank, world, ranks) for a resized incarnation)."""
+
+    def __init__(self, scripts, unavailable=()):
+        self.scripts = scripts
+        self.available = {i: i not in unavailable for i in scripts}
+        self.spawned: list[tuple] = []
+        self.procs: dict[tuple[int, int], FakeProc] = {}
+
+    def agent(self, i):
+        def _spawn(*topo):
+            inc = sum(1 for s in self.spawned if s[0] == i)
+            self.spawned.append((i,) + topo)
+            p = FakeProc(self.scripts[i][min(inc, len(self.scripts[i]) - 1)])
+            self.procs[(i, inc)] = p
+            return p
+
+        return ElasticAgent(
+            f"worker{i}",
+            _spawn,
+            worker_id=i,
+            available_fn=lambda: self.available[i],
+            topo_spawn_fn=_spawn,
+        )
+
+    def gang(self, n, **kw):
+        kw.setdefault("sleep", lambda s: None)
+        kw.setdefault("jitter", 0.0)
+        kw.setdefault("rejoin_timeout_s", 0.0)
+        return ElasticGang([self.agent(i) for i in range(n)], **kw)
+
+
+def test_gang_shrinks_when_slot_not_replaced():
+    """Acceptance: kill-without-replacement resizes to M >= min_workers,
+    charges the budget ONCE, and emits the structured Resize: line plus
+    the world_size tfevents scalar."""
+    t = ResizeTable(
+        {0: [[None, None], [None, 0]], 1: [[None, 9]]}, unavailable={1}
+    )
+    lines, writer = [], FakeWriter()
+    gang = t.gang(
+        2, max_restarts=2, min_workers=1, print_fn=lines.append,
+        summary_writer=writer,
+    )
+    assert gang.run() == 0
+    assert gang.restarts == 1  # the resize charged the budget exactly once
+    assert gang.resizes == 1 and gang.world_size == 1
+    # Incarnation 0 spawns via the ORIGINAL path; the shrunk incarnation
+    # respawns only the survivor, at compact rank 0 of world 1.
+    assert t.spawned == [(0,), (1,), (0, 0, 1, (0,))]
+    (line,) = [l for l in lines if l.startswith("Resize: world=")]
+    assert "world=1 from=2" in line and "direction=shrink" in line
+    assert "dropped=[worker1]" in line
+    # world_size scalar stream: initial world at step 0, the resize at its
+    # restart ordinal.
+    assert ("world_size", 2.0, 0) in writer.scalars
+    assert ("world_size", 1.0, 1) in writer.scalars
+
+
+def test_gang_below_floor_fail_stops():
+    """Below min_workers the gang fail-stops (round-6 semantics): rc 1,
+    denial line, no relaunch below the floor."""
+    t = ResizeTable(
+        {0: [[None, None], [None, 7]], 1: [[None, 9]]}, unavailable={0, 1}
+    )
+    lines = []
+    gang = t.gang(2, max_restarts=5, min_workers=1, print_fn=lines.append)
+    assert gang.run() == 1
+    assert gang.resizes == 1  # shrank to 1, then the survivor's host died
+    assert any(
+        l.startswith("Resize: denied world=0 min_workers=1") for l in lines
+    )
+    # Nothing spawned past the world-1 incarnation.
+    assert t.spawned == [(0,), (1,), (0, 0, 1, (0,))]
+
+
+def test_gang_replacement_within_window_preserves_fixed_size():
+    """A replacement registering INSIDE rejoin_timeout_s keeps round 7's
+    fixed-size restart path bit-for-bit: original spawn calls (no
+    topology arguments), no Resize: line, same budget accounting."""
+    t = ResizeTable({0: [[None, None], [None, 0]], 1: [[None, 9], [None, 0]]})
+    t.available[1] = False
+    now = {"t": 0.0}
+
+    def sleep(s):
+        now["t"] += max(s, 0.5)
+        if now["t"] > 5.0:  # replacement arrives 5s in; window is 30s
+            t.available[1] = True
+
+    lines = []
+    gang = t.gang(
+        2, max_restarts=2, min_workers=1, rejoin_timeout_s=30.0,
+        poll_interval=1.0, sleep=sleep, clock=lambda: now["t"],
+        print_fn=lines.append,
+    )
+    assert gang.run() == 0
+    assert gang.restarts == 1 and gang.resizes == 0
+    assert t.spawned == [(0,), (1,), (0,), (1,)]  # original path throughout
+    assert not any(l.startswith("Resize:") for l in lines)
+
+
+def test_gang_grows_back_when_replacement_registers():
+    """Acceptance (grow half): while running degraded, a benched slot's
+    replacement registering triggers a grow back to the original world —
+    original ranks, original spawn path — charging the budget once more."""
+    t = ResizeTable(
+        {0: [[None, None], [None, None], [None, 0]], 1: [[None, 9], [None, 0]]},
+        unavailable={1},
+    )
+    lines, writer = [], FakeWriter()
+    gang = t.gang(
+        2, max_restarts=3, min_workers=1, print_fn=lines.append,
+        summary_writer=writer,
+    )
+    # Replacement registers once the gang is running degraded.
+    real_sleep = gang.sleep
+
+    def sleep(s):
+        if gang.resizes >= 1:
+            t.available[1] = True
+        real_sleep(s)
+
+    gang.sleep = sleep
+    assert gang.run() == 0
+    assert gang.restarts == 2 and gang.resizes == 2 and gang.world_size == 2
+    # shrink → degraded incarnation → grow at original ranks (plain spawns).
+    assert t.spawned == [(0,), (1,), (0, 0, 1, (0,)), (0,), (1,)]
+    grow = [l for l in lines if "direction=grow" in l]
+    assert len(grow) == 1 and "rejoined=[worker1]" in grow[0]
+    assert ("world_size", 2.0, 2) in writer.scalars
+    # The grow's Restart: line names the rejoined member as its cause.
+    assert any("worker1=rejoined" in l for l in lines)
+
+
+def test_gang_resize_needs_topo_spawn():
+    """An agent without topo_spawn_fn cannot be respawned at a non-original
+    topology — loud error, not a silently wrong world size."""
+    # worker1 dies, unavailable; worker0 has no topo_spawn_fn.
+    procs = {0: [[None, None]], 1: [[None, 3]]}
+    made = []
+
+    def mk(i):
+        it = iter(procs[i])
+
+        def _spawn():
+            made.append(i)
+            return FakeProc(next(it))
+
+        return ElasticAgent(
+            f"worker{i}", _spawn, worker_id=i,
+            available_fn=lambda: i != 1,
+        )
+
+    gang = ElasticGang(
+        [mk(0), mk(1)], max_restarts=2, min_workers=1, jitter=0.0,
+        sleep=lambda s: None, print_fn=lambda *a: None,
+    )
+    with pytest.raises(RuntimeError, match="topo_spawn_fn"):
+        gang.run()
+
+
+def test_gang_min_workers_validation():
+    agents = [ElasticAgent("w0", lambda: FakeProc([0]))]
+    with pytest.raises(ValueError, match="min_workers"):
+        ElasticGang(agents, min_workers=0)
+    with pytest.raises(ValueError, match="min_workers"):
+        ElasticGang(agents, min_workers=2)
+    with pytest.raises(ValueError, match="rejoin_timeout_s"):
+        ElasticGang(agents, rejoin_timeout_s=-1.0)
+
+
+def test_gang_health_factory_receives_world():
+    """A resized incarnation's detector must expect the REDUCED member
+    count: world-aware factories get the incarnation's world size."""
+    worlds = []
+
+    class NullHealth:
+        def classify(self, wid):
+            return "ok"
+
+        def stop(self):
+            pass
+
+    def factory(world):
+        worlds.append(world)
+        return NullHealth()
+
+    t = ResizeTable(
+        {0: [[None, None], [None, 0]], 1: [[None, 9]]}, unavailable={1}
+    )
+    gang = t.gang(
+        2, max_restarts=2, min_workers=1, health_factory=factory,
+        print_fn=lambda *a: None,
+    )
+    assert gang.run() == 0
+    assert worlds == [2, 1]
+
+
+# ---------------------------------------------------------------------------
+# Round 8 wiring: env knobs, cluster subset, driver flags.
+# ---------------------------------------------------------------------------
+
+
+def test_config_from_env_resize_knobs(monkeypatch):
+    from distributed_tensorflow_tpu.launch import config_from_env
+
+    monkeypatch.setenv("DTF_MIN_WORKERS", "2")
+    monkeypatch.setenv("DTF_REJOIN_TIMEOUT_S", "12.5")
+    cfg = config_from_env()
+    assert cfg.min_workers == 2
+    assert cfg.rejoin_timeout_s == 12.5
+
+
+@pytest.mark.parametrize(
+    "var,value",
+    [
+        ("DTF_MIN_WORKERS", "two"),
+        ("DTF_REJOIN_TIMEOUT_S", "soon"),
+        ("DTF_MAX_RESTARTS", "3.5"),
+    ],
+)
+def test_config_from_env_invalid_values_raise(monkeypatch, var, value):
+    from distributed_tensorflow_tpu.launch import config_from_env
+
+    monkeypatch.setenv(var, value)
+    with pytest.raises(ValueError, match=var):
+        config_from_env()
+
+
+def test_config_from_env_negative_min_workers_rejected(monkeypatch):
+    from distributed_tensorflow_tpu.launch import config_from_env
+
+    monkeypatch.setenv("DTF_MIN_WORKERS", "-1")
+    with pytest.raises(ValueError, match="min_workers"):
+        config_from_env()
+
+
+def test_cluster_subset_selects_and_validates():
+    from distributed_tensorflow_tpu.config import ClusterConfig
+
+    cluster = ClusterConfig.from_lists(["h0:1", "h1:2", "h2:3"])
+    sub = cluster.subset((2, 0))
+    assert sub.worker_svrs == ("h2:3", "h0:1")
+    assert sub.coordinator_address == "h2:3"  # new rank 0's host
+    assert sub.num_processes == 2
+    with pytest.raises(ValueError, match="at least one"):
+        cluster.subset(())
+    with pytest.raises(ValueError, match="unique"):
+        cluster.subset((1, 1))
+    with pytest.raises(ValueError, match="out of range"):
+        cluster.subset((0, 3))
+
+
+def test_cluster_from_env_world_size_and_ranks(monkeypatch):
+    from distributed_tensorflow_tpu.launch import cluster_from_env
+
+    base = ClusterConfig.from_lists(["h0:1", "h1:2", "h2:3"])
+    monkeypatch.setenv("DTF_WORLD_SIZE", "2")
+    assert cluster_from_env(base).worker_svrs == ("h0:1", "h1:2")
+
+    monkeypatch.setenv("DTF_WORKER_RANKS", "1")
+    monkeypatch.setenv("DTF_WORLD_SIZE", "1")
+    shrunk = cluster_from_env(base)
+    assert shrunk.worker_svrs == ("h1:2",)
+    assert shrunk.num_processes == 1
+
+    # Contradiction and malformed values are loud.
+    monkeypatch.setenv("DTF_WORLD_SIZE", "2")
+    with pytest.raises(ValueError, match="contradicts"):
+        cluster_from_env(base)
+    monkeypatch.setenv("DTF_WORLD_SIZE", "two")
+    with pytest.raises(ValueError, match="DTF_WORLD_SIZE"):
+        cluster_from_env(base)
+    monkeypatch.delenv("DTF_WORLD_SIZE")
+    monkeypatch.setenv("DTF_WORKER_RANKS", "1,x")
+    with pytest.raises(ValueError, match="DTF_WORKER_RANKS"):
+        cluster_from_env(base)
+    monkeypatch.setenv("DTF_WORKER_RANKS", "7")
+    with pytest.raises(ValueError, match="out of range"):
+        cluster_from_env(base)
+    monkeypatch.delenv("DTF_WORKER_RANKS")
+    monkeypatch.setenv("DTF_WORLD_SIZE", "0")
+    with pytest.raises(ValueError, match=">= 1"):
+        cluster_from_env(base)
+
+
+def test_cluster_from_env_world_size_needs_worker_svrs(monkeypatch):
+    from distributed_tensorflow_tpu.launch import cluster_from_env
+
+    monkeypatch.setenv("DTF_WORLD_SIZE", "2")
+    with pytest.raises(ValueError, match="worker_svrs"):
+        cluster_from_env(ClusterConfig())
+
+
+def test_launch_local_shrinks_on_lost_marker(tmp_path):
+    """Driver end-to-end over real (trivial) subprocesses: a worker that
+    dies with its .lost marker present is benched; the survivor relaunches
+    at world 1 with the topology env set."""
+    import sys
+
+    from distributed_tensorflow_tpu.tools.launch_local import launch
+
+    script = (
+        "import os, sys\n"
+        "task = [a for a in sys.argv if a.startswith('--task_index')]"
+        "[0].split('=')[1]\n"
+        "wd = sys.argv[1]\n"
+        "print('WORLD', os.environ.get('DTF_WORLD_SIZE', 'orig'),\n"
+        "      'RANKS', os.environ.get('DTF_WORKER_RANKS', '-'), flush=True)\n"
+        "if task == '1' and not os.path.exists(os.path.join(wd, 'died')):\n"
+        "    open(os.path.join(wd, 'died'), 'w').close()\n"
+        "    open(os.path.join(wd, 'logs', 'worker1.lost'), 'w').close()\n"
+        "    sys.exit(5)\n"
+        "sys.exit(0)\n"
+    )
+    lines = []
+    rc = launch(
+        [sys.executable, "-c", script, str(tmp_path)],
+        num_workers=2,
+        logdir=str(tmp_path / "logs"),
+        max_restarts=2,
+        min_workers=1,
+        rejoin_timeout_s=1.0,
+        backoff=0.05,
+        poll_interval=0.05,
+        print_fn=lambda *a: lines.append(" ".join(str(x) for x in a)),
+    )
+    assert rc == 0, lines
+    assert any(
+        l.startswith("Resize: world=1 from=2") and "dropped=[worker1]" in l
+        for l in lines
+    ), lines
+    w0 = (tmp_path / "logs" / "worker0.log").read_bytes().decode()
+    # Incarnation 1: original env; incarnation 2: shrunk topology env.
+    assert "WORLD orig RANKS -" in w0 and "WORLD 1 RANKS 0" in w0, w0
+
+
+def test_launch_local_resize_flag_validation(tmp_path):
+    import sys
+
+    from distributed_tensorflow_tpu.tools.launch_local import launch
+
+    with pytest.raises(ValueError, match="exceeds num_workers"):
+        launch([sys.executable, "-c", "pass"], num_workers=1,
+               logdir=str(tmp_path), max_restarts=1, min_workers=2)
+    with pytest.raises(ValueError, match="max_restarts"):
+        launch([sys.executable, "-c", "pass"], num_workers=2,
+               logdir=str(tmp_path), max_restarts=0, min_workers=1)
+    with pytest.raises(ValueError, match="drive_mode"):
+        launch([sys.executable, "-c", "pass"], num_workers=2,
+               logdir=str(tmp_path), max_restarts=1, min_workers=1,
+               drive_mode="explode")
+
+
+def test_launch_local_cli_resize_defaults_from_env(monkeypatch):
+    from distributed_tensorflow_tpu.tools import launch_local
+
+    monkeypatch.setenv("DTF_MAX_RESTARTS", "2")
+    monkeypatch.setenv("DTF_MIN_WORKERS", "1")
+    monkeypatch.setenv("DTF_REJOIN_TIMEOUT_S", "7.5")
+    seen = {}
+
+    def fake_launch(command, workers, ps, logdir, **kw):
+        seen.update(kw, workers=workers)
+        return 0
+
+    monkeypatch.setattr(launch_local, "launch", fake_launch)
+    assert launch_local.main(["--workers", "2", "--", "echo", "hi"]) == 0
+    assert seen["min_workers"] == 1
+    assert seen["rejoin_timeout_s"] == 7.5
+    assert seen["drive_mode"] == "none"

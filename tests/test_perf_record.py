@@ -1,0 +1,49 @@
+"""Number-of-record freshness (round 8, VERDICT r5 weak #6): the perf
+docs' bench citation is GENERATED from the newest ``BENCH_r*.json`` and
+this module pins the committed docs against the newest committed
+artifact — landing a new driver artifact without running
+``perf_record --write-docs`` fails here instead of shipping a stale
+number-of-record. No jax needed (pure file checks)."""
+
+import json
+import os
+
+from distributed_tensorflow_tpu.tools import perf_record
+
+
+def test_latest_bench_resolves_highest_round():
+    latest = perf_record.latest_bench()
+    assert latest is not None
+    name, parsed = latest
+    # Highest-numbered artifact at the repo root wins.
+    rounds = [
+        int(f[7:-5])
+        for f in os.listdir(perf_record.repo_root())
+        if f.startswith("BENCH_r") and f.endswith(".json")
+    ]
+    assert name == f"BENCH_r{max(rounds):02d}.json" or name == (
+        f"BENCH_r{max(rounds)}.json"
+    )
+    assert parsed["value"] > 0 and "impl" in parsed
+
+
+def test_latest_bench_skips_unparseable(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"parsed": {"value": 1.0, "vs_baseline": 1.0, "impl": "x"}})
+    )
+    (tmp_path / "BENCH_r02.json").write_text("not json")
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps({"rc": 1}))
+    name, parsed = perf_record.latest_bench(str(tmp_path))
+    assert name == "BENCH_r01.json"  # r02/r03 carry no parseable metric
+
+
+def test_committed_docs_cite_newest_artifact():
+    stale = perf_record.check_docs()
+    assert not stale, (
+        f"stale bench-record citations in {stale}; run "
+        "python -m distributed_tensorflow_tpu.tools.perf_record --write-docs"
+    )
+
+
+def test_write_docs_is_idempotent():
+    assert perf_record.write_docs(print_fn=lambda *a: None) is False
