@@ -50,6 +50,11 @@ _LAZY_EXPORTS = {
         "ShardedDataParallel",
     ),
     "Predictor": ("distributed_tensorflow_tpu.inference", "Predictor"),
+    "TextServer": ("distributed_tensorflow_tpu.serve", "TextServer"),
+    "GenerationConfig": (
+        "distributed_tensorflow_tpu.serve",
+        "GenerationConfig",
+    ),
     "read_data_sets": ("distributed_tensorflow_tpu.data", "read_data_sets"),
     "make_mesh": ("distributed_tensorflow_tpu.parallel", "make_mesh"),
     "SingleDevice": ("distributed_tensorflow_tpu.parallel", "SingleDevice"),

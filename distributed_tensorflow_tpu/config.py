@@ -243,6 +243,12 @@ class TrainConfig:
     # lifecycle API at near-compiled_run throughput, with a bounded
     # resume/stop granularity of k epochs instead of the whole run.
     # None/0 disables. Ignored when compiled_run=True (strictly coarser).
+    # Picking k: per-epoch cost is t + C/k (t = whole-run compute, C = the
+    # per-dispatch fixed cost — benchmark_suite's `single-k*` sweep fits
+    # both; docs/benchmarks/tpu_single.md), so choose the smallest k with
+    # C/(k·t) at your tolerable overhead — on the tunneled v5e that knee
+    # sits around k≈25-50, and smaller k buys nothing but a finer
+    # checkpoint/stop boundary.
     epochs_per_dispatch: int | None = None
     # Keep N device-placed batches in flight in the eager per-batch loop
     # (data/prefetch.py): batch i+1's host→device transfer overlaps step i's

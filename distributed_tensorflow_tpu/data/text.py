@@ -52,6 +52,11 @@ class ByteTokenizer:
         arr = arr[(arr >= 0) & (arr < 256)]
         return arr.astype(np.uint8).tobytes().decode("utf-8", errors="replace")
 
+    def decode_batch(self, batches) -> list[str]:
+        """Decode many id sequences — the read half of the batch round-trip
+        the serving layer uses (``encode`` → generate → ``decode_batch``)."""
+        return [self.decode(ids) for ids in batches]
+
 
 class BPETokenizer:
     """Byte-level BPE trained on a corpus: ids 0..255 are bytes, 256 is
@@ -150,6 +155,11 @@ class BPETokenizer:
             self._bytes[i] for i in arr if 0 <= i < self.vocab_size
         )
         return out.decode("utf-8", errors="replace")
+
+    def decode_batch(self, batches) -> list[str]:
+        """Decode many id sequences (inverse of :meth:`encode_batch` for
+        any round-trippable input; the serving layer's read half)."""
+        return [self.decode(ids) for ids in batches]
 
     # -- serialization (the vocab file that ships with a checkpoint) ------
 

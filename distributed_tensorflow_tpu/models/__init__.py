@@ -5,43 +5,82 @@ The registry gives launchers and configs a stable string surface for model
 selection — the role the reference filled by picking which script to run
 (tfsingle.py vs tfdist_between.py all hardcode the same MLP graph,
 reference tfsingle.py:23-42).
+
+Exports are lazy (PEP 562, same pattern as the package root, ``train/``
+and ``parallel/``): importing the package names no model module, so the
+serving stack (``serve.py`` → ``models/gpt.py``) stays importable in a
+degraded container whose jax cannot back every family's dependencies.
 """
 
-from distributed_tensorflow_tpu.models.cnn import CNN, CNNParams  # noqa: F401
-from distributed_tensorflow_tpu.models.gpt import (  # noqa: F401
-    GPTLM,
-    GPTLMParams,
-    KVCache,
-    make_lm_async_train_step,
-    make_lm_train_step,
-)
-from distributed_tensorflow_tpu.models.mlp import MLP, MLPParams  # noqa: F401
-from distributed_tensorflow_tpu.models.rnn import (  # noqa: F401
-    LSTMClassifier,
-    LSTMParams,
-)
-from distributed_tensorflow_tpu.models.transformer import (  # noqa: F401
-    TransformerClassifier,
-    TransformerParams,
-)
+_LAZY_EXPORTS = {
+    "CNN": ("distributed_tensorflow_tpu.models.cnn", "CNN"),
+    "CNNParams": ("distributed_tensorflow_tpu.models.cnn", "CNNParams"),
+    "GPTLM": ("distributed_tensorflow_tpu.models.gpt", "GPTLM"),
+    "GPTLMParams": ("distributed_tensorflow_tpu.models.gpt", "GPTLMParams"),
+    "KVCache": ("distributed_tensorflow_tpu.models.gpt", "KVCache"),
+    "make_lm_async_train_step": (
+        "distributed_tensorflow_tpu.models.gpt",
+        "make_lm_async_train_step",
+    ),
+    "make_lm_train_step": (
+        "distributed_tensorflow_tpu.models.gpt",
+        "make_lm_train_step",
+    ),
+    "MLP": ("distributed_tensorflow_tpu.models.mlp", "MLP"),
+    "MLPParams": ("distributed_tensorflow_tpu.models.mlp", "MLPParams"),
+    "LSTMClassifier": (
+        "distributed_tensorflow_tpu.models.rnn",
+        "LSTMClassifier",
+    ),
+    "LSTMParams": ("distributed_tensorflow_tpu.models.rnn", "LSTMParams"),
+    "TransformerClassifier": (
+        "distributed_tensorflow_tpu.models.transformer",
+        "TransformerClassifier",
+    ),
+    "TransformerParams": (
+        "distributed_tensorflow_tpu.models.transformer",
+        "TransformerParams",
+    ),
+}
 
+# name → (module, attr); values resolve to classes in build_model. Keys are
+# the stable string surface (sorted(MODEL_REGISTRY) stays the choices list).
 MODEL_REGISTRY = {
-    "mlp": MLP,
-    "cnn": CNN,
-    "transformer": TransformerClassifier,
-    "lstm": LSTMClassifier,
+    "mlp": ("distributed_tensorflow_tpu.models.mlp", "MLP"),
+    "cnn": ("distributed_tensorflow_tpu.models.cnn", "CNN"),
+    "transformer": (
+        "distributed_tensorflow_tpu.models.transformer",
+        "TransformerClassifier",
+    ),
+    "lstm": ("distributed_tensorflow_tpu.models.rnn", "LSTMClassifier"),
     # GPTLM is deliberately NOT here: the registry serves the Trainer's
     # image-classification pipeline (C6/C14); the LM trains through
     # models.gpt.make_lm_train_step on token batches instead.
 }
 
+__all__ = list(_LAZY_EXPORTS) + ["MODEL_REGISTRY", "build_model"]
+
+
+def __getattr__(name):
+    try:
+        module, attr = _LAZY_EXPORTS[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(module), attr)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
 
 def build_model(name: str, **kwargs):
     """Construct a registered model family by name."""
     try:
-        cls = MODEL_REGISTRY[name]
+        module, attr = MODEL_REGISTRY[name]
     except KeyError:
         raise ValueError(
             f"unknown model {name!r}; registered: {sorted(MODEL_REGISTRY)}"
         ) from None
-    return cls(**kwargs)
+    import importlib
+
+    return getattr(importlib.import_module(module), attr)(**kwargs)

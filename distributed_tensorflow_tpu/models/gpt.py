@@ -40,16 +40,20 @@ from distributed_tensorflow_tpu.ops.ring_attention import dense_attention
 
 def _rope(x, positions, base: float = 10000.0):
     """Rotary position embedding on [B, L, H, Dh] at absolute ``positions``
-    [L]: pairs (x_i, x_{i+Dh/2}) rotate by pos·base^(−2i/Dh). Computed in
+    [L] (shared across the batch) or [B, L] (per-row — the slot-decode
+    path, where every serving slot sits at its own sequence position):
+    pairs (x_i, x_{i+Dh/2}) rotate by pos·base^(−2i/Dh). Computed in
     f32, cast back — relative-position attention without any learned table,
     the modern LM default (absent from the reference, which has no sequence
     models at all)."""
     b, l, h, dh = x.shape
     half = dh // 2
     freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    ang = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [L, half]
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    # [L, half] or [B, L, half]; the head axis slots in before `half`, and
+    # leading-batch broadcasting aligns both layouts against [B, L, H, half].
+    ang = positions.astype(jnp.float32)[..., :, None] * freqs
+    cos = jnp.expand_dims(jnp.cos(ang), -2)
+    sin = jnp.expand_dims(jnp.sin(ang), -2)
     x32 = x.astype(jnp.float32)
     x1, x2 = x32[..., :half], x32[..., half:]
     return jnp.concatenate(
@@ -101,6 +105,20 @@ class GPTLMParams(NamedTuple):
     blocks: GPTBlockParams  # leaves stacked over num_layers
     lnf_scale: jax.Array
     lnf_bias: jax.Array
+
+
+class SlotKVCache(NamedTuple):
+    """Serving-side decode state over a fixed bank of request SLOTS: like
+    :class:`KVCache` but with a PER-SLOT length — every batch row is an
+    independent request at its own sequence position, which is what
+    continuous batching needs (slots free and refill at different times;
+    a shared scalar length would drain the whole bank to the longest
+    request). Written by :meth:`GPTLM.prefill_slots` /
+    :meth:`GPTLM.decode_slots`; the text layer on top is ``serve.py``."""
+
+    k: jax.Array  # [num_layers, S, cache_len, Hkv, Dh]
+    v: jax.Array  # [num_layers, S, cache_len, Hkv, Dh]
+    lengths: jax.Array  # [S] int32 — tokens written into each slot's cache
 
 
 class KVCache(NamedTuple):
@@ -332,7 +350,7 @@ class GPTLM:
             x.astype(cd), w.astype(cd), preferred_element_type=jnp.float32
         )
 
-    def _attend(self, q, k, v):
+    def _attend(self, q, k, v, kv_lens=None):
         from distributed_tensorflow_tpu.models.base import (
             resolve_flash_min_len,
         )
@@ -344,8 +362,12 @@ class GPTLM:
                 flash_attention,
             )
 
-            return flash_attention(q, k, v, causal=True, window=self.window)
-        return dense_attention(q, k, v, causal=True, window=self.window)
+            return flash_attention(
+                q, k, v, causal=True, window=self.window, kv_lens=kv_lens
+            )
+        return dense_attention(
+            q, k, v, causal=True, window=self.window, kv_lens=kv_lens
+        )
 
     def _embed_tokens(self, params, tokens, positions):
         """Token embedding, plus the learned position table when that
@@ -960,6 +982,222 @@ class GPTLM:
             nvs.append(cv)
         new_cache = KVCache(
             k=jnp.stack(nks), v=jnp.stack(nvs), length=cache.length + 1
+        )
+        return self._logits(params, h)[:, 0], new_cache
+
+    # -- slot-wise decoding (the serving surface, serve.py) ----------------
+
+    def empty_slot_cache(self, slots: int) -> SlotKVCache:
+        """A vacant ``slots``-row :class:`SlotKVCache` (lengths all zero —
+        a zero-length slot is FREE; the decode mask treats only written
+        positions as attendable, so vacant rows compute well-defined
+        garbage that the scheduler never reads)."""
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        shape = (
+            self.num_layers,
+            slots,
+            self.cache_len,
+            self.num_kv_heads,
+            self.head_dim,
+        )
+        z = jnp.zeros(shape, self.compute_dtype)
+        return SlotKVCache(k=z, v=z, lengths=jnp.zeros((slots,), jnp.int32))
+
+    def reset_slots(self, cache: SlotKVCache, free: jax.Array) -> SlotKVCache:
+        """Mark slots FREE (``free`` [S] bool): their lengths drop to 0.
+        K/V content is left in place — stale bytes are unreachable because
+        the decode validity mask ignores everything past ``lengths``, and
+        a :meth:`prefill_slots` admit overwrites the row wholesale.
+        ``serve.py``'s scheduler tracks vacancy host-side (its ``finished``
+        flag) and re-arms through the admit merge alone; this is the
+        explicit in-graph vacancy op for external schedulers that keep
+        slot state on device (pinned content-independent in
+        tests/test_serve.py)."""
+        return cache._replace(
+            lengths=jnp.where(free, 0, cache.lengths)
+        )
+
+    def prefill_slots(
+        self,
+        params: GPTLMParams,
+        cache: SlotKVCache,
+        tokens: jax.Array,
+        lengths: jax.Array,
+        admit: jax.Array,
+    ):
+        """Batched ragged prefill INTO slots: run the prompt block [S, L]
+        (right-padded rows, real lengths in ``lengths`` [S]) once, and for
+        every row with ``admit[s]`` True replace slot s's cache with the
+        prompt's K/V and its length — rows with ``admit`` False keep their
+        existing state bit-for-bit (they are mid-generation in other
+        slots' requests). Returns (per-row logits at each row's LAST REAL
+        position [S, vocab], updated cache).
+
+        Pad positions are kept out of everything that could leak into real
+        rows: attention masks keys ≥ lengths (``kv_lens``, both attention
+        impls), MoE routing/capacity sees only real tokens (``lengths``
+        threading, as in :meth:`apply_with_aux`), and the returned logits
+        are gathered at ``lengths-1``. For a prompt at exactly L the masks
+        are no-ops and the math is :meth:`prefill`'s — the serving parity
+        contract (pinned in tests/test_serve.py). One compiled executable
+        per (S, L) shape: serve.py pads prompts to a small set of length
+        BUCKETS so the compile count stays bounded."""
+        s, l = tokens.shape
+        c = self.cache_len
+        positions = jnp.arange(l)
+        token_mask = positions[None, :] < lengths[:, None]  # [S, L]
+
+        def attend(q, k, v):
+            return self._attend(q, k, v, kv_lens=lengths)
+
+        h = self._embed_tokens(params, tokens, positions)
+
+        def body(h, blk):
+            h, kv, _ = self._block(
+                blk,
+                h,
+                attend=attend,
+                positions=positions,
+                token_mask=token_mask,
+            )
+            return h, kv
+
+        h, (ks, vs) = lax.scan(body, h, params.blocks)
+        ks = ks.astype(self.compute_dtype)  # [n, S, L, Hkv, Dh]
+        vs = vs.astype(self.compute_dtype)
+        if l <= c:
+            # Every prompt position p < lengths[s] <= c lands at slot
+            # p % c = p: plain pad (the same layout prefill() writes).
+            pad = [(0, 0), (0, 0), (0, c - l), (0, 0), (0, 0)]
+            nk, nv = jnp.pad(ks, pad), jnp.pad(vs, pad)
+        else:
+            # Rolling window (c < L): per ROW, keep that row's last
+            # min(c, len) real positions at slots p % c. Cache slot j
+            # holds the largest prompt position p < len with p ≡ j
+            # (mod c): p = j + c·⌊(len−1−j)/c⌋ — per-row dynamic, unlike
+            # prefill()'s static arrays, because each row has its own len.
+            idx = jnp.arange(c)[None, :]  # [1, c]
+            p = idx + c * ((lengths[:, None] - 1 - idx) // c)  # [S, c]
+            gather = jnp.clip(p, 0, l - 1)[None, :, :, None, None]
+            nk = jnp.take_along_axis(ks, gather, axis=2)
+            nv = jnp.take_along_axis(vs, gather, axis=2)
+            # p < 0 rows (len <= j and no earlier wrap) hold garbage —
+            # unreachable: the decode mask derives validity from lengths.
+        m = admit[None, :, None, None, None]
+        new_cache = SlotKVCache(
+            k=jnp.where(m, nk, cache.k),
+            v=jnp.where(m, nv, cache.v),
+            lengths=jnp.where(admit, lengths, cache.lengths),
+        )
+        h_last = jnp.take_along_axis(
+            h, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
+        )  # [S, 1, d]
+        return self._logits(params, h_last)[:, 0], new_cache
+
+    def _decode_block_slots(self, blk, h, ck, cv, lengths, act):
+        """Per-slot single-token block step — :meth:`_decode_block` with a
+        VECTOR of positions: h [S, 1, d], ck/cv [S, cache_len, Hkv, Dh],
+        ``lengths`` [S] (each row's write position), ``act`` [S] bool
+        (inactive rows write their old K/V back — a no-op — and their
+        outputs are garbage the caller discards). Row-wise math is
+        _decode_block's exactly (pinned by test_serve.py's token-parity
+        tests); the scalar ``dynamic_update_slice`` becomes a per-row
+        scatter and the validity mask broadcasts per row."""
+        s = h.shape[0]
+        c = self.cache_len
+        hn = _layernorm(h, blk.ln1_scale, blk.ln1_bias)
+        kv_shape = (s, 1, self.num_kv_heads, self.head_dim)
+        q = self._dot(hn, blk.wq).reshape(s, 1, self.num_heads, self.head_dim)
+        k = self._dot(hn, blk.wk).reshape(kv_shape)
+        v = self._dot(hn, blk.wv).reshape(kv_shape)
+        if self.pos_embedding == "rope":
+            pos = lengths[:, None]  # [S, 1] — per-row absolute position
+            q = _rope(q, pos)
+            k = _rope(k, pos)
+        k = k.astype(ck.dtype)
+        v = v.astype(cv.dtype)
+        rows = jnp.arange(s)
+        slot = lengths % c if self.window is not None else lengths  # [S]
+        kw = jnp.where(act[:, None, None], k[:, 0], ck[rows, slot])
+        vw = jnp.where(act[:, None, None], v[:, 0], cv[rows, slot])
+        ck = ck.at[rows, slot].set(kw)
+        cv = cv.at[rows, slot].set(vw)
+        from distributed_tensorflow_tpu.ops.ring_attention import (
+            group_query_heads,
+        )
+
+        qg = group_query_heads(q[:, 0], self.num_kv_heads)
+        scores = jnp.einsum(
+            "shgd,skhd->shgk", qg, ck, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(jnp.asarray(self.head_dim, jnp.float32))
+        idx = jnp.arange(c)[None, :]  # [1, c]
+        if self.window is not None:
+            # Same rolling-buffer identity as _decode_block, per row.
+            slot_pos = lengths[:, None] - jnp.mod(slot[:, None] - idx, c)
+            valid = slot_pos >= 0  # [S, c]
+        else:
+            valid = idx <= lengths[:, None]  # [S, c]
+        scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+        w = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum(
+            "shgk,skhd->shgd",
+            w.astype(cv.dtype),
+            cv,
+            preferred_element_type=jnp.float32,
+        ).reshape(s, 1, self.num_heads, self.head_dim)
+        h = h + self._dot(attn.reshape(s, 1, self.model_dim), blk.wo)
+        hn2 = _layernorm(h, blk.ln2_scale, blk.ln2_bias)
+        ffn_out, _ = self._ffn(blk, hn2)  # aux unused: decode never drops
+        return h + ffn_out, ck, cv
+
+    def decode_slots(
+        self,
+        params: GPTLMParams,
+        token: jax.Array,
+        cache: SlotKVCache,
+        active: jax.Array | None = None,
+    ):
+        """Append one token per SLOT: token [S] int32 at each slot's own
+        position. Returns (logits [S, vocab], cache with ``lengths``
+        advanced where active). ``active`` [S] bool masks rows out of the
+        update entirely (their cache row and length are untouched and
+        their logits are garbage to discard) — finished/vacant slots ride
+        along at full batch shape, which is what keeps ONE compiled
+        executable serving every occupancy pattern. Layer loop UNROLLED
+        for the same cache-double-buffering reason as :meth:`decode_step`.
+
+        Stepping an ACTIVE row past ``max_len`` would corrupt its newest
+        cache slot (scatter clamp semantics), so eager calls raise, as in
+        :meth:`decode_step`; traced callers bound their own trip count
+        (serve.py budgets every admit so prompt+generation fits)."""
+        act = (
+            jnp.ones((token.shape[0],), bool) if active is None else active
+        )
+        if not isinstance(cache.lengths, jax.core.Tracer) and not isinstance(
+            act, jax.core.Tracer
+        ):
+            worst = int(jnp.max(jnp.where(act, cache.lengths, 0)))
+            if bool(jnp.any(act)) and worst >= self.max_len:
+                raise ValueError(
+                    f"KV cache full: an active slot is at length {worst} == "
+                    f"max_len {self.max_len}; increase max_len"
+                )
+        h = self._embed_tokens(
+            params, token[:, None], cache.lengths[:, None]
+        )
+        nks, nvs = [], []
+        for i in range(self.num_layers):
+            blk = jax.tree.map(lambda x: x[i], params.blocks)
+            h, ck, cv = self._decode_block_slots(
+                blk, h, cache.k[i], cache.v[i], cache.lengths, act
+            )
+            nks.append(ck)
+            nvs.append(cv)
+        new_cache = SlotKVCache(
+            k=jnp.stack(nks),
+            v=jnp.stack(nvs),
+            lengths=cache.lengths + act.astype(jnp.int32),
         )
         return self._logits(params, h)[:, 0], new_cache
 
@@ -1873,6 +2111,7 @@ def make_lm_train_step(
     axis: str = "data",
     *,
     tp_axis: str | None = None,
+    seq_axis: str | None = None,
 ):
     """``step(params, opt_state, tokens) -> (params, opt_state, loss)``,
     jitted, for any optax ``GradientTransformation`` (ops/optim.make).
@@ -1896,21 +2135,42 @@ def make_lm_train_step(
     ``axis``. The math is the single-device step verbatim (GSPMD
     partitioning preserves semantics), proven in tests/test_gpt.py.
     Place params with ``jax.device_put`` under the returned layout or let
-    GSPMD reshard on first call; dense models only (MoE → EP)."""
+    GSPMD reshard on first call; dense models only (MoE → EP).
+
+    ``seq_axis`` (round 9) composes GSPMD sequence sharding on top of the
+    tp form — the 3-D **dp×tp×sp** mesh real pods run: tokens constrained
+    ``P(axis, seq_axis)`` (batch over ``axis``, the SEQUENCE dim over
+    ``seq_axis``), params still per :meth:`partition_specs`, one GSPMD
+    program for the whole 3-D composition — XLA inserts the sequence
+    gathers the causal attention needs next to the Megatron collectives.
+    Still the single-device math verbatim; equality on the 2x2x2 mesh is
+    pinned in tests/test_gpt.py. GSPMD triples compose freely this way
+    because every axis is a layout annotation on one program; the
+    shard_map modes (explicit sp/ep/pp) instead compose with exactly one
+    data axis — docs/parallelism.md has the triple-composition menu."""
     import optax
 
+    if seq_axis is not None and tp_axis is None:
+        raise ValueError(
+            "seq_axis composes on the GSPMD tp path; pass tp_axis too "
+            "(for shard_map sequence parallelism use make_lm_sp_parts)"
+        )
     if tp_axis is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         if mesh is None:
             raise ValueError("tp_axis requires a mesh")
+        if seq_axis is not None and seq_axis not in mesh.shape:
+            raise ValueError(
+                f"mesh has no {seq_axis!r} axis: {dict(mesh.shape)}"
+            )
         specs = model.partition_specs(tp_axis)  # raises for MoE blocks
         opt_specs = _slot_specs(
             optimizer, jax.eval_shape(model.init, 1), specs
         )
         shardings = _as_shardings(mesh, specs)
         opt_shardings = _as_shardings(mesh, opt_specs)
-        batch_sharding = NamedSharding(mesh, P(axis))
+        batch_sharding = NamedSharding(mesh, P(axis, seq_axis))
 
         @jax.jit
         def step(params, opt_state, tokens):

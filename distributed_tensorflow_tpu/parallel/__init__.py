@@ -1,16 +1,55 @@
-from distributed_tensorflow_tpu.parallel.fsdp import (  # noqa: F401
-    ShardedDataParallel,
-    fsdp_specs,
-)
-from distributed_tensorflow_tpu.parallel.mesh import make_mesh  # noqa: F401
-from distributed_tensorflow_tpu.parallel.specs import (  # noqa: F401
-    as_shardings,
-    pinned_update,
-    slot_specs,
-)
-from distributed_tensorflow_tpu.parallel.strategy import (  # noqa: F401
-    AsyncDataParallel,
-    SingleDevice,
-    Strategy,
-    SyncDataParallel,
-)
+"""Placement/parallelism layer: mesh, strategies, specs, FSDP, pipeline.
+
+Lazy exports (PEP 562, same pattern as the package root and ``train/``):
+``mesh.py`` needs a mesh-capable jax (``jax.sharding.AxisType``), but much
+of the package — ``TrainState``, spec utilities, the serving stack that
+imports ``models/gpt.py`` (whose module level pulls ``parallel.specs``) —
+does not. Deferring the submodule imports keeps those surfaces importable
+in a degraded container or a lean supervisor process; only touching
+``make_mesh``/a Strategy pulls the mesh-backed half in.
+"""
+
+_LAZY_EXPORTS = {
+    "ShardedDataParallel": (
+        "distributed_tensorflow_tpu.parallel.fsdp",
+        "ShardedDataParallel",
+    ),
+    "fsdp_specs": ("distributed_tensorflow_tpu.parallel.fsdp", "fsdp_specs"),
+    "make_mesh": ("distributed_tensorflow_tpu.parallel.mesh", "make_mesh"),
+    "as_shardings": (
+        "distributed_tensorflow_tpu.parallel.specs",
+        "as_shardings",
+    ),
+    "pinned_update": (
+        "distributed_tensorflow_tpu.parallel.specs",
+        "pinned_update",
+    ),
+    "slot_specs": ("distributed_tensorflow_tpu.parallel.specs", "slot_specs"),
+    "AsyncDataParallel": (
+        "distributed_tensorflow_tpu.parallel.strategy",
+        "AsyncDataParallel",
+    ),
+    "SingleDevice": (
+        "distributed_tensorflow_tpu.parallel.strategy",
+        "SingleDevice",
+    ),
+    "Strategy": ("distributed_tensorflow_tpu.parallel.strategy", "Strategy"),
+    "SyncDataParallel": (
+        "distributed_tensorflow_tpu.parallel.strategy",
+        "SyncDataParallel",
+    ),
+}
+
+__all__ = list(_LAZY_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        module, attr = _LAZY_EXPORTS[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(module), attr)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value

@@ -1,0 +1,616 @@
+"""Batched LM serving: compiled prefill+decode with continuous batching.
+
+The reference's only "inference" was the in-loop eval fetch
+(reference tfsingle.py:94); the classifier side of this framework got
+``inference.py::Predictor`` (fixed-shape compiled prediction). This module
+is the LM analog — text in, text out, from a checkpoint directory — built
+from the pieces rounds 5-8 left on the table: the cross-topology canonical
+restore (``step_N.layout.json`` sidecars), the ``tokenizer.json`` the
+LMTrainer ships into ``checkpoint_dir``, and the unrolled-layer KV-cache
+decode step. Three serving-engine ideas, adapted to one tunneled TPU
+(~20-40 ms/dispatch, ~100 ms per host round-trip — CLAUDE.md):
+
+- **Bucketed prefill** (vLLM-style fixed shapes): prompts are padded to a
+  small set of length buckets and prefilled BATCHED across the server's
+  fixed request slots with ragged ``kv_lens`` masking
+  (``GPTLM.prefill_slots``), so the compile count is ``len(buckets)``, not
+  one per prompt length.
+- **Multi-token decode chunks**: ``chunk`` decode steps — including the
+  sampling — run as ONE ``lax.scan`` dispatch (``GPTLM.decode_slots`` per
+  step, in-graph greedy/temperature/nucleus picks, per-slot EOS/budget
+  tracking), so the ~100 ms tunnel round-trip is paid once per ``chunk``
+  tokens instead of once per token. This is the environment-specific lever:
+  on-chip the scan also removes per-step dispatch latency, through the
+  tunnel it removes a 100 ms round-trip per token.
+- **Continuous batching** (Orca-style): a slot scheduler admits queued
+  requests into freed slots at chunk boundaries — each slot is an
+  independent request at its own position (``SlotKVCache`` carries per-slot
+  lengths), so throughput never drains to the longest request in a static
+  batch.
+
+Parity contract (pinned in tests/test_serve.py): for every request, the
+served token stream equals the in-process single-prompt
+``GPTLM.greedy_decode`` / ``sample_decode(key=jax.random.key(seed))``
+stream token for token — generation is batch-invariant, so a request's
+output does not depend on what shared the batch with it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from collections import deque
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.models.gpt import GPTLM, GPTLMParams
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationConfig:
+    """Per-request decoding knobs. ``greedy=True`` (default) reproduces
+    ``GPTLM.greedy_decode``; ``greedy=False`` reproduces
+    ``sample_decode(key=jax.random.key(seed), temperature=, top_p=)``
+    (nucleus sampling; ``top_p=1.0`` keeps the whole distribution).
+    ``eos_id`` stops a request early once emitted (the EOS token itself is
+    included in the output); None generates exactly ``max_new`` tokens."""
+
+    max_new: int = 64
+    greedy: bool = True
+    temperature: float = 1.0
+    top_p: float = 1.0
+    seed: int = 0
+    eos_id: int | None = None
+
+    def validate(self, vocab_size: int) -> None:
+        if self.max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {self.max_new}")
+        if self.temperature <= 0:
+            raise ValueError(
+                f"temperature must be > 0, got {self.temperature}"
+            )
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if self.eos_id is not None and not 0 <= self.eos_id < vocab_size:
+            raise ValueError(
+                f"eos_id must be in [0, {vocab_size}), got {self.eos_id}"
+            )
+
+
+# -- checkpoint loading (the round-5 canonical layer, params-only) ---------
+
+
+def canonical_lm_params(
+    model: GPTLM, checkpoint_dir: str, *, optimizer=None
+) -> tuple[GPTLMParams, int]:
+    """Restore the newest valid checkpoint under ``checkpoint_dir`` written
+    by :class:`~train.lm_trainer.LMTrainer` in ANY mode layout, and return
+    ``(dense canonical params, step)`` — the serving-side half of the
+    round-5 cross-topology contract: the ``step_N.layout.json`` sidecar
+    names the source layout, pipeline checkpoints unstage their
+    [S, L/S, ...] block stacks back to [L, ...], async checkpoints merge
+    their per-replica copies at the mean (integer leaves take replica 0 —
+    ``merge_replica_leaf``), and the dense family restores as-is.
+
+    ``optimizer`` must match the training optimizer (the checkpoint stores
+    its slots; orbax fails loudly on a structure mismatch); defaults to
+    the reference SGD whose slot state is empty."""
+    from distributed_tensorflow_tpu.ops import optim as optim_lib
+    from distributed_tensorflow_tpu.parallel.strategy import TrainState
+    from distributed_tensorflow_tpu.train import supervisor as _sup
+
+    probe = _sup.latest_checkpoint_step(checkpoint_dir)
+    if probe is None:
+        raise FileNotFoundError(f"no checkpoint under {checkpoint_dir}")
+    if not _sup._HAVE_ORBAX:
+        raise RuntimeError(
+            f"checkpoint found under {checkpoint_dir} but orbax is not"
+            " importable; cannot restore"
+        )
+    sup = _sup.Supervisor(checkpoint_dir=checkpoint_dir)
+    step = sup.newest_restorable_step()
+    if step is None:
+        raise RuntimeError(
+            f"no restorable checkpoint under {checkpoint_dir} (all steps "
+            "fail manifest verification)"
+        )
+    optimizer = optimizer or optim_lib.sgd(0.001)
+    meta = sup.saved_layout(step) or {}
+    mode = meta.get("mode", "single")
+
+    params = jax.eval_shape(lambda: model.init(seed=0))
+    if mode == "pp":
+        from distributed_tensorflow_tpu.models.gpt import (
+            pipeline_stage_params,
+        )
+
+        params = jax.eval_shape(
+            lambda p: pipeline_stage_params(model, p, meta["stages"]), params
+        )
+    opt = jax.eval_shape(optimizer.init, params)
+    step_leaf = jax.ShapeDtypeStruct((), jnp.int32)
+    if mode == "async":
+        n = int(meta["replicas"])
+        stack = lambda t: jax.tree.map(  # noqa: E731
+            lambda s: jax.ShapeDtypeStruct((n,) + s.shape, s.dtype), t
+        )
+        abstract = TrainState(stack(params), stack(opt), step_leaf)
+    else:
+        abstract = TrainState(params, opt, step_leaf)
+    # eval_shape structs carry sharding=None, which some orbax vintages
+    # cannot normalize — pin every leaf to the default device explicitly.
+    dev = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    abstract = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=dev),
+        abstract,
+    )
+    state = sup.restore_raw(step, abstract)
+
+    if mode == "async":
+        from distributed_tensorflow_tpu.parallel.strategy import (
+            merge_replica_leaf,
+        )
+
+        served = jax.tree.map(merge_replica_leaf, state.params)
+    elif mode == "pp":
+        served = state.params._replace(
+            blocks=jax.tree.map(
+                lambda a: a.reshape((-1,) + a.shape[2:]), state.params.blocks
+            )
+        )
+    else:
+        served = state.params
+    return served, step
+
+
+def load_tokenizer(checkpoint_dir: str):
+    """The vocab that produced the checkpoint's token ids:
+    ``tokenizer.json`` (the record LMTrainer ships) when present, else the
+    byte-level identity tokenizer (trainings that never passed one)."""
+    from distributed_tensorflow_tpu.data.text import (
+        BPETokenizer,
+        ByteTokenizer,
+    )
+
+    path = os.path.join(checkpoint_dir, "tokenizer.json")
+    if os.path.exists(path):
+        return BPETokenizer.load(path)
+    return ByteTokenizer()
+
+
+# -- the engine ------------------------------------------------------------
+
+
+class _DecodeState(NamedTuple):
+    """Device-resident per-slot serving state, one pytree so every
+    prefill/chunk dispatch carries it whole. PRNG keys ride as raw
+    ``key_data`` (uint32) — jnp.where composes on those."""
+
+    k: jax.Array  # [layers, S, C, Hkv, Dh]
+    v: jax.Array
+    lengths: jax.Array  # [S] i32 — tokens written into each slot's cache
+    last_tok: jax.Array  # [S] i32 — most recent token (next decode input)
+    key: jax.Array  # [S, ...] u32 — per-slot PRNG key data
+    emitted: jax.Array  # [S] i32 — generated tokens so far
+    budget: jax.Array  # [S] i32 — max_new for the resident request
+    finished: jax.Array  # [S] bool — True: slot idle (done or vacant)
+    greedy: jax.Array  # [S] bool
+    temp: jax.Array  # [S] f32
+    top_p: jax.Array  # [S] f32
+    eos: jax.Array  # [S] i32 — -1: no EOS stop
+
+
+class _Request:
+    __slots__ = ("rid", "tokens", "config", "out", "done")
+
+    def __init__(self, rid, tokens, config):
+        self.rid = rid
+        self.tokens = tokens
+        self.config = config
+        self.out: list[int] = []
+        self.done = False
+
+
+class TextServer:
+    """Continuous-batching text server over a fixed bank of request slots.
+
+    Construct from live params or :meth:`from_checkpoint`; submit requests
+    (:meth:`submit` / :meth:`generate` / :meth:`serve_text`) and drive the
+    engine with :meth:`step` (one admission round + one compiled
+    ``chunk``-token decode dispatch) until :meth:`idle`.
+
+    Compiled shapes: one prefill executable per length bucket (shared
+    jitted function, shape-keyed) and ONE decode-chunk executable serving
+    every occupancy pattern — finished/vacant slots ride along masked, so
+    admission order and slot churn never recompile anything."""
+
+    def __init__(
+        self,
+        model: GPTLM,
+        params: GPTLMParams,
+        tokenizer=None,
+        *,
+        slots: int = 8,
+        buckets: tuple[int, ...] | None = None,
+        chunk: int = 32,
+    ):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        self.model = model
+        self.params = params
+        self.tokenizer = tokenizer
+        self.slots = slots
+        self.chunk = chunk
+        if buckets is None:
+            # Doubling buckets up to max_len-1 (a prompt always leaves at
+            # least one position of generation room): 16, 32, ... — small
+            # enough a handful of executables covers everything.
+            buckets, b = [], 16
+            while b < model.max_len:
+                buckets.append(min(b, model.max_len - 1))
+                b *= 2
+            if not buckets or buckets[-1] != model.max_len - 1:
+                buckets.append(model.max_len - 1)
+        buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if buckets[0] < 1 or buckets[-1] > model.max_len:
+            raise ValueError(
+                f"buckets must lie in [1, max_len={model.max_len}]: {buckets}"
+            )
+        self.buckets = buckets
+        self._queue: deque[_Request] = deque()
+        self._slot_req: list[_Request | None] = [None] * slots
+        self._next_rid = 0
+        self._results: dict[int, _Request] = {}
+        self._state = self._init_state()
+        self._prefill_jit = jax.jit(self._prefill_graph)
+        self._chunk_jit = jax.jit(self._chunk_graph)
+
+    # -- constructors ------------------------------------------------------
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        model: GPTLM,
+        checkpoint_dir: str,
+        *,
+        optimizer=None,
+        tokenizer=None,
+        **kw,
+    ) -> "TextServer":
+        """Serve the newest valid checkpoint in ``checkpoint_dir`` — any
+        mode layout (:func:`canonical_lm_params`), with the shipped
+        ``tokenizer.json`` unless an explicit tokenizer is passed."""
+        params, _ = canonical_lm_params(
+            model, checkpoint_dir, optimizer=optimizer
+        )
+        tok = tokenizer if tokenizer is not None else load_tokenizer(
+            checkpoint_dir
+        )
+        return cls(model, params, tok, **kw)
+
+    # -- compiled graphs ---------------------------------------------------
+
+    def _init_state(self) -> _DecodeState:
+        cache = self.model.empty_slot_cache(self.slots)
+        s = self.slots
+        kd = jax.random.key_data(jax.random.split(jax.random.key(0), s))
+        return _DecodeState(
+            k=cache.k,
+            v=cache.v,
+            lengths=cache.lengths,
+            last_tok=jnp.zeros((s,), jnp.int32),
+            key=kd,
+            emitted=jnp.zeros((s,), jnp.int32),
+            budget=jnp.zeros((s,), jnp.int32),
+            finished=jnp.ones((s,), bool),  # vacant == finished
+            greedy=jnp.ones((s,), bool),
+            temp=jnp.ones((s,), jnp.float32),
+            top_p=jnp.ones((s,), jnp.float32),
+            eos=jnp.full((s,), -1, jnp.int32),
+        )
+
+    def _pick(self, logits, key_data, greedy, temp, top_p):
+        """Per-slot next-token pick, the exact arithmetic of
+        ``GPTLM.{greedy,sample}_decode``'s pick closures (greedy: argmax of
+        the raw logits; sampled: f32/temperature, nucleus keep-mask by
+        EXCLUSIVE cumulative probability, categorical) — vmapped per row
+        with per-slot knobs. ``top_p=1.0`` keeps every token, making the
+        nucleus branch the identity, and the categorical runs at [1, V] so
+        its noise bits match the in-process B=1 call exactly (the parity
+        contract)."""
+
+        amax = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def row(lg, kd, t, p):
+            lt = lg.astype(jnp.float32) / t
+            order = jnp.argsort(lt)[::-1]
+            sorted_l = lt[order]
+            probs = jax.nn.softmax(sorted_l)
+            keep_sorted = jnp.cumsum(probs) - probs < p
+            keep = jnp.zeros(lt.shape, bool).at[order].set(keep_sorted)
+            lt = jnp.where(keep, lt, -jnp.inf)
+            return jax.random.categorical(
+                jax.random.wrap_key_data(kd), lt[None, :], axis=-1
+            )[0].astype(jnp.int32)
+
+        def mixed(_):
+            sampled = jax.vmap(row)(logits, key_data, temp, top_p)
+            return jnp.where(greedy, amax, sampled)
+
+        # Greedy-only banks (the default config) skip the full-vocab
+        # sort/softmax/gumbel machinery entirely — it is O(V log V) per
+        # slot per token in the hot chunk graph, and jnp.where alone
+        # would still evaluate it.
+        return jax.lax.cond(jnp.all(greedy), lambda _: amax, mixed, None)
+
+    def _split_keys(self, key_data):
+        """Per-slot ``key, sub = jax.random.split(key)`` on key-data rows —
+        the exact chain ``GPTLM._decode_loop`` advances per request."""
+
+        def row(kd):
+            nxt = jax.random.split(jax.random.wrap_key_data(kd))
+            return (
+                jax.random.key_data(nxt[0]),
+                jax.random.key_data(nxt[1]),
+            )
+
+        carried, sub = jax.vmap(row)(key_data)
+        return carried, sub
+
+    def _cache(self, st: _DecodeState):
+        from distributed_tensorflow_tpu.models.gpt import SlotKVCache
+
+        return SlotKVCache(k=st.k, v=st.v, lengths=st.lengths)
+
+    def _prefill_graph(
+        self, params, st, tokens, plens, admit, key, budget, greedy, temp,
+        top_p, eos,
+    ):
+        """One admission round: ragged batched prefill into admitted slots
+        + the first sampled token per admitted request (the pick
+        ``_decode_loop`` makes from the prefill logits), all in-graph."""
+        logits, cache = self.model.prefill_slots(
+            params, self._cache(st), tokens, plens, admit
+        )
+        keys = jnp.where(admit[:, None], key, st.key)
+        carried, sub = self._split_keys(keys)
+        first = self._pick(logits, sub, greedy, temp, top_p)
+        sel = lambda n, o: jnp.where(admit, n, o)  # noqa: E731
+        eos_eff = sel(eos, st.eos)
+        fin = sel(
+            (first == eos_eff) | (budget <= 1), st.finished
+        )
+        return st._replace(
+            k=cache.k,
+            v=cache.v,
+            lengths=cache.lengths,
+            last_tok=sel(first, st.last_tok),
+            key=jnp.where(admit[:, None], carried, st.key),
+            emitted=sel(jnp.ones_like(st.emitted), st.emitted),
+            budget=sel(budget, st.budget),
+            finished=fin,
+            greedy=sel(greedy, st.greedy),
+            temp=jnp.where(admit, temp, st.temp),
+            top_p=jnp.where(admit, top_p, st.top_p),
+            eos=eos_eff,
+        )
+
+    def _chunk_graph(self, params, st):
+        """``chunk`` decode steps as one ``lax.scan``: per step every
+        unfinished slot advances one token (decode + in-graph pick),
+        finished/vacant slots ride along masked. Returns the new state
+        plus the [chunk, S] token block and its validity mask — the only
+        per-chunk host traffic."""
+        max_len = self.model.max_len
+
+        def body(st, _):
+            act = ~st.finished & (st.lengths < max_len)
+            logits, cache = self.model.decode_slots(
+                params, st.last_tok, self._cache(st), active=act
+            )
+            carried, sub = self._split_keys(st.key)
+            nxt = self._pick(logits, sub, st.greedy, st.temp, st.top_p)
+            nxt = jnp.where(act, nxt, st.last_tok)
+            emitted = st.emitted + act.astype(jnp.int32)
+            fin = st.finished | (
+                act
+                & (
+                    (nxt == st.eos)
+                    | (emitted >= st.budget)
+                    | (cache.lengths >= max_len)
+                )
+            )
+            st = st._replace(
+                k=cache.k,
+                v=cache.v,
+                lengths=cache.lengths,
+                last_tok=nxt,
+                key=jnp.where(act[:, None], carried, st.key),
+                emitted=emitted,
+                finished=fin,
+            )
+            return st, (nxt, act)
+
+        st, (toks, valid) = jax.lax.scan(
+            body, st, None, length=self.chunk
+        )
+        return st, toks, valid
+
+    # -- the scheduler (host side) -----------------------------------------
+
+    def submit(self, tokens, config: GenerationConfig | None = None) -> int:
+        """Queue one request (prompt as a 1-D int token array). Returns a
+        request id for :meth:`result`. Validates against the bucket/cache
+        geometry up front: the prompt must fit a bucket and
+        ``len + max_new`` must fit ``max_len`` (the KV cache is the slot's
+        whole memory — vLLM's fixed-slot discipline)."""
+        config = config or GenerationConfig()
+        config.validate(self.model.vocab_size)
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if tokens.size < 1:
+            raise ValueError("empty prompt")
+        if tokens.size > self.buckets[-1]:
+            raise ValueError(
+                f"prompt length {tokens.size} exceeds the largest bucket "
+                f"{self.buckets[-1]}"
+            )
+        if tokens.size + config.max_new > self.model.max_len:
+            raise ValueError(
+                f"prompt {tokens.size} + max_new {config.max_new} exceeds "
+                f"max_len {self.model.max_len}"
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        req = _Request(rid, tokens, config)
+        self._queue.append(req)
+        self._results[rid] = req
+        return rid
+
+    def bucket_for(self, length: int) -> int:
+        """Smallest bucket holding a ``length``-token prompt."""
+        for b in self.buckets:
+            if length <= b:
+                return b
+        raise ValueError(
+            f"prompt length {length} exceeds the largest bucket "
+            f"{self.buckets[-1]}"
+        )
+
+    def _free_slots(self) -> list[int]:
+        return [i for i, r in enumerate(self._slot_req) if r is None]
+
+    def _admit(self) -> None:
+        """Move queued requests into free slots; one prefill dispatch per
+        length bucket among this round's admissions."""
+        free = self._free_slots()
+        if not free or not self._queue:
+            return
+        batch: list[tuple[int, _Request]] = []
+        while free and self._queue:
+            batch.append((free.pop(0), self._queue.popleft()))
+        by_bucket: dict[int, list[tuple[int, _Request]]] = {}
+        for slot, req in batch:
+            by_bucket.setdefault(
+                self.bucket_for(req.tokens.size), []
+            ).append((slot, req))
+        s = self.slots
+        for lb, members in sorted(by_bucket.items()):
+            tokens = np.zeros((s, lb), np.int32)
+            plens = np.ones((s,), np.int32)  # kv_lens must be >= 1
+            admit = np.zeros((s,), bool)
+            key = np.array(self._state.key)  # writable host copy
+            budget = np.zeros((s,), np.int32)
+            greedy = np.ones((s,), bool)
+            temp = np.ones((s,), np.float32)
+            top_p = np.ones((s,), np.float32)
+            eos = np.full((s,), -1, np.int32)
+            for slot, req in members:
+                c = req.config
+                tokens[slot, : req.tokens.size] = req.tokens
+                plens[slot] = req.tokens.size
+                admit[slot] = True
+                key[slot] = np.asarray(
+                    jax.random.key_data(jax.random.key(c.seed))
+                )
+                budget[slot] = c.max_new
+                greedy[slot] = c.greedy
+                temp[slot] = c.temperature
+                top_p[slot] = c.top_p
+                eos[slot] = -1 if c.eos_id is None else c.eos_id
+                self._slot_req[slot] = req
+            self._state = self._prefill_jit(
+                self.params,
+                self._state,
+                jnp.asarray(tokens),
+                jnp.asarray(plens),
+                jnp.asarray(admit),
+                jnp.asarray(key),
+                jnp.asarray(budget),
+                jnp.asarray(greedy),
+                jnp.asarray(temp),
+                jnp.asarray(top_p),
+                jnp.asarray(eos),
+            )
+            # The admission's first tokens come back with this fetch — a
+            # real D2H value read, so it is also the execution barrier.
+            first = np.asarray(self._state.last_tok)
+            fin = np.asarray(self._state.finished)
+            for slot, req in members:
+                req.out.append(int(first[slot]))
+                if fin[slot]:
+                    self._finish(slot)
+
+    def _finish(self, slot: int) -> None:
+        req = self._slot_req[slot]
+        if req is not None:
+            req.done = True
+            self._slot_req[slot] = None
+
+    def step(self) -> bool:
+        """One engine tick: admit queued requests into free slots (per-
+        bucket prefill dispatches), then — if any slot is mid-generation —
+        ONE compiled ``chunk``-token decode dispatch, then collect
+        finished requests so their slots free for the next tick's
+        admissions. Returns True while there is work left."""
+        self._admit()
+        if any(r is not None for r in self._slot_req):
+            self._state, toks, valid = self._chunk_jit(
+                self.params, self._state
+            )
+            toks = np.asarray(toks)  # D2H fetch = execution barrier
+            valid = np.asarray(valid)
+            fin = np.asarray(self._state.finished)
+            for slot, req in enumerate(self._slot_req):
+                if req is None:
+                    continue
+                req.out.extend(int(t) for t in toks[valid[:, slot], slot])
+                if fin[slot]:
+                    self._finish(slot)
+        return not self.idle()
+
+    def idle(self) -> bool:
+        return not self._queue and all(r is None for r in self._slot_req)
+
+    def result(self, rid: int) -> np.ndarray:
+        """Generated tokens of a finished request (prompt excluded).
+        Consumes the record — a second read raises — so a long-lived
+        server does not accumulate every request it ever served."""
+        req = self._results[rid]
+        if not req.done:
+            raise RuntimeError(f"request {rid} is not finished")
+        del self._results[rid]
+        return np.asarray(req.out, np.int32)
+
+    # -- convenience entries ----------------------------------------------
+
+    def generate(
+        self, prompts, configs: GenerationConfig | list | None = None
+    ) -> list[np.ndarray]:
+        """Serve a batch of token prompts to completion; returns each
+        request's generated tokens in submission order."""
+        if configs is None or isinstance(configs, GenerationConfig):
+            configs = [configs] * len(prompts)
+        rids = [
+            self.submit(p, c) for p, c in zip(prompts, configs, strict=True)
+        ]
+        while self.step():
+            pass
+        return [self.result(r) for r in rids]
+
+    def serve_text(self, texts: list[str], **gen_kwargs) -> list[str]:
+        """Text in → text out: encode with the served tokenizer, generate,
+        decode (EOS and padding drop out in ``tokenizer.decode``). By
+        default requests stop at the tokenizer's EOS id."""
+        if self.tokenizer is None:
+            raise ValueError("no tokenizer attached (pass one, or use "
+                             "from_checkpoint with a shipped tokenizer.json)")
+        gen_kwargs.setdefault("eos_id", self.tokenizer.eos_id)
+        cfg = GenerationConfig(**gen_kwargs)
+        prompts = [self.tokenizer.encode(t) for t in texts]
+        return self.tokenizer.decode_batch(self.generate(prompts, cfg))

@@ -41,7 +41,7 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
-from distributed_tensorflow_tpu.models.gpt import GPTLM
+from distributed_tensorflow_tpu.models.gpt import GPTLM, _ce_from_logits
 from distributed_tensorflow_tpu.utils.sync import timed_fetch, two_point_seconds
 
 _VOCAB = 8192
@@ -153,9 +153,35 @@ def bench_phases(
         )
         return loss + gsum * 1e-30
 
+    def fwd_dgrad(p, toks):
+        # The dgrad-only cut (round 9, VERDICT r5 weak #4): differentiate
+        # wrt the block-stack INPUT h0 with the params held constant —
+        # the backward sweeps the same layer chain (and, under remat,
+        # does the same per-layer recompute) but every wgrad matmul is
+        # dead code XLA drops. fwd+bwd − this = the wgrad matmuls;
+        # this − fwd = dgrad (+ recompute when remat).
+        positions = jnp.arange(l)
+
+        def loss_from_h(h):
+            def body(h, blk):
+                h, _, _ = model._block(blk, h, positions=positions)
+                return h, ()
+
+            b2 = jax.checkpoint(body) if model.remat else body
+            h, _ = lax.scan(b2, h, p.blocks)
+            logits = model._logits(p, h)
+            return _ce_from_logits(logits, toks)
+
+        h0 = model._embed_tokens(p, toks, positions)
+        loss, gh = jax.value_and_grad(loss_from_h)(h0)
+        return loss + jnp.sum(gh.astype(jnp.float32)) * 1e-30
+
     sec = {}
     for key, body in [
-        ("blocks-fwd", blocks_fwd), ("fwd", fwd), ("fwd+bwd", fwd_bwd)
+        ("blocks-fwd", blocks_fwd),
+        ("fwd", fwd),
+        ("fwd+bwd", fwd_bwd),
+        ("fwd+dgrad", fwd_dgrad),
     ]:
         sec[key] = _region_seconds(
             lambda n, body=body: _chain(body, n),
@@ -252,6 +278,7 @@ def bench_phases(
             "blocks-fwd": round(sec["blocks-fwd"] * 1e3, 2),
             "logits+loss": round((sec["fwd"] - sec["blocks-fwd"]) * 1e3, 2),
             "backward": round((sec["fwd+bwd"] - sec["fwd"]) * 1e3, 2),
+            "bwd-dgrad": round((sec["fwd+dgrad"] - sec["fwd"]) * 1e3, 2),
             "optimizer": round((sec["step"] - sec["fwd+bwd"]) * 1e3, 2),
             "step": round(sec["step"] * 1e3, 2),
         },
@@ -263,6 +290,7 @@ def bench_phases(
         "tokens_per_sec": round(toks_per_step / sec["step"], 1),
         "model_flops_per_step": model_flops,
     }
+    row["backward_split"] = _backward_split(row["phase_ms"], model.remat)
     # MFU† against the MEASURED ceiling — read from the committed roofline
     # record (cost_analysis.measured_ceiling_tflops), never hardcoded, so
     # a roofline re-measure propagates here as it does to lm_tpu.md.
@@ -275,6 +303,26 @@ def bench_phases(
         row["ceiling_tflops"] = None
         row["mfu_model_pct"] = None
     return row
+
+
+def _backward_split(phase_ms: dict, remat: bool) -> dict | None:
+    """Decompose the backward lump (VERDICT r5 weak #4):
+    ``backward = recompute + dgrad + wgrad``, where recompute (remat rows)
+    is one blocks-forward replay — attributed at the measured
+    ``blocks-fwd`` time, since jax.checkpoint replays exactly that scan —
+    and the measured ``bwd-dgrad`` region is dgrad(+recompute) with the
+    wgrad matmuls dead-coded away. None for rows measured before the
+    dgrad region existed (they render an em-dash until the next chip
+    run)."""
+    dg = phase_ms.get("bwd-dgrad")
+    if dg is None:
+        return None
+    rec = phase_ms["blocks-fwd"] if remat else 0.0
+    return {
+        "recompute": round(rec, 2),
+        "dgrad": round(dg - rec, 2),
+        "wgrad": round(phase_ms["backward"] - dg, 2),
+    }
 
 
 def _nonembed_param_count(row) -> int | None:
@@ -293,6 +341,9 @@ def refresh_derived(rows, ceiling) -> None:
     for r in rows:
         if "error" in r or not r.get("phase_ms"):
             continue
+        r["backward_split"] = _backward_split(
+            r["phase_ms"], bool(r.get("remat"))
+        )
         if "param_count_nonembed" not in r:
             ne = _nonembed_param_count(r)
             if ne is not None:
@@ -314,24 +365,32 @@ def refresh_derived(rows, ceiling) -> None:
 def render(rows) -> str:
     cols = [
         "config", "B", "L", "blocks-fwd", "logits+loss", "backward",
-        "optimizer", "step (ms)", "attn/layer", "ffn/layer", "MFU†",
+        "bwd rec/dgrad/wgrad", "optimizer", "step (ms)", "attn/layer",
+        "ffn/layer", "MFU†",
     ]
     out = ["| " + " | ".join(cols) + " |", "|" + "---|" * len(cols)]
     for r in rows:
         if "error" in r:
             out.append(
-                f"| {r['config']} | error: {r['error']} |" + " |" * 9
+                f"| {r['config']} | error: {r['error']} |" + " |" * 10
             )
             continue
         p, pl = r["phase_ms"], r["per_layer_ms"]
         mfu = r.get("mfu_model_pct")
+        split = r.get("backward_split")
+        split_s = (
+            "—"
+            if not split
+            else f"{split['recompute']}/{split['dgrad']}/{split['wgrad']}"
+        )
         out.append(
-            "| {config} | {batch} | {seq_len} | {b} | {ll} | {bw} | {opt} "
-            "| {st} | {at} | {ff} | {mfu} |".format(
+            "| {config} | {batch} | {seq_len} | {b} | {ll} | {bw} | {sp} "
+            "| {opt} | {st} | {at} | {ff} | {mfu} |".format(
                 config=r["config"], batch=r["batch"], seq_len=r["seq_len"],
                 b=p["blocks-fwd"], ll=p["logits+loss"], bw=p["backward"],
-                opt=p["optimizer"], st=p["step"], at=pl["attention"],
-                ff=pl["ffn"], mfu="—" if mfu is None else mfu,
+                sp=split_s, opt=p["optimizer"], st=p["step"],
+                at=pl["attention"], ff=pl["ffn"],
+                mfu="—" if mfu is None else mfu,
             )
         )
     return "\n".join(out)
@@ -447,7 +506,26 @@ def _write_md(root, table, ceiling) -> None:
             "FLOPs — the round-3/4 \"MFU gap\" was the WORKLOAD, as "
             "the roofline said, not the environment; their backward "
             "includes one full forward recompute (remat), which "
-            "MFU† deliberately does not credit.\n"
+            "MFU† deliberately does not credit.\n\n"
+            "The backward split (round 9): backward = remat RECOMPUTE "
+            "(one blocks-forward replay — the measured blocks-fwd "
+            "time) + DGRAD (the measured `bwd-dgrad` region minus "
+            "recompute; wgrad matmuls dead-coded) + WGRAD (fwd+bwd "
+            "minus the dgrad region). On the committed xl rows the "
+            "recompute third is 49-58 ms of the 170-189 ms backward "
+            "(~30%), leaving ~120-131 ms of dgrad+wgrad — and since "
+            "each of recompute/dgrad/wgrad is one forward's worth of "
+            "matmul FLOPs (3x blocks-fwd = 147-173 ms, matching the "
+            "measured lump), **no single term dominates: the backward "
+            "is three near-equal forwards**. The attackable third is "
+            "the recompute (a remat policy that stashes cheap "
+            "activations), because dgrad+wgrad are irreducible model "
+            "FLOPs; the probed dots-saveable policies (CLAUDE.md) "
+            "already showed naive stashing LOSES to recompute at these "
+            "shapes, so the next step is a selective policy, not less "
+            "remat. The rec/dgrad/wgrad column fills from the first "
+            "on-chip rerun with the `bwd-dgrad` region (em-dash = "
+            "pre-round-9 row).\n"
         )
 
 

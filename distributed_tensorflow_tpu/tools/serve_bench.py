@@ -1,0 +1,287 @@
+"""Measured record of the serving engine's two perf levers (serve.py).
+
+The engine makes two throughput claims, each a measured-design decision:
+
+- **Batched slots**: 8 concurrent requests through one slot bank vs the
+  same requests served one at a time (slots=1) — decode is memory-bound
+  per step, so batching rides along nearly free and the tunnel round-trip
+  is shared by 8 streams.
+- **Multi-token chunks**: k decode steps (including sampling) per
+  dispatch via ``lax.scan`` vs one dispatch per token — on the tunneled
+  chip every dispatch+fetch costs a ~100 ms round-trip (CLAUDE.md TIMING
+  TRAP 2), so per-token cost at chunk k amortizes it k ways.
+
+Timing discipline: every TextServer chunk ENDS in a D2H fetch of the
+token block (the scheduler needs the values), so wall-clock around a
+served workload is dispatch-inclusive and barrier-honest by construction
+— exactly the quantity a serving client sees. The chunk sweep
+additionally separates the per-dispatch fixed cost C from the marginal
+per-token cost t by a least-squares fit of ``wall = (N/k)·C + N·t`` over
+the chunk sizes — the two-point method generalized to the k-point chain.
+
+Usage::
+
+    python -m distributed_tensorflow_tpu.tools.serve_bench              # print
+    python -m distributed_tensorflow_tpu.tools.serve_bench --write-docs # commit
+
+``--write-docs`` writes docs/benchmarks/serving.md + serving.json;
+tests/test_serve.py pins the committed md against the committed json
+(the perf_record staleness pattern: a new artifact cannot land without
+regenerating the doc).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _build(model_kw=None):
+    from distributed_tensorflow_tpu.models.gpt import GPTLM
+
+    kw = dict(
+        vocab_size=512,
+        max_len=256,
+        model_dim=128,
+        num_heads=4,
+        num_layers=2,
+    )
+    kw.update(model_kw or {})
+    model = GPTLM(**kw)
+    return model, model.init(seed=1)
+
+
+def _workload(model, n_requests: int, max_new: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    sizes = rng.integers(8, 60, n_requests)
+    prompts = [
+        rng.integers(0, model.vocab_size, (int(s),)).astype(np.int32)
+        for s in sizes
+    ]
+    from distributed_tensorflow_tpu.serve import GenerationConfig
+
+    return prompts, GenerationConfig(max_new=max_new)
+
+
+def _make_server(model, params, *, slots, chunk):
+    """One server per (slots, chunk) config, WARMED once: jit caches live
+    on the instance, so the measured runs below re-dispatch the compiled
+    executables (a fresh server per run would re-trace — the first version
+    of this bench did, and its 'per-token cost' was mostly tracing)."""
+    from distributed_tensorflow_tpu.serve import GenerationConfig, TextServer
+
+    srv = TextServer(model, params, slots=slots, chunk=chunk, buckets=(64,))
+    warm = [np.arange(1, 9, dtype=np.int32)] * min(2, slots)
+    srv.generate(warm, GenerationConfig(max_new=max(2, chunk)))
+    return srv
+
+
+def _serve_wall(srv, prompts, cfg) -> float:
+    """Wall seconds to serve the workload to completion on a warmed
+    server. Each chunk's token fetch is the D2H barrier, so this is
+    honest dispatch-inclusive time."""
+    t0 = time.perf_counter()
+    srv.generate(prompts, cfg)
+    return time.perf_counter() - t0
+
+
+def bench(
+    *,
+    n_requests: int = 24,
+    max_new: int = 96,
+    slots: int = 8,
+    chunk: int = 32,
+    chunk_sweep: tuple[int, ...] = (1, 8, 32, 64),
+    model_kw=None,
+) -> dict:
+    model, params = _build(model_kw)
+    prompts, cfg = _workload(model, n_requests, max_new)
+    total_tokens = n_requests * max_new
+
+    # -- batched vs sequential at the default chunk -----------------------
+    srv_b = _make_server(model, params, slots=slots, chunk=chunk)
+    srv_s = _make_server(model, params, slots=1, chunk=chunk)
+    wall_batched = min(_serve_wall(srv_b, prompts, cfg) for _ in range(2))
+    wall_seq = min(_serve_wall(srv_s, prompts, cfg) for _ in range(2))
+
+    # -- per-token cost vs chunk size (one long request, slots=1) ---------
+    long_prompt, long_cfg = _workload(model, 1, max_new=192, seed=1)
+    sweep = []
+    for k in chunk_sweep:
+        srv_k = _make_server(model, params, slots=1, chunk=k)
+        w = min(
+            _serve_wall(srv_k, long_prompt, long_cfg) for _ in range(3)
+        )
+        sweep.append(
+            {
+                "chunk": int(k),
+                "wall_s": round(w, 4),
+                "per_token_ms": round(w * 1e3 / long_cfg.max_new, 3),
+            }
+        )
+    # wall = b + (N/k)·C + N·t — least squares over the sweep for the
+    # per-dispatch fixed cost C and marginal per-token cost t. The
+    # intercept b absorbs the per-REQUEST constants (the prefill dispatch,
+    # host scheduler setup): with N fixed across the sweep, omitting it
+    # would fold those into t — the fixed-cost-diluted-into-the-marginal
+    # artifact CLAUDE.md's TIMING TRAP 2 warns about.
+    n_tok = long_cfg.max_new
+    a = np.array([[1.0, n_tok / r["chunk"], n_tok] for r in sweep])
+    y = np.array([r["wall_s"] for r in sweep])
+    (req_b, fixed_c, marg_t), *_ = np.linalg.lstsq(a, y, rcond=None)
+
+    k1 = next((r for r in sweep if r["chunk"] == 1), sweep[0])
+    kbig = min(
+        (r for r in sweep if r["chunk"] >= 32),
+        key=lambda r: r["per_token_ms"],
+        default=sweep[-1],
+    )
+    return {
+        "device": jax.devices()[0].device_kind,
+        "model": {
+            "vocab": model.vocab_size,
+            "model_dim": model.model_dim,
+            "num_layers": model.num_layers,
+            "max_len": model.max_len,
+        },
+        "workload": {
+            "requests": n_requests,
+            "max_new": max_new,
+            "total_tokens": total_tokens,
+        },
+        "batched": {
+            "slots": slots,
+            "chunk": chunk,
+            "wall_s": round(wall_batched, 4),
+            "tokens_per_s": round(total_tokens / wall_batched, 1),
+        },
+        "sequential": {
+            "slots": 1,
+            "chunk": chunk,
+            "wall_s": round(wall_seq, 4),
+            "tokens_per_s": round(total_tokens / wall_seq, 1),
+        },
+        "batched_speedup": round(wall_seq / wall_batched, 2),
+        "chunk_sweep": sweep,
+        "chunk_speedup": round(
+            k1["per_token_ms"] / kbig["per_token_ms"], 2
+        ),
+        "dispatch_fixed_ms": round(float(fixed_c) * 1e3, 3),
+        "marginal_token_ms": round(float(marg_t) * 1e3, 3),
+        "per_request_ms": round(float(req_b) * 1e3, 3),
+    }
+
+
+# -- rendering (offline: the staleness guard re-renders committed JSON) ----
+
+
+def render(payload: dict) -> str:
+    b, s = payload["batched"], payload["sequential"]
+    lines = [
+        "| mode | slots | chunk | wall (s) | tokens/s |",
+        "|---|---|---|---|---|",
+        f"| batched | {b['slots']} | {b['chunk']} | {b['wall_s']} "
+        f"| {b['tokens_per_s']} |",
+        f"| sequential | {s['slots']} | {s['chunk']} | {s['wall_s']} "
+        f"| {s['tokens_per_s']} |",
+        "",
+        f"**Batched speedup: {payload['batched_speedup']}x** "
+        f"({payload['workload']['requests']} requests x "
+        f"{payload['workload']['max_new']} tokens).",
+        "",
+        "| chunk k | per-token (ms) |",
+        "|---|---|",
+    ]
+    for r in payload["chunk_sweep"]:
+        lines.append(f"| {r['chunk']} | {r['per_token_ms']} |")
+    lines += [
+        "",
+        f"**Chunking speedup: {payload['chunk_speedup']}x** per-token vs "
+        "one-dispatch-per-token; fit wall = b + (N/k)·C + N·t gives "
+        f"C = {payload['dispatch_fixed_ms']} ms/dispatch, "
+        f"t = {payload['marginal_token_ms']} ms/token, "
+        f"b = {payload.get('per_request_ms', 0.0)} ms/request "
+        "(prefill + scheduler constants, kept out of t).",
+    ]
+    return "\n".join(lines)
+
+
+def _docs_root() -> str:
+    return os.path.abspath(
+        os.path.join(
+            os.path.dirname(__file__), "..", "..", "docs", "benchmarks"
+        )
+    )
+
+
+def write_docs(payload: dict, root: str | None = None) -> None:
+    root = root or _docs_root()
+    with open(os.path.join(root, "serving.json"), "w") as f:
+        json.dump(payload, f, indent=1)
+    with open(os.path.join(root, "serving.md"), "w") as f:
+        f.write(
+            "# LM serving engine (serve.py): measured record\n\n"
+            "Generated by `python -m distributed_tensorflow_tpu.tools."
+            f"serve_bench --write-docs` on **{payload['device']}** "
+            "(rerun on the v5e chip to refresh the on-chip record; "
+            "tests/test_serve.py fails if this file drifts from "
+            "serving.json). Timing is wall-clock around "
+            "served workloads; every chunk ends in a D2H token fetch, so "
+            "the numbers are dispatch-inclusive and barrier-honest "
+            "(CLAUDE.md timing traps). Model: "
+            f"d={payload['model']['model_dim']}, "
+            f"{payload['model']['num_layers']} layers, vocab "
+            f"{payload['model']['vocab']}.\n\n"
+            + render(payload)
+            + "\n\nReading it: chunking amortizes the per-dispatch fixed "
+            "cost C (on the tunneled TPU a ~100 ms host round-trip; on "
+            "CPU the ~2 ms dispatch+fetch overhead) over k tokens: "
+            "per-token cost approaches the marginal t as k grows, with "
+            "diminishing returns once C/(k·t) « 1. The scheduler admits "
+            "at chunk boundaries, so k also bounds admission latency — "
+            "pick the smallest k whose per-token cost sits on the flat "
+            "part of the sweep. Batching rides the decode's "
+            "parameter-read-bound step: on an accelerator 8 slots cost "
+            "barely more HBM traffic per step than 1 (params dominate at "
+            "serving widths), so 8 streams multiply tokens/s; a CPU run "
+            "of this bench pays batch compute linearly and shows ~1x "
+            "there — the slots lever is an accelerator phenomenon, the "
+            "chunk lever shows everywhere (and both multiply through the "
+            "~100 ms tunnel round-trip on the chip of record).\n"
+        )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--max-new", type=int, default=96)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--chunk", type=int, default=32)
+    ap.add_argument("--write-docs", action="store_true")
+    args = ap.parse_args(argv)
+    payload = bench(
+        n_requests=args.requests,
+        max_new=args.max_new,
+        slots=args.slots,
+        chunk=args.chunk,
+    )
+    print(json.dumps(payload))
+    if args.write_docs:
+        write_docs(payload)
+        print(f"wrote {_docs_root()}/serving.md and serving.json")
+    else:
+        print(render(payload))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -9,11 +9,12 @@ from distributed_tensorflow_tpu.tools import benchmark_suite, device_info
 
 def test_row_specs_cover_reference_grid():
     rows = [r[0] for r in benchmark_suite._row_specs(8)]
+    ks = [f"single-k{k}" for k in benchmark_suite.K_SWEEP]
     assert rows == [
         "single",
         "single-compiled",
         "single-compiled-pallas",
-        "single-k10",
+        *ks,
         "sync-2",
         "async-2",
         "zero-2",
@@ -22,13 +23,38 @@ def test_row_specs_cover_reference_grid():
         "zero-8",
         "tp-2",
     ]
+    assert "single-k10" in ks  # the round-5 row is a sweep point
     # One chip: only the single-device rows survive.
     assert [r[0] for r in benchmark_suite._row_specs(1)] == [
         "single",
         "single-compiled",
         "single-compiled-pallas",
-        "single-k10",
+        *ks,
     ]
+
+
+def test_k_sweep_fixed_cost_recovers_model():
+    """The fit inverts its own model: rows generated from s(k) = t + C/k
+    give back (t, C)."""
+    t, c = 0.02, 0.5
+    rows = [
+        {
+            "row": f"single-k{k}",
+            "devices": 1,
+            "mode": f"chunked-{k}",
+            "s_per_epoch": t + c / k,
+            "examples_per_sec": 100.0,
+            "reference": "ref #1",
+        }
+        for k in benchmark_suite.K_SWEEP
+    ]
+    fit = benchmark_suite.k_sweep_fixed_cost(rows)
+    assert abs(fit["per_epoch_compute_s"] - t) < 1e-3
+    assert abs(fit["per_dispatch_fixed_s"] - c) < 1e-2
+    assert benchmark_suite.k_sweep_fixed_cost(rows[:1]) is None
+    # The fit line rides the generated table.
+    table = benchmark_suite.markdown_table(rows)
+    assert "k-sweep fit" in table and "per-dispatch fixed cost" in table
 
 
 def test_suite_runs_grid_on_virtual_mesh(small_datasets):
@@ -216,8 +242,12 @@ def test_lm_phase_bench_smoke(capsys, monkeypatch):
 
     p = row["phase_ms"]
     assert set(p) == {
-        "blocks-fwd", "logits+loss", "backward", "optimizer", "step"
+        "blocks-fwd", "logits+loss", "backward", "bwd-dgrad", "optimizer",
+        "step",
     }
+    # The split is derived, keys always present (values are chip-grade
+    # only on-chip; remat micro attributes recompute at blocks-fwd).
+    assert set(row["backward_split"]) == {"recompute", "dgrad", "wgrad"}
     assert all(math.isfinite(v) for v in p.values())
     assert math.isfinite(row["per_layer_ms"]["attention"])
     assert math.isfinite(row["per_layer_ms"]["ffn"])

@@ -86,6 +86,18 @@ def test_resilient_example_runs_and_resumes(tmp_path):
     assert f"resuming from step {step}" in r2.stdout
 
 
+def test_serve_example_trains_checkpoints_and_serves():
+    # The serving loop end to end as a user would run it: train with a
+    # BPE vocab + checkpoint_dir, then TextServer.from_checkpoint serves
+    # greedy and nucleus batches through continuous batching.
+    r = _run("serve_text.py", "1", "8", timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "trained: perplexity" in r.stdout
+    assert r.stdout.count("greedy  ") == 3
+    assert r.stdout.count("nucleus ") == 3
+    assert r.stdout.rstrip().endswith("Done")
+
+
 def test_lm_example_trains_and_generates():
     # The example now drives the LMTrainer lifecycle: 2 epochs exercises
     # the loop contract (Step lines, perplexity eval) plus generation.

@@ -1,0 +1,491 @@
+"""LM serving engine (serve.py + models/gpt.py slot decoding): scheduler
+bookkeeping with no compiled programs, slot-decode token parity against the
+in-process decode loops, and the full train → checkpoint → TextServer
+round trip (greedy and seeded sampling, dense AND non-dense checkpoint
+layouts through the round-5 canonical layer).
+
+No module-level cache opt-out needed: everything here is single-device
+(no multi-device scanned executables — the warm-cache rendezvous abort
+surface; see conftest._CACHE_OPT_OUT_FIRST)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.models.gpt import GPTLM
+from distributed_tensorflow_tpu.serve import (
+    GenerationConfig,
+    TextServer,
+    canonical_lm_params,
+    load_tokenizer,
+)
+
+
+def tiny_model(**kw):
+    kw.setdefault("vocab_size", 97)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("model_dim", 32)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("compute_dtype", jnp.float32)
+    return GPTLM(**kw)
+
+
+def _prompts(vocab, sizes, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, (n,)).astype(np.int32) for n in sizes]
+
+
+# -- scheduler bookkeeping (compiles nothing) -------------------------------
+
+
+class _FakeEngine:
+    """Numpy stand-ins for the two jitted graphs: deterministic token
+    streams (prompt's last token, then +1 mod vocab each step) and the
+    same finished/budget bookkeeping, so the scheduler's host half is
+    pinned without tracing a single program."""
+
+    def __init__(self, server, vocab):
+        self.vocab = vocab
+        self.prefill_calls = 0
+        self.chunk_calls = 0
+        server._prefill_jit = self.prefill
+        server._chunk_jit = self.chunk
+        self.chunk_len = server.chunk
+
+    def prefill(self, params, st, tokens, plens, admit, key, budget, greedy,
+                temp, top_p, eos):
+        self.prefill_calls += 1
+        st = jax.tree.map(np.array, st)
+        tokens, plens, admit = map(np.array, (tokens, plens, admit))
+        first = (tokens[np.arange(tokens.shape[0]), np.maximum(plens - 1, 0)]
+                 + 1) % self.vocab
+        st = st._replace(
+            lengths=np.where(admit, plens, st.lengths),
+            last_tok=np.where(admit, first, st.last_tok).astype(np.int32),
+            emitted=np.where(admit, 1, st.emitted).astype(np.int32),
+            budget=np.where(admit, np.array(budget), st.budget).astype(np.int32),
+            finished=np.where(
+                admit,
+                (np.array(budget) <= 1) | (first == np.array(eos)),
+                st.finished,
+            ),
+            eos=np.where(admit, np.array(eos), st.eos).astype(np.int32),
+        )
+        return st
+
+    def chunk(self, params, st):
+        self.chunk_calls += 1
+        st = jax.tree.map(np.array, st)
+        toks = np.zeros((self.chunk_len, st.last_tok.shape[0]), np.int32)
+        valid = np.zeros_like(toks, bool)
+        for i in range(self.chunk_len):
+            act = ~st.finished
+            nxt = np.where(act, (st.last_tok + 1) % self.vocab, st.last_tok)
+            emitted = st.emitted + act.astype(np.int32)
+            st = st._replace(
+                lengths=st.lengths + act.astype(np.int32),
+                last_tok=nxt.astype(np.int32),
+                emitted=emitted,
+                finished=st.finished | (act & (
+                    (emitted >= st.budget) | (nxt == st.eos))),
+            )
+            toks[i], valid[i] = nxt, act
+        return st, toks, valid
+
+
+def _expected_stream(prompt, max_new, vocab, eos=None):
+    out, t = [], (int(prompt[-1]) + 1) % vocab
+    out.append(t)
+    while len(out) < max_new and (eos is None or t != eos):
+        t = (t + 1) % vocab
+        out.append(t)
+        if eos is not None and t == eos:
+            break
+    return np.asarray(out, np.int32)
+
+
+def test_scheduler_continuous_batching_reuses_slots():
+    """More requests than slots: freed slots re-admit at chunk boundaries
+    and every request still gets ITS deterministic stream — the continuous
+    half of continuous batching, no compiled programs involved."""
+    m = tiny_model()
+    srv = TextServer(m, params=None, slots=2, chunk=4, buckets=(8, 16))
+    eng = _FakeEngine(srv, m.vocab_size)
+    prompts = _prompts(m.vocab_size, [3, 8, 12, 5, 16, 2])
+    lens = [5, 9, 2, 7, 1, 6]
+    cfgs = [GenerationConfig(max_new=n) for n in lens]
+    outs = srv.generate(prompts, cfgs)
+    for pr, n, out in zip(prompts, lens, outs):
+        assert np.array_equal(out, _expected_stream(pr, n, m.vocab_size))
+    assert eng.prefill_calls >= 3  # 6 requests through 2 slots
+    assert srv.idle()
+
+
+def test_scheduler_one_prefill_dispatch_per_bucket():
+    m = tiny_model()
+    srv = TextServer(m, params=None, slots=4, chunk=4, buckets=(4, 8, 16))
+    eng = _FakeEngine(srv, m.vocab_size)
+    # Four admissions, three distinct buckets -> exactly 3 prefill calls
+    # on the first tick.
+    for pr in _prompts(m.vocab_size, [3, 4, 7, 12]):
+        srv.submit(pr, GenerationConfig(max_new=2))
+    srv.step()
+    assert eng.prefill_calls == 3
+
+
+def test_scheduler_eos_frees_slot_early():
+    m = tiny_model()
+    srv = TextServer(m, params=None, slots=1, chunk=4, buckets=(8,))
+    _FakeEngine(srv, m.vocab_size)
+    pr = _prompts(m.vocab_size, [4])[0]
+    eos = (int(pr[-1]) + 3) % m.vocab_size  # third generated token
+    out = srv.generate([pr], GenerationConfig(max_new=32, eos_id=eos))[0]
+    assert out[-1] == eos and len(out) == 3
+
+
+def test_bucket_selection_and_submit_validation():
+    m = tiny_model(max_len=64)
+    srv = TextServer(m, params=None, slots=2, buckets=(8, 32))
+    assert srv.bucket_for(1) == 8 and srv.bucket_for(8) == 8
+    assert srv.bucket_for(9) == 32
+    with pytest.raises(ValueError, match="largest bucket"):
+        srv.bucket_for(33)
+    with pytest.raises(ValueError, match="largest bucket"):
+        srv.submit(np.zeros(40, np.int32))
+    with pytest.raises(ValueError, match="exceeds"):
+        srv.submit(np.zeros(30, np.int32), GenerationConfig(max_new=40))
+    with pytest.raises(ValueError, match="empty"):
+        srv.submit(np.zeros(0, np.int32))
+    with pytest.raises(ValueError, match="temperature"):
+        GenerationConfig(temperature=0.0).validate(m.vocab_size)
+    with pytest.raises(ValueError, match="top_p"):
+        GenerationConfig(top_p=0.0).validate(m.vocab_size)
+    with pytest.raises(ValueError, match="eos_id"):
+        GenerationConfig(eos_id=97).validate(m.vocab_size)
+
+
+def test_default_buckets_cover_max_len():
+    m = tiny_model(max_len=100)
+    srv = TextServer(m, params=None, slots=1)
+    assert srv.buckets[-1] == 99  # always one position of generation room
+    assert all(a < b for a, b in zip(srv.buckets, srv.buckets[1:]))
+
+
+# -- slot decode == in-process decode (the parity contract) -----------------
+
+
+@pytest.mark.parametrize(
+    "mkw",
+    [
+        {},
+        dict(num_kv_heads=2, pos_embedding="rope"),
+        dict(window=6),
+    ],
+    ids=["dense", "gqa-rope", "window"],
+)
+def test_served_tokens_match_in_process_decode(mkw):
+    """Greedy AND seeded nucleus sampling, mixed in one slot bank with
+    mid-flight admissions: every request's served stream equals the
+    in-process single-prompt decode token for token (batch-invariance —
+    the serving parity contract)."""
+    m = tiny_model(**mkw)
+    p = m.init(3)
+    prompts = _prompts(m.vocab_size, [5, 9, 17, 3, 20, 8], seed=1)
+    cfgs = [
+        GenerationConfig(max_new=10, greedy=True)
+        if i % 2 == 0
+        else GenerationConfig(
+            max_new=10, greedy=False, temperature=0.8, top_p=0.9,
+            seed=50 + i,
+        )
+        for i in range(len(prompts))
+    ]
+    srv = TextServer(m, p, slots=3, chunk=4, buckets=(8, 24))
+    outs = srv.generate(prompts, cfgs)
+    for pr, c, out in zip(prompts, cfgs, outs):
+        if c.greedy:
+            ref = m.greedy_decode(p, jnp.asarray(pr[None]), c.max_new)
+        else:
+            ref = m.sample_decode(
+                p, jnp.asarray(pr[None]), c.max_new,
+                jax.random.key(c.seed), temperature=c.temperature,
+                top_p=c.top_p,
+            )
+        assert np.array_equal(out, np.asarray(ref)[0, pr.size :]), (c, pr)
+
+
+def test_rolling_window_bucket_longer_than_cache():
+    """Prompts padded to a bucket LONGER than the rolling window cache:
+    the per-row rolling insert keeps each row's last W real positions and
+    generation matches the in-process path."""
+    m = tiny_model(window=6, max_len=48)
+    p = m.init(3)
+    prompts = _prompts(m.vocab_size, [9, 14, 16], seed=2)
+    srv = TextServer(m, p, slots=3, chunk=4, buckets=(16,))
+    outs = srv.generate(prompts, GenerationConfig(max_new=8))
+    for pr, out in zip(prompts, outs):
+        ref = m.greedy_decode(p, jnp.asarray(pr[None]), 8)
+        assert np.array_equal(out, np.asarray(ref)[0, pr.size :])
+
+
+def test_prefill_slots_at_exact_bucket_matches_prefill():
+    """A full-bucket prompt makes the ragged masks no-ops: prefill_slots'
+    last-position logits equal prefill()'s bitwise."""
+    m = tiny_model()
+    p = m.init(5)
+    toks = jnp.asarray(_prompts(m.vocab_size, [8, 8], seed=3))
+    ref_logits, _ = m.prefill(p, toks)
+    cache = m.empty_slot_cache(2)
+    lens = jnp.full((2,), 8, jnp.int32)
+    logits, _ = m.prefill_slots(
+        p, cache, toks, lens, jnp.ones((2,), bool)
+    )
+    np.testing.assert_array_equal(np.asarray(logits), np.asarray(ref_logits))
+
+
+def test_reset_slots_makes_stale_content_unreachable():
+    """reset_slots drops lengths to 0 without touching K/V; a readmitted
+    request generates exactly as into a fresh cache — stale bytes from the
+    previous occupant are unreachable through the validity mask."""
+    m = tiny_model()
+    p = m.init(3)
+    pr_a, pr_b = _prompts(m.vocab_size, [8, 6], seed=7)
+    ones = jnp.ones((1,), bool)
+
+    def run(cache, pr, steps=5):
+        toks = np.zeros((1, 8), np.int32)
+        toks[0, : pr.size] = pr
+        logits, cache = m.prefill_slots(
+            p, cache, jnp.asarray(toks),
+            jnp.asarray([pr.size], jnp.int32), ones,
+        )
+        out = [int(jnp.argmax(logits, -1)[0])]
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        for _ in range(steps - 1):
+            logits, cache = m.decode_slots(p, tok, cache)
+            tok = jnp.argmax(logits, -1).astype(jnp.int32)
+            out.append(int(tok[0]))
+        return out, cache
+
+    fresh, _ = run(m.empty_slot_cache(1), pr_b)
+    used, cache = run(m.empty_slot_cache(1), pr_a)
+    cache = m.reset_slots(cache, ones)
+    assert int(cache.lengths[0]) == 0
+    reused, _ = run(cache, pr_b)
+    assert reused == fresh
+
+
+def test_decode_slots_full_cache_raises():
+    m = tiny_model(max_len=8)
+    p = m.init(1)
+    cache = m.empty_slot_cache(2)
+    cache = cache._replace(lengths=jnp.asarray([8, 2], jnp.int32))
+    with pytest.raises(ValueError, match="cache full"):
+        m.decode_slots(p, jnp.zeros((2,), jnp.int32), cache)
+    # the full row masked out -> fine
+    m.decode_slots(
+        p, jnp.zeros((2,), jnp.int32), cache,
+        active=jnp.asarray([False, True]),
+    )
+
+
+# -- checkpoint round trip (train -> save -> serve) -------------------------
+
+
+def _train_checkpoint(tmp_path, tokenizer=None, epochs=1):
+    from distributed_tensorflow_tpu.config import TrainConfig
+    from distributed_tensorflow_tpu.data.text import text_corpus
+    from distributed_tensorflow_tpu.train import LMTrainer
+
+    vocab = tokenizer.vocab_size if tokenizer is not None else 257
+    ds = text_corpus(
+        num_docs=64, seq_len=32, n_val=8, n_test=8, seed=0,
+        tokenizer=tokenizer,
+    )
+    model = tiny_model(vocab_size=vocab, max_len=64)
+    cfg = TrainConfig(
+        epochs=epochs, batch_size=8, optimizer="adam", learning_rate=1e-3,
+        scan_epoch=False, log_frequency=10**9,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    tr = LMTrainer(
+        model, ds, cfg, tokenizer=tokenizer, print_fn=lambda *a: None
+    )
+    tr.run()
+    import optax
+
+    return model, tr.state.params, str(tmp_path / "ckpt"), optax.adam(1e-3)
+
+
+def test_checkpoint_round_trip_serves_identical_tokens(tmp_path):
+    """The acceptance contract: a checkpoint written by LMTrainer (with
+    its shipped tokenizer.json) serves generations token-identical to
+    in-process decode on the trained parameters — greedy and seeded
+    sampling."""
+    from distributed_tensorflow_tpu.data.text import (
+        BPETokenizer,
+        synthetic_documents,
+    )
+
+    tok = BPETokenizer.train(synthetic_documents(32, seed=5), num_merges=16)
+    model, live_params, ckpt, opt = _train_checkpoint(tmp_path, tok)
+    srv = TextServer.from_checkpoint(
+        model, ckpt, optimizer=opt, slots=2, chunk=4, buckets=(8, 16)
+    )
+    assert isinstance(srv.tokenizer, BPETokenizer)
+    assert srv.tokenizer.merges == tok.merges  # the shipped vocab record
+
+    prompts = _prompts(model.vocab_size, [5, 11, 7], seed=4)
+    cfgs = [
+        GenerationConfig(max_new=8, greedy=True),
+        GenerationConfig(max_new=8, greedy=False, seed=9, temperature=0.7),
+        GenerationConfig(max_new=8, greedy=True),
+    ]
+    outs = srv.generate(prompts, cfgs)
+    # In-process reference ON THE LIVE TRAINED PARAMS: restore fidelity
+    # and serving parity in one assertion.
+    for pr, c, out in zip(prompts, cfgs, outs):
+        if c.greedy:
+            ref = model.greedy_decode(
+                live_params, jnp.asarray(pr[None]), c.max_new
+            )
+        else:
+            ref = model.sample_decode(
+                live_params, jnp.asarray(pr[None]), c.max_new,
+                jax.random.key(c.seed), temperature=c.temperature,
+            )
+        assert np.array_equal(out, np.asarray(ref)[0, pr.size :])
+
+    # Text in -> text out round-trips through the shipped vocab.
+    texts = srv.serve_text(["the model", "one step"], max_new=6)
+    assert len(texts) == 2 and all(isinstance(t, str) for t in texts)
+
+
+def test_non_dense_checkpoint_serves_via_canonical_layer(tmp_path):
+    """A pipeline-layout checkpoint (staged [S, L/S, ...] block stacks +
+    layout sidecar, the round-5 format) restores through the canonical
+    layer and serves — no mesh, no trainer, just the sidecar telling the
+    restorer which re-layout applies. Async's stacked-replica layout too."""
+    import optax
+
+    from distributed_tensorflow_tpu.models.gpt import pipeline_stage_params
+    from distributed_tensorflow_tpu.parallel.strategy import TrainState
+    from distributed_tensorflow_tpu.train.supervisor import Supervisor
+
+    model = tiny_model(num_layers=4)
+    params = model.init(7)
+    opt = optax.adam(1e-3)
+
+    # pp-layout checkpoint: staged params AND staged optimizer slots.
+    staged = pipeline_stage_params(model, params, 2)
+    sup = Supervisor(checkpoint_dir=str(tmp_path / "pp"))
+    sup.save(
+        TrainState(staged, opt.init(staged), jnp.asarray(3, jnp.int32)),
+        3,
+        layout={"mode": "pp", "stages": 2},
+    )
+    served, step = canonical_lm_params(
+        model, str(tmp_path / "pp"), optimizer=opt
+    )
+    assert step == 3
+    for a, b in zip(jax.tree.leaves(served), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # async-layout checkpoint: stacked copies merge at the mean.
+    stack = lambda t: jax.tree.map(  # noqa: E731
+        lambda x: jnp.stack([x, x + 2 * jnp.ones_like(x)]), t
+    )
+    sup2 = Supervisor(checkpoint_dir=str(tmp_path / "async"))
+    sup2.save(
+        TrainState(
+            stack(params), stack(opt.init(params)), jnp.asarray(5, jnp.int32)
+        ),
+        5,
+        layout={"mode": "async", "replicas": 2},
+    )
+    merged, _ = canonical_lm_params(
+        model, str(tmp_path / "async"), optimizer=opt
+    )
+    for a, b in zip(jax.tree.leaves(merged), jax.tree.leaves(params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b) + 1.0, rtol=1e-6
+        )
+
+    # And the pp checkpoint actually serves tokens == in-process decode.
+    srv = TextServer(model, served, slots=2, chunk=4, buckets=(8,))
+    pr = _prompts(model.vocab_size, [6], seed=8)[0]
+    out = srv.generate([pr], GenerationConfig(max_new=6))[0]
+    ref = model.greedy_decode(params, jnp.asarray(pr[None]), 6)
+    assert np.array_equal(out, np.asarray(ref)[0, pr.size :])
+
+
+def test_byte_tokenizer_fallback_when_no_vocab_shipped(tmp_path):
+    from distributed_tensorflow_tpu.data.text import ByteTokenizer
+
+    model, _, ckpt, opt = _train_checkpoint(tmp_path, tokenizer=None)
+    assert isinstance(load_tokenizer(ckpt), ByteTokenizer)
+    srv = TextServer.from_checkpoint(
+        model, ckpt, optimizer=opt, slots=1, chunk=4, buckets=(16,)
+    )
+    [txt] = srv.serve_text(["ab"], max_new=4)
+    assert isinstance(txt, str)
+
+
+# -- serving bench record freshness (perf_record pattern) -------------------
+
+
+def test_serving_record_docs_match_committed_artifact(tmp_path):
+    """docs/benchmarks/serving.md is GENERATED from serving.json
+    (tools/serve_bench.write_docs): re-rendering the committed JSON must
+    reproduce the committed md byte for byte, so a new bench artifact
+    cannot land without regenerating the doc (the perf_record staleness
+    discipline; no jax programs involved)."""
+    import json
+
+    from distributed_tensorflow_tpu.tools import serve_bench
+
+    root = serve_bench._docs_root()
+    with open(os.path.join(root, "serving.json")) as f:
+        payload = json.load(f)
+    with open(os.path.join(root, "serving.md")) as f:
+        committed = f.read()
+    serve_bench.write_docs(payload, str(tmp_path))
+    with open(tmp_path / "serving.md") as f:
+        regenerated = f.read()
+    assert regenerated == committed, (
+        "docs/benchmarks/serving.md is stale vs serving.json; run "
+        "python -m distributed_tensorflow_tpu.tools.serve_bench "
+        "--write-docs"
+    )
+    # The committed artifact carries every claim the doc renders.
+    for key in (
+        "batched_speedup", "chunk_speedup", "dispatch_fixed_ms",
+        "marginal_token_ms", "device",
+    ):
+        assert key in payload
+
+
+def test_tokenizer_batch_round_trip():
+    from distributed_tensorflow_tpu.data.text import (
+        BPETokenizer,
+        ByteTokenizer,
+        synthetic_documents,
+    )
+
+    docs = synthetic_documents(8, seed=11) + ["ünïcødé ≠ ascii"]
+    for tok in (
+        ByteTokenizer(),
+        BPETokenizer.train(synthetic_documents(16, seed=12), num_merges=24),
+    ):
+        encode_batch = getattr(tok, "encode_batch", None)
+        ids = (
+            encode_batch(docs)
+            if encode_batch is not None
+            else [tok.encode(d) for d in docs]
+        )
+        assert tok.decode_batch(ids) == docs
