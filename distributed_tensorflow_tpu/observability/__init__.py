@@ -1,0 +1,68 @@
+"""Unified telemetry layer (round 10): journal, metrics, spans.
+
+Four pieces over the reference's stdout-only instrumentation
+(tfdist_between.py:98-110; SURVEY.md §5):
+
+- :mod:`~.journal` — typed append-only JSONL event stream
+  (``<logdir>/events.jsonl``), rank/world/run tagged; every structured
+  stdout line is rendered FROM one of these events (byte-identical
+  output, machine-readable superset).
+- :mod:`~.format` — the event→line renderers (the single home of the
+  ``Restart:``/``Resize:``/``Rollback:``/… wording; grep-lint-enforced).
+- :mod:`~.metrics` — process-local counters/gauges/fixed-edge histograms
+  with Prometheus text export and journal snapshots.
+- :mod:`~.spans` — chrome-trace host spans whose dispatch flavor refuses
+  to close without a D2H value fetch (the honest barrier, CLAUDE.md).
+
+The whole package is jax-free (lean-import convention): it imports and
+fully works on a degraded container, like the elastic driver layer it
+instruments. Reader tooling: ``tools/obs_report.py``. Docs:
+``docs/observability.md``.
+"""
+
+from distributed_tensorflow_tpu.observability.format import emit_line, render
+from distributed_tensorflow_tpu.observability.journal import (
+    EventJournal,
+    NullJournal,
+    append_event,
+    configure,
+    emit,
+    get_journal,
+    read_events,
+)
+from distributed_tensorflow_tpu.observability.metrics import (
+    LATENCY_EDGES_S,
+    TIME_MS_EDGES,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from distributed_tensorflow_tpu.observability.spans import (
+    DispatchSpan,
+    SpanRecorder,
+    chrome_trace,
+    force_host,
+)
+
+__all__ = [
+    "EventJournal",
+    "NullJournal",
+    "append_event",
+    "configure",
+    "emit",
+    "get_journal",
+    "read_events",
+    "emit_line",
+    "render",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "LATENCY_EDGES_S",
+    "TIME_MS_EDGES",
+    "DispatchSpan",
+    "SpanRecorder",
+    "chrome_trace",
+    "force_host",
+]

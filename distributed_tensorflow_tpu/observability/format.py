@@ -1,0 +1,161 @@
+"""Event → log-line renderers: the ONE place that knows the line formats.
+
+The reference's log contract (SURVEY.md §5; tfdist_between.py:98-115) and
+the framework's structured lifecycle lines (``Restart:``/``Resize:``/
+``Rollback:``/``Preemption:``/``Restore:``, rounds 6-8) are rendered HERE,
+from journal events — call sites emit an event and print the rendering,
+never a hand-built f-string. ``tests/test_observability.py`` grep-lints
+the package for structured-line literals outside this module, and pins
+every renderer byte-for-byte against the pre-journal output.
+
+``%``-formatting is deliberate: the step/epoch renderers must reproduce
+the reference's ``%2d``/``%3d``/``%3.2f`` padding exactly (the C14 byte-
+parity contract — downstream tooling that parsed the reference's stdout
+keeps working).
+"""
+
+from __future__ import annotations
+
+_FAILSTOP_TAIL = (
+    "failing stop (checkpoints intact; newest valid step restores on the "
+    "next launch)"
+)
+
+
+def _step(ev: dict) -> str:
+    # The exact bytes of the reference's per-freq line
+    # (tfdist_between.py:102-106): StepLogger printed five %-formatted
+    # args which print() joined with single spaces.
+    return (
+        "Step: %d,  Epoch: %2d,  Batch: %3d of %3d,  Cost: %.4f,"
+        "  AvgTime: %3.2fms"
+        % (ev["step"], ev["epoch"], ev["batch"], ev["batch_count"],
+           ev["cost"], ev["avg_ms"])
+    )
+
+
+def _epoch(ev: dict) -> list[str]:
+    # Test-Accuracy keeps the reference's %2.2f (tfdist_between.py:109);
+    # other per-epoch metrics (the LM's Test-Perplexity) use the %.4f
+    # shape StepLogger.log_epoch_metric introduced.
+    metric = ev.get("metric", "Test-Accuracy")
+    if metric == "Test-Accuracy":
+        head = "Test-Accuracy: %2.2f" % ev["value"]
+    else:
+        head = "%s: %.4f" % (metric, ev["value"])
+    return [head, "Total Time: %3.2fs" % ev["total_time_s"]]
+
+
+def _final(ev: dict) -> list[str]:
+    return ["Final Cost: %.4f" % ev["cost"], "Done"]
+
+
+def _restart(ev: dict) -> str:
+    return (
+        f"Restart: restart={ev['restart']}/{ev['max_restarts']} "
+        f"cause[{ev['cause']}] backoff_s={ev['backoff_s']:.1f}"
+    )
+
+
+def _restart_exhausted(ev: dict) -> str:
+    return (
+        f"Restart: budget exhausted restarts={ev['restarts']}/"
+        f"{ev['max_restarts']} cause[{ev['cause']}] — " + _FAILSTOP_TAIL
+    )
+
+
+def _resize(ev: dict) -> str:
+    return (
+        f"Resize: world={ev['world']} from={ev['from_world']} "
+        f"min_workers={ev['min_workers']} direction={ev['direction']} "
+        f"dropped=[{','.join(ev['dropped'])}] "
+        f"rejoined=[{','.join(ev['rejoined'])}] "
+        f"restart={ev['restart']}/{ev['max_restarts']}"
+    )
+
+
+def _resize_denied(ev: dict) -> str:
+    return (
+        f"Resize: denied world={ev['world']} "
+        f"min_workers={ev['min_workers']} restarts={ev['restarts']}/"
+        f"{ev['max_restarts']} cause[{ev['cause']}] — " + _FAILSTOP_TAIL
+    )
+
+
+def _rollback(ev: dict) -> str:
+    # The anomaly class rides the event as "anomaly" (the journal's own
+    # type key is "kind"); the line keeps the round-6 wording.
+    return (
+        f"Rollback: kind={ev['anomaly']} epoch={ev['epoch']} "
+        f"detected_step={ev['detected_step']} "
+        f"restored_step={ev['restored_step']} "
+        f"rollback={ev['rollback']}/{ev['max_rollbacks']} "
+        "data_window=skipped"
+    )
+
+
+def _rollback_compiled(ev: dict) -> str:
+    return (
+        "Rollback: kind=nan dispatch=compiled save=skipped "
+        "(state not checkpointed; last good step kept)"
+    )
+
+
+def _preemption(ev: dict) -> str:
+    return (
+        f"Preemption: signal={ev['signal']} stop_requested=1 — finishing "
+        "the current epoch, saving, exiting (signal again to force)"
+    )
+
+
+def _restore(ev: dict) -> str:
+    return (
+        f"Restore: global_batch={ev['global_batch']} preserved "
+        f"(world={ev['from_world']}->{ev['world']}, config batch "
+        f"{ev['config_batch']}x{ev['world']}={ev['config_global']} "
+        f"overridden, per-replica batch {ev['per_replica']})"
+    )
+
+
+RENDERERS = {
+    "step": _step,
+    "epoch": _epoch,
+    "final": _final,
+    "restart": _restart,
+    "restart_exhausted": _restart_exhausted,
+    "resize": _resize,
+    "resize_denied": _resize_denied,
+    "rollback": _rollback,
+    "rollback_compiled": _rollback_compiled,
+    "preemption": _preemption,
+    "restore": _restore,
+}
+
+
+def render(kind: str, ev: dict) -> list[str]:
+    """The stdout line(s) for an event of ``kind`` (most kinds render one
+    line; epoch/final render two, matching the reference's pairs)."""
+    out = RENDERERS[kind](ev)
+    return [out] if isinstance(out, str) else list(out)
+
+
+def emit_line(
+    kind: str,
+    *,
+    journal=None,
+    print_fn=None,
+    **fields,
+) -> dict:
+    """The event-first logging primitive: journal the event (NullJournal
+    when none attached — the dict is still built), then print the line(s)
+    RENDERED FROM IT. Returns the event. Every structured stdout line in
+    the framework goes through here (grep-lint-enforced)."""
+    if journal is None:
+        from distributed_tensorflow_tpu.observability import journal as _j
+
+        journal = _j.get_journal()
+    ev = journal.emit(kind, **fields)
+    if print_fn is not None:
+        for line in render(kind, ev):
+            print_fn(line)
+    return ev

@@ -1,0 +1,215 @@
+"""Structured event journal: typed, append-only JSONL (SURVEY.md §5).
+
+The reference's only machine surface was stdout (tfdist_between.py:98-110);
+everything downstream of it — the experiment tables in the reference
+README, the parity oracles here — was grep'd out of log files. This module
+is the machine-readable record those greps were standing in for: every
+structured signal the framework emits (Step/Cost/AvgTime lines, lifecycle
+``Restart:``/``Resize:``/``Rollback:``/``Preemption:``/``Restore:`` lines,
+serving admissions/completions, checkpoint saves, metrics snapshots, host
+spans) is ONE JSON object per line in ``<logdir>/events.jsonl``, tagged
+with wall time, rank/world, and a run id.
+
+Write discipline: one event = one ``write()`` of one ``\\n``-terminated
+line on an ``O_APPEND`` descriptor — concurrent writers (a gang of ranks
+sharing a logdir) interleave whole lines, never bytes, for lines under
+the pipe/page atomicity bound our events stay well inside. The reader
+(:func:`read_events`) tolerates a torn final line (a killed process mid-
+write), mirroring the checkpoint layer's crash-consistency stance.
+
+The stdout bytes remain byte-identical to the reference format: renderers
+in :mod:`observability.format` produce the log lines FROM these events
+(the C14 parity contract — see ``utils/logging.StepLogger``), so the
+journal is a superset of stdout, never a replacement.
+
+jax-free by design (the lean-import convention, CLAUDE.md round 8/9):
+this module and the whole ``observability`` package import and run on a
+container with no working jax — the elastic driver and the reader tooling
+live there.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+class NullJournal:
+    """The unconfigured default: ``emit`` builds and returns the event
+    dict (so renderers can still format lines from it) but writes
+    nothing. Trainers construct their log lines through this path even
+    when no journal is attached — one code path, zero I/O."""
+
+    path = None
+
+    def emit(self, kind: str, **fields) -> dict:
+        ev = {"ts": time.time(), "kind": kind}
+        ev.update(fields)
+        return ev
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "NullJournal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class EventJournal(NullJournal):
+    """Append-only JSONL event stream.
+
+    Every event carries ``ts`` (wall clock), ``kind``, and — when set —
+    ``rank``/``world``/``run`` tags, then the caller's fields. Field
+    values must be JSON-serializable (the writer coerces stray numpy
+    scalars via their ``item()``)."""
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        rank: int | None = None,
+        world: int | None = None,
+        run_id: str | None = None,
+        clock=time.time,
+    ):
+        self.path = path
+        self.rank = rank
+        self.world = world
+        self.run_id = run_id
+        self._clock = clock
+        self._f = None
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+
+    @classmethod
+    def in_dir(cls, logdir: str, **kw) -> "EventJournal":
+        """The conventional location: ``<logdir>/events.jsonl``."""
+        return cls(os.path.join(logdir, "events.jsonl"), **kw)
+
+    def _file(self):
+        if self._f is None:
+            # O_APPEND via mode "a": the kernel serializes whole-buffer
+            # appends, so multi-process journals interleave whole lines.
+            self._f = open(self.path, "a", encoding="utf-8")
+        return self._f
+
+    @staticmethod
+    def _default(o):
+        # numpy scalars/arrays without importing numpy: anything exposing
+        # item() (0-d) or tolist() degrades to plain Python.
+        if hasattr(o, "item") and getattr(o, "ndim", 1) == 0:
+            return o.item()
+        if hasattr(o, "tolist"):
+            return o.tolist()
+        raise TypeError(
+            f"event field of type {type(o).__name__} is not JSON-serializable"
+        )
+
+    def emit(self, kind: str, **fields) -> dict:
+        ev: dict = {"ts": self._clock(), "kind": kind}
+        if self.rank is not None:
+            ev["rank"] = int(self.rank)
+        if self.world is not None:
+            ev["world"] = int(self.world)
+        if self.run_id is not None:
+            ev["run"] = self.run_id
+        ev.update(fields)
+        line = json.dumps(ev, default=self._default) + "\n"
+        f = self._file()
+        f.write(line)  # one write = one line: the atomicity contract
+        f.flush()
+        return ev
+
+    def flush(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+            try:
+                os.fsync(self._f.fileno())
+            except OSError:  # pragma: no cover — exotic filesystems
+                pass
+
+    def close(self) -> None:
+        if self._f is not None:
+            self.flush()
+            self._f.close()
+            self._f = None
+
+
+def read_events(path: str, *, kind: str | None = None) -> list[dict]:
+    """Parse an ``events.jsonl`` (or a logdir containing one). A torn
+    final line — a writer killed mid-append — is skipped silently; a torn
+    line anywhere else raises (that is corruption, not a crash tail).
+    ``kind`` filters."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "events.jsonl")
+    out: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        lines = f.read().split("\n")
+    # A complete file ends with "\n", so split leaves a trailing "".
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            if i == len(lines) - 1:
+                continue  # torn tail: the writer died mid-line
+            raise ValueError(f"{path}:{i + 1}: corrupt event line") from None
+        if kind is None or ev.get("kind") == kind:
+            out.append(ev)
+    return out
+
+
+def append_event(path: str, kind: str, **fields) -> dict:
+    """One-shot append (open → emit → close) for tools that record a
+    single measurement — the bench emitters use this so a crash between
+    points never holds a descriptor open."""
+    j = EventJournal(path)
+    try:
+        return j.emit(kind, **fields)
+    finally:
+        j.close()
+
+
+# -- module default (process-wide wiring) -----------------------------------
+
+_default: NullJournal = NullJournal()
+
+
+def configure(
+    logdir: str | None = None,
+    *,
+    path: str | None = None,
+    rank: int | None = None,
+    world: int | None = None,
+    run_id: str | None = None,
+) -> NullJournal:
+    """Install the process-default journal (``<logdir>/events.jsonl``, or
+    an explicit ``path``). Components that were not handed a journal
+    explicitly fall back to this one; with neither, emission is a no-op
+    (:class:`NullJournal`)."""
+    global _default
+    if _default is not None:
+        _default.close()
+    if path is None and logdir is None:
+        _default = NullJournal()
+    else:
+        if path is None:
+            path = os.path.join(logdir, "events.jsonl")
+        _default = EventJournal(path, rank=rank, world=world, run_id=run_id)
+    return _default
+
+
+def get_journal() -> NullJournal:
+    return _default
+
+
+def emit(kind: str, **fields) -> dict:
+    """Emit through the process-default journal."""
+    return _default.emit(kind, **fields)

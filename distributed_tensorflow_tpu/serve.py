@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from collections import deque
 from typing import NamedTuple
 
@@ -48,6 +49,9 @@ import jax
 import jax.numpy as jnp
 
 from distributed_tensorflow_tpu.models.gpt import GPTLM, GPTLMParams
+from distributed_tensorflow_tpu.observability import journal as obs_journal
+from distributed_tensorflow_tpu.observability.metrics import MetricsRegistry
+from distributed_tensorflow_tpu.observability.spans import SpanRecorder
 
 
 @dataclasses.dataclass(frozen=True)
@@ -205,7 +209,10 @@ class _DecodeState(NamedTuple):
 
 
 class _Request:
-    __slots__ = ("rid", "tokens", "config", "out", "done")
+    __slots__ = (
+        "rid", "tokens", "config", "out", "done",
+        "t_submit", "t_admit", "t_first",
+    )
 
     def __init__(self, rid, tokens, config):
         self.rid = rid
@@ -213,6 +220,9 @@ class _Request:
         self.config = config
         self.out: list[int] = []
         self.done = False
+        self.t_submit = time.perf_counter()
+        self.t_admit = None  # set at slot admission
+        self.t_first = None  # set when the first token lands (TTFT)
 
 
 class TextServer:
@@ -237,6 +247,8 @@ class TextServer:
         slots: int = 8,
         buckets: tuple[int, ...] | None = None,
         chunk: int = 32,
+        journal=None,
+        metrics: MetricsRegistry | None = None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -247,6 +259,14 @@ class TextServer:
         self.tokenizer = tokenizer
         self.slots = slots
         self.chunk = chunk
+        # Serving telemetry (round 10, observability/): admissions and
+        # completions as journal events (rid, TTFT, latency, tokens),
+        # queue/occupancy gauges + latency histograms in the registry,
+        # and every prefill/chunk dispatch as a host span closed by the
+        # scheduler's own D2H token fetch. Defaults are no-ops.
+        self.journal = journal if journal is not None else obs_journal.get_journal()
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.spans = SpanRecorder(journal=self.journal)
         if buckets is None:
             # Doubling buckets up to max_len-1 (a prompt always leaves at
             # least one position of generation room): 16, 32, ... — small
@@ -470,6 +490,8 @@ class TextServer:
         req = _Request(rid, tokens, config)
         self._queue.append(req)
         self._results[rid] = req
+        self.metrics.counter("requests_submitted_total").inc()
+        self.metrics.gauge("queue_depth").set(len(self._queue))
         return rid
 
     def bucket_for(self, length: int) -> int:
@@ -524,33 +546,75 @@ class TextServer:
                 top_p[slot] = c.top_p
                 eos[slot] = -1 if c.eos_id is None else c.eos_id
                 self._slot_req[slot] = req
-            self._state = self._prefill_jit(
-                self.params,
-                self._state,
-                jnp.asarray(tokens),
-                jnp.asarray(plens),
-                jnp.asarray(admit),
-                jnp.asarray(key),
-                jnp.asarray(budget),
-                jnp.asarray(greedy),
-                jnp.asarray(temp),
-                jnp.asarray(top_p),
-                jnp.asarray(eos),
-            )
-            # The admission's first tokens come back with this fetch — a
-            # real D2H value read, so it is also the execution barrier.
-            first = np.asarray(self._state.last_tok)
+                req.t_admit = time.perf_counter()
+                self.metrics.counter("admissions_total").inc()
+                self.journal.emit(
+                    "admission",
+                    rid=req.rid,
+                    slot=int(slot),
+                    bucket=int(lb),
+                    prompt_len=int(req.tokens.size),
+                    queue_wait_s=round(req.t_admit - req.t_submit, 6),
+                )
+            with self.spans.dispatch(
+                "prefill", bucket=int(lb), admitted=len(members)
+            ) as sp:
+                self._state = self._prefill_jit(
+                    self.params,
+                    self._state,
+                    jnp.asarray(tokens),
+                    jnp.asarray(plens),
+                    jnp.asarray(admit),
+                    jnp.asarray(key),
+                    jnp.asarray(budget),
+                    jnp.asarray(greedy),
+                    jnp.asarray(temp),
+                    jnp.asarray(top_p),
+                    jnp.asarray(eos),
+                )
+                # The admission's first tokens come back with this fetch —
+                # a real D2H value read, so it is also the execution
+                # barrier (and what lets the dispatch span close).
+                first = sp.fetch(self._state.last_tok)
             fin = np.asarray(self._state.finished)
+            t_first = time.perf_counter()
             for slot, req in members:
+                req.t_first = t_first
+                self.metrics.histogram("ttft_s").observe(
+                    t_first - req.t_submit
+                )
                 req.out.append(int(first[slot]))
                 if fin[slot]:
                     self._finish(slot)
+        self.metrics.gauge("queue_depth").set(len(self._queue))
 
     def _finish(self, slot: int) -> None:
         req = self._slot_req[slot]
         if req is not None:
             req.done = True
             self._slot_req[slot] = None
+            now = time.perf_counter()
+            latency = now - req.t_submit
+            self.metrics.counter("completions_total").inc()
+            # A completion IS the slot eviction in this engine (no
+            # preemptive eviction yet); counted under both names so the
+            # scheduler-side math (admissions - evictions = occupancy)
+            # reads naturally.
+            self.metrics.counter("slot_evictions_total").inc()
+            self.metrics.counter("tokens_generated_total").inc(len(req.out))
+            self.metrics.histogram("request_latency_s").observe(latency)
+            self.journal.emit(
+                "completion",
+                rid=req.rid,
+                slot=int(slot),
+                tokens=len(req.out),
+                latency_s=round(latency, 6),
+                ttft_s=round(
+                    (req.t_first if req.t_first is not None else now)
+                    - req.t_submit,
+                    6,
+                ),
+            )
 
     def step(self) -> bool:
         """One engine tick: admit queued requests into free slots (per-
@@ -559,11 +623,15 @@ class TextServer:
         finished requests so their slots free for the next tick's
         admissions. Returns True while there is work left."""
         self._admit()
-        if any(r is not None for r in self._slot_req):
-            self._state, toks, valid = self._chunk_jit(
-                self.params, self._state
-            )
-            toks = np.asarray(toks)  # D2H fetch = execution barrier
+        occupied = sum(r is not None for r in self._slot_req)
+        self.metrics.gauge("slots_busy").set(occupied)
+        if occupied:
+            with self.spans.dispatch("decode_chunk", chunk=self.chunk) as sp:
+                self._state, toks, valid = self._chunk_jit(
+                    self.params, self._state
+                )
+                # D2H fetch = execution barrier (closes the span).
+                toks = sp.fetch(toks)
             valid = np.asarray(valid)
             fin = np.asarray(self._state.finished)
             for slot, req in enumerate(self._slot_req):
@@ -572,6 +640,12 @@ class TextServer:
                 req.out.extend(int(t) for t in toks[valid[:, slot], slot])
                 if fin[slot]:
                     self._finish(slot)
+            # Re-read after _finish frees slots: the tick that completes
+            # the last request must leave the gauge at 0 (an idle server
+            # must not scrape as busy forever).
+            self.metrics.gauge("slots_busy").set(
+                sum(r is not None for r in self._slot_req)
+            )
         return not self.idle()
 
     def idle(self) -> bool:

@@ -169,6 +169,14 @@ def _launch_elastic(
         summary_writer = SummaryWriter(logdir, filename_suffix=".elastic")
     except OSError:  # pragma: no cover — unwritable logdir already raised
         summary_writer = None
+    # The driver's event journal (round 10): <logdir>/events.jsonl carries
+    # every Restart:/Resize: as a typed event plus the gang's metrics
+    # snapshot — tools/obs_report.py replays the run from it.
+    from distributed_tensorflow_tpu.observability import EventJournal
+
+    journal = EventJournal.in_dir(
+        logdir, run_id=f"elastic-{os.getpid()}", world=num_workers
+    )
 
     launched: set[int] = set()
 
@@ -222,6 +230,7 @@ def _launch_elastic(
         rejoin_timeout_s=rejoin_timeout_s,
         print_fn=print_fn,
         summary_writer=summary_writer,
+        journal=journal,
     )
     if drive_mode:
         # Scenario driver (demos + integration tests): SIGKILL the highest
@@ -248,6 +257,7 @@ def _launch_elastic(
 
         threading.Thread(target=_drive, daemon=True).start()
     rc = gang.run()
+    journal.close()
     for agent in agents:
         code = agent.poll()
         print_fn(f"{agent.name}: exit {code}")

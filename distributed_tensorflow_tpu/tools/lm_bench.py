@@ -467,6 +467,37 @@ def render(rows) -> str:
     return "\n".join(out)
 
 
+def emit_bench_events(rows, device: str, events_path: str) -> list[dict]:
+    """The measured LM rows as ``bench_point`` journal events (round 10):
+    one event per config carrying tokens/s and the MFU columns, so the
+    docs tables and the journal share one source
+    (``tools/perf_record.py --journal`` reads them back)."""
+    from distributed_tensorflow_tpu.observability.journal import EventJournal
+
+    j = EventJournal(events_path, run_id="lm_bench")
+    try:
+        out = []
+        for r in rows:
+            if "error" in r or "tokens_per_sec" not in r:
+                continue
+            out.append(
+                j.emit(
+                    "bench_point",
+                    tool="lm_bench",
+                    name=r["config"],
+                    value=r["tokens_per_sec"],
+                    unit="tokens/s",
+                    device=device,
+                    step_ms=r.get("step_ms"),
+                    mfu_model_pct=r.get("mfu_model_pct"),
+                    mfu_star_pct=r.get("mfu_star_pct"),
+                )
+            )
+        return out
+    finally:
+        j.close()
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--configs", nargs="+", default=None, choices=sorted(CONFIGS))
@@ -496,6 +527,12 @@ def main(argv=None) -> None:
         "MFU*/MFU† vs the current ceiling) from the committed measured "
         "fields, then rewrite md+json — runs anywhere, no chip needed",
     )
+    ap.add_argument(
+        "--events",
+        default=None,
+        help="append the measured rows as bench_point journal events "
+        "(default with --write-docs: docs/benchmarks/events.jsonl)",
+    )
     args = ap.parse_args(argv)
     ceiling = args.ceiling_tflops or _roofline_ceiling()
     root = os.path.abspath(
@@ -521,6 +558,10 @@ def main(argv=None) -> None:
         print(f"recomputed {root}/lm_tpu.md and lm_tpu.json (no re-measurement)")
         return
     rows = run(args.configs, steps=args.steps, ceiling_tflops=ceiling)
+    # Journal events carry only THIS run's measurements — the carry-
+    # forward merge below folds committed rows from other devices/dates
+    # into payload["rows"], which must not be re-stamped as fresh points.
+    measured_rows = list(rows)
     device = jax.devices()[0].device_kind
     print(
         f"device: {device}  steps/dispatch: {args.steps}  measured "
@@ -586,6 +627,12 @@ def main(argv=None) -> None:
         cmd_flags = f"--steps {args.steps}" + (" --decode" if args.decode else "")
         _write_md(root, table, decode_rows, ceiling, device, cmd_flags)
         print(f"wrote {root}/lm_tpu.md and lm_tpu.json")
+    events_path = args.events
+    if events_path is None and args.write_docs:
+        events_path = os.path.join(root, "events.jsonl")
+    if events_path:
+        n = len(emit_bench_events(measured_rows, device, events_path))
+        print(f"appended {n} bench_point events to {events_path}")
 
 
 def _write_md(root, table, decode_rows, ceiling, device, cmd_flags) -> None:

@@ -1,0 +1,272 @@
+"""Run report from the event journal: replay ``events.jsonl`` into a
+human summary + a Perfetto-loadable trace.
+
+The reader half of the round-10 telemetry layer (docs/observability.md):
+everything the framework journals — Step/Cost lines, epoch metrics,
+lifecycle events (restart/resize/rollback/preemption/restore), checkpoint
+saves, serving admissions/completions, metrics snapshots, host spans —
+reconstructs here WITHOUT grep'ing stdout::
+
+    python -m distributed_tensorflow_tpu.tools.obs_report <logdir|events.jsonl>
+    python -m distributed_tensorflow_tpu.tools.obs_report run/ --json
+    python -m distributed_tensorflow_tpu.tools.obs_report run/ --trace t.json
+
+``--trace`` exports the journal's ``span`` events in the chrome trace
+event format (load in Perfetto / chrome://tracing). ``--json`` prints the
+summary dict instead of the rendered report.
+
+jax-free (lean-import convention): runs anywhere the journal was written,
+including degraded containers and machines with no accelerator stack.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from distributed_tensorflow_tpu.observability import format as obs_format
+from distributed_tensorflow_tpu.observability.journal import read_events
+from distributed_tensorflow_tpu.observability.spans import chrome_trace
+
+LIFECYCLE_KINDS = (
+    "restart",
+    "restart_exhausted",
+    "resize",
+    "resize_denied",
+    "rollback",
+    "rollback_compiled",
+    "preemption",
+    "restore",
+)
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile over raw per-event values (the journal
+    keeps every completion, so no bucket estimation is needed here)."""
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def summarize(events: list[dict]) -> dict:
+    """Fold a journal into the run summary dict (the ``--json`` payload)."""
+    by_kind: dict = {}
+    for ev in events:
+        by_kind.setdefault(ev.get("kind", "?"), []).append(ev)
+
+    out: dict = {
+        "events": len(events),
+        "kinds": {k: len(v) for k, v in sorted(by_kind.items())},
+    }
+    span = [e.get("ts") for e in events if isinstance(e.get("ts"), (int, float))]
+    if span:
+        out["wall_span_s"] = round(max(span) - min(span), 3)
+
+    # -- training ---------------------------------------------------------
+    steps = by_kind.get("step", [])
+    if steps:
+        out["training"] = {
+            "step_lines": len(steps),
+            "first_step": steps[0].get("step"),
+            "last_step": steps[-1].get("step"),
+            "last_cost": steps[-1].get("cost"),
+            "last_avg_ms": steps[-1].get("avg_ms"),
+        }
+    epochs = by_kind.get("epoch", [])
+    if epochs:
+        out["epochs"] = [
+            {
+                "metric": e.get("metric"),
+                "value": e.get("value"),
+                "total_time_s": e.get("total_time_s"),
+            }
+            for e in epochs
+        ]
+    finals = by_kind.get("final", [])
+    if finals:
+        out["final_cost"] = finals[-1].get("cost")
+
+    # -- lifecycle history (the Restart/Resize/Rollback/... replay) -------
+    history = []
+    for ev in events:
+        kind = ev.get("kind")
+        if kind in LIFECYCLE_KINDS:
+            try:
+                lines = obs_format.render(kind, ev)
+            except KeyError:
+                lines = [f"{kind}: {ev}"]  # unrenderable: still replayed
+            history.append({"ts": ev.get("ts"), "kind": kind, "line": lines[0]})
+    if history:
+        out["lifecycle"] = history
+
+    saves = by_kind.get("checkpoint_save", [])
+    if saves:
+        out["checkpoints"] = {
+            "saves": len(saves),
+            "bytes_total": sum(int(e.get("bytes", 0)) for e in saves),
+            "last_step": saves[-1].get("step"),
+            "mean_duration_s": round(
+                sum(float(e.get("duration_s", 0.0)) for e in saves)
+                / len(saves),
+                4,
+            ),
+        }
+
+    # -- serving ----------------------------------------------------------
+    admissions = by_kind.get("admission", [])
+    completions = by_kind.get("completion", [])
+    if admissions or completions:
+        serving: dict = {
+            "admissions": len(admissions),
+            "completions": len(completions),
+        }
+        if completions:
+            lat = sorted(float(e.get("latency_s", 0.0)) for e in completions)
+            ttft = sorted(float(e.get("ttft_s", 0.0)) for e in completions)
+            tokens = sum(int(e.get("tokens", 0)) for e in completions)
+            t0 = min(e["ts"] for e in completions + admissions)
+            t1 = max(e["ts"] for e in completions)
+            serving.update(
+                tokens=tokens,
+                tokens_per_s=round(tokens / max(t1 - t0, 1e-9), 2),
+                latency_s={
+                    "p50": round(_percentile(lat, 0.50), 4),
+                    "p90": round(_percentile(lat, 0.90), 4),
+                    "p99": round(_percentile(lat, 0.99), 4),
+                },
+                ttft_s={
+                    "p50": round(_percentile(ttft, 0.50), 4),
+                    "p90": round(_percentile(ttft, 0.90), 4),
+                    "p99": round(_percentile(ttft, 0.99), 4),
+                },
+            )
+        out["serving"] = serving
+
+    # -- bench points (serve_bench / lm_bench emitters) -------------------
+    bench = by_kind.get("bench_point", [])
+    if bench:
+        out["bench_points"] = [
+            {k: e.get(k) for k in ("tool", "name", "value", "unit")}
+            for e in bench
+        ]
+
+    # -- metrics snapshots (last one wins) --------------------------------
+    snaps = by_kind.get("metrics", [])
+    if snaps:
+        out["metrics"] = snaps[-1].get("metrics", {})
+
+    spans = by_kind.get("span", [])
+    if spans:
+        out["spans"] = {"count": len(spans)}
+        # The dispatch p50 is a DISPATCH statistic — checkpoint/profiler
+        # spans (seconds) would otherwise dominate the median.
+        disp = sorted(
+            float(e.get("dur_us", 0.0))
+            for e in spans
+            if e.get("cat") == "dispatch"
+        )
+        if disp:
+            out["spans"]["p50_dispatch_ms"] = round(
+                _percentile(disp, 0.5) / 1000, 3
+            )
+    return out
+
+
+def render_report(summary: dict) -> str:
+    lines = [
+        f"events: {summary['events']}"
+        + (
+            f"  (wall span {summary['wall_span_s']}s)"
+            if "wall_span_s" in summary
+            else ""
+        ),
+        "by kind: "
+        + ", ".join(f"{k}={n}" for k, n in summary["kinds"].items()),
+    ]
+    tr = summary.get("training")
+    if tr:
+        lines.append(
+            f"training: steps {tr['first_step']}..{tr['last_step']} "
+            f"({tr['step_lines']} step lines), last cost "
+            f"{tr['last_cost']:.4f}, last AvgTime {tr['last_avg_ms']:.2f}ms"
+        )
+    for e in summary.get("epochs", []):
+        lines.append(
+            f"  epoch: {e['metric']}={e['value']:.4f} "
+            f"(total {e['total_time_s']:.2f}s)"
+        )
+    if "final_cost" in summary:
+        lines.append(f"final cost: {summary['final_cost']:.4f}")
+    ck = summary.get("checkpoints")
+    if ck:
+        lines.append(
+            f"checkpoints: {ck['saves']} saves, {ck['bytes_total']} bytes, "
+            f"last step {ck['last_step']}, mean {ck['mean_duration_s']}s"
+        )
+    if summary.get("lifecycle"):
+        lines.append("lifecycle history:")
+        for h in summary["lifecycle"]:
+            lines.append(f"  [{h['ts']:.3f}] {h['line']}")
+    sv = summary.get("serving")
+    if sv:
+        lines.append(
+            f"serving: {sv['admissions']} admissions, "
+            f"{sv['completions']} completions"
+            + (
+                f", {sv['tokens']} tokens @ {sv['tokens_per_s']} tok/s; "
+                f"latency p50/p90/p99 = {sv['latency_s']['p50']}/"
+                f"{sv['latency_s']['p90']}/{sv['latency_s']['p99']}s; "
+                f"TTFT p50 = {sv['ttft_s']['p50']}s"
+                if "tokens" in sv
+                else ""
+            )
+        )
+    for b in summary.get("bench_points", []):
+        lines.append(
+            f"bench: {b.get('tool')}/{b.get('name')} = {b.get('value')} "
+            f"{b.get('unit') or ''}".rstrip()
+        )
+    sp = summary.get("spans")
+    if sp:
+        p50 = (
+            f" (dispatch p50 {sp['p50_dispatch_ms']}ms)"
+            if "p50_dispatch_ms" in sp
+            else ""
+        )
+        lines.append(
+            f"spans: {sp['count']} recorded{p50} — export with --trace"
+        )
+    return "\n".join(lines)
+
+
+def export_trace(events: list[dict], path: str) -> int:
+    """Write the journal's span events as a chrome trace; returns the
+    span count (0 is legal — an empty trace still loads)."""
+    spans = [e for e in events if e.get("kind") == "span"]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(chrome_trace(spans), f)
+    return len(spans)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="events.jsonl or a logdir containing one")
+    ap.add_argument("--json", action="store_true", help="print the summary dict")
+    ap.add_argument("--trace", metavar="OUT", help="export chrome-trace JSON")
+    args = ap.parse_args(argv)
+    events = read_events(args.path)
+    summary = summarize(events)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(render_report(summary))
+    if args.trace:
+        n = export_trace(events, args.trace)
+        print(f"wrote {n} spans to {args.trace}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

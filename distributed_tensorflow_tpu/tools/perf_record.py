@@ -124,11 +124,34 @@ def check_docs(root: str | None = None) -> list[str]:
     return stale
 
 
+def journal_points(path: str) -> list[dict]:
+    """``bench_point`` events from an event journal (round 10: serve_bench
+    and lm_bench emit their measured points as journal events — the BENCH
+    artifacts, docs tables, and journal share one source). Latest wins
+    per (tool, name), mirroring the BENCH_r* latest-wins band rule."""
+    from distributed_tensorflow_tpu.observability.journal import read_events
+
+    latest: dict = {}
+    for ev in read_events(path, kind="bench_point"):
+        latest[(ev.get("tool"), ev.get("name"))] = ev
+    return [latest[k] for k in sorted(latest, key=str)]
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--write-docs", action="store_true")
     parser.add_argument("--check", action="store_true")
+    parser.add_argument(
+        "--journal",
+        metavar="EVENTS",
+        help="summarize bench_point events from an events.jsonl "
+        "(latest per tool/name) instead of the BENCH_r* artifacts",
+    )
     args = parser.parse_args(argv)
+    if args.journal:
+        points = journal_points(args.journal)
+        print(json.dumps(points))
+        return 0 if points else 1
     if args.write_docs:
         write_docs()
         return 0

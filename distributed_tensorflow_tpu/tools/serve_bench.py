@@ -179,6 +179,50 @@ def bench(
     }
 
 
+# -- journal emission (round 10): the measured points as bench_point
+# events, so BENCH artifacts, docs tables, and the event journal share
+# one source (tools/perf_record.py --journal reads them back). ----------
+
+
+def emit_bench_events(payload: dict, events_path: str) -> list[dict]:
+    from distributed_tensorflow_tpu.observability.journal import EventJournal
+
+    j = EventJournal(events_path, run_id="serve_bench")
+    try:
+        common = dict(tool="serve_bench", device=payload["device"])
+        return [
+            j.emit(
+                "bench_point", name="batched_tokens_per_s",
+                value=payload["batched"]["tokens_per_s"], unit="tokens/s",
+                slots=payload["batched"]["slots"],
+                chunk=payload["batched"]["chunk"], **common,
+            ),
+            j.emit(
+                "bench_point", name="sequential_tokens_per_s",
+                value=payload["sequential"]["tokens_per_s"],
+                unit="tokens/s", **common,
+            ),
+            j.emit(
+                "bench_point", name="batched_speedup",
+                value=payload["batched_speedup"], unit="x", **common,
+            ),
+            j.emit(
+                "bench_point", name="chunk_speedup",
+                value=payload["chunk_speedup"], unit="x", **common,
+            ),
+            j.emit(
+                "bench_point", name="dispatch_fixed_ms",
+                value=payload["dispatch_fixed_ms"], unit="ms", **common,
+            ),
+            j.emit(
+                "bench_point", name="marginal_token_ms",
+                value=payload["marginal_token_ms"], unit="ms", **common,
+            ),
+        ]
+    finally:
+        j.close()
+
+
 # -- rendering (offline: the staleness guard re-renders committed JSON) ----
 
 
@@ -265,6 +309,12 @@ def main(argv=None) -> int:
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--chunk", type=int, default=32)
     ap.add_argument("--write-docs", action="store_true")
+    ap.add_argument(
+        "--events",
+        default=None,
+        help="append the measured points as bench_point journal events "
+        "(default with --write-docs: docs/benchmarks/events.jsonl)",
+    )
     args = ap.parse_args(argv)
     payload = bench(
         n_requests=args.requests,
@@ -273,11 +323,17 @@ def main(argv=None) -> int:
         chunk=args.chunk,
     )
     print(json.dumps(payload))
+    events_path = args.events
+    if events_path is None and args.write_docs:
+        events_path = os.path.join(_docs_root(), "events.jsonl")
     if args.write_docs:
         write_docs(payload)
         print(f"wrote {_docs_root()}/serving.md and serving.json")
     else:
         print(render(payload))
+    if events_path:
+        n = len(emit_bench_events(payload, events_path))
+        print(f"appended {n} bench_point events to {events_path}")
     return 0
 
 

@@ -82,7 +82,10 @@ from __future__ import annotations
 import time
 from typing import Callable, Sequence
 
+from distributed_tensorflow_tpu.observability import journal as obs_journal
+from distributed_tensorflow_tpu.observability.metrics import MetricsRegistry
 from distributed_tensorflow_tpu.train import resilience
+from distributed_tensorflow_tpu.utils.summary import lifecycle_event
 
 
 class WorkerFailure(RuntimeError):
@@ -141,6 +144,11 @@ class HeartbeatHealth:
         self._grace_ms = int(grace_ms if grace_ms is not None else 5 * timeout_ms)
         self._clock = clock
         self._start = clock()
+
+    def age_ms(self, worker_id: int) -> float:
+        """Milliseconds since the member's last beat (-1: never seen) —
+        the per-worker heartbeat-age gauge the gang exports (round 10)."""
+        return float(self._coord.ms_since_seen(worker_id))
 
     def classify(self, worker_id: int) -> str:
         since = self._coord.ms_since_seen(worker_id)
@@ -288,6 +296,8 @@ class ElasticGang:
         rejoin_timeout_s: float = 0.0,
         print_fn=print,
         summary_writer=None,
+        journal=None,
+        metrics: MetricsRegistry | None = None,
         sleep=time.sleep,
         clock=time.monotonic,
         rng=None,
@@ -315,6 +325,12 @@ class ElasticGang:
             )
         self.print_fn = print_fn
         self.summary_writer = summary_writer
+        # Telemetry (round 10): Restart:/Resize: lines become journal
+        # events (rendered back byte-identically); the registry carries
+        # restart/resize counters, the world-size gauge, and per-worker
+        # heartbeat age. Defaults keep the round-7/8 surface untouched.
+        self.journal = journal if journal is not None else obs_journal.get_journal()
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.sleep = sleep
         self.clock = clock
         self.rng = rng
@@ -390,6 +406,11 @@ class ElasticGang:
                     for rank, a in enumerate(self.active):
                         wid = a.worker_id if identity else rank
                         if rcs[a.name] is None and wid is not None:
+                            if hasattr(health, "age_ms"):
+                                self.metrics.gauge(
+                                    "heartbeat_age_ms",
+                                    labels={"worker": a.name},
+                                ).set(health.age_ms(wid))
                             v = health.classify(wid)
                             if v != "ok":
                                 verdicts[a.name] = v
@@ -494,34 +515,49 @@ class ElasticGang:
         self.active = roster
         self.benched = [a for a in self.agents if a not in roster]
         self.resizes += 1
+        self.metrics.counter("resizes_total").inc()
+        self.metrics.gauge("world_size").set(len(roster))
         direction = (
             "shrink"
             if len(roster) < len(prev)
             else ("grow" if len(roster) > len(prev) else "swap")
         )
-        # Structured, greppable — same key=value shape as Restart:.
-        self.print_fn(
-            f"Resize: world={len(roster)} from={len(prev)} "
-            f"min_workers={self.min_workers} direction={direction} "
-            f"dropped=[{','.join(dropped)}] rejoined=[{','.join(rejoined)}] "
-            f"restart={self.restarts}/{self.max_restarts}"
+        # Structured, greppable — same key=value shape as Restart:. One
+        # lifecycle_event fans out: stdout line + journal event + the
+        # world_size tfevents scalar (utils/summary.py, round 10).
+        lifecycle_event(
+            "resize",
+            print_fn=self.print_fn,
+            journal=self.journal,
+            writer=self.summary_writer,
+            scalar=("world_size", float(len(roster)), self.restarts),
+            world=len(roster),
+            from_world=len(prev),
+            min_workers=self.min_workers,
+            direction=direction,
+            dropped=dropped,
+            rejoined=rejoined,
+            restart=self.restarts,
+            max_restarts=self.max_restarts,
         )
-        if self.summary_writer is not None:
-            self.summary_writer.add_scalar(
-                "world_size", float(len(roster)), self.restarts
-            )
 
     def _on_retry(self, exc: WorkerFailure, attempt: int, delay: float) -> None:
         self.restarts = attempt + 1
-        # Structured, greppable — same key=value shape as Preemption:/Rollback:.
-        self.print_fn(
-            f"Restart: restart={self.restarts}/{self.max_restarts} "
-            f"cause[{exc}] backoff_s={delay:.1f}"
+        self.metrics.counter("restarts_total").inc()
+        # Structured, greppable — same key=value shape as Preemption:/
+        # Rollback:; the lifecycle_event fans out stdout + journal +
+        # the restart tfevents scalar.
+        lifecycle_event(
+            "restart",
+            print_fn=self.print_fn,
+            journal=self.journal,
+            writer=self.summary_writer,
+            scalar=("restart", float(self.restarts), self.restarts),
+            restart=self.restarts,
+            max_restarts=self.max_restarts,
+            cause=str(exc),
+            backoff_s=float(delay),
         )
-        if self.summary_writer is not None:
-            self.summary_writer.add_scalar(
-                "restart", float(self.restarts), self.restarts
-            )
         # After the Restart bookkeeping: decide WHAT relaunches (may wait
         # the rejoin window, may shrink/grow, may raise GangBelowFloor —
         # which aborts the retry loop into run()'s fail-stop).
@@ -532,6 +568,7 @@ class ElasticGang:
         after restarts and resizes), 1 when the budget is exhausted or the
         roster fell below ``min_workers`` (fail-stop, with a final
         structured line; checkpoints intact)."""
+        self.metrics.gauge("world_size").set(len(self.active))
         if self.summary_writer is not None and self._elastic:
             # Initial world size, so the scalar stream starts at the
             # launched topology (resizes append to it at their restart
@@ -554,26 +591,33 @@ class ElasticGang:
                 rng=self.rng,
             )
         except GangBelowFloor as exc:
-            self.print_fn(
-                f"Resize: denied world={exc.world} "
-                f"min_workers={self.min_workers} restarts={self.restarts}/"
-                f"{self.max_restarts} cause[{exc}] — failing stop "
-                "(checkpoints intact; newest valid step restores on the "
-                "next launch)"
+            lifecycle_event(
+                "resize_denied",
+                print_fn=self.print_fn,
+                journal=self.journal,
+                world=exc.world,
+                min_workers=self.min_workers,
+                restarts=self.restarts,
+                max_restarts=self.max_restarts,
+                cause=str(exc),
             )
             if self.summary_writer is not None:
                 self.summary_writer.flush()
             return 1
         except WorkerFailure as exc:
-            self.print_fn(
-                f"Restart: budget exhausted restarts={self.restarts}/"
-                f"{self.max_restarts} cause[{exc}] — failing stop "
-                "(checkpoints intact; newest valid step restores on the "
-                "next launch)"
+            lifecycle_event(
+                "restart_exhausted",
+                print_fn=self.print_fn,
+                journal=self.journal,
+                restarts=self.restarts,
+                max_restarts=self.max_restarts,
+                cause=str(exc),
             )
             if self.summary_writer is not None:
                 self.summary_writer.flush()
             return 1
         finally:
+            self.metrics.flush_to(self.journal, component="elastic")
+            self.journal.flush()
             if self.summary_writer is not None and self.restarts:
                 self.summary_writer.flush()

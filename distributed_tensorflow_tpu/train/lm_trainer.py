@@ -88,11 +88,14 @@ import optax
 
 from distributed_tensorflow_tpu.config import TrainConfig
 from distributed_tensorflow_tpu.models.gpt import GPTLM, make_lm_train_step
+from distributed_tensorflow_tpu.observability import journal as obs_journal
+from distributed_tensorflow_tpu.observability.metrics import MetricsRegistry
+from distributed_tensorflow_tpu.observability.spans import SpanRecorder
 from distributed_tensorflow_tpu.ops import optim as optim_lib
 from distributed_tensorflow_tpu.parallel.strategy import TrainState
 from distributed_tensorflow_tpu.train.supervisor import Supervisor
 from distributed_tensorflow_tpu.utils.logging import StepLogger
-from distributed_tensorflow_tpu.utils.summary import SummaryWriter
+from distributed_tensorflow_tpu.utils.summary import SummaryWriter, lifecycle_event
 
 
 class LMTrainer:
@@ -118,6 +121,8 @@ class LMTrainer:
         seq_axis: str = "seq",
         sp_attention: str | None = None,
         tokenizer=None,
+        journal=None,
+        metrics: MetricsRegistry | None = None,
     ):
         self.model = model
         self.datasets = datasets
@@ -138,6 +143,12 @@ class LMTrainer:
         self.pp_microbatches = pp_microbatches
         self.seq_axis = seq_axis
         self.sp_attention = sp_attention
+        # Telemetry (round 10, observability/): journal defaults to the
+        # process-wide one (no-op NullJournal unless configured); the
+        # structured lines below render FROM journal events.
+        self.journal = journal if journal is not None else obs_journal.get_journal()
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.spans = SpanRecorder(journal=self.journal)
         self._ragged = datasets.train.lengths is not None
         self.mode = self._resolve_mode()
 
@@ -174,6 +185,9 @@ class LMTrainer:
             self._write_tokenizer(tokenizer)
         self.start_step = 0
         if self.supervisor is not None:
+            self.supervisor.attach_observability(
+                self.journal, self.metrics, self.spans
+            )
             # Newest step that is not known-corrupt (manifest-verified,
             # train/resilience.py): a truncated latest checkpoint points
             # the restore at the previous valid one.
@@ -982,7 +996,10 @@ class LMTrainer:
         train = self.datasets.train
         val = self.datasets.validation
         steps = train.num_examples // cfg.batch_size
-        logger = StepLogger(freq=cfg.log_frequency, print_fn=self.print_fn)
+        logger = StepLogger(
+            freq=cfg.log_frequency, print_fn=self.print_fn,
+            journal=self.journal,
+        )
         if epochs * steps == 0:
             # Nothing to dispatch (epochs=0, or dataset smaller than one
             # batch) — mirror run()'s no-op semantics instead of crashing
@@ -1018,14 +1035,20 @@ class LMTrainer:
             )
         )
         step_before = self.global_step
+        mark = self.spans.mark()
         t0 = time.time()
         self.state, costs, ppls = run_fn(
             self.state, toks, lens, idxs, val_toks, val_lens
         )
-        costs = jax.device_get(costs)  # D2H fetch = execution barrier
+        # D2H fetch = execution barrier; dispatch_fetch also records the
+        # honest dispatch span (CLAUDE.md timing trap).
+        costs = self.spans.dispatch_fetch(
+            "lm_compiled_run", costs, start=mark, epochs=int(epochs)
+        )
         ppls = jax.device_get(ppls)
         elapsed = time.time() - t0
         avg_ms = elapsed * 1000 / max(epochs * steps, 1)
+        self._observe_step_time(avg_ms)
         self.last_cost = float(costs[-1, -1])
         for epoch in range(epochs):
             for i in range(steps):
@@ -1065,9 +1088,10 @@ class LMTrainer:
                 # poisoned state over the last good checkpoint (the
                 # per-epoch run() path does the full restore+retry).
                 if self.is_chief:
-                    self.print_fn(
-                        "Rollback: kind=nan dispatch=compiled save=skipped "
-                        "(state not checkpointed; last good step kept)"
+                    lifecycle_event(
+                        "rollback_compiled",
+                        print_fn=self.print_fn,
+                        journal=self.journal,
                     )
             else:
                 self.supervisor.save(
@@ -1084,6 +1108,8 @@ class LMTrainer:
             logger.log_final(cost=self.last_cost)
             if self.summary_writer is not None:
                 self.summary_writer.flush()
+            self.metrics.flush_to(self.journal, component="lm_trainer")
+            self.journal.flush()
         return {
             "perplexity": perplexity,
             "final_cost": self.last_cost,
@@ -1134,6 +1160,7 @@ class LMTrainer:
                         StepLogger(
                             freq=self.config.log_frequency,
                             print_fn=self.print_fn,
+                            journal=self.journal,
                         ).log_final(cost=res["final_cost"])
                         if self.summary_writer is not None:
                             self.summary_writer.flush()
@@ -1204,10 +1231,15 @@ class LMTrainer:
             toks = self._stage("train_tokens", train.tokens)
             lens = self._train_lens()
             idxs = self._replicated(self._epoch_indices(steps, cfg.batch_size))
+            mark = self.spans.mark()
             t0 = time.time()
             self.state, costs = self._scanned_fn(self.state, toks, lens, idxs)
-            costs = jax.device_get(costs)  # D2H fetch = execution barrier
+            # D2H fetch = execution barrier (+ the honest dispatch span).
+            costs = self.spans.dispatch_fetch(
+                "lm_epoch_scan", costs, start=mark, epoch=int(epoch)
+            )
             avg_ms = (time.time() - t0) * 1000 / steps
+            self._observe_step_time(avg_ms)
             self.last_cost = float(costs[-1])
             self._epoch_costs = costs  # anomaly guard sees every step's cost
             for i in range(steps):
@@ -1226,6 +1258,7 @@ class LMTrainer:
             if self._eager_step is None:
                 self._eager_step = self._build_eager_step()
             logger.reset_window()
+            t_epoch = time.time()
             for i in range(steps):
                 batch = train.next_batch(cfg.batch_size)
                 toks, lens = batch if self._ragged else (batch, None)
@@ -1251,9 +1284,23 @@ class LMTrainer:
                         cost=float(cost),
                     )
             self.last_cost = float(self.last_cost)
+            self._observe_step_time(
+                (time.time() - t_epoch) * 1000 / max(steps, 1)
+            )
         if self.summary_writer is not None and self.is_chief:
             for step, cost in summaries:
                 self.summary_writer.add_scalar("cost", float(cost), step)
+
+    def _observe_step_time(self, avg_ms: float) -> None:
+        """Per-epoch average step time into the metrics registry (mirror
+        of Trainer._observe_step_time)."""
+        from distributed_tensorflow_tpu.observability.metrics import (
+            TIME_MS_EDGES,
+        )
+
+        self.metrics.histogram("step_time_ms", edges=TIME_MS_EDGES).observe(
+            float(avg_ms)
+        )
 
     def _anomaly_rollback(self, guard, kind: str, epoch: int) -> None:
         """LM analog of Trainer._anomaly_rollback: restore the newest
@@ -1274,21 +1321,26 @@ class LMTrainer:
                 + ("" if self.supervisor else "; no supervisor") + ")"
             )
         guard.rollbacks += 1
+        self.metrics.counter("rollbacks_total").inc()
         fresh = self._init_state(self.model.init(seed=self.config.seed))
         restored, restored_step = self.supervisor.prepare_or_restore(fresh)
         self.state = self._place_state(restored)
         self.last_cost = None
         if self.is_chief:
-            self.print_fn(
-                f"Rollback: kind={kind} epoch={epoch} "
-                f"detected_step={detected_step} restored_step={restored_step} "
-                f"rollback={guard.rollbacks}/{guard.max_rollbacks} "
-                "data_window=skipped"
+            # One lifecycle_event fans out to stdout + journal + tfevents.
+            lifecycle_event(
+                "rollback",
+                print_fn=self.print_fn,
+                journal=self.journal,
+                writer=self.summary_writer,
+                scalar=("rollback", float(restored_step), detected_step),
+                anomaly=kind,
+                epoch=epoch,
+                detected_step=detected_step,
+                restored_step=restored_step,
+                rollback=guard.rollbacks,
+                max_rollbacks=guard.max_rollbacks,
             )
-            if self.summary_writer is not None:
-                self.summary_writer.add_scalar(
-                    "rollback", float(restored_step), detected_step
-                )
 
     def run(self, epochs: int | None = None) -> dict:
         """Public entry: the whole run under the preemption contract —
@@ -1301,6 +1353,7 @@ class LMTrainer:
             self.supervisor,
             enabled=self.config.handle_preemption,
             print_fn=self.print_fn,
+            journal=self.journal,
         ):
             return self._run(epochs)
 
@@ -1309,7 +1362,10 @@ class LMTrainer:
         epochs = cfg.epochs if epochs is None else epochs
         if cfg.epochs_per_dispatch:
             return self._run_chunked(epochs)
-        logger = StepLogger(freq=cfg.log_frequency, print_fn=self.print_fn)
+        logger = StepLogger(
+            freq=cfg.log_frequency, print_fn=self.print_fn,
+            journal=self.journal,
+        )
         from distributed_tensorflow_tpu.train.resilience import AnomalyGuard
 
         guard = AnomalyGuard.from_config(cfg)
@@ -1331,6 +1387,7 @@ class LMTrainer:
                     self._anomaly_rollback(guard, kind, epoch)
                     continue  # retry this epoch index on the next window
                 guard.record(cost)
+            self.metrics.counter("epochs_total").inc()
             # EVERY process runs the eval — it is a global-mesh computation
             # (GSPMD may partition it with collectives), so a chief-only
             # dispatch would hang or die once non-chief processes move on
@@ -1368,6 +1425,8 @@ class LMTrainer:
             logger.log_final(cost=final_cost)
             if self.summary_writer is not None:
                 self.summary_writer.flush()
+            self.metrics.flush_to(self.journal, component="lm_trainer")
+            self.journal.flush()
         return {
             "perplexity": perplexity,
             "final_cost": final_cost,

@@ -330,13 +330,17 @@ def retry_io(
 
 
 @contextlib.contextmanager
-def preemption_guard(supervisor, *, enabled: bool = True, print_fn=print):
+def preemption_guard(
+    supervisor, *, enabled: bool = True, print_fn=print, journal=None
+):
     """Install SIGTERM/SIGINT handlers for the duration of a training run:
     the first signal flips ``supervisor.request_stop()`` (the loop exits
     at the next epoch/dispatch boundary, whose save makes the final
     checkpoint) and immediately restores the previous handlers, so a
     second signal falls through to the old disposition (default: die) —
-    graceful first, killable always.
+    graceful first, killable always. The ``Preemption:`` line is a
+    lifecycle event (round 10): journaled through ``journal`` (or the
+    process default) and rendered byte-identically to stdout.
 
     No-ops (yields None) when disabled, when there is no supervisor to
     stop, or off the main thread (CPython only delivers signals there)."""
@@ -357,12 +361,29 @@ def preemption_guard(supervisor, *, enabled: bool = True, print_fn=print):
             except (ValueError, OSError):  # pragma: no cover
                 pass
 
+    pending: list[dict] = []
+
     def _handler(signum, frame):
         supervisor.request_stop()
         # Structured one-liner (greppable key=value, like Step:/Cost:).
-        print_fn(
-            f"Preemption: signal={signum} stop_requested=1 — finishing the "
-            "current epoch, saving, exiting (signal again to force)"
+        # Journal file I/O is NOT reentrancy-safe: the signal can land
+        # mid-write on the journal's own buffered file (StepLogger emits
+        # on every step line), and a second write from the handler would
+        # raise "reentrant call" INTO the training loop — killing the run
+        # the guard exists to stop gracefully. So the handler builds and
+        # prints the event with zero I/O (NullJournal) and defers the
+        # real journal write to guard exit, after the loop has stopped.
+        from distributed_tensorflow_tpu.observability import format as obs_format
+        from distributed_tensorflow_tpu.observability.journal import NullJournal
+
+        ev = obs_format.emit_line(
+            "preemption",
+            journal=NullJournal(),
+            print_fn=print_fn,
+            signal=int(signum),
+        )
+        pending.append(
+            {k: v for k, v in ev.items() if k not in ("ts", "kind")}
         )
         _restore()
 
@@ -375,6 +396,14 @@ def preemption_guard(supervisor, *, enabled: bool = True, print_fn=print):
         yield _handler
     finally:
         _restore()
+        if pending:
+            from distributed_tensorflow_tpu.observability import (
+                journal as obs_journal,
+            )
+
+            j = journal if journal is not None else obs_journal.get_journal()
+            for fields in pending:
+                j.emit("preemption", **fields)
 
 
 # ---------------------------------------------------------------------------

@@ -115,9 +115,32 @@ class Supervisor:
         self._stall_timeout_ms = 0
         self._progress_fn = None
         self._ckptr = None
+        self._journal = None
+        self._metrics = None
+        self._spans = None
         if self.checkpoint_dir and _HAVE_ORBAX:
             os.makedirs(self.checkpoint_dir, exist_ok=True)
             self._ckptr = ocp.StandardCheckpointer()
+
+    def attach_observability(
+        self, journal=None, metrics=None, spans=None
+    ) -> None:
+        """Arm checkpoint telemetry (round 10): each save/restore emits a
+        ``checkpoint_save``/``checkpoint_restore`` journal event (step,
+        bytes, duration), feeds the metrics registry (save count/bytes/
+        duration histogram), and records a host span. All three sinks are
+        optional — trainers wire theirs in; a bare Supervisor stays
+        silent."""
+        self._journal = journal
+        self._metrics = metrics
+        self._spans = spans
+
+    def _span(self, name: str, **args):
+        import contextlib
+
+        if self._spans is None:
+            return contextlib.nullcontext()
+        return self._spans.span(name, cat="checkpoint", **args)
 
     def attach_heartbeat(self, heartbeat, *, stall_timeout_ms: int = 0) -> None:
         """Arm failure-reactive stopping: when the attached
@@ -195,23 +218,46 @@ class Supervisor:
         policy GCs steps beyond ``keep_last_n`` — never the last valid."""
         if not (self.is_chief and self._ckptr):
             return
+        import time as _time
+
         path = os.path.join(self.checkpoint_dir, f"step_{step}")
+        t0 = _time.perf_counter()
 
         def _write():
             self._ckptr.save(path, state, force=True)
             self._ckptr.wait_until_finished()
 
-        self._retry(_write, f"save step_{step}")
-        if layout is not None:
-            side = f"{path}.layout.json"
-            tmp = f"{side}.tmp.{os.getpid()}"
-            with open(tmp, "w") as f:
-                json.dump(layout, f)
-            os.replace(tmp, side)
-        self._retry(
-            lambda: resilience.write_manifest(self.checkpoint_dir, step, state),
-            f"manifest step_{step}",
-        )
+        with self._span("checkpoint_save", step=int(step)):
+            self._retry(_write, f"save step_{step}")
+            if layout is not None:
+                side = f"{path}.layout.json"
+                tmp = f"{side}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump(layout, f)
+                os.replace(tmp, side)
+            manifest = self._retry(
+                lambda: resilience.write_manifest(
+                    self.checkpoint_dir, step, state
+                ),
+                f"manifest step_{step}",
+            )
+        duration_s = _time.perf_counter() - t0
+        # The manifest already walked the step dir with sizes — the byte
+        # count is free (no second disk pass).
+        nbytes = sum(
+            r["size"] for r in manifest.get("files", {}).values()
+        ) + sum(r["size"] for r in manifest.get("sidecars", {}).values())
+        if self._journal is not None:
+            self._journal.emit(
+                "checkpoint_save",
+                step=int(step),
+                bytes=int(nbytes),
+                duration_s=round(duration_s, 6),
+            )
+        if self._metrics is not None:
+            self._metrics.counter("checkpoint_saves_total").inc()
+            self._metrics.counter("checkpoint_bytes_total").inc(nbytes)
+            self._metrics.histogram("checkpoint_save_s").observe(duration_s)
         self._retention_sweep()
 
     def _retention_sweep(self) -> None:
@@ -357,6 +403,14 @@ class Supervisor:
                     stacklevel=2,
                 )
                 continue
+            if self._journal is not None:
+                self._journal.emit(
+                    "checkpoint_restore",
+                    step=int(step),
+                    fallback=step != candidates[0],
+                )
+            if self._metrics is not None:
+                self._metrics.counter("checkpoint_restores_total").inc()
             return restored, step
         if candidates:
             raise RuntimeError(

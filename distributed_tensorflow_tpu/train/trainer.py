@@ -24,6 +24,9 @@ from typing import Callable
 import jax
 
 from distributed_tensorflow_tpu.config import TrainConfig
+from distributed_tensorflow_tpu.observability import journal as obs_journal
+from distributed_tensorflow_tpu.observability.metrics import MetricsRegistry
+from distributed_tensorflow_tpu.observability.spans import SpanRecorder
 from distributed_tensorflow_tpu.ops import losses as losses_lib
 from distributed_tensorflow_tpu.ops import optim as optim_lib
 from distributed_tensorflow_tpu.parallel.strategy import (
@@ -33,7 +36,7 @@ from distributed_tensorflow_tpu.parallel.strategy import (
 )
 from distributed_tensorflow_tpu.train.supervisor import Supervisor
 from distributed_tensorflow_tpu.utils.logging import StepLogger
-from distributed_tensorflow_tpu.utils.summary import SummaryWriter
+from distributed_tensorflow_tpu.utils.summary import SummaryWriter, lifecycle_event
 
 
 class Trainer:
@@ -50,6 +53,8 @@ class Trainer:
         supervisor: "Supervisor | None" = None,
         is_chief: bool = True,
         print_fn=print,
+        journal=None,
+        metrics: MetricsRegistry | None = None,
     ):
         self.model = model
         self.datasets = datasets
@@ -60,6 +65,13 @@ class Trainer:
         self.summary_writer = summary_writer
         self.is_chief = is_chief
         self.print_fn = print_fn
+        # Telemetry (round 10, observability/): the journal defaults to the
+        # process-wide one (a no-op NullJournal unless observability
+        # .configure ran) — every structured line below is rendered FROM a
+        # journal event, byte-identical to the pre-journal output.
+        self.journal = journal if journal is not None else obs_journal.get_journal()
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.spans = SpanRecorder(journal=self.journal)
 
         self.state = self.strategy.init_state(self.model, self.optimizer, self.config.seed)
         self.train_step = self.strategy.make_train_step(
@@ -96,6 +108,9 @@ class Trainer:
             )
         self.start_step = 0
         if self.supervisor is not None:
+            self.supervisor.attach_observability(
+                self.journal, self.metrics, self.spans
+            )
             src = None
             # Newest step that is not known-corrupt (manifest-verified,
             # train/resilience.py) — a truncated/flipped latest checkpoint
@@ -277,12 +292,17 @@ class Trainer:
             )
         if self.is_chief:
             # Structured, greppable — the trainer-side half of the gang's
-            # Resize: line.
-            self.print_fn(
-                f"Restore: global_batch={saved} preserved "
-                f"(world={saved_world}->{n}, config batch "
-                f"{self.config.batch_size}x{n}={self.global_batch} "
-                f"overridden, per-replica batch {saved // n})"
+            # Resize: line (rendered from the journal event).
+            lifecycle_event(
+                "restore",
+                print_fn=self.print_fn,
+                journal=self.journal,
+                global_batch=saved,
+                from_world=saved_world,
+                world=n,
+                config_batch=self.config.batch_size,
+                config_global=self.global_batch,
+                per_replica=saved // n,
             )
         self.global_batch = saved
 
@@ -409,6 +429,7 @@ class Trainer:
         summaries: list[tuple[int, jax.Array]] = []
         step_before = self.strategy.global_step(self.state)
         logger.reset_window()
+        t_epoch = time.time()
         if cfg.prefetch:
             from distributed_tensorflow_tpu.data.prefetch import prefetch_batches
 
@@ -440,6 +461,9 @@ class Trainer:
                     batch_count=batch_count,
                     cost=self.strategy.cost_scalar(cost),
                 )
+        self._observe_step_time(
+            (time.time() - t_epoch) * 1000 / max(batch_count, 1)
+        )
         if self.summary_writer is not None and self.is_chief:
             incr = self._step_incr(step_before, batch_count)
             for i, cost in summaries:
@@ -489,6 +513,7 @@ class Trainer:
                 getattr(self.strategy, "replicated_sharding", None),
             )
             step_before = self.strategy.global_step(self.state)
+            mark = self.spans.mark()
             t0 = time.time()
             self.state, costs = self._indexed_fn(self.state, xs, ys, idxs)
         else:
@@ -501,14 +526,21 @@ class Trainer:
             xs = jax.device_put(xs_np, sharding) if sharding else jax.numpy.asarray(xs_np)
             ys = jax.device_put(ys_np, sharding) if sharding else jax.numpy.asarray(ys_np)
             step_before = self.strategy.global_step(self.state)
+            mark = self.spans.mark()
             t0 = time.time()
             self.state, costs = self._scanned_fn(self.state, xs, ys)
-        costs = jax.device_get(costs)
+        # dispatch_fetch = jax.device_get + the host span: the fetch IS the
+        # execution barrier (CLAUDE.md timing trap), and the span records
+        # the honest dispatch→D2H window.
+        costs = self.spans.dispatch_fetch(
+            "epoch_scan", costs, start=mark, epoch=int(epoch)
+        )
         elapsed = time.time() - t0
         self.last_cost = costs[-1]
         self._epoch_costs = costs  # anomaly guard sees every step's cost
         batch_count = costs.shape[0]
         avg_ms = elapsed * 1000 / batch_count  # uniform: one dispatch ran them all
+        self._observe_step_time(avg_ms)
         self._emit_step_logs(
             costs,
             epoch,
@@ -597,7 +629,10 @@ class Trainer:
         if self.summary_writer is not None and self.is_chief and not self._graph_written:
             self.write_graph()
             self._graph_written = True
-        logger = StepLogger(freq=cfg.log_frequency, print_fn=self.print_fn)
+        logger = StepLogger(
+            freq=cfg.log_frequency, print_fn=self.print_fn,
+            journal=self.journal,
+        )
         # Stage replicated (per-step batches are random gathers, and in a
         # multi-process mesh the inputs must be globally addressable), cached
         # across calls: a repeated/resumed run re-dispatches without
@@ -616,6 +651,7 @@ class Trainer:
             stage("test_y", test.labels),
             shuffle_key,
         )
+        mark = self.spans.mark()
         if use_pallas:
             from distributed_tensorflow_tpu.ops.pallas_mlp import (
                 from_fused,
@@ -630,14 +666,19 @@ class Trainer:
             )
         else:
             self.state, metrics = run_fn(self.state, *staged_args)
-        # D2H fetches double as the execution barrier (CLAUDE.md timing trap).
-        costs = jax.device_get(metrics["costs"])
+        # D2H fetches double as the execution barrier (CLAUDE.md timing
+        # trap); dispatch_fetch also records the honest dispatch span.
+        costs = self.spans.dispatch_fetch(
+            "compiled_run", metrics["costs"], start=mark,
+            epochs=int(epochs), engine=cfg.engine,
+        )
         accs = jax.device_get(metrics["accuracy"])
         elapsed = time.time() - t0
         batch_count = costs.shape[1]
         if costs.size:
             self.last_cost = costs[-1, -1]
         avg_ms = elapsed * 1000 / max(epochs * batch_count, 1)
+        self._observe_step_time(avg_ms)
         # Per-batch global-step advance (num_replicas under async, 1 under
         # sync) — derived from the counter over the whole dispatch.
         incr = self._step_incr(step_before, epochs * batch_count)
@@ -675,9 +716,10 @@ class Trainer:
                 # commit a poisoned state over the last good checkpoint
                 # (the per-epoch run() path does the full restore+retry).
                 if self.is_chief:
-                    self.print_fn(
-                        "Rollback: kind=nan dispatch=compiled save=skipped "
-                        "(state not checkpointed; last good step kept)"
+                    lifecycle_event(
+                        "rollback_compiled",
+                        print_fn=self.print_fn,
+                        journal=self.journal,
                     )
             else:
                 self.supervisor.save(
@@ -690,6 +732,8 @@ class Trainer:
             logger.log_final(cost=final_cost)
             if self.summary_writer is not None:
                 self.summary_writer.flush()
+            self.metrics.flush_to(self.journal, component="trainer")
+            self.journal.flush()
         return {
             "accuracy": float(accs[-1]) if accs.size else 0.0,
             "final_cost": final_cost,
@@ -742,7 +786,9 @@ class Trainer:
             if self.supervisor is not None and self.supervisor.should_stop:
                 if not last and self.is_chief:
                     StepLogger(
-                        freq=self.config.log_frequency, print_fn=self.print_fn
+                        freq=self.config.log_frequency,
+                        print_fn=self.print_fn,
+                        journal=self.journal,
                     ).log_final(cost=res["final_cost"])
                     if self.summary_writer is not None:
                         self.summary_writer.flush()
@@ -823,6 +869,18 @@ class Trainer:
                 + "; ".join(problems)
             )
 
+    def _observe_step_time(self, avg_ms: float) -> None:
+        """Per-epoch average step time into the metrics registry (the
+        trainer-side slice of the telemetry layer; edges span the µs
+        Pallas steps through the ~100 ms tunnel dispatches)."""
+        from distributed_tensorflow_tpu.observability.metrics import (
+            TIME_MS_EDGES,
+        )
+
+        self.metrics.histogram("step_time_ms", edges=TIME_MS_EDGES).observe(
+            float(avg_ms)
+        )
+
     def _step_incr(self, step_before: int, batch_count: int) -> int:
         """Global-step advance per batch of the epoch just run — derived
         from the counter itself (num_replicas under async, 1 under sync)."""
@@ -897,6 +955,7 @@ class Trainer:
                 + ("" if self.supervisor else "; no supervisor") + ")"
             )
         guard.rollbacks += 1
+        self.metrics.counter("rollbacks_total").inc()
         fresh = self.strategy.init_state(
             self.model, self.optimizer, self.config.seed
         )
@@ -912,16 +971,20 @@ class Trainer:
             self.epochs_completed = int(side["epochs"])
         if self.is_chief:
             # Structured, greppable — same key=value shape as Preemption:.
-            self.print_fn(
-                f"Rollback: kind={kind} epoch={epoch} "
-                f"detected_step={detected_step} restored_step={restored_step} "
-                f"rollback={guard.rollbacks}/{guard.max_rollbacks} "
-                "data_window=skipped"
+            # One lifecycle_event fans out to stdout + journal + tfevents.
+            lifecycle_event(
+                "rollback",
+                print_fn=self.print_fn,
+                journal=self.journal,
+                writer=self.summary_writer,
+                scalar=("rollback", float(restored_step), detected_step),
+                anomaly=kind,
+                epoch=epoch,
+                detected_step=detected_step,
+                restored_step=restored_step,
+                rollback=guard.rollbacks,
+                max_rollbacks=guard.max_rollbacks,
             )
-            if self.summary_writer is not None:
-                self.summary_writer.add_scalar(
-                    "rollback", float(restored_step), detected_step
-                )
 
     # -- the loop ---------------------------------------------------------
 
@@ -936,6 +999,7 @@ class Trainer:
             self.supervisor,
             enabled=self.config.handle_preemption,
             print_fn=self.print_fn,
+            journal=self.journal,
         ):
             return self._run(epochs)
 
@@ -951,7 +1015,10 @@ class Trainer:
             # and run() may be called repeatedly (resume, epoch-at-a-time).
             self.write_graph()
             self._graph_written = True
-        logger = StepLogger(freq=cfg.log_frequency, print_fn=self.print_fn)
+        logger = StepLogger(
+            freq=cfg.log_frequency, print_fn=self.print_fn,
+            journal=self.journal,
+        )
         from distributed_tensorflow_tpu.train.resilience import AnomalyGuard
 
         guard = AnomalyGuard.from_config(cfg)
@@ -979,6 +1046,7 @@ class Trainer:
                     continue  # retry this epoch index on the next window
                 guard.record(cost)
             self.epochs_completed += 1  # a good epoch: the sidecar's count
+            self.metrics.counter("epochs_total").inc()
             # EVERY process runs the eval — it is a global-mesh computation
             # (sharded-param strategies gather over collectives), so a
             # chief-only dispatch would hang or die once non-chief
@@ -1023,6 +1091,8 @@ class Trainer:
             logger.log_final(cost=final_cost)
             if self.summary_writer is not None:
                 self.summary_writer.flush()
+            self.metrics.flush_to(self.journal, component="trainer")
+            self.journal.flush()
         return {
             "accuracy": accuracy,
             "final_cost": final_cost,

@@ -7,19 +7,32 @@ that parsed the reference's output keep working:
   AvgTime: Xms`` (reference tfdist_between.py:102-106)
 - per-epoch: ``Test-Accuracy: A`` / ``Total Time: Ts`` (reference :109-110)
 - end: ``Final Cost: C`` / ``Done`` (reference :112,115)
+
+Round 10 (telemetry layer): every line is now rendered FROM a journal
+event — ``StepLogger`` builds the typed event first (``step``/``epoch``/
+``final``), emits it through the attached :class:`~observability.journal.
+EventJournal` (a no-op :class:`NullJournal` when none is attached), and
+prints :func:`observability.format.render`'s rendering of that event.
+The stdout bytes are byte-identical to the pre-journal output (pinned by
+tests/test_observability.py::test_step_logger_byte_parity); the journal
+is a machine-readable superset, never a replacement.
 """
 
 from __future__ import annotations
 
 import time
 
+from distributed_tensorflow_tpu.observability import format as obs_format
+from distributed_tensorflow_tpu.observability import journal as obs_journal
+
 
 class StepLogger:
     """Hot-loop logger with the reference's cadence and wording."""
 
-    def __init__(self, freq: int = 100, print_fn=print):
+    def __init__(self, freq: int = 100, print_fn=print, journal=None):
         self.freq = freq
         self._print = print_fn
+        self.journal = journal if journal is not None else obs_journal.get_journal()
         self._begin_time = time.time()
         self._window_start = time.time()
         self._window_count = 0
@@ -33,6 +46,13 @@ class StepLogger:
         of truth — the trainer gates its host sync on this same predicate."""
         return count % self.freq == 0 or count == batch_count
 
+    def _emit(self, kind: str, **fields) -> dict:
+        """Journal the event, print its rendering — the event is the
+        source; the line is a view of it."""
+        return obs_format.emit_line(
+            kind, journal=self.journal, print_fn=self._print, **fields
+        )
+
     def log_step_line(
         self,
         *,
@@ -43,12 +63,16 @@ class StepLogger:
         cost: float,
         avg_ms: float,
     ) -> None:
-        self._print(
-            "Step: %d," % step,
-            " Epoch: %2d," % (epoch + 1),
-            " Batch: %3d of %3d," % (batch + 1, batch_count),
-            " Cost: %.4f," % cost,
-            " AvgTime: %3.2fms" % avg_ms,
+        # Event fields carry the PRINTED (1-based) epoch/batch numbers, so
+        # the journal reads the way the reference's logs always have.
+        self._emit(
+            "step",
+            step=int(step),
+            epoch=int(epoch) + 1,
+            batch=int(batch) + 1,
+            batch_count=int(batch_count),
+            cost=float(cost),
+            avg_ms=float(avg_ms),
         )
 
     def maybe_log_step(
@@ -72,15 +96,22 @@ class StepLogger:
             self._window_start = time.time()
 
     def log_epoch(self, *, test_accuracy: float) -> None:
-        self._print("Test-Accuracy: %2.2f" % test_accuracy)
-        self._print("Total Time: %3.2fs" % float(time.time() - self._begin_time))
+        self._emit(
+            "epoch",
+            metric="Test-Accuracy",
+            value=float(test_accuracy),
+            total_time_s=float(time.time() - self._begin_time),
+        )
 
     def log_epoch_metric(self, name: str, value: float) -> None:
         """Epoch line for non-accuracy metrics (the LM's perplexity) — same
         shape as the reference's Test-Accuracy/Total Time pair."""
-        self._print("%s: %.4f" % (name, value))
-        self._print("Total Time: %3.2fs" % float(time.time() - self._begin_time))
+        self._emit(
+            "epoch",
+            metric=str(name),
+            value=float(value),
+            total_time_s=float(time.time() - self._begin_time),
+        )
 
     def log_final(self, *, cost: float) -> None:
-        self._print("Final Cost: %.4f" % cost)
-        self._print("Done")
+        self._emit("final", cost=float(cost))

@@ -5,6 +5,15 @@ loop (AvgTime/Total Time, reference tfdist_between.py:98-110) — kept as-is in
 ``utils/logging.py``. This module adds the TPU-native upgrade the survey
 prescribes: ``jax.profiler`` traces (XLA op-level timelines viewable in
 TensorBoard/Perfetto) and an on-demand profiling server.
+
+Round 10: both wrappers compose with the host-side span layer
+(``observability/spans.py``) — pass a :class:`~observability.spans.
+SpanRecorder` and the device trace window / annotation also lands as a
+host span, so ``obs_report --trace``'s chrome-trace export shows WHERE in
+the run the device capture happened. The device trace remains the
+authority on what the chip did; host spans are the authority on what the
+host waited for (and their dispatch flavor enforces the D2H barrier that
+``jax.profiler`` does not).
 """
 
 from __future__ import annotations
@@ -15,18 +24,26 @@ import jax
 
 
 @contextlib.contextmanager
-def trace(logdir: str):
+def trace(logdir: str, recorder=None):
     """Capture a device trace for the enclosed block::
 
         with profiler.trace("./logs/profile"):
             state, cost = train_step(state, x, y)
             float(cost)  # D2H fetch: the trustworthy barrier (utils/sync.py)
-    """
-    jax.profiler.start_trace(logdir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+
+    ``recorder`` (a SpanRecorder) additionally records the capture window
+    as a host span named ``jax_profiler_trace``."""
+    ctx = (
+        recorder.span("jax_profiler_trace", cat="profiler", logdir=logdir)
+        if recorder is not None
+        else contextlib.nullcontext()
+    )
+    with ctx:
+        jax.profiler.start_trace(logdir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
 
 
 def start_server(port: int = 9999):
@@ -35,6 +52,14 @@ def start_server(port: int = 9999):
     return jax.profiler.start_server(port)
 
 
-def annotate(name: str):
-    """Named region that shows up on the trace timeline."""
-    return jax.profiler.TraceAnnotation(name)
+@contextlib.contextmanager
+def annotate(name: str, recorder=None):
+    """Named region on the device trace timeline — and, when ``recorder``
+    is given, the same region as a host span (one name, both views)."""
+    ctx = (
+        recorder.span(name, cat="annotation")
+        if recorder is not None
+        else contextlib.nullcontext()
+    )
+    with ctx, jax.profiler.TraceAnnotation(name):
+        yield

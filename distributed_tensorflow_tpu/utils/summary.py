@@ -318,3 +318,47 @@ class SummaryWriter:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle emitter (round 10): ONE call site contract for the resilience/
+# elasticity signals. Before this, the restart/resize/rollback/world_size
+# scalars were each hand-wired at their call sites next to a hand-built
+# structured print — four copies of the same three-way fan-out (stdout line
+# + tfevents scalar + now the journal event) drifting independently. The
+# line wording lives in observability/format.py (grep-lint-enforced); this
+# helper owns the fan-out.
+# ---------------------------------------------------------------------------
+
+
+def lifecycle_event(
+    kind: str,
+    *,
+    print_fn=None,
+    journal=None,
+    writer: "SummaryWriter | None" = None,
+    scalar: tuple | None = None,
+    **fields,
+) -> dict:
+    """Emit one lifecycle signal everywhere it belongs:
+
+    - a typed journal event (``observability.format.emit_line``; the
+      process-default :class:`~observability.journal.NullJournal` when no
+      journal is attached),
+    - the structured stdout line rendered FROM that event (byte-identical
+      to the pre-journal wording) via ``print_fn``,
+    - and, when ``writer`` and ``scalar=(tag, value, step)`` are given,
+      the tfevents scalar the TensorBoard surface keeps showing.
+
+    Returns the event dict. tests/test_observability.py asserts each
+    lifecycle kind lands in BOTH tfevents and the journal through here.
+    """
+    from distributed_tensorflow_tpu.observability import format as obs_format
+
+    ev = obs_format.emit_line(
+        kind, journal=journal, print_fn=print_fn, **fields
+    )
+    if writer is not None and scalar is not None:
+        tag, value, step = scalar
+        writer.add_scalar(tag, float(value), int(step))
+    return ev
