@@ -121,6 +121,28 @@ class SlotKVCache(NamedTuple):
     lengths: jax.Array  # [S] int32 — tokens written into each slot's cache
 
 
+class PagedKVCache(NamedTuple):
+    """Serving-side decode state over a shared BLOCK POOL (vLLM's
+    PagedAttention layout): K/V for every slot live in one pool of
+    fixed-size blocks, and each slot maps logical position ``p`` to
+    ``pool[block_tables[s, p // bs], p % bs]``. Occupancy scales with
+    blocks actually held, not ``slots × max_len`` slabs — the paged
+    engine (``serve.py paged=True``) admits by free blocks, and two
+    slots may map the SAME physical block for a shared prompt prefix
+    (copy-on-write via the host-side refcounts in ``serve_pool.py``;
+    shared blocks are immutable full prompt blocks, so no copy ever
+    happens). Written by :meth:`GPTLM.extend_paged` /
+    :meth:`GPTLM.decode_paged`; device primitives in
+    ``ops/paged_attention.py``. Unused table entries read garbage that
+    the validity masks keep out of every softmax (the stale-bytes-
+    unreachable stance of :class:`SlotKVCache`)."""
+
+    k: jax.Array  # [num_layers, num_blocks, block_size, Hkv, Dh]
+    v: jax.Array  # [num_layers, num_blocks, block_size, Hkv, Dh]
+    block_tables: jax.Array  # [S, max_blocks] int32 — physical block ids
+    lengths: jax.Array  # [S] int32 — tokens written for each slot
+
+
 class KVCache(NamedTuple):
     """Decode state: per-layer keys/values at a static cache length, plus
     the number of tokens decoded so far (``length`` is ABSOLUTE — it keeps
@@ -1095,17 +1117,22 @@ class GPTLM:
         )  # [S, 1, d]
         return self._logits(params, h_last)[:, 0], new_cache
 
-    def _decode_block_slots(self, blk, h, ck, cv, lengths, act):
-        """Per-slot single-token block step — :meth:`_decode_block` with a
-        VECTOR of positions: h [S, 1, d], ck/cv [S, cache_len, Hkv, Dh],
-        ``lengths`` [S] (each row's write position), ``act`` [S] bool
-        (inactive rows write their old K/V back — a no-op — and their
-        outputs are garbage the caller discards). Row-wise math is
-        _decode_block's exactly (pinned by test_serve.py's token-parity
-        tests); the scalar ``dynamic_update_slice`` becomes a per-row
-        scatter and the validity mask broadcasts per row."""
+    def _decode_block_step(self, blk, h, lengths, cache_update):
+        """Shared per-slot single-token block math (layernorm / QKV /
+        rope / GQA attention / FFN) for BOTH single-token decode cache
+        layouts. ``cache_update(k, v)`` owns everything layout-specific:
+        it commits the fresh K/V row ([S, 1, Hkv, Dh]) to its cache,
+        returns the per-slot contiguous K/V to attend over
+        ([S, C, Hkv, Dh] each), the validity mask [S, C], and the
+        updated cache state threaded back to the caller. Keeping the
+        math in ONE body is what keeps the slab and paged paths in
+        lockstep (their bitwise equality is pinned by test_gpt.py /
+        test_serve.py parity tests)."""
+        from distributed_tensorflow_tpu.ops.ring_attention import (
+            group_query_heads,
+        )
+
         s = h.shape[0]
-        c = self.cache_len
         hn = _layernorm(h, blk.ln1_scale, blk.ln1_bias)
         kv_shape = (s, 1, self.num_kv_heads, self.head_dim)
         q = self._dot(hn, blk.wq).reshape(s, 1, self.num_heads, self.head_dim)
@@ -1115,29 +1142,11 @@ class GPTLM:
             pos = lengths[:, None]  # [S, 1] — per-row absolute position
             q = _rope(q, pos)
             k = _rope(k, pos)
-        k = k.astype(ck.dtype)
-        v = v.astype(cv.dtype)
-        rows = jnp.arange(s)
-        slot = lengths % c if self.window is not None else lengths  # [S]
-        kw = jnp.where(act[:, None, None], k[:, 0], ck[rows, slot])
-        vw = jnp.where(act[:, None, None], v[:, 0], cv[rows, slot])
-        ck = ck.at[rows, slot].set(kw)
-        cv = cv.at[rows, slot].set(vw)
-        from distributed_tensorflow_tpu.ops.ring_attention import (
-            group_query_heads,
-        )
-
+        ck, cv, valid, state = cache_update(k, v)
         qg = group_query_heads(q[:, 0], self.num_kv_heads)
         scores = jnp.einsum(
             "shgd,skhd->shgk", qg, ck, preferred_element_type=jnp.float32
         ) / jnp.sqrt(jnp.asarray(self.head_dim, jnp.float32))
-        idx = jnp.arange(c)[None, :]  # [1, c]
-        if self.window is not None:
-            # Same rolling-buffer identity as _decode_block, per row.
-            slot_pos = lengths[:, None] - jnp.mod(slot[:, None] - idx, c)
-            valid = slot_pos >= 0  # [S, c]
-        else:
-            valid = idx <= lengths[:, None]  # [S, c]
         scores = jnp.where(valid[:, None, None, :], scores, -1e30)
         w = jax.nn.softmax(scores, axis=-1)
         attn = jnp.einsum(
@@ -1149,7 +1158,40 @@ class GPTLM:
         h = h + self._dot(attn.reshape(s, 1, self.model_dim), blk.wo)
         hn2 = _layernorm(h, blk.ln2_scale, blk.ln2_bias)
         ffn_out, _ = self._ffn(blk, hn2)  # aux unused: decode never drops
-        return h + ffn_out, ck, cv
+        return h + ffn_out, state
+
+    def _decode_block_slots(self, blk, h, ck0, cv0, lengths, act):
+        """Per-slot single-token block step — :meth:`_decode_block` with a
+        VECTOR of positions: h [S, 1, d], ck0/cv0 [S, cache_len, Hkv, Dh],
+        ``lengths`` [S] (each row's write position), ``act`` [S] bool
+        (inactive rows write their old K/V back — a no-op — and their
+        outputs are garbage the caller discards). Row-wise math is
+        _decode_block's exactly (pinned by test_serve.py's token-parity
+        tests); the scalar ``dynamic_update_slice`` becomes a per-row
+        scatter and the validity mask broadcasts per row."""
+        s = h.shape[0]
+        c = self.cache_len
+
+        def cache_update(k, v):
+            k = k.astype(ck0.dtype)
+            v = v.astype(cv0.dtype)
+            rows = jnp.arange(s)
+            slot = lengths % c if self.window is not None else lengths
+            kw = jnp.where(act[:, None, None], k[:, 0], ck0[rows, slot])
+            vw = jnp.where(act[:, None, None], v[:, 0], cv0[rows, slot])
+            ck = ck0.at[rows, slot].set(kw)
+            cv = cv0.at[rows, slot].set(vw)
+            idx = jnp.arange(c)[None, :]  # [1, c]
+            if self.window is not None:
+                # Same rolling-buffer identity as _decode_block, per row.
+                slot_pos = lengths[:, None] - jnp.mod(slot[:, None] - idx, c)
+                valid = slot_pos >= 0  # [S, c]
+            else:
+                valid = idx <= lengths[:, None]  # [S, c]
+            return ck, cv, valid, (ck, cv)
+
+        h, (ck, cv) = self._decode_block_step(blk, h, lengths, cache_update)
+        return h, ck, cv
 
     def decode_slots(
         self,
@@ -1195,6 +1237,189 @@ class GPTLM:
             nks.append(ck)
             nvs.append(cv)
         new_cache = SlotKVCache(
+            k=jnp.stack(nks),
+            v=jnp.stack(nvs),
+            lengths=cache.lengths + act.astype(jnp.int32),
+        )
+        return self._logits(params, h)[:, 0], new_cache
+
+    # -- paged decoding (block-table cache, serve.py paged=True) -----------
+
+    def paged_blocks_per_slot(self, block_size: int) -> int:
+        """Static block-table width: blocks to address ``max_len``
+        positions (the table is sized for the worst request; the POOL is
+        what paging shrinks)."""
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        return -(-self.max_len // block_size)
+
+    def empty_paged_cache(
+        self, slots: int, num_blocks: int, block_size: int = 16
+    ) -> PagedKVCache:
+        """A vacant :class:`PagedKVCache`: ``num_blocks`` pool blocks of
+        ``block_size`` positions each (the HBM actually reserved —
+        compare the slab's ``slots × cache_len``), all-zero block tables
+        (garbage mappings, unreachable while lengths are 0). Windowed
+        models keep FULL history here — the paged layout addresses
+        absolutely and windows by mask, trading the rolling buffer's
+        O(W) bound for block sharing (``serve_pool.PrefixCache``)."""
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        nb_slot = self.paged_blocks_per_slot(block_size)
+        shape = (
+            self.num_layers,
+            num_blocks,
+            block_size,
+            self.num_kv_heads,
+            self.head_dim,
+        )
+        z = jnp.zeros(shape, self.compute_dtype)
+        return PagedKVCache(
+            k=z,
+            v=z,
+            block_tables=jnp.zeros((slots, nb_slot), jnp.int32),
+            lengths=jnp.zeros((slots,), jnp.int32),
+        )
+
+    def extend_paged(
+        self,
+        params: GPTLMParams,
+        cache: PagedKVCache,
+        tokens: jax.Array,
+        suffix_lens: jax.Array,
+        prefix_lens: jax.Array,
+        admit: jax.Array,
+    ):
+        """Batched ragged EXTEND through the block tables: run suffix
+        block ``tokens`` [S, L] (right-padded rows, real lengths
+        ``suffix_lens`` [S]) at absolute positions
+        ``prefix_lens[s] + 0..L-1``, attending each suffix query over the
+        slot's cached prefix (read through its block table) plus the
+        suffix itself causally, and scatter the suffix K/V into the pool
+        where ``admit``. Returns (per-position logits [S, L, vocab],
+        cache with K/V written). ``lengths``/``block_tables`` are NOT
+        touched — the caller owns commit semantics, because the two
+        callers commit differently: admission prefill commits
+        ``prefix + suffix`` wholesale, the speculative verify graph
+        commits only ``accepted + 1`` tokens (rejected drafts' K/V stay
+        as unreachable garbage past ``lengths`` and are overwritten by
+        the next write at that position).
+
+        ``prefix_lens = 0`` is plain ragged prefill (the paged analog of
+        :meth:`prefill_slots`); block-aligned nonzero prefixes are the
+        prefix-cache hit path — the shared system prompt's K/V is read,
+        never recomputed. The caller guarantees every written position
+        ``< prefix + suffix ≤`` the slot's reserved table extent (the
+        engine budgets ``prompt + max_new`` blocks at admission)."""
+        from distributed_tensorflow_tpu.ops import paged_attention as paged
+
+        s, l = tokens.shape
+        positions = prefix_lens[:, None] + jnp.arange(l)[None, :]  # [S, L]
+        token_mask = jnp.arange(l)[None, :] < suffix_lens[:, None]
+        h = self._embed_tokens(params, tokens, positions)
+
+        def body(h, xs):
+            blk, pk, pv = xs
+
+            def attend(q, k, v):
+                kview = paged.gather_block_view(pk, cache.block_tables)
+                vview = paged.gather_block_view(pv, cache.block_tables)
+                return paged.paged_extend_attention(
+                    q, k, v, kview, vview, positions, prefix_lens,
+                    suffix_lens, window=self.window,
+                )
+
+            h, kv, _ = self._block(
+                blk, h, attend=attend, positions=positions,
+                token_mask=token_mask,
+            )
+            return h, kv
+
+        h, (ks, vs) = lax.scan(body, h, (params.blocks, cache.k, cache.v))
+        ks = ks.astype(cache.k.dtype)  # [n, S, L, Hkv, Dh]
+        vs = vs.astype(cache.v.dtype)
+        valid = token_mask & admit[:, None]
+        nk = paged.scatter_token_kv_all_layers(
+            cache.k, ks, cache.block_tables, positions, valid
+        )
+        nv = paged.scatter_token_kv_all_layers(
+            cache.v, vs, cache.block_tables, positions, valid
+        )
+        return self._logits(params, h), cache._replace(k=nk, v=nv)
+
+    def _decode_block_paged(self, blk, h, pk, pv, block_tables, lengths,
+                            act):
+        """Per-slot single-token block step against the BLOCK POOL —
+        :meth:`_decode_block_slots` with the slab row replaced by a
+        scatter-then-gather through the block tables: the fresh K/V row
+        lands at ``(table[s, len // bs], len % bs)`` (inactive rows drop
+        at the sentinel), then the slot's contiguous view is gathered
+        back and attended with the same ``idx <= lengths`` validity.
+        Windowed models band by mask (``idx > lengths − W``) — absolute
+        addressing, no rolling arithmetic."""
+        from distributed_tensorflow_tpu.ops import paged_attention as paged
+
+        def cache_update(k, v):
+            k = k.astype(pk.dtype)
+            v = v.astype(pv.dtype)
+            nk = paged.scatter_token_kv(
+                pk, k, block_tables, lengths[:, None], act[:, None]
+            )
+            nv = paged.scatter_token_kv(
+                pv, v, block_tables, lengths[:, None], act[:, None]
+            )
+            ck = paged.gather_block_view(nk, block_tables)  # [S, C, Hkv, Dh]
+            cv = paged.gather_block_view(nv, block_tables)
+            idx = jnp.arange(ck.shape[1])[None, :]  # [1, C] absolute
+            valid = idx <= lengths[:, None]  # [S, C]
+            if self.window is not None:
+                valid &= idx > lengths[:, None] - self.window
+            return ck, cv, valid, (nk, nv)
+
+        h, (nk, nv) = self._decode_block_step(blk, h, lengths, cache_update)
+        return h, nk, nv
+
+    def decode_paged(
+        self,
+        params: GPTLMParams,
+        token: jax.Array,
+        cache: PagedKVCache,
+        active: jax.Array | None = None,
+    ):
+        """Append one token per slot through the block tables — the
+        paged counterpart of :meth:`decode_slots` (same masking
+        contract: inactive rows untouched, garbage logits to discard;
+        layer loop UNROLLED for the same double-buffering reason).
+        The caller guarantees each active slot's table covers position
+        ``lengths[s]`` (the engine reserves ``prompt + max_new`` blocks
+        at admission, so generation never outgrows the table)."""
+        act = (
+            jnp.ones((token.shape[0],), bool) if active is None else active
+        )
+        if not isinstance(cache.lengths, jax.core.Tracer) and not isinstance(
+            act, jax.core.Tracer
+        ):
+            worst = int(jnp.max(jnp.where(act, cache.lengths, 0)))
+            if bool(jnp.any(act)) and worst >= self.max_len:
+                raise ValueError(
+                    f"KV cache full: an active slot is at length {worst} == "
+                    f"max_len {self.max_len}; increase max_len"
+                )
+        h = self._embed_tokens(
+            params, token[:, None], cache.lengths[:, None]
+        )
+        nks, nvs = [], []
+        for i in range(self.num_layers):
+            blk = jax.tree.map(lambda x: x[i], params.blocks)
+            h, pk, pv = self._decode_block_paged(
+                blk, h, cache.k[i], cache.v[i], cache.block_tables,
+                cache.lengths, act,
+            )
+            nks.append(pk)
+            nvs.append(pv)
+        new_cache = cache._replace(
             k=jnp.stack(nks),
             v=jnp.stack(nvs),
             lengths=cache.lengths + act.astype(jnp.int32),

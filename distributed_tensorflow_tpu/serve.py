@@ -52,6 +52,12 @@ from distributed_tensorflow_tpu.models.gpt import GPTLM, GPTLMParams
 from distributed_tensorflow_tpu.observability import journal as obs_journal
 from distributed_tensorflow_tpu.observability.metrics import MetricsRegistry
 from distributed_tensorflow_tpu.observability.spans import SpanRecorder
+from distributed_tensorflow_tpu.serve_pool import (
+    BlockAllocator,
+    PrefixCache,
+    blocks_for,
+    lookup_draft,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -208,6 +214,26 @@ class _DecodeState(NamedTuple):
     eos: jax.Array  # [S] i32 — -1: no EOS stop
 
 
+class _PagedState(NamedTuple):
+    """:class:`_DecodeState` for the paged engine: the slab rows become
+    the shared block pool plus per-slot block tables (same scheduler
+    fields otherwise, so the host loop is mode-agnostic)."""
+
+    k: jax.Array  # [layers, num_blocks, block_size, Hkv, Dh]
+    v: jax.Array
+    block_tables: jax.Array  # [S, max_blocks] i32
+    lengths: jax.Array  # [S] i32 — tokens written into each slot's cache
+    last_tok: jax.Array  # [S] i32 — most recent token (next decode input)
+    key: jax.Array  # [S, ...] u32 — per-slot PRNG key data
+    emitted: jax.Array  # [S] i32 — generated tokens so far
+    budget: jax.Array  # [S] i32 — max_new for the resident request
+    finished: jax.Array  # [S] bool — True: slot idle (done or vacant)
+    greedy: jax.Array  # [S] bool
+    temp: jax.Array  # [S] f32
+    top_p: jax.Array  # [S] f32
+    eos: jax.Array  # [S] i32 — -1: no EOS stop
+
+
 class _Request:
     __slots__ = (
         "rid", "tokens", "config", "out", "done",
@@ -247,6 +273,12 @@ class TextServer:
         slots: int = 8,
         buckets: tuple[int, ...] | None = None,
         chunk: int = 32,
+        paged: bool = False,
+        block_size: int = 16,
+        kv_blocks: int | None = None,
+        prefix_caching: bool = True,
+        spec_draft: int = 0,
+        spec_ngram: int = 2,
         journal=None,
         metrics: MetricsRegistry | None = None,
     ):
@@ -254,11 +286,61 @@ class TextServer:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
+        if spec_draft < 0:
+            raise ValueError(f"spec_draft must be >= 0, got {spec_draft}")
+        if spec_ngram < 1:
+            raise ValueError(f"spec_ngram must be >= 1, got {spec_ngram}")
+        if spec_draft and not paged:
+            raise ValueError(
+                "speculative decoding requires the paged cache "
+                "(paged=True): the verify pass extends through block "
+                "tables"
+            )
         self.model = model
         self.params = params
         self.tokenizer = tokenizer
         self.slots = slots
         self.chunk = chunk
+        # Paged mode (round 11): KV lives in a shared pool of
+        # `kv_blocks` blocks of `block_size` positions; slots map
+        # logical positions through block tables, admission is gated on
+        # FREE BLOCKS (a request reserves ceil((prompt+max_new)/bs)
+        # blocks, minus prefix-cache hits), and an oversized request
+        # queues without blocking shorter ones behind it. Default pool
+        # = slots × ceil(max_len/bs) — the slab footprint for full-
+        # context models, so paged=True alone changes layout, not
+        # capacity; density comes from shrinking kv_blocks below that
+        # (or raising slots above it) for short-request mixes. CAVEAT:
+        # windowed models keep FULL history in the paged layout
+        # (absolute-position addressing; the slab's rolling buffer is
+        # only min(window, max_len) rows), so for window << max_len the
+        # default pool is ~max_len/window times the slab's KV HBM —
+        # size kv_blocks explicitly there.
+        self.paged = paged
+        self.block_size = int(block_size)
+        self.spec_draft = int(spec_draft)
+        self.spec_ngram = int(spec_ngram)
+        self._alloc: BlockAllocator | None = None
+        self._prefix: PrefixCache | None = None
+        if paged:
+            nb_slot = model.paged_blocks_per_slot(self.block_size)
+            self.kv_blocks = (
+                int(kv_blocks) if kv_blocks is not None else slots * nb_slot
+            )
+            if self.kv_blocks < 1:
+                raise ValueError(
+                    f"kv_blocks must be >= 1, got {self.kv_blocks}"
+                )
+            self._alloc = BlockAllocator(self.kv_blocks)
+            if prefix_caching:
+                self._prefix = PrefixCache(self._alloc, self.block_size)
+            # Host-authoritative block tables (the device copy is an
+            # input of every prefill dispatch) + per-slot held blocks
+            # for release at completion.
+            self._host_tables = np.zeros((slots, nb_slot), np.int32)
+            self._slot_blocks: list[list[int] | None] = [None] * slots
+        else:
+            self.kv_blocks = 0
         # Serving telemetry (round 10, observability/): admissions and
         # completions as journal events (rid, TTFT, latency, tokens),
         # queue/occupancy gauges + latency histograms in the registry,
@@ -288,8 +370,14 @@ class TextServer:
         self._next_rid = 0
         self._results: dict[int, _Request] = {}
         self._state = self._init_state()
-        self._prefill_jit = jax.jit(self._prefill_graph)
+        self._prefill_jit = jax.jit(
+            self._paged_prefill_graph if paged else self._prefill_graph
+        )
         self._chunk_jit = jax.jit(self._chunk_graph)
+        self._verify_jit = jax.jit(self._verify_graph) if spec_draft else None
+        if paged:
+            self.metrics.gauge("kv_blocks_total").set(self.kv_blocks)
+            self.metrics.gauge("kv_blocks_used").set(0)
 
     # -- constructors ------------------------------------------------------
 
@@ -316,14 +404,10 @@ class TextServer:
 
     # -- compiled graphs ---------------------------------------------------
 
-    def _init_state(self) -> _DecodeState:
-        cache = self.model.empty_slot_cache(self.slots)
+    def _init_state(self):
         s = self.slots
         kd = jax.random.key_data(jax.random.split(jax.random.key(0), s))
-        return _DecodeState(
-            k=cache.k,
-            v=cache.v,
-            lengths=cache.lengths,
+        common = dict(
             last_tok=jnp.zeros((s,), jnp.int32),
             key=kd,
             emitted=jnp.zeros((s,), jnp.int32),
@@ -333,6 +417,21 @@ class TextServer:
             temp=jnp.ones((s,), jnp.float32),
             top_p=jnp.ones((s,), jnp.float32),
             eos=jnp.full((s,), -1, jnp.int32),
+        )
+        if self.paged:
+            cache = self.model.empty_paged_cache(
+                s, self.kv_blocks, self.block_size
+            )
+            return _PagedState(
+                k=cache.k,
+                v=cache.v,
+                block_tables=cache.block_tables,
+                lengths=cache.lengths,
+                **common,
+            )
+        cache = self.model.empty_slot_cache(s)
+        return _DecodeState(
+            k=cache.k, v=cache.v, lengths=cache.lengths, **common
         )
 
     def _pick(self, logits, key_data, greedy, temp, top_p):
@@ -383,9 +482,19 @@ class TextServer:
         carried, sub = jax.vmap(row)(key_data)
         return carried, sub
 
-    def _cache(self, st: _DecodeState):
-        from distributed_tensorflow_tpu.models.gpt import SlotKVCache
+    def _cache(self, st):
+        from distributed_tensorflow_tpu.models.gpt import (
+            PagedKVCache,
+            SlotKVCache,
+        )
 
+        if self.paged:
+            return PagedKVCache(
+                k=st.k,
+                v=st.v,
+                block_tables=st.block_tables,
+                lengths=st.lengths,
+            )
         return SlotKVCache(k=st.k, v=st.v, lengths=st.lengths)
 
     def _prefill_graph(
@@ -421,17 +530,132 @@ class TextServer:
             eos=eos_eff,
         )
 
+    def _paged_prefill_graph(
+        self, params, st, tokens, suffix_lens, prefix_lens, admit,
+        block_tables, key, budget, greedy, temp, top_p, eos,
+    ):
+        """Paged admission round: ragged batched EXTEND through the
+        block tables (prefix-cache hits arrive as nonzero
+        ``prefix_lens`` — those blocks are read, not recomputed; the
+        host strips the cached prefix, so ``tokens`` is only each
+        request's suffix padded to its bucket) + the first pick from
+        each row's last real suffix position. ``block_tables`` [S, NB]
+        is the host-authoritative table snapshot (non-admitted rows
+        unchanged by construction)."""
+        cache = self._cache(st)._replace(block_tables=block_tables)
+        logits, cache = self.model.extend_paged(
+            params, cache, tokens, suffix_lens, prefix_lens, admit
+        )
+        last_lg = jnp.take_along_axis(
+            logits,
+            jnp.maximum(suffix_lens - 1, 0)[:, None, None],
+            axis=1,
+        )[:, 0]  # [S, vocab]
+        keys = jnp.where(admit[:, None], key, st.key)
+        carried, sub = self._split_keys(keys)
+        first = self._pick(last_lg, sub, greedy, temp, top_p)
+        sel = lambda n, o: jnp.where(admit, n, o)  # noqa: E731
+        eos_eff = sel(eos, st.eos)
+        fin = sel((first == eos_eff) | (budget <= 1), st.finished)
+        return st._replace(
+            k=cache.k,
+            v=cache.v,
+            block_tables=block_tables,
+            lengths=sel(prefix_lens + suffix_lens, st.lengths),
+            last_tok=sel(first, st.last_tok),
+            key=jnp.where(admit[:, None], carried, st.key),
+            emitted=sel(jnp.ones_like(st.emitted), st.emitted),
+            budget=sel(budget, st.budget),
+            finished=fin,
+            greedy=sel(greedy, st.greedy),
+            temp=jnp.where(admit, temp, st.temp),
+            top_p=jnp.where(admit, top_p, st.top_p),
+            eos=eos_eff,
+        )
+
+    def _verify_graph(self, params, st, suffix, suffix_lens):
+        """One speculative verify round (the paged engine's decode tick
+        when ``spec_draft > 0``): per active slot the host sent
+        ``suffix = [last_tok, d_1..d_k]`` (k = that slot's draft length,
+        0 for sampled slots — speculation is greedy-only) — ONE batched
+        extend scores every draft position, then GREEDY-EXACT
+        acceptance in-graph: target ``tgt[i] = argmax(logits[i])``
+        (position 0 through :meth:`_pick`, so sampled slots keep their
+        PRNG chain), draft ``d_i`` is accepted iff it equals
+        ``tgt[i-1]`` and every earlier draft was accepted, and the
+        emitted run is ``tgt[0..n_acc]`` — each accepted position's
+        target IS the draft token, plus the first-mismatch correction,
+        so the stream is the pure greedy stream by construction (the
+        parity contract survives speculation; a bad draft costs wasted
+        compute, never a changed token). EOS/budget truncate the run
+        exactly as the chunk scan would token by token; ``lengths``
+        advance only by tokens actually emitted — rejected drafts' K/V
+        stay past ``lengths`` as unreachable garbage, overwritten by
+        the next write at those positions. Returns
+        ``(state, tokens [D+1, S], valid [D+1, S])`` — the chunk
+        graph's host contract, so the scheduler loop is shared."""
+        max_len = self.model.max_len
+        act = ~st.finished & (st.lengths < max_len)
+        logits, cache = self.model.extend_paged(
+            params, self._cache(st), suffix, suffix_lens, st.lengths, act
+        )
+        s, d1 = suffix.shape
+        amax = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, D+1]
+        carried, sub = self._split_keys(st.key)
+        t0 = self._pick(logits[:, 0], sub, st.greedy, st.temp, st.top_p)
+        tgt = amax.at[:, 0].set(t0)
+        pos = jnp.arange(d1)
+        # Leading accepted-draft run: d_i == tgt_{i-1}, all-prior rule.
+        ok = (suffix[:, 1:] == tgt[:, :-1]) & (
+            pos[None, 1:] < suffix_lens[:, None]
+        )
+        n_acc = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(1)  # [S]
+        eos_hit = tgt == st.eos[:, None]
+        prev_eos = (
+            jnp.cumsum(eos_hit.astype(jnp.int32), axis=1)
+            - eos_hit.astype(jnp.int32)
+        ) > 0
+        valid = (
+            act[:, None]
+            & (pos[None] <= n_acc[:, None])
+            & (pos[None] < (st.budget - st.emitted)[:, None])
+            & ~prev_eos
+        )
+        n_emit = valid.sum(1).astype(jnp.int32)  # >= 1 for active slots
+        emitted = st.emitted + n_emit
+        last = jnp.take_along_axis(
+            tgt, jnp.maximum(n_emit - 1, 0)[:, None], axis=1
+        )[:, 0]
+        fin = st.finished | (
+            act & ((eos_hit & valid).any(1) | (emitted >= st.budget))
+        )
+        st = st._replace(
+            k=cache.k,
+            v=cache.v,
+            lengths=st.lengths + n_emit,
+            last_tok=jnp.where(act, last, st.last_tok),
+            key=jnp.where(act[:, None], carried, st.key),
+            emitted=emitted,
+            finished=fin,
+        )
+        return st, tgt.T, valid.T
+
     def _chunk_graph(self, params, st):
         """``chunk`` decode steps as one ``lax.scan``: per step every
         unfinished slot advances one token (decode + in-graph pick),
         finished/vacant slots ride along masked. Returns the new state
         plus the [chunk, S] token block and its validity mask — the only
-        per-chunk host traffic."""
+        per-chunk host traffic. One body for both cache layouts: the
+        paged step differs only in how the cache row is addressed
+        (:meth:`GPTLM.decode_paged` vs :meth:`GPTLM.decode_slots`)."""
         max_len = self.model.max_len
+        decode = (
+            self.model.decode_paged if self.paged else self.model.decode_slots
+        )
 
         def body(st, _):
             act = ~st.finished & (st.lengths < max_len)
-            logits, cache = self.model.decode_slots(
+            logits, cache = decode(
                 params, st.last_tok, self._cache(st), active=act
             )
             carried, sub = self._split_keys(st.key)
@@ -485,6 +709,16 @@ class TextServer:
                 f"prompt {tokens.size} + max_new {config.max_new} exceeds "
                 f"max_len {self.model.max_len}"
             )
+        if self.paged:
+            need = blocks_for(
+                tokens.size + config.max_new, self.block_size
+            )
+            if need > self.kv_blocks:
+                raise ValueError(
+                    f"request needs {need} KV blocks but the pool holds "
+                    f"{self.kv_blocks}; raise kv_blocks or shrink the "
+                    "request"
+                )
         rid = self._next_rid
         self._next_rid += 1
         req = _Request(rid, tokens, config)
@@ -509,7 +743,190 @@ class TextServer:
 
     def _admit(self) -> None:
         """Move queued requests into free slots; one prefill dispatch per
-        length bucket among this round's admissions."""
+        length bucket among this round's admissions. Paged mode admits by
+        free BLOCKS (worst-case reservation minus prefix-cache hits) with
+        no head-of-line blocking; slab mode by free slots alone."""
+        if self.paged:
+            self._admit_paged()
+        else:
+            self._admit_slab()
+
+    def _plan_admission(self, req: _Request):
+        """Block reservation for one request: prefix-cache match
+        (matched blocks retained IMMEDIATELY, so this round's own
+        evictions cannot free them out from under the plan), worst-case
+        new-block reservation for ``prompt + max_new`` (admission never
+        overcommits, so generation never OOMs mid-flight), LRU eviction
+        of cache-only blocks under pressure. Returns None — releasing
+        any retains — when the request does not fit right now."""
+        bs = self.block_size
+        total = blocks_for(int(req.tokens.size) + req.config.max_new, bs)
+        matched: list[int] = []
+        if self._prefix is not None:
+            matched = self._prefix.match(req.tokens)
+            for b in matched:
+                self._alloc.retain(b)
+        n_new = total - len(matched)
+        if not self._alloc.can_alloc(n_new) and self._prefix is not None:
+            deficit = n_new - self._alloc.free_blocks
+            # Evict only when eviction can actually make this request
+            # fit — a hopeless flush would trade the warm prefix cache
+            # for nothing and the request would still be skipped.
+            if self._prefix.evictable_blocks() >= deficit:
+                self._prefix.evict(deficit)
+        if not self._alloc.can_alloc(n_new):
+            for b in matched:
+                self._alloc.release(b)
+            return None
+        return {
+            "table": matched + self._alloc.alloc(n_new),
+            "matched": len(matched),
+            "new": n_new,
+        }
+
+    def _admit_member_row(
+        self, slot, req, lb, key, budget, greedy, temp, top_p, eos,
+        journal_extra=None,
+    ) -> None:
+        """Per-member sampling/budget row + admission telemetry shared by
+        BOTH engine modes — a ``GenerationConfig`` field wired here
+        reaches the slab and paged admission paths together (they must
+        never drift: the parity contract spans both)."""
+        c = req.config
+        key[slot] = np.asarray(
+            jax.random.key_data(jax.random.key(c.seed))
+        )
+        budget[slot] = c.max_new
+        greedy[slot] = c.greedy
+        temp[slot] = c.temperature
+        top_p[slot] = c.top_p
+        eos[slot] = -1 if c.eos_id is None else c.eos_id
+        self._slot_req[slot] = req
+        req.t_admit = time.perf_counter()
+        self.metrics.counter("admissions_total").inc()
+        self.journal.emit(
+            "admission",
+            rid=req.rid,
+            slot=int(slot),
+            bucket=int(lb),
+            prompt_len=int(req.tokens.size),
+            **(journal_extra or {}),
+            queue_wait_s=round(req.t_admit - req.t_submit, 6),
+        )
+
+    def _record_first_token(self, slot, req, first, fin, t_first) -> None:
+        """Post-prefill bookkeeping shared by both engine modes: TTFT,
+        the admission's first generated token, early EOS/budget finish."""
+        req.t_first = t_first
+        self.metrics.histogram("ttft_s").observe(t_first - req.t_submit)
+        req.out.append(int(first[slot]))
+        if fin[slot]:
+            self._finish(slot)
+
+    def _admit_paged(self) -> None:
+        free = self._free_slots()
+        if not free or not self._queue:
+            return
+        batch: list[tuple[int, _Request, dict]] = []
+        skipped: deque[_Request] = deque()
+        while free and self._queue:
+            req = self._queue.popleft()
+            plan = self._plan_admission(req)
+            if plan is None:
+                # No head-of-line blocking: a request the pool cannot
+                # hold yet waits WITHOUT starving shorter requests
+                # behind it (relative FIFO order is preserved both among
+                # the admitted and among the skipped).
+                skipped.append(req)
+                continue
+            batch.append((free.pop(0), req, plan))
+        skipped.extend(self._queue)
+        self._queue = skipped
+        self.metrics.gauge("queue_depth").set(len(self._queue))
+        if not batch:
+            return
+        s = self.slots
+        by_bucket: dict[int, list] = {}
+        for slot, req, plan in batch:
+            prefix_len = plan["matched"] * self.block_size
+            suffix = req.tokens[prefix_len:]
+            row = self._host_tables[slot]
+            row[:] = 0
+            row[: len(plan["table"])] = plan["table"]
+            self._slot_blocks[slot] = list(plan["table"])
+            by_bucket.setdefault(self.bucket_for(suffix.size), []).append(
+                (slot, req, plan, prefix_len, suffix)
+            )
+        for lb, members in sorted(by_bucket.items()):
+            tokens = np.zeros((s, lb), np.int32)
+            slens = np.ones((s,), np.int32)  # suffix lens must be >= 1
+            plens = np.zeros((s,), np.int32)  # cached-prefix lens
+            admit = np.zeros((s,), bool)
+            key = np.array(self._state.key)  # writable host copy
+            budget = np.zeros((s,), np.int32)
+            greedy = np.ones((s,), bool)
+            temp = np.ones((s,), np.float32)
+            top_p = np.ones((s,), np.float32)
+            eos = np.full((s,), -1, np.int32)
+            for slot, req, plan, prefix_len, suffix in members:
+                tokens[slot, : suffix.size] = suffix
+                slens[slot] = suffix.size
+                plens[slot] = prefix_len
+                admit[slot] = True
+                miss = 0
+                if self._prefix is not None:
+                    miss = (
+                        self._prefix.matchable_blocks(int(req.tokens.size))
+                        - plan["matched"]
+                    )
+                    self.metrics.counter("prefix_cache_hits").inc(
+                        plan["matched"]
+                    )
+                    self.metrics.counter("prefix_cache_misses").inc(miss)
+                self._admit_member_row(
+                    slot, req, lb, key, budget, greedy, temp, top_p, eos,
+                    journal_extra=dict(
+                        prefix_len=int(prefix_len),
+                        prefix_hit_blocks=int(plan["matched"]),
+                        prefix_miss_blocks=int(miss),
+                        new_blocks=int(plan["new"]),
+                    ),
+                )
+            with self.spans.dispatch(
+                "prefill", bucket=int(lb), admitted=len(members)
+            ) as sp:
+                self._state = self._prefill_jit(
+                    self.params,
+                    self._state,
+                    jnp.asarray(tokens),
+                    jnp.asarray(slens),
+                    jnp.asarray(plens),
+                    jnp.asarray(admit),
+                    jnp.asarray(self._host_tables),
+                    jnp.asarray(key),
+                    jnp.asarray(budget),
+                    jnp.asarray(greedy),
+                    jnp.asarray(temp),
+                    jnp.asarray(top_p),
+                    jnp.asarray(eos),
+                )
+                first = sp.fetch(self._state.last_tok)
+            fin = np.asarray(self._state.finished)
+            t_first = time.perf_counter()
+            for slot, req, plan, prefix_len, suffix in members:
+                # Register the prompt's FULL blocks (now holding valid
+                # K/V) for future prefix hits — before any _finish can
+                # release the slot's references.
+                if self._prefix is not None:
+                    self._prefix.insert(
+                        req.tokens,
+                        self._slot_blocks[slot],
+                        int(req.tokens.size) // self.block_size,
+                    )
+                self._record_first_token(slot, req, first, fin, t_first)
+        self.metrics.gauge("kv_blocks_used").set(self._alloc.used_blocks)
+
+    def _admit_slab(self) -> None:
         free = self._free_slots()
         if not free or not self._queue:
             return
@@ -533,28 +950,11 @@ class TextServer:
             top_p = np.ones((s,), np.float32)
             eos = np.full((s,), -1, np.int32)
             for slot, req in members:
-                c = req.config
                 tokens[slot, : req.tokens.size] = req.tokens
                 plens[slot] = req.tokens.size
                 admit[slot] = True
-                key[slot] = np.asarray(
-                    jax.random.key_data(jax.random.key(c.seed))
-                )
-                budget[slot] = c.max_new
-                greedy[slot] = c.greedy
-                temp[slot] = c.temperature
-                top_p[slot] = c.top_p
-                eos[slot] = -1 if c.eos_id is None else c.eos_id
-                self._slot_req[slot] = req
-                req.t_admit = time.perf_counter()
-                self.metrics.counter("admissions_total").inc()
-                self.journal.emit(
-                    "admission",
-                    rid=req.rid,
-                    slot=int(slot),
-                    bucket=int(lb),
-                    prompt_len=int(req.tokens.size),
-                    queue_wait_s=round(req.t_admit - req.t_submit, 6),
+                self._admit_member_row(
+                    slot, req, lb, key, budget, greedy, temp, top_p, eos
                 )
             with self.spans.dispatch(
                 "prefill", bucket=int(lb), admitted=len(members)
@@ -579,13 +979,7 @@ class TextServer:
             fin = np.asarray(self._state.finished)
             t_first = time.perf_counter()
             for slot, req in members:
-                req.t_first = t_first
-                self.metrics.histogram("ttft_s").observe(
-                    t_first - req.t_submit
-                )
-                req.out.append(int(first[slot]))
-                if fin[slot]:
-                    self._finish(slot)
+                self._record_first_token(slot, req, first, fin, t_first)
         self.metrics.gauge("queue_depth").set(len(self._queue))
 
     def _finish(self, slot: int) -> None:
@@ -593,6 +987,17 @@ class TextServer:
         if req is not None:
             req.done = True
             self._slot_req[slot] = None
+            if self.paged and self._slot_blocks[slot] is not None:
+                # Completion IS block eviction: every reference this
+                # request held returns before the next chunk boundary's
+                # admissions (prefix-cached blocks keep the cache's own
+                # reference and stay resident for future hits).
+                for b in self._slot_blocks[slot]:
+                    self._alloc.release(b)
+                self._slot_blocks[slot] = None
+                self.metrics.gauge("kv_blocks_used").set(
+                    self._alloc.used_blocks
+                )
             now = time.perf_counter()
             latency = now - req.t_submit
             self.metrics.counter("completions_total").inc()
@@ -616,6 +1021,71 @@ class TextServer:
                 ),
             )
 
+    def _spec_dispatch(self, occupied: int):
+        """One speculative decode tick (replaces the chunk scan when
+        ``spec_draft > 0``): host-side prompt-lookup drafts per GREEDY
+        slot (``serve_pool.lookup_draft`` over the request's own
+        prompt + generated stream — no draft model), then ONE batched
+        verify dispatch (:meth:`_verify_graph`) that scores every draft
+        position and emits ``accepted + 1`` tokens per slot. Sampled
+        slots ride along at draft length 0 (one ordinary pick — their
+        PRNG chain is untouchable by speculation). Draft length is
+        capped at remaining budget MINUS ONE — a verify round emits at
+        most ``accepted + 1`` tokens, so the last position of a
+        full-budget draft could never be consumed — which also keeps
+        verify writes inside the blocks reserved at admission.
+
+        NOTE: on greedy ticks this replaces the chunk scan, so
+        tokens/dispatch is bounded by ``spec_draft + 1`` — where the
+        fixed dispatch cost dominates (the tunneled chip, small models)
+        a large ``chunk`` can beat speculation outright; measure both
+        (docs/serving.md §speculation)."""
+        s, d1 = self.slots, self.spec_draft + 1
+        suffix = np.zeros((s, d1), np.int32)
+        slens = np.ones((s,), np.int32)
+        proposed = 0
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            suffix[slot, 0] = req.out[-1]
+            if req.config.greedy:
+                cap = min(
+                    self.spec_draft, req.config.max_new - len(req.out) - 1
+                )
+                if cap <= 0:
+                    continue  # last budgeted token: drafting is wasted work
+                ctx = np.concatenate(
+                    [req.tokens, np.asarray(req.out, np.int32)]
+                )
+                d = lookup_draft(ctx, cap, self.spec_ngram)
+                if d:
+                    suffix[slot, 1 : 1 + len(d)] = d
+                    slens[slot] = 1 + len(d)
+                    proposed += len(d)
+        with self.spans.dispatch(
+            "spec_verify", draft=self.spec_draft, active=int(occupied)
+        ) as sp:
+            self._state, toks, valid = self._verify_jit(
+                self.params,
+                self._state,
+                jnp.asarray(suffix),
+                jnp.asarray(slens),
+            )
+            # D2H fetch = execution barrier (closes the span).
+            toks = sp.fetch(toks)
+        valid = np.asarray(valid)
+        accepted = int(valid.sum()) - int(occupied)
+        self.metrics.counter("spec_tokens_proposed").inc(proposed)
+        self.metrics.counter("spec_tokens_accepted").inc(accepted)
+        self.journal.emit(
+            "spec_verify",
+            proposed=int(proposed),
+            accepted=int(accepted),
+            emitted=int(valid.sum()),
+            active=int(occupied),
+        )
+        return np.asarray(toks), valid
+
     def step(self) -> bool:
         """One engine tick: admit queued requests into free slots (per-
         bucket prefill dispatches), then — if any slot is mid-generation —
@@ -626,13 +1096,26 @@ class TextServer:
         occupied = sum(r is not None for r in self._slot_req)
         self.metrics.gauge("slots_busy").set(occupied)
         if occupied:
-            with self.spans.dispatch("decode_chunk", chunk=self.chunk) as sp:
-                self._state, toks, valid = self._chunk_jit(
-                    self.params, self._state
-                )
-                # D2H fetch = execution barrier (closes the span).
-                toks = sp.fetch(toks)
-            valid = np.asarray(valid)
+            # Speculate only when a greedy slot is resident: sampled
+            # slots ride verify dispatches at draft 0 (one token each),
+            # so an all-sampled tick through the verify graph would pay
+            # one dispatch PER TOKEN — fall back to the chunk scan and
+            # keep its chunk-way amortization instead.
+            spec = self.spec_draft and any(
+                r is not None and r.config.greedy for r in self._slot_req
+            )
+            if spec:
+                toks, valid = self._spec_dispatch(occupied)
+            else:
+                with self.spans.dispatch(
+                    "decode_chunk", chunk=self.chunk, active=int(occupied)
+                ) as sp:
+                    self._state, toks, valid = self._chunk_jit(
+                        self.params, self._state
+                    )
+                    # D2H fetch = execution barrier (closes the span).
+                    toks = sp.fetch(toks)
+                valid = np.asarray(valid)
             fin = np.asarray(self._state.finished)
             for slot, req in enumerate(self._slot_req):
                 if req is None:

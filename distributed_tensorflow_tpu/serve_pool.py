@@ -1,0 +1,261 @@
+"""Host-side KV block pool: allocator, prefix radix, n-gram drafts.
+
+The paged serving engine (serve.py ``paged=True``) splits the KV cache
+into fixed-size BLOCKS drawn from one shared pool (vLLM's PagedAttention
+layout): a request holds ``ceil((prompt+max_new)/block_size)`` blocks
+instead of a whole ``max_len`` slab, so concurrent occupancy scales with
+*actual* request footprints — the serving-side analog of the reference's
+async-over-sync thesis (throughput comes from packing independent work,
+not reserving for the worst case; reference tfdist_between.py:64-66
+async workers applying updates as they land vs the lock-stepped sync
+mode — PARITY.md C10, the 0.8156-vs-0.618 oracle). Three host-side
+pieces, all
+jax-free (the lean-import convention — the device half lives in
+``ops/paged_attention.py`` + ``GPTLM.{extend_paged,decode_paged}``):
+
+- :class:`BlockAllocator` — refcounted free-list over the pool. A block
+  is FREE (on the list), or held by one or more owners (a live slot,
+  the prefix cache, or both); ``release`` returns it to the free list
+  only at refcount zero — the copy-on-write discipline that lets two
+  requests map the same physical prompt block.
+- :class:`PrefixCache` — hash-consed radix over FULL prompt blocks:
+  node key = (parent block id, that block's token content), so a chain
+  lookup is exact-prefix matching by construction (a block's K/V depends
+  only on the tokens at and before it — causal attention — so content-
+  chain identity implies K/V identity). A shared system prompt prefills
+  once; later requests map the cached physical blocks (refcount +1 each)
+  and prefill only their suffix. Only IMMUTABLE blocks enter the radix:
+  full blocks of the prompt region, which no live slot ever rewrites
+  (generation writes start past the prompt), so sharing never needs an
+  actual copy. Eviction is LRU over leaf blocks held by the cache alone.
+- :func:`lookup_draft` — prompt-lookup speculative drafts (n-gram
+  continuation from the request's own context; no draft model), verified
+  by one batched target pass in the engine's greedy-exact verify graph.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+
+def blocks_for(tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``tokens`` positions."""
+    if tokens < 0:
+        raise ValueError(f"tokens must be >= 0, got {tokens}")
+    return -(-tokens // block_size)
+
+
+class BlockAllocator:
+    """Refcounted free-list allocator over ``num_blocks`` physical KV
+    blocks. Invariants (pinned by the randomized schedule in
+    tests/test_serve.py): a block is on the free list iff its refcount
+    is 0; ``alloc`` never hands out a live block; free + live counts
+    always partition the pool."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        self.num_blocks = num_blocks
+        self._free: deque[int] = deque(range(num_blocks))
+        self._ref = [0] * num_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def can_alloc(self, n: int) -> bool:
+        return len(self._free) >= n
+
+    def alloc(self, n: int) -> list[int]:
+        """Take ``n`` fresh blocks at refcount 1. Raises ``MemoryError``
+        when the free list is short — the caller (admission control)
+        checks ``can_alloc``/evicts first, so hitting this is a bug."""
+        if n < 0:
+            raise ValueError(f"n must be >= 0, got {n}")
+        if n > len(self._free):
+            raise MemoryError(
+                f"pool exhausted: want {n} blocks, {len(self._free)} free"
+            )
+        out = [self._free.popleft() for _ in range(n)]
+        for b in out:
+            self._ref[b] = 1
+        return out
+
+    def retain(self, bid: int) -> None:
+        """One more owner for a LIVE block (prefix-cache hit sharing)."""
+        if self._ref[bid] <= 0:
+            raise ValueError(f"retain of free block {bid}")
+        self._ref[bid] += 1
+
+    def release(self, bid: int) -> bool:
+        """Drop one owner; returns True when the block went back to the
+        free list (refcount hit zero)."""
+        if self._ref[bid] <= 0:
+            raise ValueError(f"release of free block {bid}")
+        self._ref[bid] -= 1
+        if self._ref[bid] == 0:
+            self._free.append(bid)
+            return True
+        return False
+
+    def refcount(self, bid: int) -> int:
+        return self._ref[bid]
+
+    def reset(self) -> None:
+        """Everything back to free (server teardown)."""
+        self._free = deque(range(self.num_blocks))
+        self._ref = [0] * self.num_blocks
+
+
+class PrefixCache:
+    """Hash-consed radix of full prompt blocks over a
+    :class:`BlockAllocator`. The cache holds ONE reference on every
+    registered block (so completed requests can release theirs and the
+    K/V stays resident for future hits); eviction releases that
+    reference, leaf-first, LRU, and only for blocks nobody else holds."""
+
+    def __init__(self, allocator: BlockAllocator, block_size: int):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.allocator = allocator
+        self.block_size = block_size
+        self._map: dict = {}  # (parent bid | -1, block tokens) -> bid
+        self._key_of: dict = {}  # bid -> its radix key
+        self._children: dict = {}  # bid -> registered child count
+        self._lru: dict = {}  # bid -> last-touch tick
+        self._tick = 0
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def matchable_blocks(self, prompt_len: int) -> int:
+        """Full blocks of an ``prompt_len``-token prompt eligible for
+        matching: capped one token short of the prompt, because the
+        engine always needs >= 1 suffix token to prefill (the request's
+        first generated token comes from the prefill logits)."""
+        return max(prompt_len - 1, 0) // self.block_size
+
+    def match(self, tokens) -> list[int]:
+        """Longest cached chain of full prompt blocks (block ids, root
+        first). Pure lookup: no refcounts move — the caller retains each
+        returned block if (and only if) it actually admits the request
+        (the engine also owns hit/miss counting there, so a request
+        re-planned across failed admission rounds counts once)."""
+        bs = self.block_size
+        out: list[int] = []
+        parent = -1
+        nmax = self.matchable_blocks(len(tokens))
+        self._tick += 1
+        for i in range(nmax):
+            key = (parent, tuple(int(t) for t in tokens[i * bs:(i + 1) * bs]))
+            bid = self._map.get(key)
+            if bid is None:
+                break
+            out.append(bid)
+            self._lru[bid] = self._tick
+            parent = bid
+        return out
+
+    def insert(self, tokens, block_ids: list[int], n_full: int) -> int:
+        """Register the first ``n_full`` blocks of a freshly prefilled
+        prompt (``block_ids`` = the slot's block table). Already-cached
+        links are skipped (idempotent — the chain keeps following the
+        CACHED block, so concurrent same-prefix admissions converge on
+        one physical chain); each newly registered block gains the
+        cache's reference. Returns how many blocks were newly added."""
+        bs = self.block_size
+        parent = -1
+        added = 0
+        self._tick += 1
+        for i in range(n_full):
+            key = (parent, tuple(int(t) for t in tokens[i * bs:(i + 1) * bs]))
+            bid = self._map.get(key)
+            if bid is None:
+                bid = block_ids[i]
+                self._map[key] = bid
+                self._key_of[bid] = key
+                self.allocator.retain(bid)
+                if parent != -1:
+                    self._children[parent] = self._children.get(parent, 0) + 1
+                added += 1
+            self._lru[bid] = self._tick
+            parent = bid
+        return added
+
+    def evictable_blocks(self) -> int:
+        """Blocks :meth:`evict` could EVENTUALLY free: radix entries whose
+        only owner is the cache (refcount 1). A live request always
+        retains its matched chain from the root, so a refcount-1 block's
+        registered descendants are refcount-1 too — the leaf-first
+        cascade in :meth:`evict` reaches every block counted here."""
+        return sum(
+            1 for bid in self._key_of if self.allocator.refcount(bid) == 1
+        )
+
+    def evict(self, want_free: int) -> int:
+        """Release cached blocks until ``want_free`` more blocks are on
+        the allocator's free list (or no candidate remains). Candidates:
+        radix LEAVES (no registered children) whose only owner is the
+        cache (refcount 1) — blocks a live request still maps are never
+        touched. LRU order; evicting a leaf can expose its parent, so
+        the scan repeats. Returns the number of blocks actually freed."""
+        freed = 0
+        while freed < want_free:
+            candidates = [
+                bid
+                for bid in self._key_of
+                if self._children.get(bid, 0) == 0
+                and self.allocator.refcount(bid) == 1
+            ]
+            if not candidates:
+                break
+            bid = min(candidates, key=lambda b: self._lru.get(b, 0))
+            self._drop(bid)
+            freed += 1
+        return freed
+
+    def _drop(self, bid: int) -> None:
+        key = self._key_of.pop(bid)
+        del self._map[key]
+        self._lru.pop(bid, None)
+        self._children.pop(bid, None)
+        parent = key[0]
+        if parent != -1:
+            self._children[parent] -= 1
+        self.allocator.release(bid)
+
+
+def lookup_draft(context, max_draft: int, ngram: int = 2):
+    """Prompt-lookup decoding drafts (Saxena 2023-style, the no-model
+    drafter): find the most recent PRIOR occurrence of the context's
+    final ``ngram`` tokens and propose the tokens that followed it.
+    Returns a list of at most ``max_draft`` ints (possibly empty — no
+    match, or context shorter than the n-gram). Greedy-exact
+    verification makes a bad draft cost only wasted compute, never a
+    changed token, so the proposer is free to guess."""
+    ctx = np.asarray(context, np.int64)
+    n = ctx.size
+    if max_draft < 1 or n <= ngram:
+        return []
+    # Prefer the newest match with a FULL max_draft continuation (recent
+    # repetition is the common case: generated cycles, repeated
+    # boilerplate — but the very newest match of a cyclic tail sits near
+    # the context's end, where the continuation truncates to a token or
+    # two; a period-length-earlier match drafts the whole cycle ahead).
+    # One vectorized pass: matching every start against the tail is a
+    # single [n-ngram, ngram] comparison, not O(n) Python list builds
+    # per verify tick.
+    tail = ctx[n - ngram:]
+    windows = np.lib.stride_tricks.sliding_window_view(ctx, ngram)
+    hits = np.flatnonzero((windows[: n - ngram] == tail).all(axis=1))
+    if hits.size == 0:
+        return []
+    full = hits[hits + ngram + max_draft <= n]
+    start = int(full[-1]) if full.size else int(hits[-1])
+    return [int(t) for t in ctx[start + ngram : start + ngram + max_draft]]

@@ -144,6 +144,44 @@ def summarize(events: list[dict]) -> dict:
             )
         out["serving"] = serving
 
+    # -- serving cache: prefix hits, speculation, pool occupancy ----------
+    cache_sec: dict = {}
+    pref = [e for e in admissions if "prefix_hit_blocks" in e]
+    if pref:
+        hits = sum(int(e.get("prefix_hit_blocks", 0)) for e in pref)
+        miss = sum(int(e.get("prefix_miss_blocks", 0)) for e in pref)
+        cache_sec["prefix"] = {
+            "hit_blocks": hits,
+            "miss_blocks": miss,
+            "hit_rate": round(hits / max(hits + miss, 1), 3),
+        }
+    spec = by_kind.get("spec_verify", [])
+    if spec:
+        prop = sum(int(e.get("proposed", 0)) for e in spec)
+        acc = sum(int(e.get("accepted", 0)) for e in spec)
+        emitted = sum(int(e.get("emitted", 0)) for e in spec)
+        cache_sec["speculation"] = {
+            "verify_dispatches": len(spec),
+            "proposed": prop,
+            "accepted": acc,
+            "acceptance_rate": round(acc / max(prop, 1), 3),
+            "tokens_per_dispatch": round(emitted / len(spec), 2),
+        }
+    snaps_for_pool = by_kind.get("metrics", [])
+    if snaps_for_pool:
+        mm = snaps_for_pool[-1].get("metrics", {})
+        used, total = mm.get("kv_blocks_used"), mm.get("kv_blocks_total")
+        if used and total and total[0].get("value"):
+            cache_sec["kv_blocks"] = {
+                "used": used[0].get("value"),
+                "total": total[0].get("value"),
+                "occupancy": round(
+                    used[0]["value"] / total[0]["value"], 3
+                ),
+            }
+    if cache_sec:
+        out["serving_cache"] = cache_sec
+
     # -- bench points (serve_bench / lm_bench emitters) -------------------
     bench = by_kind.get("bench_point", [])
     if bench:
@@ -223,6 +261,30 @@ def render_report(summary: dict) -> str:
                 else ""
             )
         )
+    sc = summary.get("serving_cache")
+    if sc:
+        parts = []
+        p = sc.get("prefix")
+        if p:
+            parts.append(
+                f"prefix {p['hit_blocks']}/{p['hit_blocks'] + p['miss_blocks']}"
+                f" blocks cached (hit rate {p['hit_rate']})"
+            )
+        s2 = sc.get("speculation")
+        if s2:
+            parts.append(
+                f"speculation acceptance {s2['acceptance_rate']} "
+                f"({s2['accepted']}/{s2['proposed']}), "
+                f"{s2['tokens_per_dispatch']} tokens/dispatch over "
+                f"{s2['verify_dispatches']} verifies"
+            )
+        kb = sc.get("kv_blocks")
+        if kb:
+            parts.append(
+                f"kv pool {kb['used']:.0f}/{kb['total']:.0f} blocks "
+                f"({kb['occupancy']})"
+            )
+        lines.append("serving cache: " + "; ".join(parts))
     for b in summary.get("bench_points", []):
         lines.append(
             f"bench: {b.get('tool')}/{b.get('name')} = {b.get('value')} "

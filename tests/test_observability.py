@@ -643,6 +643,62 @@ def test_text_server_telemetry(tmp_path):
     assert m.histogram("request_latency_s").count == 3
 
 
+def test_paged_server_cache_telemetry_and_report(tmp_path):
+    """Round 11 serving-cache instrumentation: kv_blocks gauges, prefix
+    hit/miss counters, spec_tokens counters, their journal events
+    (admission prefix fields + spec_verify), and obs_report's
+    serving-cache section computed from them."""
+    import numpy as np
+
+    from distributed_tensorflow_tpu.models.gpt import GPTLM
+    from distributed_tensorflow_tpu.serve import GenerationConfig, TextServer
+    from distributed_tensorflow_tpu.tools import obs_report
+
+    model = GPTLM(
+        vocab_size=64, max_len=64, model_dim=32, num_heads=2, num_layers=1
+    )
+    params = model.init(seed=0)
+    j = obs.EventJournal.in_dir(str(tmp_path))
+    srv = TextServer(
+        model, params, slots=2, buckets=(16,), chunk=4, journal=j,
+        paged=True, block_size=4, spec_draft=3,
+    )
+    sysp = np.arange(1, 13, dtype=np.int32)  # 12-token shared prefix
+    srv.generate([sysp], GenerationConfig(max_new=4))
+    prompts = [np.concatenate([sysp, np.asarray([t], np.int32)])
+               for t in (20, 21)]
+    srv.generate(prompts, GenerationConfig(max_new=6))
+    srv.metrics.flush_to(j)
+    j.close()
+
+    m = srv.metrics
+    assert m.gauge("kv_blocks_total").value == srv.kv_blocks
+    assert m.gauge("kv_blocks_used").value == len(srv._prefix._map)
+    assert m.counter("prefix_cache_hits").value == 6  # 2 reqs x 3 blocks
+    assert m.counter("spec_tokens_proposed").value >= (
+        m.counter("spec_tokens_accepted").value
+    )
+
+    events = obs.read_events(str(tmp_path))
+    admissions = [e for e in events if e["kind"] == "admission"]
+    assert all("prefix_hit_blocks" in e for e in admissions)
+    assert sum(e["prefix_hit_blocks"] for e in admissions) == 6
+    assert any(e["kind"] == "spec_verify" for e in events)
+    assert {"prefill", "spec_verify"} <= {
+        e["name"] for e in events if e["kind"] == "span"
+    }
+
+    summary = obs_report.summarize(events)
+    sc = summary["serving_cache"]
+    assert sc["prefix"]["hit_blocks"] == 6
+    assert 0 < sc["prefix"]["hit_rate"] <= 1
+    assert sc["speculation"]["verify_dispatches"] >= 1
+    assert sc["speculation"]["tokens_per_dispatch"] >= 1
+    assert sc["kv_blocks"]["total"] == srv.kv_blocks
+    report = obs_report.render_report(summary)
+    assert "serving cache:" in report and "acceptance" in report
+
+
 # ---------------------------------------------------------------------------
 # obs_report: the replay reconstructs the run.
 # ---------------------------------------------------------------------------
