@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 import sys
 from typing import Sequence
 
@@ -235,6 +236,30 @@ def bootstrap(
         )
 
     print_fn("worker setting up ...")
+    # Per-rank event journal (round 12): a launcher that exported
+    # DTF_JOURNAL_DIR (tools/launch_local.py elastic mode) gets this
+    # worker's journal armed with zero worker-side code — under the
+    # member's ORIGINAL id across resizes (task_index is the compact
+    # rank; DTF_WORKER_RANKS maps it back), so one member keeps one
+    # journal across every topology it serves in, mirroring the log-file
+    # convention. No env → no-op.
+    journal_rank = task_index
+    ranks_env = os.environ.get("DTF_WORKER_RANKS")
+    if ranks_env:
+        from distributed_tensorflow_tpu.launch import parse_worker_ranks
+
+        ranks_list = parse_worker_ranks(ranks_env)
+        if 0 <= task_index < len(ranks_list):
+            # Out-of-range stays on the compact rank rather than raising:
+            # PS-mode tasks bootstrap through here too and are not in the
+            # worker roster; cluster_from_env (the resize consumer) is
+            # the layer that validates length against the world size.
+            journal_rank = ranks_list[task_index]
+    from distributed_tensorflow_tpu.observability.journal import (
+        configure_from_env,
+    )
+
+    configure_from_env(journal_rank)
     n = cluster.num_processes
     if heartbeat_port is None:
         heartbeat_port = cluster.heartbeat_port

@@ -94,6 +94,22 @@ def config_from_env(base: TrainConfig | None = None) -> TrainConfig:
     return cfg.replace(**kw) if kw else cfg
 
 
+def parse_worker_ranks(raw: str) -> tuple[int, ...]:
+    """Parse a ``DTF_WORKER_RANKS`` value (comma-separated ORIGINAL
+    ranks in new-rank order). THE one parser for the knob — the elastic
+    driver writes it, :func:`cluster_from_env` resolves the resize
+    topology from it, and ``cluster.bootstrap`` maps compact ranks back
+    to original ids for per-rank journals; all three must agree on what
+    is valid."""
+    try:
+        return tuple(int(r) for r in raw.split(","))
+    except ValueError:
+        raise ValueError(
+            f"invalid DTF_WORKER_RANKS={raw!r}: must be comma-separated "
+            "integers (original ranks in new-rank order)"
+        ) from None
+
+
 def cluster_from_env(base: ClusterConfig | None = None) -> ClusterConfig:
     """Apply environment overrides to a ClusterConfig — the detector half
     of the pod-scheduler surface (the trainer half is
@@ -140,14 +156,7 @@ def cluster_from_env(base: ClusterConfig | None = None) -> ClusterConfig:
 
     ranks = None
     if os.environ.get("DTF_WORKER_RANKS"):
-        raw = os.environ["DTF_WORKER_RANKS"]
-        try:
-            ranks = tuple(int(r) for r in raw.split(","))
-        except ValueError:
-            raise ValueError(
-                f"invalid DTF_WORKER_RANKS={raw!r}: must be comma-separated "
-                "integers (original ranks in new-rank order)"
-            ) from None
+        ranks = parse_worker_ranks(os.environ["DTF_WORKER_RANKS"])
     if os.environ.get("DTF_WORLD_SIZE"):
         raw = os.environ["DTF_WORLD_SIZE"]
         try:

@@ -1,33 +1,47 @@
-"""Unified telemetry layer (round 10): journal, metrics, spans.
+"""Unified telemetry layer (rounds 10 + 12): journal, metrics, spans,
+tracing, gang aggregation, live exporter.
 
-Four pieces over the reference's stdout-only instrumentation
+Over the reference's stdout-only instrumentation
 (tfdist_between.py:98-110; SURVEY.md §5):
 
 - :mod:`~.journal` — typed append-only JSONL event stream
-  (``<logdir>/events.jsonl``), rank/world/run tagged; every structured
-  stdout line is rendered FROM one of these events (byte-identical
-  output, machine-readable superset).
+  (``<logdir>/events.jsonl``), rank/world/run tagged, optional
+  size-based rotation; every structured stdout line is rendered FROM one
+  of these events (byte-identical output, machine-readable superset).
 - :mod:`~.format` — the event→line renderers (the single home of the
   ``Restart:``/``Resize:``/``Rollback:``/… wording; grep-lint-enforced).
 - :mod:`~.metrics` — process-local counters/gauges/fixed-edge histograms
   with Prometheus text export and journal snapshots.
 - :mod:`~.spans` — chrome-trace host spans whose dispatch flavor refuses
   to close without a D2H value fetch (the honest barrier, CLAUDE.md).
+- :mod:`~.tracing` — trace ids joining every event of one logical
+  operation (a serving request, a trainer run, a gang incarnation);
+  ambient thread-local context auto-tags journal emits.
+- :mod:`~.aggregate` — N ranks' journals merged into one fleet timeline
+  (skew-aligned on shared gang lifecycle anchors) with a per-rank-track
+  chrome trace (``obs_report --gang``).
+- :mod:`~.exporter` — live ``/metrics`` (Prometheus) + ``/healthz`` over
+  stdlib http, wired into TextServer and the elastic driver.
 
 The whole package is jax-free (lean-import convention): it imports and
 fully works on a degraded container, like the elastic driver layer it
-instruments. Reader tooling: ``tools/obs_report.py``. Docs:
-``docs/observability.md``.
+instruments. Reader tooling: ``tools/obs_report.py``; perf gate:
+``tools/regression_gate.py``. Docs: ``docs/observability.md``.
 """
 
+from distributed_tensorflow_tpu.observability import aggregate, tracing
+from distributed_tensorflow_tpu.observability.exporter import MetricsExporter
 from distributed_tensorflow_tpu.observability.format import emit_line, render
 from distributed_tensorflow_tpu.observability.journal import (
     EventJournal,
     NullJournal,
     append_event,
     configure,
+    configure_from_env,
     emit,
     get_journal,
+    journal_segments,
+    rank_journal_path,
     read_events,
 )
 from distributed_tensorflow_tpu.observability.metrics import (
@@ -48,11 +62,17 @@ from distributed_tensorflow_tpu.observability.spans import (
 __all__ = [
     "EventJournal",
     "NullJournal",
+    "MetricsExporter",
+    "aggregate",
     "append_event",
     "configure",
+    "configure_from_env",
     "emit",
     "get_journal",
+    "journal_segments",
+    "rank_journal_path",
     "read_events",
+    "tracing",
     "emit_line",
     "render",
     "Counter",

@@ -1,0 +1,307 @@
+"""Gang-wide journal aggregation: N ranks' events.jsonl → one timeline.
+
+The reference's experiment tables (reference README.md:38-40) were
+assembled by a HUMAN reading N per-task log files side by side; the
+round-10 journal made each process machine-readable but left the join to
+grep. This module performs the join: it discovers every journal under a
+gang logdir (the driver's ``events.jsonl``, the per-rank
+``events-rank<k>.jsonl`` files ``journal.configure_from_env`` creates,
+rotated segments included), aligns their clocks, and merges them into one
+fleet timeline — the substrate for ``obs_report --gang`` and the
+per-rank-track chrome trace where a restart or resize is visible on
+every rank at the same instant.
+
+Clock alignment: each journal's events carry its OWN host wall clock.
+Within one host (launch_local) the clocks agree; across hosts they skew.
+The estimator uses **shared gang lifecycle events** as anchors — a
+``restart``/``resize``/``restart_exhausted``/``resize_denied`` (or an
+explicit ``gang_sync``) with the same identifying fields names the same
+physical instant wherever it was journaled, so for each journal the
+median of ``ts_self − ts_reference`` over shared anchors is its clock
+offset, subtracted before merging. Journals sharing no anchor with the
+reference (the common single-host case: workers journal steps, the
+driver journals restarts) get offset 0 — correct there, conservative
+elsewhere.
+
+jax-free (lean-import convention): runs on the driver host or any
+machine the logdir was copied to.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import statistics
+
+from distributed_tensorflow_tpu.observability.journal import (
+    journal_segments,
+    read_events,
+)
+
+# Kinds that name ONE physical gang-wide instant in every journal that
+# records them — the skew anchors, and the events mirrored onto every
+# rank track in the chrome trace.
+GANG_KINDS = (
+    "restart",
+    "restart_exhausted",
+    "resize",
+    "resize_denied",
+    "gang_sync",
+)
+
+_RANK_FILE = re.compile(r"^events-rank(\d+)\.jsonl$")
+
+
+def discover_journals(logdir: str) -> dict:
+    """Map journal label → path for every journal under ``logdir``:
+    ``events.jsonl`` → ``driver``, ``events-rank<k>.jsonl`` → ``rank<k>``,
+    any other ``events-*.jsonl`` → its stem. Rotated segments belong to
+    their base journal (``read_events`` spans them), so they do not
+    appear as separate entries."""
+    out: dict = {}
+    for name in sorted(os.listdir(logdir)):
+        path = os.path.join(logdir, name)
+        if not os.path.isfile(path):
+            continue
+        if name == "events.jsonl":
+            out["driver"] = path
+        elif (m := _RANK_FILE.match(name)):
+            out[f"rank{int(m.group(1))}"] = path
+        elif name.startswith("events-") and name.endswith(".jsonl"):
+            out[name[len("events-") : -len(".jsonl")]] = path
+    return out
+
+
+def _anchor_key(ev: dict):
+    """Identity of a gang-wide event across journals: the kind plus its
+    stable ordinal fields (restart ordinal, world size, an explicit sync
+    id) — wall time deliberately excluded (it is what we are solving
+    for)."""
+    return (
+        ev.get("kind"),
+        ev.get("restart"),
+        ev.get("restarts"),
+        ev.get("world"),
+        ev.get("from_world"),
+        ev.get("sync"),
+    )
+
+
+def estimate_skew(journals: dict) -> dict:
+    """Per-journal clock offset (seconds, to SUBTRACT) from shared gang
+    anchors. The reference journal is the one holding the most anchor
+    events (ties: label order, so ``driver`` wins over ``rank0``); its
+    offset is 0 by construction."""
+    anchors = {
+        label: {
+            _anchor_key(e): e["ts"]
+            for e in evs
+            if e.get("kind") in GANG_KINDS and isinstance(e.get("ts"), (int, float))
+        }
+        for label, evs in journals.items()
+    }
+    if not anchors:
+        return {}
+    ref = min(anchors, key=lambda lb: (-len(anchors[lb]), lb))
+    offsets = {}
+    for label, own in anchors.items():
+        shared = [
+            own[k] - anchors[ref][k] for k in own if k in anchors[ref]
+        ]
+        offsets[label] = (
+            float(statistics.median(shared)) if label != ref and shared else 0.0
+        )
+    return offsets
+
+
+def merge(source) -> dict:
+    """Merge a gang's journals into one fleet timeline.
+
+    ``source`` is a logdir (journals discovered per
+    :func:`discover_journals`) or an explicit ``{label: path}`` /
+    ``{label: events-list}`` mapping. Returns::
+
+        {"ranks": [label, ...],            # track order: driver first
+         "skew_s": {label: offset},
+         "events": [...]}                  # ts skew-adjusted, sorted;
+                                           # each event carries _src
+
+    The per-event ``_src`` label keys the chrome-trace track and the
+    fleet report; the original journals are untouched."""
+    if isinstance(source, str):
+        paths = discover_journals(source)
+        if not paths:
+            raise FileNotFoundError(f"no events*.jsonl journals under {source}")
+        journals = {lb: read_events(p) for lb, p in paths.items()}
+    else:
+        journals = {
+            lb: (read_events(v) if isinstance(v, str) else list(v))
+            for lb, v in source.items()
+        }
+    skew = estimate_skew(journals)
+    ranks = sorted(
+        journals,
+        key=lambda lb: (lb != "driver", _rank_ordinal(lb), lb),
+    )
+    merged = []
+    for label, evs in journals.items():
+        off = skew.get(label, 0.0)
+        for ev in evs:
+            e = dict(ev)
+            e["_src"] = label
+            if isinstance(e.get("ts"), (int, float)) and off:
+                e["ts"] = e["ts"] - off
+            merged.append(e)
+    merged.sort(key=lambda e: (e.get("ts") or 0.0))
+    return {"ranks": ranks, "skew_s": skew, "events": merged}
+
+
+def _rank_ordinal(label: str) -> int:
+    m = re.match(r"rank(\d+)$", label)
+    return int(m.group(1)) if m else 1 << 30
+
+
+def gang_chrome_trace(merged: dict) -> dict:
+    """The fleet timeline in the chrome trace event format: one PROCESS
+    track per journal (pid = track index, named via ``process_name``
+    metadata), ``span`` events as complete ("X") slices anchored on the
+    skew-adjusted WALL clock (a journal's ``ts_us`` is process-local
+    perf_counter time and never comparable across ranks — the span's
+    journal-event ``ts`` is its close wall time, so start = ts − dur),
+    and lifecycle moments as instant ("i") events. Gang-wide kinds
+    (:data:`GANG_KINDS`) are mirrored onto EVERY rank track — a gang
+    restart IS an event on each rank — plus worker_start / checkpoint /
+    rollback / preemption / serving admissions and completions on their
+    own rank's track."""
+    ranks = merged["ranks"]
+    pids = {label: i for i, label in enumerate(ranks)}
+    events = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pids[label],
+            "tid": 0,
+            "args": {"name": label},
+        }
+        for label in ranks
+    ]
+    stamped = [
+        e for e in merged["events"] if isinstance(e.get("ts"), (int, float))
+    ]
+    if not stamped:
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+    t0 = min(e["ts"] for e in stamped)
+
+    def us(ts: float) -> float:
+        return (ts - t0) * 1e6
+
+    local_instants = (
+        "worker_start",
+        "checkpoint_save",
+        "checkpoint_restore",
+        "rollback",
+        "rollback_compiled",
+        "preemption",
+        "restore",
+        "request_submit",
+        "admission",
+        "completion",
+    )
+    for ev in stamped:
+        kind = ev.get("kind")
+        pid = pids.get(ev["_src"], 0)
+        args = {
+            k: v for k, v in ev.items() if k not in ("_src", "kind", "ts")
+        }
+        if kind == "span":
+            dur = float(ev.get("dur_us", 0.0))
+            events.append(
+                {
+                    "name": ev.get("name", "?"),
+                    "cat": ev.get("cat", "host"),
+                    "ph": "X",
+                    "ts": us(ev["ts"]) - dur,
+                    "dur": dur,
+                    "pid": pid,
+                    "tid": 0,
+                    "args": dict(ev.get("args", {})),
+                }
+            )
+        elif kind in GANG_KINDS:
+            for label in ranks:  # the gang moment, visible on every track
+                events.append(
+                    {
+                        "name": kind,
+                        "cat": "lifecycle",
+                        "ph": "i",
+                        "s": "g",
+                        "ts": us(ev["ts"]),
+                        "pid": pids[label],
+                        "tid": 0,
+                        "args": args,
+                    }
+                )
+        elif kind in local_instants:
+            events.append(
+                {
+                    "name": kind,
+                    "cat": "lifecycle",
+                    "ph": "i",
+                    "s": "p",
+                    "ts": us(ev["ts"]),
+                    "pid": pid,
+                    "tid": 0,
+                    "args": args,
+                }
+            )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def fleet_summary(merged: dict) -> dict:
+    """The ``obs_report --gang`` payload: per-rank event counts and wall
+    spans, the estimated skew, and the merged lifecycle history (each
+    entry tagged with the journal that recorded it)."""
+    from distributed_tensorflow_tpu.observability import format as obs_format
+
+    per_rank: dict = {}
+    for label in merged["ranks"]:
+        evs = [e for e in merged["events"] if e["_src"] == label]
+        ts = [
+            e["ts"] for e in evs if isinstance(e.get("ts"), (int, float))
+        ]
+        kinds: dict = {}
+        for e in evs:
+            kinds[e.get("kind", "?")] = kinds.get(e.get("kind", "?"), 0) + 1
+        per_rank[label] = {
+            "events": len(evs),
+            "kinds": dict(sorted(kinds.items())),
+            "wall_span_s": round(max(ts) - min(ts), 3) if ts else 0.0,
+        }
+    lifecycle = []
+    for ev in merged["events"]:
+        kind = ev.get("kind")
+        if kind in GANG_KINDS or kind in ("preemption", "rollback", "restore"):
+            try:
+                line = obs_format.render(kind, ev)[0]
+            except KeyError:
+                line = f"{kind}: {ev}"
+            lifecycle.append(
+                {"ts": ev.get("ts"), "src": ev["_src"], "kind": kind,
+                 "line": line}
+            )
+    ts_all = [
+        e["ts"]
+        for e in merged["events"]
+        if isinstance(e.get("ts"), (int, float))
+    ]
+    return {
+        "ranks": per_rank,
+        "skew_s": {k: round(v, 6) for k, v in merged["skew_s"].items()},
+        "events": len(merged["events"]),
+        "wall_span_s": round(max(ts_all) - min(ts_all), 3) if ts_all else 0.0,
+        "lifecycle": lifecycle,
+        "worker_starts": {
+            label: per_rank[label]["kinds"].get("worker_start", 0)
+            for label in merged["ranks"]
+        },
+    }

@@ -8,14 +8,27 @@ structured signal the framework emits (Step/Cost/AvgTime lines, lifecycle
 ``Restart:``/``Resize:``/``Rollback:``/``Preemption:``/``Restore:`` lines,
 serving admissions/completions, checkpoint saves, metrics snapshots, host
 spans) is ONE JSON object per line in ``<logdir>/events.jsonl``, tagged
-with wall time, rank/world, and a run id.
+with wall time, rank/world, a run id, and — when a trace context is
+active or a ``trace=`` field is passed — the trace id that joins the
+event to its logical operation (:mod:`observability.tracing`).
 
-Write discipline: one event = one ``write()`` of one ``\\n``-terminated
+Write discipline: one event = one ``os.write()`` of one ``\\n``-terminated
 line on an ``O_APPEND`` descriptor — concurrent writers (a gang of ranks
-sharing a logdir) interleave whole lines, never bytes, for lines under
-the pipe/page atomicity bound our events stay well inside. The reader
+sharing a logdir) interleave whole lines, never bytes. The raw-fd write
+matters: buffered text streams split writes larger than their buffer
+(8 KiB by default), so a big ``metrics`` snapshot event could tear across
+a concurrent append — ``tests/test_observability.py``'s multi-writer
+stress test pins >8 KiB events against exactly that. The reader
 (:func:`read_events`) tolerates a torn final line (a killed process mid-
 write), mirroring the checkpoint layer's crash-consistency stance.
+
+Rotation (round 12, default OFF — existing journals are byte-identical):
+``EventJournal(rotate_bytes=N)`` caps the active file; when an append
+would push it past ``N`` the file is renamed to ``events.jsonl.<k>``
+(``.1`` oldest) and a fresh active file starts. :func:`read_events` and
+:func:`journal_segments` span the rotated chain transparently. Rotation
+is a single-writer feature: concurrent appenders sharing one path must
+keep it off (the rename would swap the file out from under their fds).
 
 The stdout bytes remain byte-identical to the reference format: renderers
 in :mod:`observability.format` produce the log lines FROM these events
@@ -32,7 +45,12 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import time
+
+from distributed_tensorflow_tpu.observability import tracing
+
+_SEGMENT = re.compile(r"\.(\d+)$")
 
 
 class NullJournal:
@@ -45,6 +63,9 @@ class NullJournal:
 
     def emit(self, kind: str, **fields) -> dict:
         ev = {"ts": time.time(), "kind": kind}
+        ambient = tracing.current_trace()
+        if ambient is not None and "trace" not in fields:
+            ev["trace"] = ambient
         ev.update(fields)
         return ev
 
@@ -65,9 +86,10 @@ class EventJournal(NullJournal):
     """Append-only JSONL event stream.
 
     Every event carries ``ts`` (wall clock), ``kind``, and — when set —
-    ``rank``/``world``/``run`` tags, then the caller's fields. Field
-    values must be JSON-serializable (the writer coerces stray numpy
-    scalars via their ``item()``)."""
+    ``rank``/``world``/``run`` tags, the ambient trace id
+    (:mod:`~.tracing`, unless an explicit ``trace=`` field overrides),
+    then the caller's fields. Field values must be JSON-serializable
+    (the writer coerces stray numpy scalars via their ``item()``)."""
 
     def __init__(
         self,
@@ -76,14 +98,21 @@ class EventJournal(NullJournal):
         rank: int | None = None,
         world: int | None = None,
         run_id: str | None = None,
+        rotate_bytes: int = 0,
         clock=time.time,
     ):
         self.path = path
         self.rank = rank
         self.world = world
         self.run_id = run_id
+        self.rotate_bytes = int(rotate_bytes)
+        if self.rotate_bytes < 0:
+            raise ValueError(
+                f"rotate_bytes must be >= 0 (0 disables), got {rotate_bytes}"
+            )
         self._clock = clock
-        self._f = None
+        self._fd = None
+        self._size = 0
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
 
@@ -92,12 +121,16 @@ class EventJournal(NullJournal):
         """The conventional location: ``<logdir>/events.jsonl``."""
         return cls(os.path.join(logdir, "events.jsonl"), **kw)
 
-    def _file(self):
-        if self._f is None:
-            # O_APPEND via mode "a": the kernel serializes whole-buffer
-            # appends, so multi-process journals interleave whole lines.
-            self._f = open(self.path, "a", encoding="utf-8")
-        return self._f
+    def _file(self) -> int:
+        if self._fd is None:
+            # O_APPEND: the kernel serializes whole-buffer appends, so
+            # multi-process journals interleave whole lines — provided
+            # each line is ONE os.write (see the module docstring).
+            self._fd = os.open(
+                self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
+            )
+            self._size = os.fstat(self._fd).st_size
+        return self._fd
 
     @staticmethod
     def _default(o):
@@ -111,6 +144,18 @@ class EventJournal(NullJournal):
             f"event field of type {type(o).__name__} is not JSON-serializable"
         )
 
+    def _rotate(self) -> None:
+        """Retire the active file as the next ``.k`` segment (``.1`` is
+        the oldest). Single-writer only — see the module docstring."""
+        os.close(self._fd)
+        self._fd = None
+        taken = [
+            int(_SEGMENT.search(seg).group(1))
+            for seg in journal_segments(self.path)
+            if seg != self.path
+        ]
+        os.replace(self.path, f"{self.path}.{max(taken, default=0) + 1}")
+
     def emit(self, kind: str, **fields) -> dict:
         ev: dict = {"ts": self._clock(), "kind": kind}
         if self.rank is not None:
@@ -119,36 +164,58 @@ class EventJournal(NullJournal):
             ev["world"] = int(self.world)
         if self.run_id is not None:
             ev["run"] = self.run_id
+        ambient = tracing.current_trace()
+        if ambient is not None and "trace" not in fields:
+            ev["trace"] = ambient
         ev.update(fields)
-        line = json.dumps(ev, default=self._default) + "\n"
-        f = self._file()
-        f.write(line)  # one write = one line: the atomicity contract
-        f.flush()
+        data = (json.dumps(ev, default=self._default) + "\n").encode("utf-8")
+        fd = self._file()
+        if (
+            self.rotate_bytes
+            and self._size
+            and self._size + len(data) > self.rotate_bytes
+        ):
+            self._rotate()
+            fd = self._file()
+        os.write(fd, data)  # ONE write = one line: the atomicity contract
+        self._size += len(data)
         return ev
 
     def flush(self) -> None:
-        if self._f is not None:
-            self._f.flush()
+        if self._fd is not None:
             try:
-                os.fsync(self._f.fileno())
+                os.fsync(self._fd)
             except OSError:  # pragma: no cover — exotic filesystems
                 pass
 
     def close(self) -> None:
-        if self._f is not None:
+        if self._fd is not None:
             self.flush()
-            self._f.close()
-            self._f = None
+            os.close(self._fd)
+            self._fd = None
 
 
-def read_events(path: str, *, kind: str | None = None) -> list[dict]:
-    """Parse an ``events.jsonl`` (or a logdir containing one). A torn
-    final line — a writer killed mid-append — is skipped silently; a torn
-    line anywhere else raises (that is corruption, not a crash tail).
-    ``kind`` filters."""
-    if os.path.isdir(path):
-        path = os.path.join(path, "events.jsonl")
-    out: list[dict] = []
+def journal_segments(path: str) -> list[str]:
+    """The on-disk chain of one journal, oldest→newest: rotated segments
+    ``<path>.1..N`` (numeric order) then the active ``<path>``. Files
+    that do not exist are omitted (a never-rotated journal is just
+    ``[path]``)."""
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    base = os.path.basename(path)
+    nums = []
+    if os.path.isdir(parent):
+        for name in os.listdir(parent):
+            if name.startswith(base + "."):
+                m = _SEGMENT.search(name)
+                if m and name == f"{base}.{m.group(1)}":
+                    nums.append(int(m.group(1)))
+    chain = [f"{path}.{n}" for n in sorted(nums)]
+    if os.path.exists(path):
+        chain.append(path)
+    return chain
+
+
+def _parse_segment(path: str, out: list, *, kind: str | None) -> None:
     with open(path, encoding="utf-8") as f:
         lines = f.read().split("\n")
     # A complete file ends with "\n", so split leaves a trailing "".
@@ -163,6 +230,24 @@ def read_events(path: str, *, kind: str | None = None) -> list[dict]:
             raise ValueError(f"{path}:{i + 1}: corrupt event line") from None
         if kind is None or ev.get("kind") == kind:
             out.append(ev)
+
+
+def read_events(path: str, *, kind: str | None = None) -> list[dict]:
+    """Parse an ``events.jsonl`` (or a logdir containing one), spanning
+    rotated segments (``events.jsonl.1..N`` oldest-first, then the active
+    file) transparently. A torn final line — a writer killed mid-append —
+    is skipped silently; a torn line anywhere else raises (that is
+    corruption, not a crash tail). ``kind`` filters."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "events.jsonl")
+    segments = journal_segments(path)
+    if not segments:
+        # Preserve the single-file error shape for a missing journal.
+        with open(path, encoding="utf-8"):
+            pass  # pragma: no cover — open() raises above
+    out: list[dict] = []
+    for seg in segments:
+        _parse_segment(seg, out, kind=kind)
     return out
 
 
@@ -189,6 +274,7 @@ def configure(
     rank: int | None = None,
     world: int | None = None,
     run_id: str | None = None,
+    rotate_bytes: int = 0,
 ) -> NullJournal:
     """Install the process-default journal (``<logdir>/events.jsonl``, or
     an explicit ``path``). Components that were not handed a journal
@@ -202,8 +288,65 @@ def configure(
     else:
         if path is None:
             path = os.path.join(logdir, "events.jsonl")
-        _default = EventJournal(path, rank=rank, world=world, run_id=run_id)
+        _default = EventJournal(
+            path, rank=rank, world=world, run_id=run_id,
+            rotate_bytes=rotate_bytes,
+        )
     return _default
+
+
+def rank_journal_path(logdir: str, rank: int) -> str:
+    """The per-rank journal convention for a gang sharing a logdir:
+    ``<logdir>/events-rank<k>.jsonl``. One file per rank keeps rotation
+    legal (single writer) and gives :mod:`observability.aggregate` clean
+    per-rank timelines to merge; the driver keeps the plain
+    ``events.jsonl``."""
+    return os.path.join(logdir, f"events-rank{int(rank)}.jsonl")
+
+
+def configure_from_env(
+    rank: int | None = None, *, announce: bool = True, environ=None
+) -> NullJournal:
+    """Arm the process-default journal from the launcher-set env — the
+    zero-code path for gang workers (``tools/launch_local.py`` exports
+    these for every spawned task):
+
+    - ``DTF_EVENTS_PATH`` — explicit journal path, or
+    - ``DTF_JOURNAL_DIR`` — logdir; the journal lands at
+      :func:`rank_journal_path` when a rank is known (the ``rank``
+      argument, else ``DTF_RANK``), else ``events.jsonl``.
+
+    ``DTF_WORLD_SIZE``/``DTF_RUN_ID`` tag events;
+    ``DTF_JOURNAL_ROTATE_BYTES`` arms rotation. With neither path knob
+    set this is a no-op returning the current default — safe to call
+    unconditionally. ``announce=True`` emits a ``worker_start`` event
+    (pid + rank), which is how a per-rank journal shows its own restarts:
+    every incarnation of the worker announces itself, so ``obs_report
+    --gang`` sees one ``worker_start`` per (re)launch."""
+    env = os.environ if environ is None else environ
+    path = env.get("DTF_EVENTS_PATH")
+    logdir = env.get("DTF_JOURNAL_DIR")
+    if not path and not logdir:
+        return _default
+    if rank is None and env.get("DTF_RANK"):
+        rank = int(env["DTF_RANK"])
+    if not path:
+        path = (
+            rank_journal_path(logdir, rank)
+            if rank is not None
+            else os.path.join(logdir, "events.jsonl")
+        )
+    world = int(env["DTF_WORLD_SIZE"]) if env.get("DTF_WORLD_SIZE") else None
+    j = configure(
+        path=path,
+        rank=rank,
+        world=world,
+        run_id=env.get("DTF_RUN_ID"),
+        rotate_bytes=int(env.get("DTF_JOURNAL_ROTATE_BYTES", "0") or 0),
+    )
+    if announce:
+        j.emit("worker_start", pid=os.getpid())
+    return j
 
 
 def get_journal() -> NullJournal:

@@ -1,0 +1,73 @@
+"""Trace context: correlate every journal event of one logical operation.
+
+The reference's stdout had no request/run identity at all — a timing line
+could not be attributed to anything smaller than "the process" (reference
+tfdist_between.py:98-110). The round-10 journal made each *event* typed;
+this module makes them *joinable*: a trace id names one logical operation
+(a serving request's submit→queue→prefill→decode→completion life, a
+trainer run's epochs+dispatches+checkpoints, a gang incarnation), and
+every journal event that belongs to it carries ``trace=<id>``.
+
+Two propagation styles, matching the two shapes of instrumented code:
+
+- **Explicit** (concurrent operations interleaved on one thread — the
+  serving scheduler, where one ``step()`` advances many requests): the
+  component stores ``new_trace_id()`` per operation and passes
+  ``trace=...`` into its emits. :class:`~serve.TextServer` does this per
+  request; ``tools/obs_report.py --requests`` joins the events back into
+  per-request timelines.
+- **Ambient** (one operation per thread — a trainer run, a gang
+  supervision loop): ``with tracing.trace():`` installs a thread-local
+  current trace, and EVERY journal emit on that thread — including ones
+  deep inside the Supervisor's checkpoint path and the SpanRecorder's
+  span mirror, which never learned about tracing — is tagged
+  automatically by :meth:`journal.NullJournal.emit`. Explicit ``trace=``
+  fields always win over the ambient one.
+
+Ids are 16 hex chars from ``os.urandom`` — unique across ranks without
+coordination (no counters to collide when N processes journal into one
+logdir). jax-free (lean-import convention), stdlib only.
+"""
+
+from __future__ import annotations
+
+import binascii
+import os
+import threading
+
+_local = threading.local()
+
+
+def new_trace_id() -> str:
+    """A fresh 64-bit trace id (16 hex chars), collision-safe across
+    processes — no shared counter, so concurrent ranks never coordinate."""
+    return binascii.hexlify(os.urandom(8)).decode("ascii")
+
+
+def current_trace() -> str | None:
+    """The innermost ambient trace id on this thread, or None."""
+    stack = getattr(_local, "stack", None)
+    return stack[-1] if stack else None
+
+
+class trace:
+    """Context manager installing an ambient trace id on this thread::
+
+        with tracing.trace() as tid:       # or tracing.trace("fixed-id")
+            journal.emit("step", ...)       # carries trace=tid
+
+    Nests (inner traces shadow outer ones); re-entrant per thread; never
+    leaks across threads (each has its own stack)."""
+
+    def __init__(self, trace_id: str | None = None):
+        self.trace_id = trace_id if trace_id is not None else new_trace_id()
+
+    def __enter__(self) -> str:
+        stack = getattr(_local, "stack", None)
+        if stack is None:
+            stack = _local.stack = []
+        stack.append(self.trace_id)
+        return self.trace_id
+
+    def __exit__(self, *exc) -> None:
+        _local.stack.pop()

@@ -50,6 +50,8 @@ import jax.numpy as jnp
 
 from distributed_tensorflow_tpu.models.gpt import GPTLM, GPTLMParams
 from distributed_tensorflow_tpu.observability import journal as obs_journal
+from distributed_tensorflow_tpu.observability import tracing
+from distributed_tensorflow_tpu.observability.exporter import MetricsExporter
 from distributed_tensorflow_tpu.observability.metrics import MetricsRegistry
 from distributed_tensorflow_tpu.observability.spans import SpanRecorder
 from distributed_tensorflow_tpu.serve_pool import (
@@ -236,7 +238,7 @@ class _PagedState(NamedTuple):
 
 class _Request:
     __slots__ = (
-        "rid", "tokens", "config", "out", "done",
+        "rid", "tokens", "config", "out", "done", "trace",
         "t_submit", "t_admit", "t_first",
     )
 
@@ -246,6 +248,12 @@ class _Request:
         self.config = config
         self.out: list[int] = []
         self.done = False
+        # Trace id (round 12, observability/tracing.py): joins every
+        # journal event of this request's life — request_submit →
+        # admission → prefill/decode spans (by rid) → completion — so
+        # obs_report --requests rebuilds the per-request timeline from
+        # the journal alone.
+        self.trace = tracing.new_trace_id()
         self.t_submit = time.perf_counter()
         self.t_admit = None  # set at slot admission
         self.t_first = None  # set when the first token lands (TTFT)
@@ -281,6 +289,7 @@ class TextServer:
         spec_ngram: int = 2,
         journal=None,
         metrics: MetricsRegistry | None = None,
+        metrics_port: int | None = None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -332,8 +341,8 @@ class TextServer:
                     f"kv_blocks must be >= 1, got {self.kv_blocks}"
                 )
             self._alloc = BlockAllocator(self.kv_blocks)
-            if prefix_caching:
-                self._prefix = PrefixCache(self._alloc, self.block_size)
+            # self._prefix (initialized above) is constructed after the
+            # journal resolves, so the radix can journal its evictions.
             # Host-authoritative block tables (the device copy is an
             # input of every prefill dispatch) + per-slot held blocks
             # for release at completion.
@@ -349,6 +358,12 @@ class TextServer:
         self.journal = journal if journal is not None else obs_journal.get_journal()
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.spans = SpanRecorder(journal=self.journal)
+        if paged and prefix_caching:
+            # Constructed here (not in the paged block above) so the
+            # radix can journal its eviction-under-pressure events.
+            self._prefix = PrefixCache(
+                self._alloc, self.block_size, journal=self.journal
+            )
         if buckets is None:
             # Doubling buckets up to max_len-1 (a prompt always leaves at
             # least one position of generation room): 16, 32, ... — small
@@ -378,6 +393,21 @@ class TextServer:
         if paged:
             self.metrics.gauge("kv_blocks_total").set(self.kv_blocks)
             self.metrics.gauge("kv_blocks_used").set(0)
+        # Live scrape surface (round 12, observability/exporter.py):
+        # /metrics = the registry's Prometheus text, /healthz = engine
+        # heartbeat (seconds since the last step() tick) + occupancy.
+        # Opt-in: None/0 leaves nothing listening; port 0 is reserved
+        # for "off" so production wiring stays explicit — pass a real
+        # port (tests bind an ephemeral one via MetricsExporter
+        # directly). Started LAST: a constructor failure above must not
+        # leave a bound port + daemon thread with no handle to stop.
+        self._last_tick = time.time()
+        self.exporter: MetricsExporter | None = None
+        if metrics_port:
+            self.exporter = MetricsExporter(
+                self.metrics, port=int(metrics_port), health_fn=self._health
+            )
+            self.exporter.start()
 
     # -- constructors ------------------------------------------------------
 
@@ -726,6 +756,16 @@ class TextServer:
         self._results[rid] = req
         self.metrics.counter("requests_submitted_total").inc()
         self.metrics.gauge("queue_depth").set(len(self._queue))
+        # The trace's birth event: everything downstream (admission,
+        # spans, completion) joins to it by trace/rid.
+        self.journal.emit(
+            "request_submit",
+            rid=rid,
+            trace=req.trace,
+            prompt_len=int(tokens.size),
+            max_new=int(config.max_new),
+            greedy=bool(config.greedy),
+        )
         return rid
 
     def bucket_for(self, length: int) -> int:
@@ -807,6 +847,7 @@ class TextServer:
         self.journal.emit(
             "admission",
             rid=req.rid,
+            trace=req.trace,
             slot=int(slot),
             bucket=int(lb),
             prompt_len=int(req.tokens.size),
@@ -893,7 +934,8 @@ class TextServer:
                     ),
                 )
             with self.spans.dispatch(
-                "prefill", bucket=int(lb), admitted=len(members)
+                "prefill", bucket=int(lb), admitted=len(members),
+                rids=[int(m[1].rid) for m in members],
             ) as sp:
                 self._state = self._prefill_jit(
                     self.params,
@@ -957,7 +999,8 @@ class TextServer:
                     slot, req, lb, key, budget, greedy, temp, top_p, eos
                 )
             with self.spans.dispatch(
-                "prefill", bucket=int(lb), admitted=len(members)
+                "prefill", bucket=int(lb), admitted=len(members),
+                rids=[int(r.rid) for _, r in members],
             ) as sp:
                 self._state = self._prefill_jit(
                     self.params,
@@ -1011,6 +1054,7 @@ class TextServer:
             self.journal.emit(
                 "completion",
                 rid=req.rid,
+                trace=req.trace,
                 slot=int(slot),
                 tokens=len(req.out),
                 latency_s=round(latency, 6),
@@ -1063,7 +1107,8 @@ class TextServer:
                     slens[slot] = 1 + len(d)
                     proposed += len(d)
         with self.spans.dispatch(
-            "spec_verify", draft=self.spec_draft, active=int(occupied)
+            "spec_verify", draft=self.spec_draft, active=int(occupied),
+            rids=[int(r.rid) for r in self._slot_req if r is not None],
         ) as sp:
             self._state, toks, valid = self._verify_jit(
                 self.params,
@@ -1092,6 +1137,7 @@ class TextServer:
         ONE compiled ``chunk``-token decode dispatch, then collect
         finished requests so their slots free for the next tick's
         admissions. Returns True while there is work left."""
+        self._last_tick = time.time()  # /healthz heartbeat: engine ticking
         self._admit()
         occupied = sum(r is not None for r in self._slot_req)
         self.metrics.gauge("slots_busy").set(occupied)
@@ -1108,7 +1154,10 @@ class TextServer:
                 toks, valid = self._spec_dispatch(occupied)
             else:
                 with self.spans.dispatch(
-                    "decode_chunk", chunk=self.chunk, active=int(occupied)
+                    "decode_chunk", chunk=self.chunk, active=int(occupied),
+                    rids=[
+                        int(r.rid) for r in self._slot_req if r is not None
+                    ],
                 ) as sp:
                     self._state, toks, valid = self._chunk_jit(
                         self.params, self._state
@@ -1133,6 +1182,28 @@ class TextServer:
 
     def idle(self) -> bool:
         return not self._queue and all(r is None for r in self._slot_req)
+
+    def _health(self) -> dict:
+        """The /healthz payload: engine heartbeat age (seconds since the
+        last ``step()`` tick — an idle-but-alive server reads old, a
+        wedged one reads ancient; the scraper applies the SLO) plus the
+        occupancy the admission controller sees."""
+        return {
+            "heartbeat_age_s": round(time.time() - self._last_tick, 3),
+            "slots_busy": sum(r is not None for r in self._slot_req),
+            "slots": self.slots,
+            "queue_depth": len(self._queue),
+            "kv_blocks_free": (
+                self._alloc.free_blocks if self._alloc is not None else None
+            ),
+        }
+
+    def shutdown(self) -> None:
+        """Stop the live exporter (if armed). The engine itself holds no
+        threads — jit caches and device state die with the object."""
+        if self.exporter is not None:
+            self.exporter.stop()
+            self.exporter = None
 
     def result(self, rid: int) -> np.ndarray:
         """Generated tokens of a finished request (prompt excluded).

@@ -120,11 +120,20 @@ class PrefixCache:
     K/V stays resident for future hits); eviction releases that
     reference, leaf-first, LRU, and only for blocks nobody else holds."""
 
-    def __init__(self, allocator: BlockAllocator, block_size: int):
+    def __init__(
+        self, allocator: BlockAllocator, block_size: int, *, journal=None
+    ):
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.allocator = allocator
         self.block_size = block_size
+        # Optional event journal (round 12): eviction-under-pressure is
+        # the one pool decision invisible from the admission events —
+        # a warm radix shrinking changes future hit rates, so each
+        # evict() that freed anything lands as a prefix_evict event.
+        # Duck-typed (anything with .emit) to keep this module jax- and
+        # observability-import-free for its unit tests.
+        self.journal = journal
         self._map: dict = {}  # (parent bid | -1, block tokens) -> bid
         self._key_of: dict = {}  # bid -> its radix key
         self._children: dict = {}  # bid -> registered child count
@@ -218,6 +227,13 @@ class PrefixCache:
             bid = min(candidates, key=lambda b: self._lru.get(b, 0))
             self._drop(bid)
             freed += 1
+        if freed and self.journal is not None:
+            self.journal.emit(
+                "prefix_evict",
+                freed_blocks=freed,
+                want_free=int(want_free),
+                cached_blocks=len(self._map),
+            )
         return freed
 
     def _drop(self, bid: int) -> None:

@@ -119,6 +119,7 @@ def _launch_elastic(
     drive_mode: str | None = None,
     drive_after_s: float = 8.0,
     drive_replace_after_s: float = 10.0,
+    metrics_port: int | None = None,
     print_fn=print,
 ) -> int:
     from distributed_tensorflow_tpu.train.elastic import (
@@ -174,17 +175,29 @@ def _launch_elastic(
     # snapshot — tools/obs_report.py replays the run from it.
     from distributed_tensorflow_tpu.observability import EventJournal
 
-    journal = EventJournal.in_dir(
-        logdir, run_id=f"elastic-{os.getpid()}", world=num_workers
-    )
+    run_id = f"elastic-{os.getpid()}"
+    journal = EventJournal.in_dir(logdir, run_id=run_id, world=num_workers)
+    # Per-rank worker journals (round 12): workers that bootstrap (or
+    # call journal.configure_from_env) land their own
+    # <logdir>/events-rank<i>.jsonl next to the driver's events.jsonl —
+    # the files obs_report --gang merges into the fleet timeline.
+    env["DTF_JOURNAL_DIR"] = logdir
+    env["DTF_RUN_ID"] = run_id
 
     launched: set[int] = set()
+
+    def _worker_env(i: int) -> dict:
+        wenv = dict(env)
+        wenv["DTF_RANK"] = str(i)  # the member's ORIGINAL id (log convention)
+        return wenv
 
     def _make_spawn(i: int):
         def _spawn():
             mode = "ab" if i in launched else "wb"
             launched.add(i)
-            return _spawn_task(command, "worker", i, logdir, env, mode=mode)
+            return _spawn_task(
+                command, "worker", i, logdir, _worker_env(i), mode=mode
+            )
 
         return _spawn
 
@@ -194,7 +207,7 @@ def _launch_elastic(
             # the env (launch.cluster_from_env → ClusterConfig.subset), the
             # log continuing under the member's ORIGINAL id.
             launched.add(i)
-            tenv = dict(env)
+            tenv = _worker_env(i)
             tenv["DTF_WORLD_SIZE"] = str(world)
             tenv["DTF_WORKER_RANKS"] = ",".join(str(r) for r in ranks)
             return _spawn_task(
@@ -256,7 +269,29 @@ def _launch_elastic(
                     pass
 
         threading.Thread(target=_drive, daemon=True).start()
-    rc = gang.run()
+    exporter = None
+    if metrics_port:
+        # Live driver endpoint (round 12): /metrics scrapes the gang's
+        # registry (restarts/resizes/world_size/heartbeat ages) while it
+        # supervises; /healthz reports the roster the scheduler needs.
+        from distributed_tensorflow_tpu.observability import MetricsExporter
+
+        exporter = MetricsExporter(
+            gang.metrics,
+            port=int(metrics_port),
+            health_fn=lambda: {
+                "world_size": gang.world_size,
+                "restarts": gang.restarts,
+                "resizes": gang.resizes,
+                "benched": [a.name for a in gang.benched],
+            },
+        )
+        print_fn(f"metrics: http://127.0.0.1:{exporter.start()}/metrics")
+    try:
+        rc = gang.run()
+    finally:
+        if exporter is not None:
+            exporter.stop()
     journal.close()
     for agent in agents:
         code = agent.poll()
@@ -291,6 +326,9 @@ def launch(
     drive_mode: str | None = None,
     drive_after_s: float = 8.0,
     drive_replace_after_s: float = 10.0,
+    # Live /metrics + /healthz on the elastic driver (round 12,
+    # observability/exporter.py). None/0 = nothing listens.
+    metrics_port: int | None = None,
     print_fn=print,
 ) -> int:
     if max_restarts > 0 and not wait:
@@ -344,6 +382,7 @@ def launch(
             drive_mode=drive_mode,
             drive_after_s=drive_after_s,
             drive_replace_after_s=drive_replace_after_s,
+            metrics_port=metrics_port,
             print_fn=print_fn,
         )
         for name, p in ps_procs:
@@ -437,6 +476,14 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--drive-after-s", type=float, default=8.0)
     parser.add_argument("--drive-replace-after-s", type=float, default=10.0)
+    parser.add_argument(
+        "--metrics-port",
+        type=int,
+        default=int(os.environ.get("DTF_METRICS_PORT", "0") or 0) or None,
+        help="serve the elastic driver's live /metrics (Prometheus) and "
+        "/healthz on this port while the gang runs (observability/"
+        "exporter.py); 0/unset disables (default: $DTF_METRICS_PORT)",
+    )
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="-- command to launch per task")
     args = parser.parse_args(argv)
@@ -461,6 +508,7 @@ def main(argv=None) -> int:
         drive_mode=args.drive_mode,
         drive_after_s=args.drive_after_s,
         drive_replace_after_s=args.drive_replace_after_s,
+        metrics_port=args.metrics_port,
     )
 
 

@@ -1,7 +1,7 @@
 """Run report from the event journal: replay ``events.jsonl`` into a
 human summary + a Perfetto-loadable trace.
 
-The reader half of the round-10 telemetry layer (docs/observability.md):
+The reader half of the telemetry layer (docs/observability.md):
 everything the framework journals — Step/Cost lines, epoch metrics,
 lifecycle events (restart/resize/rollback/preemption/restore), checkpoint
 saves, serving admissions/completions, metrics snapshots, host spans —
@@ -10,10 +10,18 @@ reconstructs here WITHOUT grep'ing stdout::
     python -m distributed_tensorflow_tpu.tools.obs_report <logdir|events.jsonl>
     python -m distributed_tensorflow_tpu.tools.obs_report run/ --json
     python -m distributed_tensorflow_tpu.tools.obs_report run/ --trace t.json
+    python -m distributed_tensorflow_tpu.tools.obs_report run/ --requests
+    python -m distributed_tensorflow_tpu.tools.obs_report gang_logdir/ --gang
 
 ``--trace`` exports the journal's ``span`` events in the chrome trace
 event format (load in Perfetto / chrome://tracing). ``--json`` prints the
-summary dict instead of the rendered report.
+summary dict instead of the rendered report. ``--requests`` (round 12)
+joins a TextServer journal's trace ids back into per-request timelines —
+queue wait, prefill, decode chunks, TTFT, latency, all from the journal
+alone. ``--gang`` treats the path as a GANG logdir: every rank's journal
+is merged into one skew-aligned fleet timeline
+(observability/aggregate.py); with ``--trace`` the export has one track
+per rank, restarts/resizes visible on all of them.
 
 jax-free (lean-import convention): runs anywhere the journal was written,
 including degraded containers and machines with no accelerator stack.
@@ -25,6 +33,7 @@ import argparse
 import json
 import sys
 
+from distributed_tensorflow_tpu.observability import aggregate
 from distributed_tensorflow_tpu.observability import format as obs_format
 from distributed_tensorflow_tpu.observability.journal import read_events
 from distributed_tensorflow_tpu.observability.spans import chrome_trace
@@ -303,6 +312,145 @@ def render_report(summary: dict) -> str:
     return "\n".join(lines)
 
 
+def reconstruct_requests(events: list[dict]) -> list[dict]:
+    """Per-request serving timelines from the journal alone (round 12):
+    join ``request_submit`` → ``admission`` → prefill/decode/spec_verify
+    spans (by the ``rids`` each dispatch span carries) → ``completion``
+    on rid + trace id. Returns one record per request, submission order::
+
+        {rid, trace, prompt_len, max_new, queue_wait_s, prefill_ms,
+         decode_chunks, decode_ms, ttft_s, latency_s, tokens, done}
+
+    Decode attribution is wall-clock per resident request: a chunk
+    dispatch's duration counts toward EVERY request resident in it (they
+    all waited on it) — the sum across requests exceeds wall time by
+    design, exactly like CPU time on a multicore host. Pre-round-12
+    journals (no request_submit, no span rids) still reconstruct the
+    admission/completion half."""
+    reqs: dict = {}
+
+    def rec(rid) -> dict:
+        return reqs.setdefault(
+            rid,
+            {
+                "rid": rid,
+                "trace": None,
+                "prompt_len": None,
+                "max_new": None,
+                "queue_wait_s": None,
+                "prefill_ms": 0.0,
+                "decode_chunks": 0,
+                "decode_ms": 0.0,
+                "ttft_s": None,
+                "latency_s": None,
+                "tokens": None,
+                "done": False,
+            },
+        )
+
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "request_submit":
+            r = rec(ev.get("rid"))
+            r["trace"] = ev.get("trace")
+            r["prompt_len"] = ev.get("prompt_len")
+            r["max_new"] = ev.get("max_new")
+        elif kind == "admission":
+            r = rec(ev.get("rid"))
+            r["trace"] = r["trace"] or ev.get("trace")
+            if r["prompt_len"] is None:
+                r["prompt_len"] = ev.get("prompt_len")
+            r["queue_wait_s"] = ev.get("queue_wait_s")
+        elif kind == "span":
+            args = ev.get("args") or {}
+            rids = args.get("rids")
+            if not rids:
+                continue
+            dur_ms = float(ev.get("dur_us", 0.0)) / 1000.0
+            if ev.get("name") == "prefill":
+                for rid in rids:
+                    rec(rid)["prefill_ms"] = round(
+                        rec(rid)["prefill_ms"] + dur_ms, 3
+                    )
+            elif ev.get("name") in ("decode_chunk", "spec_verify"):
+                for rid in rids:
+                    r = rec(rid)
+                    r["decode_chunks"] += 1
+                    r["decode_ms"] = round(r["decode_ms"] + dur_ms, 3)
+        elif kind == "completion":
+            r = rec(ev.get("rid"))
+            r["trace"] = r["trace"] or ev.get("trace")
+            r["ttft_s"] = ev.get("ttft_s")
+            r["latency_s"] = ev.get("latency_s")
+            r["tokens"] = ev.get("tokens")
+            r["done"] = True
+    return [reqs[k] for k in sorted(reqs)]
+
+
+def request_percentiles(records: list[dict]) -> dict | None:
+    """p50/p95/p99 of TTFT and end-to-end latency over completed request
+    records (the serve_bench SLO rows). None when nothing completed."""
+    done = [r for r in records if r["done"] and r["latency_s"] is not None]
+    if not done:
+        return None
+    out = {"requests": len(done)}
+    for key in ("ttft_s", "latency_s"):
+        vals = sorted(float(r[key]) for r in done if r[key] is not None)
+        out[key] = {
+            "p50": round(_percentile(vals, 0.50), 4),
+            "p95": round(_percentile(vals, 0.95), 4),
+            "p99": round(_percentile(vals, 0.99), 4),
+        }
+    return out
+
+
+def render_requests(records: list[dict]) -> str:
+    lines = [
+        "rid  trace             queue(s)  prefill(ms)  decode(ms)/chunks  "
+        "ttft(s)  latency(s)  tokens",
+    ]
+    for r in records:
+        fmt = lambda v, spec: ("-" if v is None else format(v, spec))  # noqa: E731
+        lines.append(
+            f"{r['rid']:<4} {str(r['trace'] or '-'):<17} "
+            f"{fmt(r['queue_wait_s'], '.4f'):>8}  {r['prefill_ms']:>11.3f}  "
+            f"{r['decode_ms']:>10.3f}/{r['decode_chunks']:<6} "
+            f"{fmt(r['ttft_s'], '.4f'):>7}  {fmt(r['latency_s'], '.4f'):>10}  "
+            f"{fmt(r['tokens'], 'd'):>6}"
+            + ("" if r["done"] else "  (in flight)")
+        )
+    pct = request_percentiles(records)
+    if pct:
+        lines.append(
+            f"TTFT p50/p95/p99 = {pct['ttft_s']['p50']}/"
+            f"{pct['ttft_s']['p95']}/{pct['ttft_s']['p99']}s; latency "
+            f"p50/p95/p99 = {pct['latency_s']['p50']}/"
+            f"{pct['latency_s']['p95']}/{pct['latency_s']['p99']}s "
+            f"over {pct['requests']} requests"
+        )
+    return "\n".join(lines)
+
+
+def render_gang(summary: dict) -> str:
+    lines = [
+        f"fleet: {len(summary['ranks'])} journals, "
+        f"{summary['events']} events, wall span {summary['wall_span_s']}s"
+    ]
+    for label, r in summary["ranks"].items():
+        skew = summary["skew_s"].get(label, 0.0)
+        starts = summary["worker_starts"].get(label, 0)
+        lines.append(
+            f"  {label}: {r['events']} events over {r['wall_span_s']}s"
+            + (f", skew {skew}s" if skew else "")
+            + (f", {starts} incarnation(s)" if starts else "")
+        )
+    if summary["lifecycle"]:
+        lines.append("gang lifecycle:")
+        for h in summary["lifecycle"]:
+            lines.append(f"  [{h['ts']:.3f}] ({h['src']}) {h['line']}")
+    return "\n".join(lines)
+
+
 def export_trace(events: list[dict], path: str) -> int:
     """Write the journal's span events as a chrome trace; returns the
     span count (0 is legal — an empty trace still loads)."""
@@ -317,8 +465,43 @@ def main(argv=None) -> int:
     ap.add_argument("path", help="events.jsonl or a logdir containing one")
     ap.add_argument("--json", action="store_true", help="print the summary dict")
     ap.add_argument("--trace", metavar="OUT", help="export chrome-trace JSON")
+    ap.add_argument(
+        "--requests",
+        action="store_true",
+        help="per-request serving timelines (queue/prefill/decode/TTFT) "
+        "reconstructed from trace ids",
+    )
+    ap.add_argument(
+        "--gang",
+        action="store_true",
+        help="treat PATH as a gang logdir: merge every rank's journal "
+        "into one fleet timeline (--trace then exports one track per "
+        "rank)",
+    )
     args = ap.parse_args(argv)
+    if args.gang:
+        merged = aggregate.merge(args.path)
+        summary = aggregate.fleet_summary(merged)
+        if args.json:
+            print(json.dumps(summary))
+        else:
+            print(render_gang(summary))
+        if args.trace:
+            with open(args.trace, "w", encoding="utf-8") as f:
+                json.dump(aggregate.gang_chrome_trace(merged), f)
+            print(
+                f"wrote gang trace ({len(merged['ranks'])} tracks) to "
+                f"{args.trace}"
+            )
+        return 0
     events = read_events(args.path)
+    if args.requests:
+        records = reconstruct_requests(events)
+        if args.json:
+            print(json.dumps(records))
+        else:
+            print(render_requests(records))
+        return 0
     summary = summarize(events)
     if args.json:
         print(json.dumps(summary))

@@ -257,6 +257,53 @@ def bench_speculation(
     }
 
 
+def bench_request_percentiles(
+    model,
+    params,
+    *,
+    n_requests: int = 24,
+    max_new: int = 96,
+    slots: int = 8,
+    chunk: int = 32,
+) -> dict | None:
+    """Per-request TTFT/latency percentiles (round 12): the same batched
+    workload served once more with an event journal attached, then the
+    trace reconstruction (``obs_report.reconstruct_requests`` — the
+    path an operator runs on a production journal) yields p50/p95/p99
+    TTFT and end-to-end latency. A separate run, not a re-read of the
+    headline rows: those stay journal-free so their methodology is
+    unchanged. Warmup requests are dropped by rid."""
+    import tempfile
+
+    from distributed_tensorflow_tpu.observability.journal import (
+        EventJournal,
+        read_events,
+    )
+    from distributed_tensorflow_tpu.serve import GenerationConfig, TextServer
+    from distributed_tensorflow_tpu.tools import obs_report
+
+    path = os.path.join(tempfile.mkdtemp(), "events.jsonl")
+    journal = EventJournal(path)
+    srv = TextServer(
+        model, params, slots=slots, chunk=chunk, buckets=(64,),
+        journal=journal,
+    )
+    warm = [np.arange(1, 9, dtype=np.int32)] * min(2, slots)
+    srv.generate(warm, GenerationConfig(max_new=max(2, chunk)))
+    prompts, cfg = _workload(model, n_requests, max_new)
+    srv.generate(prompts, cfg)
+    journal.close()
+    records = [
+        r
+        for r in obs_report.reconstruct_requests(read_events(path))
+        if r["rid"] >= len(warm)  # warmup rids precede the workload's
+    ]
+    pct = obs_report.request_percentiles(records)
+    if pct is None:
+        return None
+    return {"slots": slots, "chunk": chunk, **pct}
+
+
 def bench(
     *,
     n_requests: int = 24,
@@ -310,6 +357,10 @@ def bench(
     )
     density = bench_paged_density(model_kw=model_kw)
     speculation = bench_speculation(model_kw=model_kw)
+    percentiles = bench_request_percentiles(
+        model, params, n_requests=n_requests, max_new=max_new,
+        slots=slots, chunk=chunk,
+    )
     return {
         "device": jax.devices()[0].device_kind,
         "model": {
@@ -345,6 +396,11 @@ def bench(
         "per_request_ms": round(float(req_b) * 1e3, 3),
         "paged_density": density,
         "speculation": speculation,
+        **(
+            {"request_percentiles": percentiles}
+            if percentiles is not None
+            else {}
+        ),
     }
 
 
@@ -413,6 +469,27 @@ def emit_bench_events(payload: dict, events_path: str) -> list[dict]:
                 )
             ]
             if "speculation" in payload
+            else []
+        ) + (
+            [
+                j.emit(
+                    "bench_point", name="ttft_p95_s",
+                    value=payload["request_percentiles"]["ttft_s"]["p95"],
+                    unit="s",
+                    requests=payload["request_percentiles"]["requests"],
+                    **common,
+                ),
+                j.emit(
+                    "bench_point", name="latency_p95_s",
+                    value=payload["request_percentiles"]["latency_s"][
+                        "p95"
+                    ],
+                    unit="s",
+                    requests=payload["request_percentiles"]["requests"],
+                    **common,
+                ),
+            ]
+            if "request_percentiles" in payload
             else []
         )
     finally:
@@ -500,6 +577,33 @@ def render(payload: dict) -> str:
             "of the quotient). Greedy-exact acceptance: the served "
             "stream is the pure greedy stream either way — a rejected "
             "draft costs wasted compute, never a changed token.",
+        ]
+    pc = payload.get("request_percentiles")
+    if pc:
+        lines += [
+            "",
+            "## Per-request latency percentiles (SLO view, "
+            f"slots={pc['slots']}, chunk={pc['chunk']})",
+            "",
+            "| percentile | TTFT (s) | latency (s) |",
+            "|---|---|---|",
+        ]
+        for p in ("p50", "p95", "p99"):
+            lines.append(
+                f"| {p} | {pc['ttft_s'][p]} | {pc['latency_s'][p]} |"
+            )
+        lines += [
+            "",
+            f"Measured over {pc['requests']} requests via the journal's "
+            "trace reconstruction (`obs_report --requests` on the run's "
+            "events.jsonl — the same path an operator uses on a "
+            "production journal), on a separate journal-attached run so "
+            "the headline rows above keep their journal-free "
+            "methodology. TTFT includes queue wait: at "
+            f"slots={pc['slots']} a workload of "
+            f"{payload['workload']['requests']} requests queues, so the "
+            "tail percentiles are an admission-control observable, not "
+            "a pure model-speed one.",
         ]
     return "\n".join(lines)
 

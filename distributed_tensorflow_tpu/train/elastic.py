@@ -568,6 +568,15 @@ class ElasticGang:
         after restarts and resizes), 1 when the budget is exhausted or the
         roster fell below ``min_workers`` (fail-stop, with a final
         structured line; checkpoints intact)."""
+        from distributed_tensorflow_tpu.observability import tracing
+
+        # One trace id per supervision (round 12): every Restart:/Resize:
+        # journal event of this gang's life joins under it, so a shared
+        # driver journal separates overlapping gangs.
+        with tracing.trace(tracing.current_trace()):
+            return self._run_supervised()
+
+    def _run_supervised(self) -> int:
         self.metrics.gauge("world_size").set(len(self.active))
         if self.summary_writer is not None and self._elastic:
             # Initial world size, so the scalar stream starts at the

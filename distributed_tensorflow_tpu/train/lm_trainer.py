@@ -978,6 +978,24 @@ class LMTrainer:
         epoch_offset: int = 0,
         finalize: bool = True,
     ) -> dict:
+        """Trace-scoped entry for :meth:`_run_compiled` (the whole-run
+        fast path — full contract on the implementation just below): one
+        trace id per run, reusing run()'s when chunked dispatches arrive
+        inside it."""
+        from distributed_tensorflow_tpu.observability import tracing
+
+        with tracing.trace(tracing.current_trace()):
+            return self._run_compiled(
+                epochs, epoch_offset=epoch_offset, finalize=finalize
+            )
+
+    def _run_compiled(
+        self,
+        epochs: int | None = None,
+        *,
+        epoch_offset: int = 0,
+        finalize: bool = True,
+    ) -> dict:
         """Whole-run fast path: all epochs + per-epoch in-graph perplexity
         as ONE dispatch. Log lines (uniform AvgTime), summaries, and
         history match :meth:`run`; the in-graph perplexity covers the
@@ -1347,9 +1365,12 @@ class LMTrainer:
         SIGTERM/SIGINT requests a stop, the loop exits at the next epoch
         (or dispatch-chunk) boundary with a final save, and the process
         can exit 0 (train/resilience.py)."""
+        from distributed_tensorflow_tpu.observability import tracing
         from distributed_tensorflow_tpu.train.resilience import preemption_guard
 
-        with preemption_guard(
+        # Ambient trace (round 12): one id across every journal event of
+        # this run — see Trainer.run. Reuses an enclosing trace.
+        with tracing.trace(tracing.current_trace()), preemption_guard(
             self.supervisor,
             enabled=self.config.handle_preemption,
             print_fn=self.print_fn,

@@ -130,7 +130,10 @@ class Supervisor:
         bytes, duration), feeds the metrics registry (save count/bytes/
         duration histogram), and records a host span. All three sinks are
         optional — trainers wire theirs in; a bare Supervisor stays
-        silent."""
+        silent. Trace ids (round 12) need no plumbing here: saves happen
+        inside the trainer's ambient trace context, so the journal tags
+        every checkpoint event with the run's trace automatically
+        (observability/tracing.py)."""
         self._journal = journal
         self._metrics = metrics
         self._spans = spans

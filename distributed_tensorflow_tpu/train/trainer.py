@@ -557,6 +557,24 @@ class Trainer:
         epoch_offset: int = 0,
         finalize: bool = True,
     ) -> dict:
+        """Trace-scoped entry for :meth:`_run_compiled` (the whole-run
+        fast path — full contract on the implementation just below): one
+        trace id per run, reusing run()'s when chunked dispatches arrive
+        inside it."""
+        from distributed_tensorflow_tpu.observability import tracing
+
+        with tracing.trace(tracing.current_trace()):
+            return self._run_compiled(
+                epochs, epoch_offset=epoch_offset, finalize=finalize
+            )
+
+    def _run_compiled(
+        self,
+        epochs: int | None = None,
+        *,
+        epoch_offset: int = 0,
+        finalize: bool = True,
+    ) -> dict:
         """Whole-run fast path (train/compiled_run.py): every epoch, shuffle,
         and test eval compiled into ONE dispatch. Observable surface matches
         ``run()`` — same log lines (uniform AvgTime, as in the scanned path),
@@ -993,9 +1011,15 @@ class Trainer:
         SIGTERM/SIGINT requests a stop, the loop exits at the next epoch
         (or dispatch-chunk) boundary with a final save, and the process
         can exit 0 (train/resilience.py)."""
+        from distributed_tensorflow_tpu.observability import tracing
         from distributed_tensorflow_tpu.train.resilience import preemption_guard
 
-        with preemption_guard(
+        # Ambient trace (round 12): every journal event of this run —
+        # steps, epochs, checkpoint saves, spans, rollbacks — carries one
+        # trace id, so obs_report can separate interleaved runs sharing a
+        # journal. Reuses an enclosing trace (a resumed run staying in
+        # its caller's scope) instead of splitting it.
+        with tracing.trace(tracing.current_trace()), preemption_guard(
             self.supervisor,
             enabled=self.config.handle_preemption,
             print_fn=self.print_fn,

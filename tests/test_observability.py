@@ -836,8 +836,11 @@ def test_observability_imports_and_runs_without_jax(tmp_path):
         import sys
         sys.modules.pop("jax", None)
         import distributed_tensorflow_tpu.observability as obs
+        from distributed_tensorflow_tpu.observability import aggregate, tracing
         from distributed_tensorflow_tpu.observability import format as F
-        from distributed_tensorflow_tpu.tools import obs_report, perf_record
+        from distributed_tensorflow_tpu.tools import (
+            obs_report, perf_record, regression_gate,
+        )
         from distributed_tensorflow_tpu.utils import summary
         from distributed_tensorflow_tpu.utils.logging import StepLogger
 
@@ -870,6 +873,36 @@ def test_observability_imports_and_runs_without_jax(tmp_path):
         assert s["lifecycle"][0]["line"].startswith("Restart: restart=1/2")
         assert s["kinds"]["span"] == 2
         assert lines[0].startswith("Step: 1,")
+
+        # Round 12: tracing + aggregator + exporter + regression gate are
+        # all jax-free too (the fleet layer must run on the driver host).
+        with tracing.trace("t-nojax"):
+            assert obs.NullJournal().emit("x")["trace"] == "t-nojax"
+        rj = obs.EventJournal(obs.rank_journal_path(%(d)r, 0), rank=0)
+        rj.emit("worker_start", pid=1)
+        rj.close()
+        merged = aggregate.merge(%(d)r)
+        assert set(merged["ranks"]) == {"driver", "rank0"}
+        trace = aggregate.gang_chrome_trace(merged)
+        assert any(e["name"] == "process_name" for e in trace["traceEvents"])
+
+        import json as _json
+        from urllib.request import urlopen
+        reg = obs.MetricsRegistry()
+        reg.gauge("world_size").set(1)
+        with obs.MetricsExporter(reg, health_fn=lambda: {"ok": 1}) as exp:
+            body = urlopen(exp.url + "/metrics").read().decode()
+            assert "world_size 1" in body
+            hz = _json.loads(urlopen(exp.url + "/healthz").read())
+            assert hz["status"] == "ok" and hz["ok"] == 1
+
+        gpath = %(d)r + "/gate.jsonl"
+        for v in (100.0, 10.0):
+            obs.append_event(gpath, "bench_point", tool="t", name="n",
+                             value=v, unit="tokens/s")
+        assert regression_gate.main(
+            ["--journal", gpath, "--bench-root", %(d)r]
+        ) == 1  # the injected drop is caught with no jax anywhere
         print("NOJAX-OK")
         """
         % {"d": str(tmp_path)}
