@@ -140,8 +140,23 @@ class TrainConfig:
     grad_clip_norm: float = 0.0
     # Rematerialization (jax.checkpoint on the model forward): recompute
     # activations in the backward pass instead of storing them — trades MXU
-    # FLOPs for HBM activation memory. Gradients unchanged.
-    remat: bool = False
+    # FLOPs for HBM activation memory. Gradients unchanged. The LM family
+    # additionally accepts "selective" (round 13): a Pallas-aware
+    # jax.checkpoint policy that SAVES the flash-attention out+lse
+    # (cheap, O(B·L·d)) and recomputes only the layernorm/QKV/MLP half of
+    # each block — grad-identical to True, reaches every dp_mode through
+    # LMTrainer. Wins on MXU-sized rows where the recompute third is
+    # mostly attention (docs/benchmarks/lm_phases.md); keep plain True at
+    # toy widths. The classifier path treats any truthy value as plain
+    # remat (its models have no selective policy surface).
+    remat: bool | str = False
+    # Opt-in low-precision projection matmuls for the LM family
+    # (models/gpt.GPTLM(matmul_dtype=), ops/quantized.py): None | "int8"
+    # | "fp8". int8 is the v5e MXU's native double-rate regime; forward
+    # quantized with dynamic symmetric scales, backward straight-through
+    # at full precision, loss-parity-guarded (tests/test_quantized.py).
+    # The classifier path rejects it (no quantized surface there).
+    matmul_dtype: str | None = None
     # "naive" = reference parity (CE over softmax probabilities, NaN-guarded,
     # reference tfsingle.py:44-45); "stable" = logits-based log-softmax CE.
     loss: str = "naive"
@@ -278,6 +293,19 @@ class TrainConfig:
         if self.max_rollbacks < 0:
             raise ValueError(
                 f"max_rollbacks must be >= 0 (0 disables), got {self.max_rollbacks}"
+            )
+        if not (
+            isinstance(self.remat, bool) or self.remat == "selective"
+        ):
+            raise ValueError(
+                f"remat must be False, True, or 'selective'; got "
+                f"{self.remat!r} (callable policies go directly on the "
+                "model: GPTLM(remat=policy))"
+            )
+        if self.matmul_dtype not in (None, "int8", "fp8"):
+            raise ValueError(
+                f"matmul_dtype must be None, 'int8', or 'fp8'; got "
+                f"{self.matmul_dtype!r}"
             )
         if self.keep_last_n is not None and self.keep_last_n < 0:
             raise ValueError(

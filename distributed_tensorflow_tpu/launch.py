@@ -48,9 +48,12 @@ def config_from_env(base: TrainConfig | None = None) -> TrainConfig:
     DTF_MAX_RESTARTS (gang-restart budget), DTF_STALL_TIMEOUT_MS
     (live-but-stalled detection window), DTF_MIN_WORKERS (shrink-to-fit
     floor, round 8; 0 disables resizing) and DTF_REJOIN_TIMEOUT_S
-    (replacement-registration window before a resize). Invalid values
+    (replacement-registration window before a resize), and the round-13
+    perf knobs: DTF_REMAT (0 | 1 | selective) and DTF_MATMUL_DTYPE
+    (int8 | fp8, empty → off). Invalid values
     raise ValueError naming the knob — a scheduler typo must fail the
-    launch, not silently train with defaults."""
+    launch, not silently train with defaults (TrainConfig.__post_init__
+    validates the perf-knob values the same way)."""
     import os
 
     def _parse(var: str, conv):
@@ -91,6 +94,15 @@ def config_from_env(base: TrainConfig | None = None) -> TrainConfig:
         kw["compiled_run"] = os.environ["DTF_COMPILED"] == "1"
     if "DTF_LOGS" in os.environ:
         kw["logs_path"] = os.environ["DTF_LOGS"]
+    if "DTF_REMAT" in os.environ:
+        raw = os.environ["DTF_REMAT"]
+        # Empty/0/1 keep the boolean surface (empty = off, matching the
+        # sibling knob's unset-style contract); "selective" is the
+        # round-13 policy; anything else fails in
+        # TrainConfig.__post_init__.
+        kw["remat"] = raw == "1" if raw in ("", "0", "1") else raw
+    if "DTF_MATMUL_DTYPE" in os.environ:
+        kw["matmul_dtype"] = os.environ["DTF_MATMUL_DTYPE"] or None
     return cfg.replace(**kw) if kw else cfg
 
 
@@ -275,6 +287,12 @@ def build_trainer(
     from distributed_tensorflow_tpu.utils.summary import SummaryWriter
 
     config = config or TrainConfig()
+    # Pure config validation runs BEFORE any model/dataset construction.
+    if getattr(config, "matmul_dtype", None):
+        raise ValueError(
+            "matmul_dtype is an LM-family knob (models/gpt.GPTLM / "
+            "LMTrainer); the classifier models have no quantized path"
+        )
     is_chief = context.is_chief if context is not None else True
     if model is None:
         from distributed_tensorflow_tpu.models import build_model
@@ -283,6 +301,9 @@ def build_trainer(
             config.model, compute_dtype=jnp.dtype(config.compute_dtype)
         )
     if config.remat:
+        # Any truthy value — including "selective" — is plain
+        # jax.checkpoint here: the classifier models carry no
+        # checkpoint-name surface for a selective policy to save.
         model = _RematAdapter(model)
     datasets = datasets or read_data_sets(data_dir, one_hot=True)
     strategy = strategy or build_strategy(config)

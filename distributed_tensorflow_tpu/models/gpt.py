@@ -179,8 +179,9 @@ class GPTLM:
         moe_z_coef: float = 1e-3,
         moe_top_k: int = 1,
         pos_embedding: str = "learned",
-        remat: bool = False,
+        remat: bool | str = False,
         flash_min_len: int | None = None,
+        matmul_dtype: str | None = None,
     ):
         assert model_dim % num_heads == 0
         if attention_impl not in ("xla", "flash"):
@@ -255,7 +256,61 @@ class GPTLM:
         # from O(num_layers · L · d) to O(L · d) + one block's recompute per
         # layer in the backward — the standard long-context memory/FLOPs
         # trade (the reference never needed it: 784-feature MLP).
+        #
+        # Round 13 widens the knob into a POLICY surface:
+        #   True        — plain jax.checkpoint (recompute everything);
+        #   "selective" — jax.checkpoint with save_only_these_names over
+        #                 the flash-attention out+lse (O(B·L·d) to store
+        #                 vs the O(L²)-work kernel recompute); only the
+        #                 layernorm/QKV/MLP half of each block replays.
+        #                 Grad-identical to True (pinned in test_gpt.py).
+        #                 WHEN IT WINS: MXU-sized rows with the flash
+        #                 kernel engaged (d≈2048, L ≥ flash_min_len),
+        #                 where the measured backward is three near-equal
+        #                 forwards and the recompute third is mostly
+        #                 attention (docs/benchmarks/lm_phases.md). Toy
+        #                 widths — and any config on the dense-attention
+        #                 fallback — should keep remat=True: there the
+        #                 saved tensors cost more HBM than the recompute
+        #                 costs FLOPs (the round-4 dots-saveable probe
+        #                 lost to plain remat the same way).
+        #   callable    — passed straight to jax.checkpoint(policy=...).
+        # Every forward path (scanned stack, sp/ep bodies, pipeline
+        # stages) routes through _remat_wrap, so the policy reaches every
+        # dp_mode. The shard_map sp ring does not thread the save names —
+        # "selective" there degrades to plain remat semantics (correct,
+        # no savings).
+        if not (
+            isinstance(remat, bool)
+            or remat == "selective"
+            or callable(remat)
+        ):
+            raise ValueError(
+                f"remat must be False, True, 'selective', or a "
+                f"jax.checkpoint policy callable; got {remat!r}"
+            )
         self.remat = remat
+        # Opt-in low-precision projection matmuls (ops/quantized.py):
+        # None | "int8" | "fp8". Covers the block QKV/out projections and
+        # the dense FFN pair wherever the model runs (training forward,
+        # prefill, decode) — NOT the logits head (tied embedding, kept at
+        # compute_dtype) and NOT MoE expert matmuls (ops/moe keeps its
+        # own dtype discipline). Forward in the reduced dtype with
+        # dynamic symmetric scales, backward straight-through at full
+        # precision; the contract is the synthetic-corpus loss-parity
+        # guard in tests/test_quantized.py. TUNNEL-TPU claim until the
+        # chip rerun: int8 is the v5e MXU's native double-rate regime.
+        if matmul_dtype is not None:
+            from distributed_tensorflow_tpu.ops.quantized import (
+                MATMUL_DTYPES,
+            )
+
+            if matmul_dtype not in MATMUL_DTYPES:
+                raise ValueError(
+                    f"unknown matmul_dtype {matmul_dtype!r}; None or one "
+                    f"of {MATMUL_DTYPES}"
+                )
+        self.matmul_dtype = matmul_dtype
 
     # -- init --------------------------------------------------------------
 
@@ -366,11 +421,63 @@ class GPTLM:
 
     # -- shared pieces -----------------------------------------------------
 
-    def _dot(self, x, w):
+    def _dot_full(self, x, w):
+        """compute_dtype matmul with f32 accumulation — the always-full-
+        precision dot (the logits/tied-embedding head, and every
+        projection when ``matmul_dtype`` is unset)."""
         cd = self.compute_dtype
         return jnp.dot(
             x.astype(cd), w.astype(cd), preferred_element_type=jnp.float32
         )
+
+    def _dot(self, x, w):
+        """Block-projection matmul (QKV/out and the dense-FFN pair,
+        training AND decode): ``matmul_dtype`` reroutes it through
+        :func:`~ops.quantized.quantized_dot` — int8/fp8 forward on the
+        MXU's native low-precision path, exact full-precision backward
+        (straight-through). The logits head stays on :meth:`_dot_full`
+        (quantizing the tied-embedding head measurably hurts loss), and
+        MoE expert matmuls stay at compute_dtype (``_moe_block_ffn``
+        routes through ops/moe, which the ``matmul_dtype`` contract
+        deliberately excludes — see __init__)."""
+        if self.matmul_dtype is None:
+            return self._dot_full(x, w)
+        from distributed_tensorflow_tpu.ops.quantized import quantized_dot
+
+        return quantized_dot(self.matmul_dtype, x, w)
+
+    @property
+    def _policy_remat(self) -> bool:
+        """Whether ``remat`` is a POLICY mode ("selective" or a callable)
+        rather than the plain boolean — the modes under which ``_attend``
+        tags the flash forward with checkpoint names."""
+        return bool(self.remat) and self.remat is not True
+
+    def _remat_policy(self):
+        """The jax.checkpoint policy for the current ``remat`` value, or
+        None for the plain (save-nothing) checkpoint."""
+        if self.remat == "selective":
+            from distributed_tensorflow_tpu.ops.pallas_attention import (
+                REMAT_SAVE_NAMES,
+            )
+
+            return jax.checkpoint_policies.save_only_these_names(
+                *REMAT_SAVE_NAMES
+            )
+        if callable(self.remat):
+            return self.remat
+        return None
+
+    def _remat_wrap(self, body):
+        """``jax.checkpoint`` around a scanned-block (or pipeline-stage)
+        body per the ``remat`` knob — the ONE wrapper every forward path
+        uses, so a policy mode reaches dense/sp/ep/pp identically."""
+        if not self.remat:
+            return body
+        policy = self._remat_policy()
+        if policy is None:
+            return jax.checkpoint(body)
+        return jax.checkpoint(body, policy=policy)
 
     def _attend(self, q, k, v, kv_lens=None):
         from distributed_tensorflow_tpu.models.base import (
@@ -381,9 +488,22 @@ class GPTLM:
             resolve_flash_min_len(self.flash_min_len)
         ):
             from distributed_tensorflow_tpu.ops.pallas_attention import (
+                REMAT_SAVE_NAMES,
                 flash_attention,
+                flash_attention_with_lse,
             )
 
+            if self._policy_remat:
+                # Selective remat: name out+lse so the enclosing
+                # checkpoint policy saves them and the backward recompute
+                # skips the O(L²)-work forward kernel (the rebuild
+                # composition — see flash_attention_with_lse). Inert
+                # without an enclosing policy (eval/prefill paths).
+                out, _ = flash_attention_with_lse(
+                    q, k, v, causal=True, window=self.window,
+                    kv_lens=kv_lens, save_names=REMAT_SAVE_NAMES,
+                )
+                return out
             return flash_attention(
                 q, k, v, causal=True, window=self.window, kv_lens=kv_lens
             )
@@ -520,7 +640,7 @@ class GPTLM:
 
     def _logits(self, p: GPTLMParams, h):
         hf = _layernorm(h, p.lnf_scale, p.lnf_bias)
-        return self._dot(hf, p.embed.T)
+        return self._dot_full(hf, p.embed.T)
 
     # -- training forward --------------------------------------------------
 
@@ -556,8 +676,7 @@ class GPTLM:
             )
             return h, aux
 
-        if self.remat:
-            body = jax.checkpoint(body)
+        body = self._remat_wrap(body)
         h, auxs = lax.scan(body, h, params.blocks)
         return self._logits(params, h), auxs
 
@@ -669,8 +788,7 @@ class GPTLM:
             h, _, _ = self._block(blk, h, attend=sp_attend, positions=positions)
             return h, None
 
-        if self.remat:
-            body = jax.checkpoint(body)
+        body = self._remat_wrap(body)
         h, _ = lax.scan(body, h, params.blocks)
         return self._logits(params, h)
 
@@ -737,8 +855,7 @@ class GPTLM:
             h, _, aux = self._block(blk, h, ffn=ep_ffn, positions=positions)
             return h, aux
 
-        if self.remat:
-            body = jax.checkpoint(body)
+        body = self._remat_wrap(body)
         h, auxs = lax.scan(body, h, params.blocks)
         logits = self._logits(params, h)
         return (logits, auxs) if with_aux else logits
@@ -777,7 +894,7 @@ class GPTLM:
             h, _ = lax.scan(body, x, jax.tree.map(lambda a: a[0], blk_stack))
             return h
 
-        return jax.checkpoint(stage_fn) if self.remat else stage_fn
+        return self._remat_wrap(stage_fn)
 
     def apply_pipeline_parallel(
         self,

@@ -16,14 +16,26 @@ Forward (online softmax, one grid step per (batch·head, q-block, k-block)):
     acc  = acc·corr + p·v
     out  = acc / l;  lse = m + log(l)     (written at the last k-block)
 
-Backward is the standard two-kernel split, re-deriving p from the saved
-row-wise log-sum-exp instead of storing it:
+Backward re-derives p from the saved row-wise log-sum-exp instead of
+storing it:
 
     p  = exp(s - lse)                      (exact, no second softmax pass)
     dv += pᵀ·do
     ds = p·(do·vᵀ - delta)·scale           delta = rowsum(do·out)
-    dk += dsᵀ·q                            (k-major kernel, q innermost)
-    dq += ds·k                             (q-major kernel, k innermost)
+    dk += dsᵀ·q
+    dq += ds·k
+
+The default backward (round 13) is ONE fused k-major kernel: a single
+pass over KV blocks computes s/p/dp/ds once and produces all three
+gradients — dk/dv accumulate in VMEM scratch exactly as before, while
+each grid step writes its dq *partial* to its own block of a
+[nk, B·H, L, D] output that one XLA sum reduces afterwards (TPU grids
+may only revisit output blocks in consecutive iterations, so cross-k
+in-kernel dq accumulation is illegal; the partial-sum layout is the
+same one jax's splash-attention fused backward uses). ``fused=False``
+restores the classic two-kernel split (q-major dq kernel + k-major dkv
+kernel), which computes the score-space work twice — kept as the escape
+hatch and the parity oracle for the fused path.
 
 Accumulators live in VMEM scratch that persists across the innermost grid
 dimension (TPU grids run sequentially, minor-most fastest); causal masking
@@ -272,11 +284,7 @@ def _fwd_call(
         else (lambda b, iq, ik: (row(b), ik, 0))
     )
     has_lens = kv_lens is not None
-    lens_spec = (
-        pl.BlockSpec((1, 1), lambda b, iq, ik: (b, 0))
-        if interpret
-        else pl.BlockSpec(memory_space=pltpu.SMEM)  # whole array
-    )
+    lens_spec = _lens_blockspec(interpret)
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
         pl.BlockSpec((1, bk, d), kmap),
@@ -429,9 +437,107 @@ def _dkv_kernel(
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _fused_bwd_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    scale: float, causal: bool, window: int | None, nq: int, total: int,
+    has_lens: bool, offset: int = 0, lens_by_row: bool = True,
+):
+    """One pass over the KV stream producing ALL THREE gradients: the
+    k-major ``_dkv_kernel`` grid, with the score-space work (s, p, dp,
+    ds) computed ONCE per block pair — the two-kernel split computes it
+    twice. dk/dv accumulate in VMEM scratch exactly as in
+    ``_dkv_kernel``; dq cannot accumulate the same way (its q-block is
+    revisited at every non-consecutive k step, which TPU output
+    semantics forbid), so each grid step writes its dq *partial* to its
+    own block of a [nk, B·H, L, D] f32 output and ``_bwd_call`` sums
+    the leading axis in XLA — the splash-attention fused-backward
+    layout. Skipped (fully-masked) block pairs still own a block, which
+    is zeroed up front so the sum sees no garbage."""
+    if has_lens:
+        kvlen_ref, dqp_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        dqp_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+        kvlen_ref = None
+    ik = pl.program_id(1)
+    j = pl.program_id(2)
+    iq = j % nq  # positional q block; j // nq is the GQA head in the group
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # Every grid step owns exactly one dq-partial block: zero it first so
+    # block pairs the causal/window predicate skips contribute zero.
+    dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
+
+    def _accumulate():
+        q = q_ref[0]
+        k = k_ref[0]
+        do = do_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        # Explicit p masking — see _dq_kernel (fully-masked rows saved
+        # lse ~= -1e30; underflow alone would give p = 1 there).
+        mask = None
+        if causal:
+            mask = _causal_mask(iq, ik, bq, bk, window, offset)
+        if has_lens:
+            lm = _kvlen_valid(ik, bq, bk, kvlen_ref, lens_by_row)
+            mask = lm if mask is None else mask & lm
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0])
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+        dv_scr[:] += jnp.dot(
+            p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
+        )
+        dp = jnp.dot(do, v_ref[0].T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale
+        dk_scr[:] += jnp.dot(
+            ds.astype(q.dtype).T, q, preferred_element_type=jnp.float32
+        )
+        dqp_ref[0, 0] = jnp.dot(
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        pl.when(_block_needed(iq, ik, bq, bk, window, offset))(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(j == total - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _lens_blockspec(interpret):
+    """Key-padding lengths spec shared by every kernel launch (forward,
+    dq, dkv, fused — see ``_kvlen_valid`` for the two layouts): the
+    whole [rows, 1] array in SMEM on Mosaic, a per-row (1, 1) block on
+    the CPU interpreter."""
+    return (
+        pl.BlockSpec((1, 1), lambda b, i, j: (b, 0))
+        if interpret
+        else pl.BlockSpec(memory_space=pltpu.SMEM)  # whole array
+    )
+
+
+def _qrow_specs(bq, d, qmap):
+    """[1, bq, d] q/do blocks and the matching [1, bq, 1] lse/delta
+    row-statistic blocks walking one shared index map — the ONE builder
+    for every backward launch (q-major dq, k-major dkv, fused), so the
+    row-spec layout cannot drift between consumers."""
+    return pl.BlockSpec((1, bq, d), qmap), pl.BlockSpec((1, bq, 1), qmap)
+
+
 def _bwd_call(
     q, k, v, o, lse, do, delta, kv_lens,
     *, causal, window, offset, bq, bk, scale, interpret, vma, hq, hkv,
+    fused,
 ):
     sds = partial(jax.ShapeDtypeStruct, vma=vma) if vma else jax.ShapeDtypeStruct
     bh, l, d = q.shape
@@ -439,21 +545,79 @@ def _bwd_call(
     g = hq // hkv
     nq, nk = l // bq, l // bk
     row = _kv_row(hq, hkv)
-    qspec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
-    rowspec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
+    has_lens = kv_lens is not None
+    lens_spec = _lens_blockspec(interpret)
+    banded = offset == 0 and _use_banding(window, l)
+
+    # k-major layout (dkv and fused launches): q/do/lse/delta blocks walk
+    # the innermost dim, which under GQA spans all g query heads sharing
+    # this KV head (j = head·nq + jq) — dk/dv accumulate over the whole
+    # group in one scratch pass.
+    def qrow(b, j):
+        return (b // hkv) * hq + (b % hkv) * g + j // nq
+
+    if banded:
+        _band = _banded_q_index(window, bq, bk, nq)
+
+        def qmap2(b, i, j):
+            _, jq, _ = _band(b, i, j % nq)
+            return (qrow(b, j), jq, 0)
+
+    else:
+
+        def qmap2(b, i, j):
+            return (qrow(b, j), j % nq, 0)
+
+    qspec2, rowspec2 = _qrow_specs(bq, d, qmap2)
+    kspec2 = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0))
+    kv_inputs = [q, k, v, do, lse, delta]
+    kv_specs = [qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2]
+    if has_lens:
+        # k-major grid: b indexes B·Hkv rows.
+        kv_inputs.append(jnp.repeat(kv_lens.astype(jnp.int32), hkv)[:, None])
+        kv_specs.append(lens_spec)
+
+    if fused:
+        # dq partials: each grid step's own block (index map UNclamped —
+        # banding only redirects the resident input blocks), reduced in
+        # XLA. f32 partials + f32 sum match the two-kernel path's f32
+        # scratch accumulation.
+        dqp_spec = pl.BlockSpec(
+            (1, 1, bq, d), lambda b, i, j: (i, qrow(b, j), j % nq, 0)
+        )
+        dqp, dk, dv = pl.pallas_call(
+            partial(
+                _fused_bwd_kernel,
+                scale=scale, causal=causal, window=window, nq=nq,
+                total=nq * g, has_lens=has_lens, offset=offset,
+                lens_by_row=not interpret,
+            ),
+            grid=(bhkv, nk, nq * g),
+            in_specs=kv_specs,
+            out_specs=(dqp_spec, kspec2, kspec2),
+            out_shape=(
+                sds((nk, bh, l, d), jnp.float32),
+                sds((bhkv, l, d), k.dtype),
+                sds((bhkv, l, d), v.dtype),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((bk, d), jnp.float32),
+                pltpu.VMEM((bk, d), jnp.float32),
+            ],
+            interpret=interpret,
+        )(*kv_inputs)
+        return jnp.sum(dqp, axis=0).astype(q.dtype), dk, dv
+
+    # Two-kernel escape hatch (fused=False): q-major dq kernel + k-major
+    # dkv kernel, each re-deriving p — the parity oracle for the fused
+    # path and the fallback if a Mosaic regression ever hits it.
     kmap = (
         _banded_k_index(window, bq, bk, row)
-        if offset == 0 and _use_banding(window, l)
+        if banded
         else (lambda b, i, j: (row(b), j, 0))
     )
+    qspec, rowspec = _qrow_specs(bq, d, lambda b, i, j: (b, i, 0))
     kspec = pl.BlockSpec((1, bk, d), kmap)
-    has_lens = kv_lens is not None
-    lens_spec = (
-        pl.BlockSpec((1, 1), lambda b, i, j: (b, 0))
-        if interpret
-        else pl.BlockSpec(memory_space=pltpu.SMEM)  # whole array
-    )
-
     dq_inputs = [q, k, v, do, lse, delta]
     dq_specs = [qspec, kspec, kspec, qspec, rowspec, rowspec]
     if has_lens:
@@ -473,33 +637,6 @@ def _bwd_call(
         interpret=interpret,
     )(*dq_inputs)
 
-    # k-major: q/do/lse/delta blocks walk the innermost dim, which under
-    # GQA spans all g query heads sharing this KV head (j = head·nq + jq) —
-    # dk/dv accumulate over the whole group in one scratch pass.
-    def qrow(b, j):
-        return (b // hkv) * hq + (b % hkv) * g + j // nq
-
-    if offset == 0 and _use_banding(window, l):
-        _band = _banded_q_index(window, bq, bk, nq)
-
-        def qmap(b, i, j):
-            _, jq, _ = _band(b, i, j % nq)
-            return (qrow(b, j), jq, 0)
-
-    else:
-
-        def qmap(b, i, j):
-            return (qrow(b, j), j % nq, 0)
-
-    qspec2 = pl.BlockSpec((1, bq, d), qmap)
-    rowspec2 = pl.BlockSpec((1, bq, 1), qmap)
-    kspec2 = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0))
-    dkv_inputs = [q, k, v, do, lse, delta]
-    dkv_specs = [qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2]
-    if has_lens:
-        # k-major grid: b indexes B·Hkv rows.
-        dkv_inputs.append(jnp.repeat(kv_lens.astype(jnp.int32), hkv)[:, None])
-        dkv_specs.append(lens_spec)
     dk, dv = pl.pallas_call(
         partial(
             _dkv_kernel,
@@ -507,7 +644,7 @@ def _bwd_call(
             has_lens=has_lens, offset=offset, lens_by_row=not interpret,
         ),
         grid=(bhkv, nk, nq * g),
-        in_specs=dkv_specs,
+        in_specs=kv_specs,
         out_specs=(kspec2, kspec2),
         out_shape=(
             sds((bhkv, l, d), k.dtype),
@@ -518,7 +655,7 @@ def _bwd_call(
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
-    )(*dkv_inputs)
+    )(*kv_inputs)
     return dq, dk, dv
 
 
@@ -538,14 +675,19 @@ def _from_bh(x, b, h):
     return jnp.einsum("bhld->blhd", x.reshape(b, h, l, d))
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8))
-def _flash(causal, window, offset, bq, bk, interpret, vma, hq, hkv, q, k, v, kv_lens):
+@partial(jax.custom_vjp, nondiff_argnums=tuple(range(10)))
+def _flash(
+    causal, window, offset, bq, bk, interpret, vma, hq, hkv, fused,
+    q, k, v, kv_lens,
+):
     """Primal returns (out, lse) — both differentiable. The lse output is
     what makes blockwise *composition* (ring attention) differentiable: a
     cotangent on lse folds into the backward's delta term, since
     ∂lse_i/∂s_ij = p_ij means ds = p·(dp − (delta − g_lse))·scale.
     ``kv_lens`` (None or [B] int32) is an integer side input — its
-    "gradient" is None."""
+    "gradient" is None. ``fused`` picks the backward implementation
+    (one-pass fused kernel vs the two-kernel split); the primal ignores
+    it."""
     scale = 1.0 / (q.shape[-1] ** 0.5)
     return _fwd_call(
         q, k, v, kv_lens,
@@ -555,16 +697,22 @@ def _flash(causal, window, offset, bq, bk, interpret, vma, hq, hkv, q, k, v, kv_
 
 
 def _flash_fwd(
-    causal, window, offset, bq, bk, interpret, vma, hq, hkv, q, k, v, kv_lens
+    causal, window, offset, bq, bk, interpret, vma, hq, hkv, fused,
+    q, k, v, kv_lens,
 ):
     o, lse = _flash(
-        causal, window, offset, bq, bk, interpret, vma, hq, hkv, q, k, v,
-        kv_lens,
+        causal, window, offset, bq, bk, interpret, vma, hq, hkv, fused,
+        q, k, v, kv_lens,
     )
     return (o, lse), (q, k, v, o, lse, kv_lens)
 
 
-def _flash_bwd(causal, window, offset, bq, bk, interpret, vma, hq, hkv, res, g):
+def _flash_bwd_impl(
+    causal, window, offset, bq, bk, interpret, vma, hq, hkv, fused, res, g
+):
+    """(dq, dk, dv) from the saved residuals — shared by ``_flash``'s vjp
+    and the selective-remat rebuild (``_flash_rebuild``), whose residual
+    tuples are identical by construction."""
     q, k, v, o, lse, kv_lens = res
     do, dlse = g
     scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -574,15 +722,101 @@ def _flash_bwd(causal, window, offset, bq, bk, interpret, vma, hq, hkv, res, g):
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
     ) - dlse.astype(jnp.float32)
-    dq, dk, dv = _bwd_call(
+    return _bwd_call(
         q, k, v, o, lse, do, delta, kv_lens,
         causal=causal, window=window, offset=offset, bq=bq, bk=bk,
         scale=scale, interpret=interpret, vma=vma, hq=hq, hkv=hkv,
+        fused=fused,
+    )
+
+
+def _flash_bwd(
+    causal, window, offset, bq, bk, interpret, vma, hq, hkv, fused, res, g
+):
+    dq, dk, dv = _flash_bwd_impl(
+        causal, window, offset, bq, bk, interpret, vma, hq, hkv, fused,
+        res, g,
     )
     return dq, dk, dv, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# The checkpoint_name labels under which the selective-remat policy saves
+# the attention forward (models/gpt.py remat="selective" builds
+# jax.checkpoint_policies.save_only_these_names(*REMAT_SAVE_NAMES)).
+REMAT_SAVE_NAMES = ("flash_out", "flash_lse")
+
+# Auto-fusion cap: the fused backward's dq-partial buffer is
+# nk · (B·H·L·D) f32 in HBM — (L/bk) full gradient copies. The default
+# (fused=None) picks the fused kernel only while that buffer stays under
+# this cap, so extreme-length configs (L=16k attention-bench rows and
+# beyond) silently keep the two-kernel split instead of OOMing a 16 GB
+# v5e that is already carrying the xl activation stash. 1 GiB keeps the
+# primary target (gpt-xl-L2048: ~536 MB of partials) fused. PROVISIONAL
+# until the chip rerun measures where the fused win stops paying for the
+# extra HBM traffic — an explicit fused=True/False always wins.
+_FUSED_DQ_CAP_BYTES = 1 << 30
+
+
+def _resolve_fused(
+    fused: bool | None, bh: int, l: int, d: int, bk: int,
+    window: int | None = None,
+) -> bool:
+    """fused=None → auto: fuse unless (a) the [nk, B·H, L, D] f32
+    dq-partial output would exceed ``_FUSED_DQ_CAP_BYTES``, or (b) the
+    call is in the BANDED-window regime (``_use_banding``) — there the
+    fused kernel would write and re-read mostly structurally-zero
+    partial planes (only in-band k-blocks contribute to a q-block's dq,
+    but every plane exists), multiplying dq HBM traffic by ~nk against
+    the split path's single VMEM-accumulated dq. Both rules are
+    PROVISIONAL pending the chip rerun; an explicit bool always wins."""
+    if fused is not None:
+        return fused
+    if _use_banding(window, l):
+        return False
+    return (l // bk) * bh * l * d * 4 <= _FUSED_DQ_CAP_BYTES
+
+
+@partial(jax.custom_vjp, nondiff_argnums=tuple(range(10)))
+def _flash_rebuild(
+    causal, window, offset, bq, bk, interpret, vma, hq, hkv, fused,
+    q, k, v, kv_lens, o, lse,
+):
+    """Identity on (o, lse) whose VJP is the real flash backward — the
+    selective-remat composition hook (``save_names=`` in the public
+    API). Its residuals are its own INPUTS, so under
+    ``jax.checkpoint(policy=save_only_these_names(...))`` the saved
+    (named) o/lse substitute directly and DCE drops the flash *forward*
+    from the backward recompute. Naming the outputs of ``_flash`` alone
+    cannot achieve that: a custom-vjp's residuals are the pre-name
+    values, so the kernel still reruns (measured — recompute FLOPs
+    unchanged). The gradient path is exclusively through this function
+    (the primal ``_flash`` call is gradient-stopped), so nothing double
+    counts; o/lse arrive via stop_gradient and get zero cotangents."""
+    return o, lse
+
+
+def _flash_rebuild_fwd(
+    causal, window, offset, bq, bk, interpret, vma, hq, hkv, fused,
+    q, k, v, kv_lens, o, lse,
+):
+    return (o, lse), (q, k, v, o, lse, kv_lens)
+
+
+def _flash_rebuild_bwd(
+    causal, window, offset, bq, bk, interpret, vma, hq, hkv, fused, res, g
+):
+    dq, dk, dv = _flash_bwd_impl(
+        causal, window, offset, bq, bk, interpret, vma, hq, hkv, fused,
+        res, g,
+    )
+    _, _, _, o, lse, _ = res
+    return dq, dk, dv, None, jnp.zeros_like(o), jnp.zeros_like(lse)
+
+
+_flash_rebuild.defvjp(_flash_rebuild_fwd, _flash_rebuild_bwd)
 
 
 def flash_attention(
@@ -598,6 +832,8 @@ def flash_attention(
     block_k: int | None = None,
     interpret: bool | None = None,
     vma: tuple[str, ...] | None = None,
+    fused: bool | None = None,
+    save_names: tuple[str, str] | None = None,
 ) -> jax.Array:
     """Exact attention on [B, L, H, D] without materializing [L, L] scores.
 
@@ -625,12 +861,21 @@ def flash_attention(
     ``_pick_block`` (512 below L=4096, 1024 from there up — the round-3 ≤128
     cap was 4x slower at L=2048); pass ``block_q``/``block_k`` to
     override for odd shapes.
+
+    ``fused`` picks the backward: the default (None) runs the one-pass
+    fused dq+dk+dv kernel whenever its dq-partial buffer fits
+    ``_FUSED_DQ_CAP_BYTES`` (see :func:`_resolve_fused`), falling back
+    to the two-kernel split past the cap; an explicit True/False always
+    wins. Gradients are identical either way within accumulation-order
+    tolerance — pinned in tests/test_pallas_attention.py and
+    tools/attention_parity.py. ``save_names`` — see
+    :func:`flash_attention_with_lse`.
     """
     out, _ = flash_attention_with_lse(
         q, k, v,
         causal=causal, window=window, kv_lens=kv_lens, offset=offset,
         block_q=block_q, block_k=block_k,
-        interpret=interpret, vma=vma,
+        interpret=interpret, vma=vma, fused=fused, save_names=save_names,
     )
     return out
 
@@ -648,6 +893,8 @@ def flash_attention_with_lse(
     block_k: int | None = None,
     interpret: bool | None = None,
     vma: tuple[str, ...] | None = None,
+    fused: bool | None = None,
+    save_names: tuple[str, str] | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """:func:`flash_attention` that also returns the per-row softmax
     log-sum-exp, shape [B, L, H] f32 — the statistic needed to *combine*
@@ -662,7 +909,19 @@ def flash_attention_with_lse(
     holding a KV block that originated F positions behind the local queries
     is exactly causal+window attention at offset F (all-past blocks without
     a window are the degenerate ``F >= L`` case, where it equals
-    ``causal=False``)."""
+    ``causal=False``).
+
+    ``save_names=(out_name, lse_name)`` arms the selective-remat
+    composition (pass :data:`REMAT_SAVE_NAMES` unless you need distinct
+    labels): the forward is computed gradient-stopped, both outputs are
+    tagged with ``jax.ad_checkpoint.checkpoint_name``, and gradients
+    route through :func:`_flash_rebuild` whose residuals ARE the named
+    values — so an enclosing ``jax.checkpoint`` with
+    ``save_only_these_names(*save_names)`` stores only out+lse
+    (O(B·L·d), cheap) and the backward recompute skips the O(L²)-work
+    forward kernel entirely. Without an enclosing policy the naming is
+    inert and the math/gradients are unchanged (pinned in
+    tests/test_gpt.py selective-remat grad-identity tests)."""
     if k.shape != v.shape:
         raise ValueError(f"k/v shapes must match: {k.shape} {v.shape}")
     if (
@@ -696,10 +955,30 @@ def flash_attention_with_lse(
         )
     bq = _pick_block(l, block_q)
     bk = _pick_block(l, block_k)
-    out, lse = _flash(
+    statics = (
         causal, window, offset, bq, bk, interpret,
         frozenset(vma) if vma else None,  # ShapeDtypeStruct wants a set
-        h, hkv,
-        _to_bh(q), _to_bh(k), _to_bh(v), kv_lens,
+        h, hkv, _resolve_fused(fused, b * h, l, d, bk, window),
     )
+    qb, kb, vb = _to_bh(q), _to_bh(k), _to_bh(v)
+    if save_names is None:
+        out, lse = _flash(*statics, qb, kb, vb, kv_lens)
+    else:
+        if len(save_names) != 2:
+            raise ValueError(
+                f"save_names must be (out_name, lse_name), got {save_names}"
+            )
+        from jax.ad_checkpoint import checkpoint_name
+
+        # Gradient-stopped primal + named outputs + rebuild: the ONLY
+        # grad path is _flash_rebuild's vjp (no double counting), and
+        # its residuals are the named values a selective policy saves.
+        o, lse0 = _flash(
+            *statics,
+            lax.stop_gradient(qb), lax.stop_gradient(kb),
+            lax.stop_gradient(vb), kv_lens,
+        )
+        o = checkpoint_name(o, save_names[0])
+        lse0 = checkpoint_name(lse0, save_names[1])
+        out, lse = _flash_rebuild(*statics, qb, kb, vb, kv_lens, o, lse0)
     return _from_bh(out, b, h), jnp.transpose(lse.reshape(b, h, l), (0, 2, 1))

@@ -1,0 +1,105 @@
+"""Low-precision (int8 / fp8) matmul with a straight-through backward.
+
+The reference squeezes throughput out of fixed hardware by restructuring
+the training step — its whole experiment table is async-vs-sync modes ×
+worker counts at fixed wall-clock (reference README.md:166-254, the
+multi-ps × multi-worker benchmark grid; no reference analog exists at
+the arithmetic level, TF1 ran f32 throughout). This module is the same
+theme one layer down:
+the v5e MXU's native low-precision regime is int8 (double the bf16
+TOPS), and fp8 (e4m3) rides the same hardware path. ``quantized_dot``
+computes the forward contraction in the reduced dtype with
+full-precision accumulation and SYMMETRIC dynamic scales — per
+activation ROW and per weight COLUMN, the standard dynamic-quantization
+recipe, so one outlier row/column cannot crush everyone else's
+resolution — while the backward is the exact full-precision matmul
+transpose via a straight-through estimator: quantization noise perturbs
+the forward only, and gradients flow as if the matmul were exact (the
+standard quantized-training recipe; W8A8 dynamic, LLM.int8()/SmoothQuant
+lineage). The consumer contract is ``GPTLM(matmul_dtype=)`` — opt-in,
+guarded by the synthetic-corpus loss-parity test in
+tests/test_quantized.py.
+
+Scope note: this is a *dot wrapper*, not a Pallas kernel — XLA lowers an
+int8×int8→int32 ``dot_general`` straight onto the MXU's int8 path on
+TPU, so there is nothing for a custom kernel to add at these shapes; on
+CPU (tests) the same graph runs through XLA's emulation bit-exactly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-12
+# Largest representable magnitudes the scales map amax onto: int8's 127,
+# float8_e4m3fn's largest normal 448.
+_QMAX = {"int8": 127.0, "fp8": 448.0}
+
+MATMUL_DTYPES = tuple(_QMAX)
+
+
+def _amax_scale(x, axis, qmax):
+    """Symmetric dynamic scale mapping max|x| over ``axis`` onto qmax
+    (floored at eps so all-zero rows/columns quantize to zeros instead
+    of NaNs)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    return jnp.maximum(amax, _EPS) / qmax
+
+
+def _qdot_impl(dtype: str, x, w):
+    if dtype not in _QMAX:
+        raise ValueError(
+            f"unknown matmul dtype {dtype!r}; one of {MATMUL_DTYPES}"
+        )
+    qmax = _QMAX[dtype]
+    sx = _amax_scale(x, -1, qmax)  # [..., 1]   per activation row
+    sw = _amax_scale(w, 0, qmax)  # [1, N]     per weight column
+    xs = x.astype(jnp.float32) / sx
+    ws = w.astype(jnp.float32) / sw
+    if dtype == "int8":
+        xq = jnp.clip(jnp.round(xs), -qmax, qmax).astype(jnp.int8)
+        wq = jnp.clip(jnp.round(ws), -qmax, qmax).astype(jnp.int8)
+        # int8×int8 → int32 accumulation: the MXU-native pass.
+        acc = jnp.dot(
+            xq, wq, preferred_element_type=jnp.int32
+        ).astype(jnp.float32)
+    else:  # fp8: cast carries rounding; e4m3 covers |x| <= 448 post-scale
+        acc = jnp.dot(
+            xs.astype(jnp.float8_e4m3fn),
+            ws.astype(jnp.float8_e4m3fn),
+            preferred_element_type=jnp.float32,
+        )
+    return acc * sx * sw  # dequantize: [..., 1] × [1, N] broadcast
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def quantized_dot(dtype: str, x, w):
+    """``x [..., K] @ w [K, N]`` with the contraction in ``dtype``
+    (``"int8"`` or ``"fp8"``), f32 result — dynamic symmetric scales per
+    activation row and weight column. Differentiable via the
+    straight-through estimator: both gradients are the exact f32 matmul
+    transposes of the UNquantized operands (residuals x, w), so
+    quantization error never enters the backward. Under GSPMD the scale
+    reductions partition like the dot itself (a row-sharded weight's
+    per-column amax becomes one all-reduce-max)."""
+    return _qdot_impl(dtype, x, w)
+
+
+def _qdot_fwd(dtype, x, w):
+    return _qdot_impl(dtype, x, w), (x, w)
+
+
+def _qdot_bwd(dtype, res, g):
+    x, w = res
+    gf = g.astype(jnp.float32)
+    dx = jnp.dot(gf, w.astype(jnp.float32).T).astype(x.dtype)
+    g2 = gf.reshape(-1, gf.shape[-1])
+    x2 = x.astype(jnp.float32).reshape(-1, x.shape[-1])
+    dw = jnp.dot(x2.T, g2).astype(w.dtype)
+    return dx, dw
+
+
+quantized_dot.defvjp(_qdot_fwd, _qdot_bwd)

@@ -9,6 +9,9 @@ for the full feature matrix — causal, sliding window (both sides of the
 banding crossover), GQA, key-padding (kv_lens), and the ring-composition
 ``offset`` — against ``dense_attention`` on whatever backend it's launched
 on, and emits one JSON line with per-case max errors and pass/fail.
+Round 13 adds a ``fused-vs-split:*`` row per case: the one-pass fused
+dq+dk+dv backward (the new default) against the two-kernel split on the
+same forward, so the on-chip record covers the fused kernel explicitly.
 
 Usage (on the TPU)::
 
@@ -143,6 +146,61 @@ def run_case(c: dict) -> dict:
     }
 
 
+def run_fused_split_case(c: dict) -> dict:
+    """Round-13 rows: the fused one-pass backward against the two-kernel
+    split on the SAME flash forward — the on-chip record for the new
+    kernel (the main rows already run the fused default against dense;
+    this isolates fused-vs-split, which should be ~bitwise since both
+    accumulate in f32). The round-3 lesson applies verbatim: the CPU
+    interpreter tolerates Mosaic-only bugs, so these rows only count
+    when the header says Mosaic."""
+    from distributed_tensorflow_tpu.ops.pallas_attention import flash_attention
+
+    b = 2
+    kq, kk, kv, kc = jax.random.split(jax.random.key(7), 4)
+    q = jax.random.normal(kq, (b, c["l"], c["h"], c["d"]), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, c["l"], c["hkv"], c["d"]), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, c["l"], c["hkv"], c["d"]), jnp.bfloat16)
+    lens = (
+        None if c["kv_lens"] is None else jnp.asarray(c["kv_lens"], jnp.int32)
+    )
+    cot = jax.random.normal(kc, q.shape, jnp.float32)
+    kw = dict(
+        causal=c["causal"], window=c["window"], kv_lens=lens,
+        offset=c["offset"], block_q=c["block"], block_k=c["block"],
+    )
+
+    def gsum(fused):
+        return jax.jit(
+            jax.grad(
+                lambda q, k, v: jnp.sum(
+                    flash_attention(q, k, v, fused=fused, **kw).astype(
+                        jnp.float32
+                    )
+                    * cot
+                ),
+                argnums=(0, 1, 2),
+            )
+        )(q, k, v)
+
+    g_f, g_s = gsum(True), gsum(False)
+
+    def err(a, b):
+        return float(np.max(np.abs(np.asarray(a, np.float32) - np.asarray(b, np.float32))))
+
+    grad_errs = {n: err(a, b) for n, a, b in zip("qkv", g_f, g_s)}
+    tol = ATOL + RTOL
+    ok = all(e < tol for e in grad_errs.values())
+    return {
+        "case": f"fused-vs-split:{c['name']}",
+        "fwd_max_err": 0.0,  # same forward kernel by construction
+        "dq_max_err": round(grad_errs["q"], 5),
+        "dk_max_err": round(grad_errs["k"], 5),
+        "dv_max_err": round(grad_errs["v"], 5),
+        "ok": bool(ok),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--write-docs", action="store_true")
@@ -160,13 +218,15 @@ def main(argv=None) -> int:
     for c in CASES:
         if args.cases and c["name"] not in args.cases:
             continue
-        try:
-            rows.append(run_case(c))
-        except Exception as exc:  # noqa: BLE001
-            rows.append(
-                {"case": c["name"], "ok": False,
-                 "error": f"{type(exc).__name__}: {exc}"[:200]}
-            )
+        for runner, label in ((run_case, c["name"]),
+                              (run_fused_split_case, f"fused-vs-split:{c['name']}")):
+            try:
+                rows.append(runner(c))
+            except Exception as exc:  # noqa: BLE001
+                rows.append(
+                    {"case": label, "ok": False,
+                     "error": f"{type(exc).__name__}: {exc}"[:200]}
+                )
     device = jax.devices()[0].device_kind
     backend = jax.default_backend()
     all_ok = bool(rows) and all(r["ok"] for r in rows)

@@ -235,10 +235,14 @@ def render_decode(rows) -> str:
 
 def bench_config(
     name: str, *, steps: int = 32, lr: float = 1e-3, seed: int = 0,
-    ceiling_tflops: float | None = None,
+    ceiling_tflops: float | None = None, model_overrides: dict | None = None,
 ) -> dict:
     spec = CONFIGS[name]
-    model = GPTLM(vocab_size=_VOCAB, **spec["model"])
+    # Ad-hoc A/B knobs (round 13: remat="selective", matmul_dtype=...)
+    # land on every selected config; main() refuses them with
+    # --write-docs so a probe cannot re-anchor the committed record.
+    mkw = dict(spec["model"], **(model_overrides or {}))
+    model = GPTLM(vocab_size=_VOCAB, **mkw)
     b, l = spec["batch"], model.max_len
     params = model.init(seed=1)
     opt = optax.adam(lr)
@@ -429,12 +433,18 @@ def refresh_derived(rows, ceiling, peaks=None) -> None:
             r["mfu_pct"] = round(100 * achieved / peaks["flops"], 2)
 
 
-def run(configs=None, *, steps: int = 32, ceiling_tflops=None) -> list[dict]:
+def run(
+    configs=None, *, steps: int = 32, ceiling_tflops=None,
+    model_overrides: dict | None = None,
+) -> list[dict]:
     rows = []
     for name in configs or CONFIGS:
         try:
             rows.append(
-                bench_config(name, steps=steps, ceiling_tflops=ceiling_tflops)
+                bench_config(
+                    name, steps=steps, ceiling_tflops=ceiling_tflops,
+                    model_overrides=model_overrides,
+                )
             )
         except Exception as exc:  # noqa: BLE001 — record, keep sweeping
             rows.append(
@@ -533,7 +543,31 @@ def main(argv=None) -> None:
         help="append the measured rows as bench_point journal events "
         "(default with --write-docs: docs/benchmarks/events.jsonl)",
     )
+    ap.add_argument(
+        "--remat",
+        choices=("plain", "selective"),
+        default=None,
+        help="override every selected config's remat mode (A/B the "
+        "round-13 selective policy at the committed shapes); refused "
+        "with --write-docs",
+    )
+    ap.add_argument(
+        "--matmul-dtype",
+        choices=("int8", "fp8"),
+        default=None,
+        help="run with quantized projection matmuls (GPTLM "
+        "matmul_dtype); refused with --write-docs",
+    )
     args = ap.parse_args(argv)
+    if (args.remat or args.matmul_dtype) and (args.write_docs or args.events):
+        # Probes must touch neither the committed docs nor the gate's
+        # bench_point series (their keys carry no override tag — probe
+        # points would contaminate the default-config band).
+        ap.error(
+            "--remat/--matmul-dtype are ad-hoc probes; the committed "
+            "record and the gate's event series track the configs as "
+            "written (drop --write-docs/--events)"
+        )
     ceiling = args.ceiling_tflops or _roofline_ceiling()
     root = os.path.abspath(
         os.path.join(os.path.dirname(__file__), "..", "..", "docs", "benchmarks")
@@ -557,7 +591,15 @@ def main(argv=None) -> None:
         )
         print(f"recomputed {root}/lm_tpu.md and lm_tpu.json (no re-measurement)")
         return
-    rows = run(args.configs, steps=args.steps, ceiling_tflops=ceiling)
+    overrides = {}
+    if args.remat:
+        overrides["remat"] = True if args.remat == "plain" else "selective"
+    if args.matmul_dtype:
+        overrides["matmul_dtype"] = args.matmul_dtype
+    rows = run(
+        args.configs, steps=args.steps, ceiling_tflops=ceiling,
+        model_overrides=overrides or None,
+    )
     # Journal events carry only THIS run's measurements — the carry-
     # forward merge below folds committed rows from other devices/dates
     # into payload["rows"], which must not be re-stamped as fresh points.

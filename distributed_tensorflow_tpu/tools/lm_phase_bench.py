@@ -75,6 +75,18 @@ CONFIGS = {
         ),
         8,
     ),
+    # CPU-runnable flash+remat row (round 13): small enough for the
+    # Pallas interpreter, so the remat-policy comparison region has a
+    # committed point on an egress-less container; its numbers are
+    # interpreter-scale (the row is device-tagged and the table marks
+    # it) — the chip rerun replaces them with Mosaic measurements.
+    "gpt-tiny-L128-flash-remat": (
+        dict(
+            model_dim=128, num_layers=2, num_heads=4, max_len=128,
+            attention_impl="flash", flash_min_len=0, remat=True,
+        ),
+        4,
+    ),
 }
 
 
@@ -117,9 +129,11 @@ def _region_seconds(make_run, args, steps, reps):
 
 def bench_phases(
     name: str, *, steps: int = 4, reps: int = 3,
-    ceiling_tflops: float | None = None,
+    ceiling_tflops: float | None = None, matmul_dtype: str | None = None,
 ) -> dict:
     mkw, b = CONFIGS[name]
+    if matmul_dtype:
+        mkw = dict(mkw, matmul_dtype=matmul_dtype)
     model = GPTLM(vocab_size=_VOCAB, **mkw)
     params = model.init(seed=1)
     opt = optax.adam(1e-3)
@@ -136,8 +150,7 @@ def bench_phases(
             h, _, _ = model._block(blk, h, positions=jnp.arange(l))
             return h, ()
 
-        if model.remat:
-            body = jax.checkpoint(body)
+        body = model._remat_wrap(body)  # honors the policy knob too
         h, _ = lax.scan(body, h, p.blocks)
         return jnp.sum(h.astype(jnp.float32)) * 1e-9
 
@@ -167,7 +180,7 @@ def bench_phases(
                 h, _, _ = model._block(blk, h, positions=positions)
                 return h, ()
 
-            b2 = jax.checkpoint(body) if model.remat else body
+            b2 = model._remat_wrap(body)
             h, _ = lax.scan(b2, h, p.blocks)
             logits = model._logits(p, h)
             return _ce_from_logits(logits, toks)
@@ -188,6 +201,28 @@ def bench_phases(
             (params, tokens),
             steps,
             reps,
+        )
+
+    # Remat-policy comparison region (round 13, ROADMAP item 4): the same
+    # fwd+bwd region under remat="selective" (flash out+lse saved, only
+    # the LN/QKV/MLP half replayed) — measured on remat rows, where the
+    # two policies are the actual A/B. Params as runtime args (the
+    # HTTP-413 gotcha) ride in through _chain unchanged.
+    if model.remat:
+        sel_model = GPTLM(
+            vocab_size=_VOCAB, **dict(mkw, remat="selective")
+        )
+
+        def fwd_bwd_sel(p, toks):
+            loss, grads = jax.value_and_grad(sel_model.loss)(p, toks)
+            gsum = sum(
+                jnp.sum(g.astype(jnp.float32))
+                for g in jax.tree.leaves(grads)
+            )
+            return loss + gsum * 1e-30
+
+        sec["fwd+bwd-selective"] = _region_seconds(
+            lambda n: _chain(fwd_bwd_sel, n), (params, tokens), steps, reps
         )
 
     # Full train step: chained through (params, opt_state) — the same
@@ -274,10 +309,20 @@ def bench_phases(
         "param_count": int(n_params),
         "param_count_nonembed": n_nonembed,
         "remat": bool(model.remat),
+        "matmul_dtype": model.matmul_dtype,
+        "device": jax.devices()[0].device_kind,
         "phase_ms": {
             "blocks-fwd": round(sec["blocks-fwd"] * 1e3, 2),
             "logits+loss": round((sec["fwd"] - sec["blocks-fwd"]) * 1e3, 2),
             "backward": round((sec["fwd+bwd"] - sec["fwd"]) * 1e3, 2),
+            # The round-13 comparison column: the same backward under the
+            # selective policy (None on non-remat rows and rows measured
+            # before the region existed — rendered as an em-dash).
+            "backward-selective": (
+                round((sec["fwd+bwd-selective"] - sec["fwd"]) * 1e3, 2)
+                if "fwd+bwd-selective" in sec
+                else None
+            ),
             "bwd-dgrad": round((sec["fwd+dgrad"] - sec["fwd"]) * 1e3, 2),
             "optimizer": round((sec["step"] - sec["fwd+bwd"]) * 1e3, 2),
             "step": round(sec["step"] * 1e3, 2),
@@ -365,14 +410,14 @@ def refresh_derived(rows, ceiling) -> None:
 def render(rows) -> str:
     cols = [
         "config", "B", "L", "blocks-fwd", "logits+loss", "backward",
-        "bwd rec/dgrad/wgrad", "optimizer", "step (ms)", "attn/layer",
-        "ffn/layer", "MFU†",
+        "bwd selective", "bwd rec/dgrad/wgrad", "optimizer", "step (ms)",
+        "attn/layer", "ffn/layer", "MFU†",
     ]
     out = ["| " + " | ".join(cols) + " |", "|" + "---|" * len(cols)]
     for r in rows:
         if "error" in r:
             out.append(
-                f"| {r['config']} | error: {r['error']} |" + " |" * 10
+                f"| {r['config']} | error: {r['error']} |" + " |" * 11
             )
             continue
         p, pl = r["phase_ms"], r["per_layer_ms"]
@@ -383,17 +428,71 @@ def render(rows) -> str:
             if not split
             else f"{split['recompute']}/{split['dgrad']}/{split['wgrad']}"
         )
+        # Provenance mark (serving.md convention): rows measured off-chip
+        # carry their device; legacy rows without the key are the
+        # committed TUNNEL-TPU record.
+        dev = r.get("device")
+        cfg = r["config"] + (
+            "" if dev is None or "TPU" in str(dev) else f" ({dev})"
+        )
+        sel = p.get("backward-selective")
         out.append(
-            "| {config} | {batch} | {seq_len} | {b} | {ll} | {bw} | {sp} "
-            "| {opt} | {st} | {at} | {ff} | {mfu} |".format(
-                config=r["config"], batch=r["batch"], seq_len=r["seq_len"],
+            "| {config} | {batch} | {seq_len} | {b} | {ll} | {bw} | {sel} "
+            "| {sp} | {opt} | {st} | {at} | {ff} | {mfu} |".format(
+                config=cfg, batch=r["batch"], seq_len=r["seq_len"],
                 b=p["blocks-fwd"], ll=p["logits+loss"], bw=p["backward"],
+                sel="—" if sel is None else sel,
                 sp=split_s, opt=p["optimizer"], st=p["step"],
                 at=pl["attention"], ff=pl["ffn"],
                 mfu="—" if mfu is None else mfu,
             )
         )
     return "\n".join(out)
+
+
+def emit_bench_events(rows, events_path: str) -> list[dict]:
+    """THIS RUN's measured rows as ``bench_point`` journal events, so the
+    round-12 regression gate covers the phase series — including the new
+    plain-vs-selective backward pair. Series identity is
+    ``(lm_phase_bench, <config>/<phase>, device)``: a chip rerun starts
+    its own series and never collides with a CPU-container point."""
+    from distributed_tensorflow_tpu.observability.journal import EventJournal
+
+    j = EventJournal(events_path, run_id="lm_phase_bench")
+    try:
+        out = []
+        for r in rows:
+            if "error" in r or not r.get("phase_ms"):
+                continue
+            pm = r["phase_ms"]
+            common = dict(
+                tool="lm_phase_bench",
+                device=r.get("device") or "",
+                config=r["config"],
+            )
+            out.append(
+                j.emit(
+                    "bench_point", name=f"{r['config']}/step_ms",
+                    value=pm["step"], unit="ms", **common,
+                )
+            )
+            out.append(
+                j.emit(
+                    "bench_point", name=f"{r['config']}/backward_ms",
+                    value=pm["backward"], unit="ms", **common,
+                )
+            )
+            if pm.get("backward-selective") is not None:
+                out.append(
+                    j.emit(
+                        "bench_point",
+                        name=f"{r['config']}/backward_selective_ms",
+                        value=pm["backward-selective"], unit="ms", **common,
+                    )
+                )
+        return out
+    finally:
+        j.close()
 
 
 def main(argv=None) -> None:
@@ -409,7 +508,32 @@ def main(argv=None) -> None:
         "recompute the derived columns (non-embedding 6N, MFU† vs the "
         "current ceiling) and rewrite md+json — runs anywhere, no chip",
     )
+    ap.add_argument(
+        "--matmul-dtype",
+        choices=("int8", "fp8"),
+        default=None,
+        help="run the selected configs with quantized projection matmuls "
+        "(GPTLM matmul_dtype) — an ad-hoc A/B probe, refused with "
+        "--write-docs so it cannot silently re-anchor the record",
+    )
+    ap.add_argument(
+        "--events",
+        default=None,
+        help="append the measured rows as bench_point journal events to "
+        "this events.jsonl (default with --write-docs: "
+        "docs/benchmarks/events.jsonl — the regression-gate series)",
+    )
     args = ap.parse_args(argv)
+    if args.matmul_dtype and (args.write_docs or args.events):
+        # A probe must touch NEITHER committed surface: not the docs, and
+        # not the bench_point journal — its series keys carry no override
+        # tag, so probe points would contaminate the regression-gate band
+        # for the default-precision record.
+        ap.error(
+            "--matmul-dtype is an ad-hoc probe; the committed record and "
+            "the gate's event series track the default precision (drop "
+            "--write-docs/--events)"
+        )
     from distributed_tensorflow_tpu.tools.cost_analysis import (
         measured_ceiling_tflops,
     )
@@ -439,6 +563,7 @@ def main(argv=None) -> None:
                 bench_phases(
                     name, steps=args.steps, reps=args.reps,
                     ceiling_tflops=ceiling,
+                    matmul_dtype=args.matmul_dtype,
                 )
             )
         except Exception as exc:  # noqa: BLE001 — record, keep sweeping
@@ -446,9 +571,11 @@ def main(argv=None) -> None:
                 {"config": name, "error": f"{type(exc).__name__}: {exc}"[:200]}
             )
         print(json.dumps(rows[-1]))
+    measured_rows = list(rows)  # events cover THIS run, not carried rows
     if args.write_docs:
         from distributed_tensorflow_tpu.tools.lm_bench import merge_rows
 
+        prev = None  # the merged prior record, when one was loadable
         if os.path.exists(json_path):
             # Carry-forward merge (lm_bench's --write-docs discipline): a
             # --configs touch-up or a transient tunnel error must not
@@ -470,15 +597,24 @@ def main(argv=None) -> None:
             refresh_derived(rows, ceiling)
         table = render(rows)
         print(table)
+        # Top-level device describes the LEGACY rows (measured before
+        # per-row device tags); preserve it across merges so a CPU
+        # touch-up run cannot relabel the carried TUNNEL-TPU rows.
+        device = jax.devices()[0].device_kind
+        if prev is not None:
+            device = prev.get("device", device)
         with open(json_path, "w") as f:
-            json.dump(
-                {"rows": rows, "device": jax.devices()[0].device_kind}, f,
-                indent=1,
-            )
+            json.dump({"rows": rows, "device": device}, f, indent=1)
         _write_md(root, table, ceiling)
         print(f"wrote {root}/lm_phases.md and lm_phases.json")
     else:
         print(render(rows))
+    events_path = args.events
+    if events_path is None and args.write_docs:
+        events_path = os.path.join(root, "events.jsonl")
+    if events_path:
+        n = len(emit_bench_events(measured_rows, events_path))
+        print(f"appended {n} bench_point events to {events_path}")
 
 
 def _write_md(root, table, ceiling) -> None:
@@ -525,7 +661,24 @@ def _write_md(root, table, ceiling) -> None:
             "shapes, so the next step is a selective policy, not less "
             "remat. The rec/dgrad/wgrad column fills from the first "
             "on-chip rerun with the `bwd-dgrad` region (em-dash = "
-            "pre-round-9 row).\n"
+            "pre-round-9 row).\n\n"
+            "The `bwd selective` column (round 13) is that selective "
+            "policy, built: the same fwd+bwd region re-measured with "
+            "`remat=\"selective\"` — a Pallas-aware jax.checkpoint "
+            "policy that SAVES the flash-attention out+lse (O(B·L·d) to "
+            "store) so the backward replays only the layernorm/QKV/MLP "
+            "half of each block, grad-identical to plain remat "
+            "(test_gpt.py) and paired with the fused one-pass dq+dk+dv "
+            "backward kernel (ops/pallas_attention, "
+            "attention_parity's fused-vs-split rows). Rows tagged with "
+            "a device (e.g. `(cpu)`) are off-chip interpreter points "
+            "committed so the regression-gate series exists — their "
+            "absolute times are NOT comparable to the TUNNEL-TPU rows; "
+            "the xl rows' selective column is an em-dash until the chip "
+            "rerun regenerates this table (serving.md provenance "
+            "convention; no committed MFU† row is re-anchored by the "
+            "policy change — `--recompute-docs` migrates derived "
+            "columns only).\n"
         )
 
 

@@ -78,6 +78,7 @@ ep reads the dense forward, == the EP forward in the no-drop regime —
 
 from __future__ import annotations
 
+import copy
 import os
 import time
 
@@ -124,9 +125,27 @@ class LMTrainer:
         journal=None,
         metrics: MetricsRegistry | None = None,
     ):
-        self.model = model
         self.datasets = datasets
         self.config = config or TrainConfig()
+        # Config-driven perf knobs (round 13): TrainConfig is the single
+        # config surface (config_from_env deployments), so a remat policy
+        # (True | "selective") or low-precision matmul request set there
+        # lands on the model — every dp_mode routes through the model's
+        # forward, which is what makes the knob reach all of them. A knob
+        # the caller already set on the model itself wins on conflict
+        # (TrainConfig validates its values in __post_init__). The knobs
+        # land on a trainer-local SHALLOW COPY: mutating the caller's
+        # instance would leak one trainer's config into every other user
+        # of the same model object (a second trainer, an eval harness).
+        apply_remat = self.config.remat and not model.remat
+        apply_mm = self.config.matmul_dtype and model.matmul_dtype is None
+        if apply_remat or apply_mm:
+            model = copy.copy(model)
+            if apply_remat:
+                model.remat = self.config.remat
+            if apply_mm:
+                model.matmul_dtype = self.config.matmul_dtype
+        self.model = model
         self.optimizer = optimizer or optim_lib.make(
             self.config.optimizer, self.config.learning_rate
         )

@@ -884,3 +884,33 @@ def test_serve_bench_request_percentiles_measures(tmp_path):
     assert pct["requests"] == 3
     assert pct["ttft_s"]["p50"] > 0
     assert pct["latency_s"]["p99"] >= pct["latency_s"]["p50"]
+
+
+def test_lm_phase_bench_events_feed_the_gate(tmp_path):
+    # The round-13 phase series (step / backward / backward-selective)
+    # must ride the same bench_point → regression-gate path as
+    # serve_bench's: two emissions form a band, and a blown-up ms point
+    # fails HIGH (lower-is-better unit).
+    from distributed_tensorflow_tpu.tools import lm_phase_bench, regression_gate
+
+    row = {
+        "config": "x",
+        "device": "cpu",
+        "phase_ms": {"step": 10.0, "backward": 5.0, "backward-selective": 4.0},
+    }
+    path = str(tmp_path / "events.jsonl")
+    lm_phase_bench.emit_bench_events([row], path)
+    row["phase_ms"]["backward-selective"] = 4.1
+    lm_phase_bench.emit_bench_events([row], path)
+    series = regression_gate.journal_series(path)
+    key = ("lm_phase_bench", "x/backward_selective_ms", "cpu")
+    assert key in series and len(series[key]) == 2
+    res = regression_gate.check_series(series)
+    assert not res["failures"]
+    row["phase_ms"]["backward-selective"] = 40.0
+    lm_phase_bench.emit_bench_events([row], path)
+    res = regression_gate.check_series(regression_gate.journal_series(path))
+    assert any(
+        f["name"] == "x/backward_selective_ms" and f["direction"] == "above"
+        for f in res["failures"]
+    )

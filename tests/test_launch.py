@@ -123,6 +123,34 @@ def test_env_override_compiled_run(monkeypatch):
     assert config_from_env().compiled_run is True
 
 
+def test_env_override_perf_knobs(monkeypatch):
+    # Round 13: the perf knobs ride the same env surface the elastic
+    # driver/config deployments use; a typo fails the launch (the
+    # TrainConfig validation), never silently trains with defaults.
+    from distributed_tensorflow_tpu.launch import config_from_env
+
+    monkeypatch.setenv("DTF_REMAT", "selective")
+    monkeypatch.setenv("DTF_MATMUL_DTYPE", "int8")
+    cfg = config_from_env()
+    assert cfg.remat == "selective" and cfg.matmul_dtype == "int8"
+    monkeypatch.setenv("DTF_REMAT", "1")
+    monkeypatch.setenv("DTF_MATMUL_DTYPE", "")
+    cfg = config_from_env()
+    assert cfg.remat is True and cfg.matmul_dtype is None
+    monkeypatch.setenv("DTF_REMAT", "0")
+    assert config_from_env().remat is False
+    # empty = off, matching DTF_MATMUL_DTYPE's unset-style contract
+    monkeypatch.setenv("DTF_REMAT", "")
+    assert config_from_env().remat is False
+    monkeypatch.setenv("DTF_REMAT", "sometimes")
+    with pytest.raises(ValueError, match="remat"):
+        config_from_env()
+    monkeypatch.setenv("DTF_REMAT", "1")
+    monkeypatch.setenv("DTF_MATMUL_DTYPE", "int4")
+    with pytest.raises(ValueError, match="matmul_dtype"):
+        config_from_env()
+
+
 @pytest.mark.heavy
 def test_remat_knob_gradients_match(small_datasets):
     """remat=True recomputes activations in the backward pass; gradients

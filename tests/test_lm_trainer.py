@@ -685,3 +685,34 @@ def test_ep_trainer_shards_and_trains(corpus):
     assert slot_specs and all(s == P(None, "expert") for s in slot_specs)
     ppls = [h["perplexity"] for h in ep.history]
     assert ppls[-1] < ppls[0] and np.isfinite(res["perplexity"])
+
+
+def test_config_perf_knobs_reach_the_model(corpus):
+    # TrainConfig is the single config surface: remat="selective" and
+    # matmul_dtype set THERE must land on the model (and therefore reach
+    # every dp_mode through the model's forward) — unless the caller
+    # already set the knob on the model, which wins. The knobs land on a
+    # trainer-local copy: the caller's instance must stay untouched
+    # (review finding — a shared model object would leak one trainer's
+    # config into every other user).
+    caller_model = _model(attention_impl="flash", flash_min_len=0)
+    tr = LMTrainer(
+        caller_model,
+        corpus(),
+        _cfg(epochs=1, remat="selective", matmul_dtype="int8"),
+        print_fn=lambda *a: None,
+    )
+    assert tr.model.remat == "selective"
+    assert tr.model.matmul_dtype == "int8"
+    assert caller_model.remat is False
+    assert caller_model.matmul_dtype is None
+    res = tr.run()
+    assert np.isfinite(res["perplexity"])
+    # model-set knobs win over config
+    tr2 = LMTrainer(
+        _model(remat=True),
+        corpus(),
+        _cfg(epochs=1, remat="selective"),
+        print_fn=lambda *a: None,
+    )
+    assert tr2.model.remat is True

@@ -360,6 +360,154 @@ def test_kv_lens_shape_validated():
         flash_attention(q, k, v, kv_lens=jnp.asarray([3], jnp.int32))
 
 
+# -- fused one-pass backward vs the two-kernel split -------------------------
+
+
+def _grad3(fn, q, k, v, cot):
+    return jax.grad(
+        lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) * cot),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+
+
+# NOTE: kv_lens stays a plain tuple here — jnp arrays materialized at
+# MODULE scope are created during pytest collection, and a later GC pass
+# over them segfaults this container's jaxlib (cost a debugging cycle:
+# the crash surfaced inside orbax's metadata serializer in a DIFFERENT
+# module). The test body converts.
+_FUSED_CASES = [
+    ("causal", dict(causal=True), dict()),
+    ("noncausal", dict(causal=False), dict()),
+    ("mixed-blocks", dict(causal=True, block_q=16, block_k=32), dict()),
+    ("window", dict(causal=True, window=6, block_q=16, block_k=16), dict()),
+    # L=64, W=10, blocks 16 → 4W <= L: the BANDED k-major index maps.
+    (
+        "banded-gqa",
+        dict(causal=True, window=10, block_q=16, block_k=16),
+        dict(h=4, hkv=2),
+    ),
+    ("gqa", dict(causal=True, block_q=8, block_k=16), dict(h=4, hkv=2, l=32, d=8)),
+    (
+        "kv-lens",
+        dict(causal=True, kv_lens=(13, 29)),
+        dict(l=32, d=8),
+    ),
+    (
+        "kv-lens-gqa",
+        dict(causal=True, kv_lens=(13, 29), block_q=8, block_k=16),
+        dict(h=4, hkv=2, l=32, d=8),
+    ),
+    # offset > window: empty-band rows (the round-3 p-masking regression
+    # territory) must stay exactly zero through the fused path too.
+    (
+        "offset-empty-band",
+        dict(causal=True, window=24, offset=32, block_q=16, block_k=16),
+        dict(),
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "kw,qkv_kw", [c[1:] for c in _FUSED_CASES],
+    ids=[c[0] for c in _FUSED_CASES],
+)
+def test_fused_backward_matches_two_kernel_split(kw, qkv_kw):
+    """The one-pass fused dq+dk+dv kernel (default) against the
+    two-kernel escape hatch across the full feature matrix: both
+    accumulate in f32 (scratch vs partial-sum), so they agree to
+    float-accumulation-order tolerance — in practice bitwise on almost
+    every case."""
+    kw = dict(kw)
+    if kw.get("kv_lens") is not None:
+        kw["kv_lens"] = jnp.asarray(kw["kv_lens"], jnp.int32)
+    shape_kw = dict(qkv_kw)
+    h = shape_kw.pop("hkv", None)
+    q, k, v = _qkv(42, **{k_: v_ for k_, v_ in shape_kw.items()})
+    if h is not None:
+        k, v = k[:, :, :h], v[:, :, :h]
+    cot = jax.random.normal(jax.random.key(43), q.shape, jnp.float32)
+    g_fused = _grad3(
+        lambda q, k, v: flash_attention(q, k, v, fused=True, **kw),
+        q, k, v, cot,
+    )
+    g_split = _grad3(
+        lambda q, k, v: flash_attention(q, k, v, fused=False, **kw),
+        q, k, v, cot,
+    )
+    for gf, gs, name in zip(g_fused, g_split, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gs), atol=1e-6, rtol=1e-6,
+            err_msg=f"d{name} fused vs split",
+        )
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_both_backends_match_dense_gradients(fused):
+    # The dense oracle pins BOTH backward implementations (the suite's
+    # other gradient tests run the fused default; this keeps the escape
+    # hatch from rotting).
+    q, k, v = _qkv(44, l=64, d=16)
+    cot = jax.random.normal(jax.random.key(45), q.shape, jnp.float32)
+    g_flash = _grad3(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=True, window=12, block_q=16, block_k=16,
+            fused=fused,
+        ),
+        q, k, v, cot,
+    )
+    g_dense = _grad3(
+        lambda q, k, v: dense_attention(q, k, v, causal=True, window=12),
+        q, k, v, cot,
+    )
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gd), atol=2e-5, rtol=1e-4,
+            err_msg=f"d{name} mismatch (fused={fused})",
+        )
+
+
+def test_fused_auto_cap_falls_back_past_budget():
+    # fused=None resolves per shape: under the dq-partial HBM cap the
+    # fused kernel runs; past it the two-kernel split is auto-selected
+    # (review finding: extreme-L configs must not OOM by default). An
+    # explicit bool always wins.
+    from distributed_tensorflow_tpu.ops.pallas_attention import (
+        _FUSED_DQ_CAP_BYTES,
+        _resolve_fused,
+    )
+
+    assert _resolve_fused(None, bh=4, l=64, d=16, bk=16) is True
+    # bytes = (l/bk)·bh·l·d·4; pick shapes straddling the cap exactly
+    bh, l, d, bk = 256, 16384, 128, 1024
+    assert (l // bk) * bh * l * d * 4 > _FUSED_DQ_CAP_BYTES
+    assert _resolve_fused(None, bh=bh, l=l, d=d, bk=bk) is False
+    assert _resolve_fused(True, bh=bh, l=l, d=d, bk=bk) is True
+    assert _resolve_fused(False, bh=4, l=64, d=16, bk=16) is False
+    # Banded-window regime (4W <= L): auto prefers the split — the fused
+    # dq partials would be mostly structural zeros (review finding);
+    # below the banding crossover the window changes nothing.
+    assert _resolve_fused(None, bh=4, l=4096, d=64, bk=512, window=1024) is False
+    assert _resolve_fused(None, bh=4, l=2048, d=64, bk=512, window=1024) is True
+    assert _resolve_fused(True, bh=4, l=4096, d=64, bk=512, window=1024) is True
+
+
+def test_fused_kv_lens_pad_gradients_stay_zero():
+    # Padded keys/values receive exactly zero gradient through the fused
+    # kernel (the zeroed dq-partial blocks and the masked p/ds paths).
+    q, k, v = _qkv(46, l=32, d=8)
+    lens = jnp.asarray([13, 29], jnp.int32)
+    cot = jax.random.normal(jax.random.key(47), q.shape, jnp.float32)
+    g = _grad3(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=True, kv_lens=lens, fused=True
+        ),
+        q, k, v, cot,
+    )
+    for grad, name in zip(g[1:], "kv"):
+        for b, n in enumerate(np.asarray(lens)):
+            assert np.all(np.asarray(grad[b, n:]) == 0.0), f"d{name} pad leak"
+
+
 def test_offset_shifted_band_matches_reference():
     # offset=F shifts queries F ahead of keys (the ring composition hook).
     # Regression (found by tools/attention_parity.py on-chip): when

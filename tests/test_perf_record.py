@@ -47,3 +47,41 @@ def test_committed_docs_cite_newest_artifact():
 
 def test_write_docs_is_idempotent():
     assert perf_record.write_docs(print_fn=lambda *a: None) is False
+
+
+def test_lm_phases_docs_match_committed_artifact(tmp_path):
+    """docs/benchmarks/lm_phases.md is GENERATED from lm_phases.json
+    (lm_phase_bench render + _write_md): re-rendering the committed JSON
+    must reproduce the committed md byte for byte, so new JSON columns
+    (round 13: the plain-vs-selective backward pair) cannot land without
+    regenerating the doc — the serving.md staleness discipline."""
+    from distributed_tensorflow_tpu.tools import lm_phase_bench
+    from distributed_tensorflow_tpu.tools.cost_analysis import (
+        measured_ceiling_tflops,
+    )
+
+    root = os.path.abspath(
+        os.path.join(
+            os.path.dirname(perf_record.__file__), "..", "..", "docs",
+            "benchmarks",
+        )
+    )
+    with open(os.path.join(root, "lm_phases.json")) as f:
+        payload = json.load(f)
+    with open(os.path.join(root, "lm_phases.md")) as f:
+        committed = f.read()
+    table = lm_phase_bench.render(payload["rows"])
+    lm_phase_bench._write_md(str(tmp_path), table, measured_ceiling_tflops())
+    with open(tmp_path / "lm_phases.md") as f:
+        regenerated = f.read()
+    assert regenerated == committed, (
+        "docs/benchmarks/lm_phases.md is stale vs lm_phases.json; run "
+        "python -m distributed_tensorflow_tpu.tools.lm_phase_bench "
+        "--recompute-docs (or --write-docs after a measurement)"
+    )
+    # The committed artifact carries the round-13 comparison at least
+    # once (the CPU point until the chip rerun fills the xl rows).
+    assert any(
+        (r.get("phase_ms") or {}).get("backward-selective") is not None
+        for r in payload["rows"]
+    )
