@@ -217,6 +217,32 @@ class TrainConfig:
     rejoin_timeout_s: float = 30.0
     sync: bool = True  # sync DP (pmean all-reduce) vs async emulation
     async_avg_every: int = 0  # async mode: average params every N steps (0 = never)
+    # -- local-SGD / DiLoCo outer loop (train/local_sgd.py; LM family,
+    # dp_mode="diloco") — the paper's async thesis in its modern
+    # communication-reducing form: each worker runs sync_every = H inner
+    # steps with the inner optimizer, then the gang applies ONE outer
+    # update from the pseudo-gradient Δ = θ_start − mean_w(θ_w) through
+    # Nesterov momentum — H× fewer all-reduce rounds per token than sync
+    # dp. The DEFAULTS are the paper-parity convention, momentum-free:
+    # outer_lr=None resolves to N (the worker count), the same
+    # update_scale=N sequential-apply semantics as the async modes, and
+    # outer_momentum=0 keeps that step un-compounded (N× PLUS momentum
+    # is a regime no reference sanctions and it measurably overshoots).
+    # DiLoCo-paper settings are the explicit opt-in: sync_every>=8,
+    # outer_lr≈0.7-1.0, outer_momentum=0.9 — what the convergence
+    # record (docs/benchmarks/diloco.md) uses. outer_momentum=0 +
+    # outer_lr=1 + sync_every=1 degenerates to the per-step parameter
+    # mean (the sync-dp anchor, test-pinned).
+    sync_every: int = 1
+    outer_lr: float | None = None
+    outer_momentum: float = 0.0
+    # Mesh-free diloco gang width: with dp_mode="diloco" and NO mesh, the
+    # LMTrainer runs the SAME gang as one vmapped single-device program
+    # over this many emulated workers (the bench/degraded-container
+    # engine — tools/diloco_bench.py; mathematically the mesh gang with
+    # parallel execution replaced by vectorization). 0 (default) means
+    # dp_mode="diloco" requires a mesh.
+    diloco_workers: int = 0
     # Sync parameter layout: "replicated" (params on every chip, gradient
     # all-reduce — the reference-parity mode) or "zero" (ZeRO-3/FSDP: params
     # and optimizer state sharded over 'data', all-gather fwd/bwd +
@@ -332,6 +358,25 @@ class TrainConfig:
         if self.rejoin_timeout_s < 0:
             raise ValueError(
                 f"rejoin_timeout_s must be >= 0, got {self.rejoin_timeout_s}"
+            )
+        if self.sync_every < 1:
+            raise ValueError(
+                f"sync_every must be >= 1 (1 = exchange every step), "
+                f"got {self.sync_every}"
+            )
+        if self.outer_lr is not None and not self.outer_lr > 0:
+            raise ValueError(
+                f"outer_lr must be > 0 (or None for the worker-count "
+                f"default), got {self.outer_lr}"
+            )
+        if not 0 <= self.outer_momentum < 1:
+            raise ValueError(
+                f"outer_momentum must be in [0, 1), got {self.outer_momentum}"
+            )
+        if self.diloco_workers < 0:
+            raise ValueError(
+                f"diloco_workers must be >= 0 (0 = diloco needs a mesh), "
+                f"got {self.diloco_workers}"
             )
 
     def replace(self, **kw) -> "TrainConfig":

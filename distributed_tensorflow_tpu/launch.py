@@ -48,9 +48,12 @@ def config_from_env(base: TrainConfig | None = None) -> TrainConfig:
     DTF_MAX_RESTARTS (gang-restart budget), DTF_STALL_TIMEOUT_MS
     (live-but-stalled detection window), DTF_MIN_WORKERS (shrink-to-fit
     floor, round 8; 0 disables resizing) and DTF_REJOIN_TIMEOUT_S
-    (replacement-registration window before a resize), and the round-13
+    (replacement-registration window before a resize), the round-13
     perf knobs: DTF_REMAT (0 | 1 | selective) and DTF_MATMUL_DTYPE
-    (int8 | fp8, empty → off). Invalid values
+    (int8 | fp8, empty → off), and the DiLoCo outer-loop knobs
+    (train/local_sgd.py): DTF_SYNC_EVERY (H inner steps per outer
+    round), DTF_OUTER_LR (empty → the worker-count default) and
+    DTF_OUTER_MOMENTUM. Invalid values
     raise ValueError naming the knob — a scheduler typo must fail the
     launch, not silently train with defaults (TrainConfig.__post_init__
     validates the perf-knob values the same way)."""
@@ -94,6 +97,15 @@ def config_from_env(base: TrainConfig | None = None) -> TrainConfig:
         kw["compiled_run"] = os.environ["DTF_COMPILED"] == "1"
     if "DTF_LOGS" in os.environ:
         kw["logs_path"] = os.environ["DTF_LOGS"]
+    if "DTF_SYNC_EVERY" in os.environ:
+        kw["sync_every"] = _parse("DTF_SYNC_EVERY", int)
+    if "DTF_OUTER_LR" in os.environ:
+        # Empty = the worker-count default (the update_scale=N
+        # convention), mirroring the other unset-style knobs.
+        raw = os.environ["DTF_OUTER_LR"]
+        kw["outer_lr"] = _parse("DTF_OUTER_LR", float) if raw else None
+    if "DTF_OUTER_MOMENTUM" in os.environ:
+        kw["outer_momentum"] = _parse("DTF_OUTER_MOMENTUM", float)
     if "DTF_REMAT" in os.environ:
         raw = os.environ["DTF_REMAT"]
         # Empty/0/1 keep the boolean surface (empty = off, matching the

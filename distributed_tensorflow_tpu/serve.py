@@ -868,8 +868,12 @@ class TextServer:
         free = self._free_slots()
         if not free or not self._queue:
             return
-        batch: list[tuple[int, _Request, dict]] = []
+        batch: list[tuple[int, _Request, dict, int]] = []
         skipped: deque[_Request] = deque()
+        # Same-round cold-prefix serialization (round 14): block id →
+        # the admission WAVE whose prefill writes its K/V this round.
+        pending: dict[int, int] = {}
+        bs = self.block_size
         while free and self._queue:
             req = self._queue.popleft()
             plan = self._plan_admission(req)
@@ -880,21 +884,54 @@ class TextServer:
                 # the admitted and among the skipped).
                 skipped.append(req)
                 continue
-            batch.append((free.pop(0), req, plan))
+            # Register the planned full PROMPT blocks in the radix NOW —
+            # round 11 registered post-prefill, so N cold requests
+            # sharing a prefix admitted in ONE round all missed and
+            # prefilled it N times (the GOTCHA that needed staggered
+            # test choreography). A match against a block whose K/V
+            # this round has not yet written is sound only when the
+            # reader dispatches AFTER the writer, so each member lands
+            # in a wave one past its deepest pending dependency and
+            # waves dispatch in order below. Refcounts make the early
+            # registration safe: the writer's slot holds every pending
+            # block until its prefill ran, so eviction (cache-only,
+            # refcount 1) can never reclaim one, and an early finisher
+            # only drops the slot references — the radix keeps its own.
+            wave = 0
+            if self._prefix is not None:
+                matched_ids = plan["table"][: plan["matched"]]
+                deps = [pending[b] for b in matched_ids if b in pending]
+                if deps:
+                    wave = max(deps) + 1
+                n_full = int(req.tokens.size) // bs
+                self._prefix.insert(req.tokens, plan["table"], n_full)
+                for b in plan["table"][plan["matched"]: n_full]:
+                    pending[b] = wave
+            batch.append((free.pop(0), req, plan, wave))
         skipped.extend(self._queue)
         self._queue = skipped
         self.metrics.gauge("queue_depth").set(len(self._queue))
         if not batch:
             return
-        s = self.slots
-        by_bucket: dict[int, list] = {}
-        for slot, req, plan in batch:
-            prefix_len = plan["matched"] * self.block_size
-            suffix = req.tokens[prefix_len:]
+        for slot, req, plan, wave in batch:
             row = self._host_tables[slot]
             row[:] = 0
             row[: len(plan["table"])] = plan["table"]
             self._slot_blocks[slot] = list(plan["table"])
+        for wave in sorted({w for _, _, _, w in batch}):
+            self._prefill_wave(
+                [m for m in batch if m[3] == wave], wave
+            )
+        self.metrics.gauge("kv_blocks_used").set(self._alloc.used_blocks)
+
+    def _prefill_wave(self, members_w, wave: int) -> None:
+        """One admission wave's prefill dispatches (one per length
+        bucket among the wave's members)."""
+        s = self.slots
+        by_bucket: dict[int, list] = {}
+        for slot, req, plan, _ in members_w:
+            prefix_len = plan["matched"] * self.block_size
+            suffix = req.tokens[prefix_len:]
             by_bucket.setdefault(self.bucket_for(suffix.size), []).append(
                 (slot, req, plan, prefix_len, suffix)
             )
@@ -931,6 +968,7 @@ class TextServer:
                         prefix_hit_blocks=int(plan["matched"]),
                         prefix_miss_blocks=int(miss),
                         new_blocks=int(plan["new"]),
+                        wave=int(wave),
                     ),
                 )
             with self.spans.dispatch(
@@ -956,17 +994,10 @@ class TextServer:
             fin = np.asarray(self._state.finished)
             t_first = time.perf_counter()
             for slot, req, plan, prefix_len, suffix in members:
-                # Register the prompt's FULL blocks (now holding valid
-                # K/V) for future prefix hits — before any _finish can
-                # release the slot's references.
-                if self._prefix is not None:
-                    self._prefix.insert(
-                        req.tokens,
-                        self._slot_blocks[slot],
-                        int(req.tokens.size) // self.block_size,
-                    )
+                # Prompt blocks were registered in the radix at
+                # admission-plan time (wave scheduling above); their K/V
+                # is valid as of this dispatch.
                 self._record_first_token(slot, req, first, fin, t_first)
-        self.metrics.gauge("kv_blocks_used").set(self._alloc.used_blocks)
 
     def _admit_slab(self) -> None:
         free = self._free_slots()

@@ -1,0 +1,429 @@
+"""DiLoCo vs sync-dp: held-out perplexity vs sync rounds / wall-clock.
+
+The converged-parity discipline of ``tools/parity_converged.py`` (run the
+claim to convergence, print PASS/FAIL orderings — not 3-epoch throughput
+next to converged reference numbers) applied to ROADMAP item 5: the
+paper's async-over-sync thesis in its modern communication-reducing form
+(train/local_sgd.py). Every row trains the same GPT on the same synthetic
+copy corpus with the same inner optimizer and GLOBAL batch; the rows
+differ only in how often the gang synchronizes:
+
+- ``sync-dp`` — gradient all-reduce every step (one sync round per
+  step). On a mesh-capable jax this is the real ``dp`` mode with
+  measured ``comm_stats`` journal events; on a degraded container it
+  runs as the single-device program (bit-the-same math — GSPMD dp ==
+  single-device on the global batch, proven repo-wide) with the rounds
+  computed by the same ``sync_rounds_between`` arithmetic the trainer
+  journals (engine column says which).
+- ``diloco-hH`` — H inner steps per worker, ONE outer Nesterov update:
+  H× fewer sync rounds per token, measured from the journal's
+  ``comm_stats`` counters, never asserted.
+
+The PASS/FAIL checks are the acceptance claims: DiLoCo at H ≥ 8 within
+2% of sync-dp held-out perplexity at ≥ 4× fewer sync rounds. The
+``outer_lr=N`` row reproduces the reference's ``update_scale=N``
+sequential-apply convention for completeness (its convergence at toy
+scale is aggressive, exactly like the async oracle's early epochs — the
+paper-parity claims for that convention live in parity_converged).
+
+Wall-clock on a CPU container reflects vectorization, not communication
+— the dispatch-amortization half of the story (the outer round as the
+dispatch unit over the ~100 ms tunnel) is a TUNNEL-TPU phenomenon;
+rerun ``--write-docs`` on the chip (the verify-skill runbook has the
+command). Usage::
+
+    python -m distributed_tensorflow_tpu.tools.diloco_bench \
+        --epochs 8 --write-docs
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _silent(*a, **k):
+    pass
+
+
+class _CaptureJournal:
+    """List-capturing journal (duck-typed) for the per-row comm events."""
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, **fields):
+        self.events.append({"kind": kind, **fields})
+        return fields
+
+    def flush(self):
+        pass
+
+
+def _model():
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models.gpt import GPTLM
+
+    return GPTLM(
+        vocab_size=61,
+        max_len=16,
+        model_dim=32,
+        num_heads=4,
+        num_layers=2,
+        compute_dtype=jnp.float32,
+    )
+
+
+def _corpus():
+    from distributed_tensorflow_tpu.data import copy_corpus
+
+    return copy_corpus(
+        num=1664, half_len=8, vocab=61, n_val=128, n_test=128, seed=0
+    )
+
+
+def _mesh_or_none(workers: int):
+    """A ``workers``-wide data mesh, or None on a degraded jax / small
+    device count — the vmapped single-device gang engine then carries
+    the same math (train/local_sgd.py)."""
+    import jax
+
+    if len(jax.devices()) < workers:
+        return None
+    try:
+        from distributed_tensorflow_tpu.parallel import make_mesh
+
+        return make_mesh(
+            (workers,), ("data",), devices=jax.devices()[:workers]
+        )
+    except (ImportError, AttributeError):
+        return None
+
+
+def _rows(workers: int):
+    """(name, sync_every | None for the dp baseline, outer kwargs)."""
+    return [
+        ("sync-dp", None, {}),
+        (
+            "diloco-h8",
+            8,
+            dict(outer_lr=1.0, outer_momentum=0.9),
+        ),
+        (
+            "diloco-h32",
+            32,
+            dict(outer_lr=1.0, outer_momentum=0.9),
+        ),
+        (
+            "diloco-h8-lrN",
+            8,
+            # outer_lr=None → N: the reference PS sequential-apply
+            # convention (update_scale=N); recorded, not gated.
+            dict(outer_lr=None, outer_momentum=0.0),
+        ),
+    ]
+
+
+def run_grid(
+    epochs: int = 8, workers: int = 4, print_fn=print
+) -> list[dict]:
+    import jax
+
+    from distributed_tensorflow_tpu.config import TrainConfig
+    from distributed_tensorflow_tpu.train import LMTrainer
+    from distributed_tensorflow_tpu.train.local_sgd import (
+        params_nbytes,
+        sync_rounds_between,
+    )
+
+    device = jax.devices()[0].device_kind
+    mesh = _mesh_or_none(workers)
+    pbytes = params_nbytes(
+        jax.eval_shape(lambda: _model().init(seed=0))
+    )
+    results = []
+    for name, sync_every, outer_kw in _rows(workers):
+        journal = _CaptureJournal()
+        cfg_kw: dict = {}
+        trainer_kw: dict = {"journal": journal}
+        if sync_every is None:
+            engine = "dp-mesh" if mesh is not None else "single(dp-math)"
+            if mesh is not None:
+                trainer_kw["mesh"] = mesh
+        else:
+            cfg_kw = dict(
+                dp_mode="diloco", sync_every=sync_every, **outer_kw
+            )
+            if mesh is not None:
+                engine = "diloco-mesh"
+                trainer_kw["mesh"] = mesh
+            else:
+                engine = "diloco-vmapped"
+                cfg_kw["diloco_workers"] = workers
+        tr = LMTrainer(
+            _model(),
+            _corpus(),
+            TrainConfig(
+                epochs=epochs,
+                batch_size=64,
+                optimizer="adam",
+                learning_rate=3e-3,
+                log_frequency=10**9,
+                logs_path="",
+                scan_epoch=True,
+                **cfg_kw,
+            ),
+            print_fn=_silent,
+            **trainer_kw,
+        )
+        t0 = time.time()
+        res = tr.run()
+        wall = time.time() - t0
+        comm = [
+            e for e in journal.events if e["kind"] == "comm_stats"
+        ]
+        if comm:
+            rounds = sum(e["sync_rounds"] for e in comm)
+            nbytes = sum(e["allreduce_bytes"] for e in comm)
+        else:
+            # single(dp-math) engine: dp all-reduces every step — the
+            # same arithmetic the trainer journals on a mesh.
+            rounds = sync_rounds_between(0, res["global_step"], 1)
+            nbytes = rounds * pbytes
+        results.append(
+            {
+                "row": name,
+                "engine": engine,
+                "device": device,
+                "workers": workers,
+                "epochs": epochs,
+                "sync_every": sync_every or 1,
+                "outer_lr": None
+                if sync_every is None
+                else (
+                    "N"
+                    if outer_kw["outer_lr"] is None
+                    else outer_kw["outer_lr"]
+                ),
+                "outer_momentum": outer_kw.get("outer_momentum"),
+                "perplexity": round(float(res["perplexity"]), 4),
+                "steps": int(res["global_step"]),
+                "sync_rounds": int(rounds),
+                "allreduce_mb": round(nbytes / 1e6, 2),
+                # One lax.scan dispatch per epoch: on the tunneled chip
+                # the outer round rides inside it (docs/performance.md).
+                "train_dispatches": int(epochs),
+                "wall_s": round(wall, 1),
+            }
+        )
+        print_fn(
+            f"{name}: ppl={results[-1]['perplexity']} "
+            f"rounds={rounds} ({wall:.0f}s, {engine})"
+        )
+    return results
+
+
+def check_claims(results: list[dict]) -> list[str]:
+    """The acceptance claims as explicit PASS/FAIL lines (the
+    parity_converged discipline)."""
+    by = {r["row"]: r for r in results}
+    checks = []
+    sync = by.get("sync-dp")
+    d8 = by.get("diloco-h8")
+    if sync and d8:
+        red = sync["sync_rounds"] / max(d8["sync_rounds"], 1)
+        ok = red >= 4.0
+        checks.append(
+            f"{'PASS' if ok else 'FAIL'} diloco-h8 comm reduction >= 4x "
+            f"(measured {red:.1f}x: {sync['sync_rounds']} -> "
+            f"{d8['sync_rounds']} sync rounds)"
+        )
+        ratio = d8["perplexity"] / sync["perplexity"]
+        ok = ratio <= 1.02
+        checks.append(
+            f"{'PASS' if ok else 'FAIL'} diloco-h8 perplexity within 2% "
+            f"of sync-dp ({d8['perplexity']} vs {sync['perplexity']}, "
+            f"ratio {ratio:.4f})"
+        )
+    d32 = by.get("diloco-h32")
+    if sync and d32:
+        ratio = d32["perplexity"] / sync["perplexity"]
+        checks.append(
+            f"{'PASS' if ratio <= 1.02 else 'FAIL'} diloco-h32 "
+            f"perplexity within 2% at "
+            f"{sync['sync_rounds'] / max(d32['sync_rounds'], 1):.1f}x "
+            f"fewer rounds ({d32['perplexity']} vs {sync['perplexity']})"
+        )
+    return checks
+
+
+def markdown(results: list[dict], checks: list[str]) -> str:
+    dev = results[0]["device"] if results else "?"
+    lines = [
+        "# DiLoCo vs sync-dp — perplexity vs sync rounds / wall-clock",
+        "",
+        "Generated by `python -m distributed_tensorflow_tpu.tools."
+        "diloco_bench --write-docs` (train/local_sgd.py; ROADMAP item 5)."
+        " Same model, corpus, inner optimizer (adam 3e-3) and global "
+        "batch per row; only the gang sync cadence differs.",
+        "",
+        "| Row | Engine | H | outer lr | outer μ | Held-out ppl | "
+        "Sync rounds | All-reduced MB | Train dispatches | Wall s |",
+        "|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in results:
+        lines.append(
+            "| {row} | {engine} | {h} | {lr} | {mu} | {ppl} | {rounds} "
+            "| {mb} | {disp} | {wall} |".format(
+                row=r["row"],
+                engine=f"{r['engine']} ({r['device']})",
+                h=r["sync_every"],
+                lr="—" if r["outer_lr"] is None else r["outer_lr"],
+                mu=(
+                    "—"
+                    if r["outer_momentum"] is None
+                    else r["outer_momentum"]
+                ),
+                ppl=r["perplexity"],
+                rounds=r["sync_rounds"],
+                mb=r["allreduce_mb"],
+                disp=r["train_dispatches"],
+                wall=r["wall_s"],
+            )
+        )
+    lines += [
+        "",
+        "Claim checks:",
+        *(f"- {c}" for c in checks),
+        "",
+        f"Provenance: rows above were measured on `{dev}` — the "
+        "perplexity-vs-sync-rounds columns are the portable claim "
+        "(counted, device-independent); the wall-clock column on a CPU "
+        "container reflects vectorization, NOT communication. The "
+        "dispatch-amortization half (outer round = dispatch unit over "
+        "the ~100 ms tunnel) and the TUNNEL-TPU wall-clock rows await "
+        "the chip rerun (`--write-docs` there; verify-skill runbook). "
+        "The async-beats-sync-under-failure scenario — a DiLoCo gang "
+        "surviving a worker kill mid-run through the round-8 elastic "
+        "resize — is proven end-to-end in "
+        "tests/integration/test_fault_injection.py (RUN_SLOW).",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def emit_bench_events(results: list[dict], events_path: str) -> int:
+    """Gate-covered ``bench_point`` events: the comm-reduction factor and
+    the sync/diloco perplexity ratio per diloco row — both fail LOW under
+    the round-12 direction rule (unit is not ms/s), so a future change
+    that erodes either parity claim fails the fast tier."""
+    from distributed_tensorflow_tpu.observability.journal import (
+        EventJournal,
+    )
+
+    by = {r["row"]: r for r in results}
+    sync = by.get("sync-dp")
+    if sync is None:
+        return 0
+    j = EventJournal(events_path, run_id="diloco_bench")
+    n = 0
+    try:
+        for r in results:
+            if not r["row"].startswith("diloco-h") or r["row"].endswith(
+                "lrN"
+            ):
+                continue
+            common = dict(
+                tool="diloco_bench", device=r["device"], row=r["row"]
+            )
+            j.emit(
+                "bench_point",
+                name=f"{r['row']}/comm_reduction",
+                value=round(
+                    sync["sync_rounds"] / max(r["sync_rounds"], 1), 2
+                ),
+                unit="x",
+                **common,
+            )
+            j.emit(
+                "bench_point",
+                name=f"{r['row']}/ppl_parity",
+                value=round(
+                    sync["perplexity"] / max(r["perplexity"], 1e-9), 4
+                ),
+                unit="ratio",
+                **common,
+            )
+            n += 2
+    finally:
+        j.close()
+    return n
+
+
+def _docs_root() -> str:
+    return os.path.abspath(
+        os.path.join(
+            os.path.dirname(__file__), "..", "..", "docs", "benchmarks"
+        )
+    )
+
+
+def render_from_payload(payload: dict) -> str:
+    """md from the committed json — the staleness-guard entry point
+    (tests/test_perf_record.py re-renders and compares byte-for-byte)."""
+    return markdown(payload["rows"], payload["checks"])
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--workers", type=int, default=4)
+    p.add_argument("--json", type=str, default=None)
+    p.add_argument(
+        "--write-docs",
+        action="store_true",
+        help="rewrite docs/benchmarks/diloco.{md,json} and append the "
+        "gate-covered bench_point events to docs/benchmarks/events.jsonl",
+    )
+    p.add_argument(
+        "--events",
+        default=None,
+        help="append bench_point events to this events.jsonl (default "
+        "with --write-docs: docs/benchmarks/events.jsonl)",
+    )
+    args = p.parse_args(argv)
+    results = run_grid(
+        epochs=args.epochs,
+        workers=args.workers,
+        print_fn=lambda *a: print(*a, file=sys.stderr),
+    )
+    checks = check_claims(results)
+    payload = {"rows": results, "checks": checks}
+    out = render_from_payload(payload)
+    print(out)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(payload, f, indent=1)
+    events_path = args.events
+    if args.write_docs:
+        root = _docs_root()
+        with open(os.path.join(root, "diloco.json"), "w") as f:
+            json.dump(payload, f, indent=1)
+        with open(os.path.join(root, "diloco.md"), "w") as f:
+            f.write(out)
+        events_path = events_path or os.path.join(root, "events.jsonl")
+        print(f"wrote {root}/diloco.md and diloco.json", file=sys.stderr)
+    if events_path:
+        n = emit_bench_events(results, events_path)
+        print(
+            f"appended {n} bench_point events to {events_path}",
+            file=sys.stderr,
+        )
+    return 0 if all(c.startswith("PASS") for c in checks) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

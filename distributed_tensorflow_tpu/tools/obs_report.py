@@ -191,6 +191,38 @@ def summarize(events: list[dict]) -> dict:
     if cache_sec:
         out["serving_cache"] = cache_sec
 
+    # -- comm/compute (round 14: dp vs diloco sync-round accounting) ------
+    # Grouped per (mode, sync_every): one journal can span a mode change
+    # (cross-topology resume) or a sync_every change (a POLICY key — a
+    # resume under a new H is explicitly allowed), and a blended ratio
+    # would misstate the H× headline each segment exists to show.
+    comm = by_kind.get("comm_stats", [])
+    if comm:
+        segs: dict = {}
+        for e in comm:
+            key = (e.get("mode"), e.get("sync_every"))
+            s = segs.setdefault(
+                key,
+                {
+                    "mode": key[0],
+                    "sync_every": key[1],
+                    "steps": 0,
+                    "sync_rounds": 0,
+                    "allreduce_bytes": 0,
+                },
+            )
+            s["steps"] += int(e.get("steps", 0))
+            s["sync_rounds"] += int(e.get("sync_rounds", 0))
+            s["allreduce_bytes"] += int(e.get("allreduce_bytes", 0))
+        for s in segs.values():
+            # Steps of compute per gang sync round — dp is 1.0 by
+            # construction; diloco's value IS the H× comm-reduction
+            # headline (measured from the journal, not asserted).
+            s["steps_per_round"] = round(
+                s["steps"] / max(s["sync_rounds"], 1), 2
+            )
+        out["comm"] = list(segs.values())
+
     # -- bench points (serve_bench / lm_bench emitters) -------------------
     bench = by_kind.get("bench_point", [])
     if bench:
@@ -294,6 +326,13 @@ def render_report(summary: dict) -> str:
                 f"({kb['occupancy']})"
             )
         lines.append("serving cache: " + "; ".join(parts))
+    for cm in summary.get("comm", []):
+        lines.append(
+            f"comm: mode={cm['mode']} sync_every={cm['sync_every']} — "
+            f"{cm['sync_rounds']} sync rounds over {cm['steps']} steps "
+            f"({cm['steps_per_round']} steps/round), "
+            f"{cm['allreduce_bytes']} bytes all-reduced"
+        )
     for b in summary.get("bench_points", []):
         lines.append(
             f"bench: {b.get('tool')}/{b.get('name')} = {b.get('value')} "

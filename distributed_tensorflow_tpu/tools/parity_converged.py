@@ -37,17 +37,11 @@ import json
 import sys
 import time
 
-import jax
-
-from distributed_tensorflow_tpu.config import TrainConfig
-from distributed_tensorflow_tpu.models import MLP
-from distributed_tensorflow_tpu.parallel.mesh import make_mesh
-from distributed_tensorflow_tpu.parallel.strategy import (
-    AsyncDataParallel,
-    SingleDevice,
-    SyncDataParallel,
-)
-from distributed_tensorflow_tpu.train import Trainer
+# jax-backed imports live inside build_trainer/run_grid (lean-import
+# convention, round 8/9): the bench_point emission half of this module
+# (emit_bench_events — the round-14 regression-gate wiring for the
+# paper-parity margins) must import on degraded containers whose jax
+# lacks the mesh APIs the grid itself needs.
 
 
 def _silent(*a, **k):
@@ -76,6 +70,18 @@ def build_trainer(name: str, workers: int, sync: bool, epochs: int, datasets):
     (SURVEY.md §2b sanctions update-count matching); measured: with
     update_scale=1 every async row converges exactly like sync, with
     update_scale=N the reference's orderings reappear."""
+    import jax
+
+    from distributed_tensorflow_tpu.config import TrainConfig
+    from distributed_tensorflow_tpu.models import MLP
+    from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+    from distributed_tensorflow_tpu.parallel.strategy import (
+        AsyncDataParallel,
+        SingleDevice,
+        SyncDataParallel,
+    )
+    from distributed_tensorflow_tpu.train import Trainer
+
     cfg = TrainConfig(
         epochs=epochs,
         compiled_run=True,
@@ -95,6 +101,8 @@ def build_trainer(name: str, workers: int, sync: bool, epochs: int, datasets):
 
 
 def run_grid(epochs: int = 100, datasets=None, print_fn=print) -> list[dict]:
+    import jax
+
     if datasets is None:
         from distributed_tensorflow_tpu.data import read_data_sets
 
@@ -108,6 +116,7 @@ def run_grid(epochs: int = 100, datasets=None, print_fn=print) -> list[dict]:
             {
                 "row": name,
                 "workers": workers,
+                "device": jax.devices()[0].device_kind,
                 "epochs": epochs,
                 "final_accuracy": round(res["accuracy"], 4),
                 "final_cost": round(res["final_cost"], 4),
@@ -145,6 +154,65 @@ def check_orderings(results: list[dict]) -> list[str]:
     return checks
 
 
+def oracle_margins(results: list[dict]) -> dict:
+    """The experiment table's findings as NUMBERS (not just orderings):
+    per-row converged accuracy plus the two margins the reference's
+    claims rest on — async-over-sync at equal workers, and
+    more-async-workers-is-better. One place computes them so the
+    PASS/FAIL checks, the bench_point events, and any future table stay
+    on the same definitions."""
+    acc = {r["row"]: r["final_accuracy"] for r in results}
+    out = {f"{row}_acc": v for row, v in acc.items()}
+    if "async-2-pw" in acc and "sync-2-pw" in acc:
+        out["async2_minus_sync2"] = round(
+            acc["async-2-pw"] - acc["sync-2-pw"], 4
+        )
+    if "async-3-pw" in acc and "async-2-pw" in acc:
+        out["async3_minus_async2"] = round(
+            acc["async-3-pw"] - acc["async-2-pw"], 4
+        )
+    return out
+
+
+def emit_bench_events(results: list[dict], events_path: str) -> int:
+    """The paper-parity oracle margins as ``bench_point`` journal events
+    (round 14): the round-12 regression gate then guards the PARITY
+    trajectory — a change that shrinks the async-over-sync margin fails
+    the fast tier the same way an eroded throughput number does.
+    Accuracy units are not ms/s, so the gate's direction rule fails LOW
+    (a higher accuracy or wider margin is never a regression). Series
+    identity is (parity_converged, <name>, device): a chip rerun starts
+    its own series. Rows re-emitted from a committed grid json
+    (``--from-json``) carry the json's device — every historical grid
+    ran on the 8-virtual-CPU harness, so rows without the key are
+    "cpu"."""
+    from distributed_tensorflow_tpu.observability.journal import EventJournal
+
+    device = results[0].get("device") if results else None
+    if device is None:
+        import jax
+
+        device = jax.devices()[0].device_kind
+    epochs = results[0]["epochs"] if results else None
+    j = EventJournal(events_path, run_id="parity_converged")
+    n = 0
+    try:
+        for name, value in oracle_margins(results).items():
+            j.emit(
+                "bench_point",
+                tool="parity_converged",
+                name=name,
+                value=float(value),
+                unit="acc",
+                device=device,
+                epochs=epochs,
+            )
+            n += 1
+    finally:
+        j.close()
+    return n
+
+
 def markdown(results: list[dict], checks: list[str]) -> str:
     lines = [
         "| Row | Workers | Epochs | Final accuracy | Final cost | Global step | Reference counterpart |",
@@ -174,7 +242,33 @@ def main(argv=None) -> int:
     p.add_argument("--epochs", type=int, default=100)
     p.add_argument("--json", type=str, default=None)
     p.add_argument("--markdown", type=str, default=None)
+    p.add_argument(
+        "--events",
+        default=None,
+        help="append the oracle margins as bench_point journal events "
+        "(docs/benchmarks/events.jsonl to feed the regression gate — "
+        "only meaningful for full-length runs: the margins are "
+        "epoch-count-dependent and the events carry the count)",
+    )
+    p.add_argument(
+        "--from-json",
+        default=None,
+        help="no measurement: load a committed grid json (--json output) "
+        "and emit its margins as bench_point events to --events — runs "
+        "anywhere, no mesh (the lm_phase_bench --recompute-docs "
+        "pattern); rows without a device key are tagged cpu (every "
+        "historical grid ran on the 8-virtual-CPU harness)",
+    )
     args = p.parse_args(argv)
+    if args.from_json:
+        if not args.events:
+            p.error("--from-json needs --events (the journal to append to)")
+        with open(args.from_json) as f:
+            payload = json.load(f)
+        rows = [dict(r, device=r.get("device", "cpu")) for r in payload["rows"]]
+        n = emit_bench_events(rows, args.events)
+        print(f"appended {n} bench_point events to {args.events}")
+        return 0
     results = run_grid(
         epochs=args.epochs, print_fn=lambda *a: print(*a, file=sys.stderr)
     )
@@ -187,6 +281,12 @@ def main(argv=None) -> int:
     if args.markdown:
         with open(args.markdown, "w") as f:
             f.write(out)
+    if args.events:
+        n = emit_bench_events(results, args.events)
+        print(
+            f"appended {n} bench_point events to {args.events}",
+            file=sys.stderr,
+        )
     return 0 if all(c.startswith("PASS") for c in checks) else 1
 
 

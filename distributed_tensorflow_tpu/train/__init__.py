@@ -18,6 +18,10 @@ _LAZY_EXPORTS = {
         "distributed_tensorflow_tpu.train.elastic",
         "HeartbeatHealth",
     ),
+    "DiLoCoState": (
+        "distributed_tensorflow_tpu.train.local_sgd",
+        "DiLoCoState",
+    ),
 }
 
 __all__ = list(_LAZY_EXPORTS)

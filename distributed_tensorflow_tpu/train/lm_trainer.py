@@ -64,7 +64,19 @@ picking which script to launch, reference README.md:90-121):
   activations per device, KV on the causal ring (or Ulysses all-to-all,
   ``sp_attention=``), the EXACT global masked CE assembled from psum'd
   shard sums with the boundary target over one ppermute hop; params
-  replicated; composes with a ``data`` axis → dp×sp).
+  replicated; composes with a ``data`` axis → dp×sp);
+- ``dp_mode="diloco"`` → **diloco** (round 14: local-SGD/DiLoCo outer
+  loop, ``train/local_sgd.py`` — per-worker copies run
+  ``config.sync_every`` = H inner steps each, then ONE outer
+  Nesterov-momentum update from the pseudo-gradient
+  Δ = θ_start − mean_w(θ_w): H× fewer all-reduce rounds per token than
+  dp, the paper's async-over-sync thesis in its communication-reducing
+  modern form. Gang = the ``data`` mesh axis, or — with no mesh —
+  ``config.diloco_workers`` emulated workers vmapped into one
+  single-device program (same math, bench/degraded-container engine).
+  Outer state (θ_start anchor + momentum buffer) lives in the
+  optimizer-state slot as a ``DiLoCoState`` and is world-size-invariant,
+  so an elastic resize carries it across a world change).
 
 Every mode runs the FULL lifecycle: log lines, per-epoch perplexity,
 tfevents, Supervisor save/restore (async checkpoints the stacked copies;
@@ -229,11 +241,24 @@ class LMTrainer:
                 raw = self.supervisor.restore_raw(
                     step, self._abstract_state_for(src)
                 )
-                self.state = self._place_state(
-                    self._state_from_canonical(
-                        self._state_to_canonical(raw, src)
-                    )
+                restored = self._state_from_canonical(
+                    self._state_to_canonical(raw, src)
                 )
+                if src.get("mode") == "diloco" and self.mode == "diloco":
+                    # Elastic resize within the diloco family: the outer
+                    # state (θ_start anchor + Nesterov momentum) carries
+                    # DENSE shapes, so it survives the world change
+                    # verbatim — the next outer round's pseudo-gradient
+                    # is computed against the SAVED anchor over the
+                    # survivor gang ("the outer update proceeds over
+                    # survivors", docs/parallelism.md §local-SGD).
+                    restored = restored._replace(
+                        opt_state=restored.opt_state._replace(
+                            theta=raw.opt_state.theta,
+                            momentum=raw.opt_state.momentum,
+                        )
+                    )
+                self.state = self._place_state(restored)
                 self.start_step = step
             else:
                 # verified_step: the probe above already CRC-verified this
@@ -320,11 +345,42 @@ class LMTrainer:
 
     def _resolve_mode(self) -> str:
         cfg = self.config
-        if cfg.dp_mode not in ("replicated", "zero", "tp", "ep", "pp", "sp"):
+        if cfg.dp_mode not in (
+            "replicated", "zero", "tp", "ep", "pp", "sp", "diloco"
+        ):
             raise ValueError(
                 f"unknown dp_mode {cfg.dp_mode!r}; "
-                "replicated|zero|tp|ep|pp|sp"
+                "replicated|zero|tp|ep|pp|sp|diloco"
             )
+        if cfg.dp_mode == "diloco":
+            if not cfg.sync:
+                raise ValueError(
+                    "dp_mode='diloco' does not compose with sync=False: "
+                    "the outer loop IS the (reduced) synchronization; "
+                    "use sync=False + async_avg_every for the HOGWILD "
+                    "emulation instead"
+                )
+            if self.mesh is not None:
+                if self.data_axis not in self.mesh.shape:
+                    raise ValueError(
+                        f"dp_mode='diloco' needs a {self.data_axis!r} "
+                        f"mesh axis (the gang): {dict(self.mesh.shape)}"
+                    )
+                n = self.mesh.shape[self.data_axis]
+            elif cfg.diloco_workers >= 1:
+                n = cfg.diloco_workers
+            else:
+                raise ValueError(
+                    "dp_mode='diloco' needs a mesh (the gang is the "
+                    f"{self.data_axis!r} axis) or diloco_workers >= 1 "
+                    "(the vmapped single-device gang emulation)"
+                )
+            if cfg.batch_size % n:
+                raise ValueError(
+                    f"dp_mode='diloco' shards the batch over {n} "
+                    f"workers: batch_size {cfg.batch_size} must divide"
+                )
+            return "diloco"
         if self.mesh is None:
             return "single"
         if not cfg.sync:
@@ -496,6 +552,35 @@ class LMTrainer:
             )
             # Params stay replicated (sp shards activations, not weights):
             # the plain TrainState below is already the right layout.
+        if self.mode == "diloco":
+            from distributed_tensorflow_tpu.train.local_sgd import (
+                make_lm_diloco_parts,
+                make_lm_diloco_vmapped,
+            )
+
+            kw = dict(
+                sync_every=self.config.sync_every,
+                outer_lr=self.config.outer_lr,
+                outer_momentum=self.config.outer_momentum,
+                ragged=self._ragged,
+            )
+            if self.mesh is not None:
+                init_state, self._diloco_mapped = make_lm_diloco_parts(
+                    self.model,
+                    self.optimizer,
+                    self.mesh,
+                    axis=self.data_axis,
+                    **kw,
+                )
+            else:
+                init_state, self._diloco_mapped = make_lm_diloco_vmapped(
+                    self.model,
+                    self.optimizer,
+                    self.config.diloco_workers,
+                    **kw,
+                )
+            stacked_p, dstate, count = init_state(params, opt_state)
+            return TrainState(stacked_p, dstate, count)
         if self.mode == "async":
             from distributed_tensorflow_tpu.models.gpt import (
                 make_lm_async_parts,
@@ -563,6 +648,21 @@ class LMTrainer:
                 jax.device_put(state.opt_state, stacked),
                 jax.device_put(state.step, repl),
             )
+        if self.mode == "diloco":
+            # Worker copies + inner opt slots stacked over the gang; the
+            # outer state (θ_start, momentum) replicated — it is ONE
+            # gang-level quantity, not per-worker.
+            stacked = NamedSharding(self.mesh, P(self.data_axis))
+            d = state.opt_state
+            return TrainState(
+                jax.device_put(state.params, stacked),
+                d._replace(
+                    inner=jax.device_put(d.inner, stacked),
+                    theta=jax.device_put(d.theta, repl),
+                    momentum=jax.device_put(d.momentum, repl),
+                ),
+                jax.device_put(state.step, repl),
+            )
         return TrainState(
             jax.device_put(state.params, repl),
             jax.device_put(state.opt_state, repl),
@@ -576,8 +676,10 @@ class LMTrainer:
         stack (pure reshape — the dense forward then reads the same
         weights the pipeline trains), every other mode the parameters
         themselves. Works traced (the compiled run folds in-graph) and
-        concrete alike."""
-        if self.mode == "async":
+        concrete alike. DiLoCo evaluates where async does — at the mean
+        of the worker copies (== θ_start exactly on round boundaries,
+        and the natural mid-round point between them)."""
+        if self.mode in ("async", "diloco"):
             return jax.tree.map(lambda x: jnp.mean(x, axis=0), params)
         if self.mode == "pp":
             return params._replace(
@@ -616,11 +718,27 @@ class LMTrainer:
             meta["stages"] = int(self.mesh.shape[self.stage_axis])
         if self.mode == "async":
             meta["replicas"] = int(self.mesh.shape[self.data_axis])
+        if self.mode == "diloco":
+            meta["replicas"] = int(self._gang_size())
+            # POLICY key (like world/global_batch): the outer-round
+            # length is a schedule knob, not a shape — layout_shape
+            # ignores it, so resuming under a different H keeps the
+            # bitwise same-layout path.
+            meta["sync_every"] = int(self.config.sync_every)
         meta["world"] = int(
             1 if self.mesh is None else self.mesh.size
         )
         meta["global_batch"] = int(self.config.batch_size)
         return meta
+
+    def _gang_size(self) -> int:
+        """Workers in the data-parallel gang (1 when there is none):
+        the data-axis size, or the emulated diloco gang width."""
+        if self.mesh is not None and self.data_axis in self.mesh.shape:
+            return int(self.mesh.shape[self.data_axis])
+        if self.mode == "diloco":
+            return int(self.config.diloco_workers)
+        return 1
 
     def _layout_compatible(self, src: dict) -> bool:
         """True when the saved state's SHAPES match this trainer's (the
@@ -653,7 +771,19 @@ class LMTrainer:
     def _abstract_state_for(self, src: dict) -> TrainState:
         """ShapeDtypeStructs of a checkpoint written under layout ``src``
         (this model + optimizer; cross-OPTIMIZER restore is out of scope —
-        orbax fails loudly on a structure mismatch)."""
+        orbax fails loudly on a structure mismatch). Leaves are pinned to
+        the default LOCAL device: eval_shape structs carry sharding=None,
+        which some orbax vintages cannot normalize (the serve.py
+        canonical_lm_params gotcha, round 9) — and it must be
+        ``local_devices`` because every rank of a multi-process gang
+        restores (``jax.devices()[0]`` is non-addressable on rank > 0)."""
+        dev = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
+        return jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=dev),
+            self._abstract_state_shapes(src),
+        )
+
+    def _abstract_state_shapes(self, src: dict) -> TrainState:
         params = jax.eval_shape(lambda: self.model.init(seed=0))
         if src["mode"] == "pp":
             from distributed_tensorflow_tpu.models.gpt import (
@@ -668,11 +798,23 @@ class LMTrainer:
             )
         opt = jax.eval_shape(self.optimizer.init, params)
         step = jax.ShapeDtypeStruct((), jnp.int32)
-        if src["mode"] == "async":
+        if src["mode"] in ("async", "diloco"):
             n = src["replicas"]
             stack = lambda t: jax.tree.map(  # noqa: E731
                 lambda s: jax.ShapeDtypeStruct((n,) + s.shape, s.dtype), t
             )
+            if src["mode"] == "diloco":
+                from distributed_tensorflow_tpu.train.local_sgd import (
+                    DiLoCoState,
+                )
+
+                # Outer anchor + momentum carry DENSE parameter shapes
+                # regardless of the gang size (world-invariant).
+                return TrainState(
+                    stack(params),
+                    DiLoCoState(stack(opt), params, params),
+                    step,
+                )
             return TrainState(stack(params), stack(opt), step)
         return TrainState(params, opt, step)
 
@@ -693,6 +835,23 @@ class LMTrainer:
             merge = lambda t: jax.tree.map(merge_replica_leaf, t)  # noqa: E731
             return TrainState(
                 merge(state.params), merge(state.opt_state), state.step
+            )
+        if mode == "diloco":
+            # Same merge-at-the-mean as async for the worker copies and
+            # inner slots (merge_replica_leaf keeps integer leaves exact);
+            # the OUTER state (θ_start, momentum) has no canonical slot —
+            # the diloco→diloco resize path carries it verbatim instead
+            # (__init__), every other destination starts a fresh outer
+            # round from the merged parameters.
+            from distributed_tensorflow_tpu.parallel.strategy import (
+                merge_replica_leaf,
+            )
+
+            merge = lambda t: jax.tree.map(merge_replica_leaf, t)  # noqa: E731
+            return TrainState(
+                merge(state.params),
+                merge(state.opt_state.inner),
+                state.step,
             )
         if mode == "pp":
             unstage = lambda p: p._replace(  # noqa: E731
@@ -730,6 +889,27 @@ class LMTrainer:
                 lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), t
             )
             return TrainState(bcast(c.params), bcast(c.opt_state), c.step)
+        if self.mode == "diloco":
+            from distributed_tensorflow_tpu.train.local_sgd import (
+                DiLoCoState,
+            )
+
+            n = self._gang_size()
+            bcast = lambda t: jax.tree.map(  # noqa: E731
+                lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), t
+            )
+            # Fresh outer round from the canonical point: anchor at the
+            # restored params, zero momentum (the diloco→diloco resize
+            # overwrites both with the saved outer state — __init__).
+            return TrainState(
+                bcast(c.params),
+                DiLoCoState(
+                    bcast(c.opt_state),
+                    c.params,
+                    jax.tree.map(jnp.zeros_like, c.params),
+                ),
+                c.step,
+            )
         return c
 
     # -- compiled pieces ---------------------------------------------------
@@ -788,8 +968,12 @@ class LMTrainer:
         ``step(params, opt_state, count, toks, lens) -> (params, opt_state,
         loss)`` (``count`` drives the async exchange cadence; the sync
         modes ignore it)."""
-        if self.mode == "async":
-            mapped = self._async_mapped
+        if self.mode in ("async", "diloco"):
+            mapped = (
+                self._async_mapped
+                if self.mode == "async"
+                else self._diloco_mapped
+            )
             ragged = self._ragged
 
             @jax.jit
@@ -870,8 +1054,12 @@ class LMTrainer:
         model, opt = self.model, self.optimizer
         ragged = self._ragged
         shard = self._shard_batch
-        if self.mode == "async":
-            mapped = self._async_mapped
+        if self.mode in ("async", "diloco"):
+            mapped = (
+                self._async_mapped
+                if self.mode == "async"
+                else self._diloco_mapped
+            )
 
             def abody(carry, idx):
                 params, opt_state, step = carry
@@ -1098,6 +1286,11 @@ class LMTrainer:
                         cost=float(costs[epoch, i]),
                         avg_ms=avg_ms,
                     )
+            self._emit_comm_stats(
+                epoch=epoch_offset + epoch,
+                steps=steps,
+                count_before=step_before + epoch * steps,
+            )
             if self.is_chief:
                 ppl = float(ppls[epoch])
                 logger.log_epoch_metric("Test-Perplexity", ppl)
@@ -1216,7 +1409,7 @@ class LMTrainer:
         if self._eval_chunk is None:
             self._eval_chunk = self._build_eval_chunk()
         params = self.state.params
-        if self.mode in ("async", "pp"):
+        if self.mode in ("async", "diloco", "pp"):
             # Fold to the eval layout ONCE per evaluate call (not per
             # chunk): async takes the mean of the stacked copies, pp
             # merges the staged layer groups — the parameters the metric
@@ -1327,6 +1520,58 @@ class LMTrainer:
         if self.summary_writer is not None and self.is_chief:
             for step, cost in summaries:
                 self.summary_writer.add_scalar("cost", float(cost), step)
+        self._emit_comm_stats(
+            epoch=epoch, steps=steps, count_before=step_before
+        )
+
+    def _emit_comm_stats(
+        self, *, epoch: int, steps: int, count_before: int
+    ) -> None:
+        """Per-epoch communication accounting (round 14) — MEASURED
+        counters, not claims: how many gang-level sync rounds this
+        epoch's steps fired and the bytes they all-reduced (one round
+        moves one dense parameter set: dp's per-step gradient all-reduce
+        and diloco's per-H-steps parameter mean carry the same payload,
+        so the round ratio IS the traffic ratio). Journal ``comm_stats``
+        events feed ``obs_report``'s comm/compute section; the counters
+        land in the metrics registry. Modes whose traffic is not a
+        param-sized all-reduce per round (zero/tp/ep/pp/sp collectives)
+        are out of scope."""
+        if self.mode not in ("dp", "diloco") or steps <= 0:
+            return
+        if self.mode == "diloco":
+            from distributed_tensorflow_tpu.train.local_sgd import (
+                sync_rounds_between,
+            )
+
+            h = self.config.sync_every
+            rounds = sync_rounds_between(
+                count_before, count_before + steps, h
+            )
+        else:
+            h = 1
+            rounds = steps
+        if not hasattr(self, "_dense_param_nbytes"):
+            from distributed_tensorflow_tpu.train.local_sgd import (
+                params_nbytes,
+            )
+
+            self._dense_param_nbytes = params_nbytes(
+                jax.eval_shape(lambda: self.model.init(seed=0))
+            )
+        nbytes = rounds * self._dense_param_nbytes
+        self.journal.emit(
+            "comm_stats",
+            epoch=int(epoch),
+            mode=self.mode,
+            steps=int(steps),
+            sync_every=int(h),
+            sync_rounds=int(rounds),
+            allreduce_bytes=int(nbytes),
+            workers=int(self._gang_size()),
+        )
+        self.metrics.counter("sync_rounds_total").inc(int(rounds))
+        self.metrics.counter("allreduce_bytes_total").inc(int(nbytes))
 
     def _observe_step_time(self, avg_ms: float) -> None:
         """Per-epoch average step time into the metrics registry (mirror

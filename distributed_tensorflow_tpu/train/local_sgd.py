@@ -1,0 +1,376 @@
+"""Local-SGD / DiLoCo outer loop for the LM family — the paper's async
+thesis at LM scale.
+
+The reference's signature result is that ASYNC parameter-server training
+beats sync at fixed wall-clock because workers apply updates the moment
+they have them instead of waiting for the slowest peer (reference
+tfdist_between.py:64-66, README.md:66-74; reproduced by our oracles:
+async 0.8156 vs sync 0.618 @ 2 workers/100 epochs,
+tools/parity_converged.py). ``make_lm_async_parts`` carries that claim to
+the GPT family as per-chip copies exchanging at the mean. This module is
+the *communication-reducing* modern form of the same thesis — local-SGD
+with a DiLoCo-style outer optimizer (Douillard et al. 2023):
+
+- each worker runs ``sync_every`` = H **inner** steps with the ordinary
+  inner optimizer on its own data shard (zero cross-worker traffic);
+- the gang then applies ONE **outer** update from the pseudo-gradient
+
+      Δ = θ_start − mean_w(θ_w)
+
+  through Nesterov momentum:  m ← μ·m + Δ;  θ ← θ_start − η_out·(Δ + μ·m)
+  (``nesterov=False`` uses the heavy-ball form θ ← θ_start − η_out·m);
+  every worker copy then jumps to the new θ, which becomes the next
+  round's θ_start.
+
+That is H× fewer all-reduce rounds per token than sync dp — and on the
+tunneled v5e, where every dispatch carries a ~100 ms roundtrip, the outer
+round is also the natural dispatch unit, so comm reduction and dispatch
+amortization compound (the whole H-step round rides the scanned-epoch
+``lax.scan`` machinery as part of one dispatch).
+
+``outer_lr`` defaults to **N (the worker count)** — the same convention
+as ``AsyncDataParallel``/``make_lm_async_parts``'s ``update_scale=N``
+(parallel/strategy.py:451-470): the reference PS applied all N workers'
+updates *sequentially* to one parameter set, moving it N× the mean
+worker movement per exchange; Δ is exactly the mean worker movement, so
+``outer_lr=N`` with the default ``outer_momentum=0`` reproduces the
+sequential-apply semantics, while ``outer_lr=1`` is pure local-SGD
+averaging. DiLoCo-paper settings are the explicit opt-in —
+``outer_lr≈0.7-1.0, outer_momentum=0.9`` — used by the convergence
+record (an N× step COMPOUNDED by momentum is sanctioned by neither
+regime and measurably overshoots, hence the momentum-free default).
+
+Degenerate anchor: at ``sync_every=1, outer_lr=1, outer_momentum=0`` the
+outer update IS the per-step parameter mean — the computation is
+implemented to reduce to exactly ``pmean(θ_w)`` in that corner (see
+:func:`outer_update`), which makes it bitwise-identical to the async
+exchange (``make_lm_async_parts`` with ``avg_every=1, update_scale=1``)
+and — for SGD, which is linear in the gradient — equal to the sync
+data-parallel step up to float reassociation (both pinned in
+tests/test_local_sgd.py).
+
+Two engines, one math:
+
+- :func:`make_lm_diloco_parts` — the gang on a live mesh: ``shard_map``
+  over the data axis, per-worker copies as [n, ...] stacked leaves (the
+  ``make_lm_async_parts`` layout), outer state replicated.
+- :func:`make_lm_diloco_vmapped` — the same gang as ONE single-device
+  program (``jax.vmap`` over the worker axis). Mathematically the same
+  update; runs on any jax, including degraded containers without the
+  mesh APIs — the engine ``tools/diloco_bench.py`` uses for the CPU
+  perplexity record, and the LMTrainer's ``dp_mode="diloco"`` fallback
+  when no mesh is given (``TrainConfig.diloco_workers``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class DiLoCoState(NamedTuple):
+    """The ``opt_state`` slot of a diloco-mode ``TrainState``.
+
+    ``inner`` are the per-worker inner optimizer states ([n, ...] stacked
+    leaves, sharded/vmapped over the worker axis — they persist ACROSS
+    outer rounds, the DiLoCo recipe); ``theta`` is the outer anchor
+    θ_start (dense parameter shapes, replicated) and ``momentum`` the
+    outer Nesterov buffer (same shapes). ``theta``/``momentum`` are
+    world-size-invariant, which is what lets an elastic resize carry the
+    outer state across a world change (train/lm_trainer.py)."""
+
+    inner: Any
+    theta: Any
+    momentum: Any
+
+
+def outer_update(
+    theta,
+    mean_params,
+    momentum,
+    *,
+    outer_lr: float,
+    outer_momentum: float,
+    nesterov: bool = True,
+):
+    """One outer apply: ``(θ_start, mean_w(θ_w), m) → (θ', m')``.
+
+    Pseudo-gradient Δ = θ_start − mean_params; m' = μ·m + Δ; the applied
+    step is Δ + μ·m' (Nesterov) or m' (heavy-ball); θ' = θ_start −
+    η_out·step. ``outer_lr``/``outer_momentum`` are trace-time Python
+    floats: the ``outer_lr==1 and outer_momentum==0`` corner is
+    specialized to ``θ' = mean_params`` — algebraically identical
+    (θ − 1·(θ − mean) = mean) and, as floats, EXACTLY the parameter mean,
+    which is what makes ``sync_every=1`` degenerate bitwise to the async
+    per-step exchange (module docstring)."""
+    mu = float(outer_momentum)
+    eta = float(outer_lr)
+    delta = jax.tree.map(lax.sub, theta, mean_params)
+    new_m = (
+        jax.tree.map(lambda m, d: mu * m + d, momentum, delta)
+        if mu != 0.0
+        else delta
+    )
+    if eta == 1.0 and mu == 0.0:
+        return mean_params, new_m
+    if nesterov:
+        step = (
+            jax.tree.map(lambda d, m: d + mu * m, delta, new_m)
+            if mu != 0.0
+            else delta
+        )
+    else:
+        step = new_m
+    new_theta = jax.tree.map(lambda t, s: t - eta * s, theta, step)
+    return new_theta, new_m
+
+
+def resolve_outer_lr(outer_lr: float | None, num_workers: int) -> float:
+    """The ONE place the ``None → N`` default lives (the
+    ``update_scale=N`` convention both async APIs share — module
+    docstring); both engines and the trainer's comm accounting route
+    through it so they cannot drift."""
+    return float(num_workers) if outer_lr is None else float(outer_lr)
+
+
+def sync_rounds_between(count0: int, count1: int, sync_every: int) -> int:
+    """Outer rounds fired by steps ``count0 .. count1-1`` (global step
+    counter semantics: step ``t`` fires the exchange iff
+    ``(t+1) % sync_every == 0`` — the ``make_lm_async_parts`` cadence).
+    Host-side mirror of the traced predicate, used by the trainer's
+    per-epoch comm accounting (``comm_stats`` journal events)."""
+    if sync_every < 1:
+        raise ValueError(f"sync_every must be >= 1, got {sync_every}")
+    return count1 // sync_every - count0 // sync_every
+
+
+def params_nbytes(params) -> int:
+    """Bytes of ONE dense parameter set — the payload of one outer
+    all-reduce round (sync dp moves the same bytes per STEP as gradient
+    traffic; the ratio is the H× headline). Works on concrete arrays and
+    ShapeDtypeStructs alike."""
+    return int(
+        sum(
+            x.size * jnp.dtype(x.dtype).itemsize
+            for x in jax.tree.leaves(params)
+        )
+    )
+
+
+def _local_inner_step(model, optimizer, ragged: bool):
+    """One worker's inner step — shared verbatim by both engines (a
+    divergence here would silently split their proven equality)."""
+    import optax
+
+    def step(p, o, tokens, lens):
+        loss_fn = (
+            (lambda q: model.loss(q, tokens, lens))
+            if ragged
+            else (lambda q: model.loss(q, tokens))
+        )
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, o = optimizer.update(grads, o, p)
+        p = optax.apply_updates(p, updates)
+        return p, o, loss
+
+    return step
+
+
+def make_lm_diloco_parts(
+    model,
+    optimizer,
+    mesh,
+    *,
+    axis: str = "data",
+    sync_every: int,
+    outer_lr: float | None = None,
+    outer_momentum: float = 0.0,
+    nesterov: bool = True,
+    ragged: bool = False,
+):
+    """DiLoCo building blocks on a live mesh (the LMTrainer's
+    ``dp_mode="diloco"`` engine) — same contract as
+    :func:`~models.gpt.make_lm_async_parts`: returns ``(init_state,
+    mapped)`` where
+
+    - ``init_state(params, opt_state) -> (stacked_params, DiLoCoState,
+      count)`` — per-worker copies ([n, ...] leaves sharded over
+      ``axis``), outer anchor θ_start = params and zero momentum
+      (replicated), plus the step counter the exchange keys on;
+    - ``mapped(stacked_params, dstate, tokens, lens, count) ->
+      (stacked_params, dstate, loss)`` — NOT jitted (call it inside your
+      own jit/scan); tokens [n·B, L] sharded on the batch dim; loss is
+      the cross-worker mean of the local losses.
+
+    The exchange is a ``lax.cond`` keyed on the replicated ``count`` (the
+    all-reduce fires only on round boundaries — a ``where`` would void
+    the traffic bound, same trap as the async exchange)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_tpu.models.gpt import _default_lens
+    from distributed_tensorflow_tpu.ops.collectives import to_varying
+
+    if sync_every < 1:
+        raise ValueError(f"sync_every must be >= 1, got {sync_every}")
+    n = mesh.shape[axis]
+    eta = resolve_outer_lr(outer_lr, n)
+    step_fn = _local_inner_step(model, optimizer, ragged)
+
+    def init_state(params, opt_state):
+        stacked = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (n,) + x.shape),
+            (params, opt_state),
+        )
+        stacked = jax.device_put(stacked, NamedSharding(mesh, P(axis)))
+        repl = NamedSharding(mesh, P())
+        theta = jax.device_put(params, repl)
+        m = jax.device_put(jax.tree.map(jnp.zeros_like, params), repl)
+        return (
+            stacked[0],
+            DiLoCoState(stacked[1], theta, m),
+            jnp.zeros((), jnp.int32),
+        )
+
+    def local(params, inner, theta, m, tokens, lens, count):
+        p = jax.tree.map(lambda x: x[0], params)
+        o = jax.tree.map(lambda x: x[0], inner)
+        p, o, loss = step_fn(p, o, tokens, lens if ragged else None)
+        pvary = partial(to_varying, axis_name=(axis,))
+
+        def exchange(args):
+            p, theta, m = args
+            # pmean outputs are typed invariant — exactly right for the
+            # outer state (replicated); the worker copy is re-cast to
+            # varying so both cond branches agree under check_vma (the
+            # make_lm_async_parts pattern).
+            pbar = jax.tree.map(lambda x: lax.pmean(x, axis), p)
+            theta2, m2 = outer_update(
+                theta,
+                pbar,
+                m,
+                outer_lr=eta,
+                outer_momentum=outer_momentum,
+                nesterov=nesterov,
+            )
+            return jax.tree.map(pvary, theta2), theta2, m2
+
+        p, theta, m = lax.cond(
+            (count + 1) % sync_every == 0,
+            exchange,
+            lambda args: args,
+            (p, theta, m),
+        )
+        return (
+            jax.tree.map(lambda x: x[None], p),
+            jax.tree.map(lambda x: x[None], o),
+            theta,
+            m,
+            lax.pmean(loss, axis),
+        )
+
+    lens_spec = (P(axis),) if ragged else (P(),)
+    inner_fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(), P(), P(axis)) + lens_spec + (P(),),
+        out_specs=(P(axis), P(axis), P(), P(), P()),
+    )
+
+    def mapped(params, dstate, tokens, lens, count):
+        if lens is None:
+            lens = _default_lens(tokens, ragged)
+        p, inner, theta, m, loss = inner_fn(
+            params, dstate.inner, dstate.theta, dstate.momentum,
+            tokens, lens, count,
+        )
+        return p, DiLoCoState(inner, theta, m), loss
+
+    return init_state, mapped
+
+
+def make_lm_diloco_vmapped(
+    model,
+    optimizer,
+    num_workers: int,
+    *,
+    sync_every: int,
+    outer_lr: float | None = None,
+    outer_momentum: float = 0.0,
+    nesterov: bool = True,
+    ragged: bool = False,
+):
+    """The same DiLoCo gang as ONE single-device program: worker copies
+    are [n, ...] stacked leaves advanced by ``jax.vmap`` over the worker
+    axis, the exchange is a mean over axis 0 — mathematically the mesh
+    engine with the parallelism replaced by vectorization (reduction
+    order may differ at float precision; the per-worker inner step is
+    the SAME function). Contract identical to
+    :func:`make_lm_diloco_parts` (tokens [n·B, L]; the first batch
+    dimension is split n ways in worker order, matching the mesh
+    engine's ``P(axis)`` batch sharding)."""
+    if sync_every < 1:
+        raise ValueError(f"sync_every must be >= 1, got {sync_every}")
+    if num_workers < 1:
+        raise ValueError(f"num_workers must be >= 1, got {num_workers}")
+    n = num_workers
+    eta = resolve_outer_lr(outer_lr, n)
+    step_fn = _local_inner_step(model, optimizer, ragged)
+    vstep = jax.vmap(step_fn)
+
+    def init_state(params, opt_state):
+        stacked = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (n,) + x.shape),
+            (params, opt_state),
+        )
+        return (
+            stacked[0],
+            DiLoCoState(
+                stacked[1], params, jax.tree.map(jnp.zeros_like, params)
+            ),
+            jnp.zeros((), jnp.int32),
+        )
+
+    def mapped(params, dstate, tokens, lens, count):
+        b, L = tokens.shape
+        if b % n:
+            raise ValueError(
+                f"batch {b} must divide over {n} emulated workers"
+            )
+        toks = tokens.reshape(n, b // n, L)
+        wl = (
+            lens.reshape(n, b // n)
+            if ragged
+            else jnp.zeros((n, b // n), jnp.int32)
+        )
+        p, inner, losses = vstep(params, dstate.inner, toks, wl)
+        theta, m = dstate.theta, dstate.momentum
+
+        def exchange(args):
+            p, theta, m = args
+            pbar = jax.tree.map(lambda x: jnp.mean(x, axis=0), p)
+            theta2, m2 = outer_update(
+                theta,
+                pbar,
+                m,
+                outer_lr=eta,
+                outer_momentum=outer_momentum,
+                nesterov=nesterov,
+            )
+            p2 = jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), theta2
+            )
+            return p2, theta2, m2
+
+        p, theta, m = lax.cond(
+            (count + 1) % sync_every == 0,
+            exchange,
+            lambda args: args,
+            (p, theta, m),
+        )
+        return p, DiLoCoState(inner, theta, m), jnp.mean(losses)
+
+    return init_state, mapped

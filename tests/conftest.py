@@ -192,7 +192,14 @@ def pytest_sessionfinish(session, exitstatus):
 # test_elastic.py compiles nothing — fake process tables, no jax programs —
 # and the fault-injection integration cases compile only in their own
 # subprocesses, so neither needs a slot here).
-_CACHE_OPT_OUT_FIRST = ("test_lm_trainer.py", "test_cross_topology_restore.py")
+_CACHE_OPT_OUT_FIRST = (
+    "test_lm_trainer.py",
+    "test_cross_topology_restore.py",
+    # Round 14: mixes diloco/async/dp multi-device scan programs (its
+    # autouse fixture opts out of the persistent cache like the two
+    # above — fresh compiles must not follow a warm-loaded preamble).
+    "test_local_sgd.py",
+)
 
 
 def pytest_collection_modifyitems(config, items):

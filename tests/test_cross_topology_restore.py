@@ -65,6 +65,17 @@ _MODES = {
         lambda: make_mesh((8,), ("data",)),
     ),
     "sp": (dict(dp_mode="sp"), lambda: make_mesh((2, 4), ("data", "seq"))),
+    # sync_every=3: 8 steps/epoch ends mid-outer-round, so the
+    # checkpointed copies are mid-divergence and the momentum buffer is
+    # live (same rationale as the async avg_every=3 fixture below).
+    "diloco": (
+        dict(dp_mode="diloco", sync_every=3, outer_lr=1.0),
+        lambda: make_mesh((8,), ("data",)),
+    ),
+    "diloco4": (
+        dict(dp_mode="diloco", sync_every=3, outer_lr=1.0),
+        lambda: make_mesh((4,), ("data",)),
+    ),
 }
 
 
@@ -110,9 +121,12 @@ def _assert_trees_equal(a, b, **tol):
         ("pp", "pp2"),  # re-stage: 4 stages → 2 stages
         ("async", "dp"),  # stacked copies → mean
         ("dp", "async"),  # broadcast into equal copies
+        ("diloco", "dp"),  # round 14: copies+inner merge, outer dropped
+        ("dp", "diloco"),  # fresh outer round from the canonical point
         pytest.param("zero", "pp", marks=pytest.mark.heavy),
         pytest.param("pp", "async", marks=pytest.mark.heavy),
         pytest.param("tp", "single", marks=pytest.mark.heavy),
+        pytest.param("pp", "diloco", marks=pytest.mark.heavy),
     ],
 )
 def test_cross_restore_state_matches_canonical(tmp_path, src, dst):
@@ -199,6 +213,48 @@ def test_same_mode_async_resume_stays_bitwise(tmp_path):
     assert any(
         not np.allclose(leaf[0], leaf[1]) for leaf in leaves if leaf.ndim > 1
     )
+
+
+def test_same_mode_diloco_resume_stays_bitwise(tmp_path):
+    # Mesh twin of test_local_sgd's vmapped pin: same-layout diloco
+    # resume keeps the mid-round copies AND the outer state (θ_start,
+    # momentum) bit for bit — no mean collapse, no zeroed momentum.
+    tr_a = _trainer("diloco", tmp_path)
+    tr_a.run()
+    tr_b = _trainer("diloco", tmp_path)
+    assert tr_b.start_step == tr_a.global_step
+    _assert_trees_equal(tr_b.state.params, tr_a.state.params)
+    _assert_trees_equal(tr_b.state.opt_state, tr_a.state.opt_state)
+    leaves = jax.tree.leaves(jax.device_get(tr_a.state.params))
+    assert any(
+        not np.allclose(leaf[0], leaf[1]) for leaf in leaves if leaf.ndim > 1
+    )
+
+
+def test_cross_world_diloco_resize_carries_outer_state(tmp_path):
+    # The elastic-resize restore (8 → 4 workers): copies re-derive from
+    # the canonical merge, the world-invariant outer state carries
+    # VERBATIM — the next outer round's pseudo-gradient is computed
+    # against the SAVED anchor over the survivor gang (round 14).
+    tr_a = _trainer("diloco", tmp_path)
+    tr_a.run()
+    assert any(
+        float(np.abs(np.asarray(l)).max()) > 0
+        for l in jax.tree.leaves(
+            jax.device_get(tr_a.state.opt_state.momentum)
+        )
+    )
+    tr_b = _trainer("diloco4", tmp_path)
+    assert tr_b.start_step == tr_a.global_step
+    _assert_trees_equal(
+        tr_b.state.opt_state.theta, tr_a.state.opt_state.theta
+    )
+    _assert_trees_equal(
+        tr_b.state.opt_state.momentum, tr_a.state.opt_state.momentum
+    )
+    res = tr_b.run()
+    assert np.isfinite(res["perplexity"])
+    assert tr_b.global_step == 2 * tr_a.global_step
 
 
 def test_layout_sidecar_written_and_read(tmp_path):

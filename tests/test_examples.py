@@ -98,6 +98,7 @@ def test_serve_example_trains_checkpoints_and_serves():
     assert r.stdout.rstrip().endswith("Done")
 
 
+@pytest.mark.heavy  # round-14 audit: compile-tail; representative sibling stays fast-tier
 def test_lm_example_trains_and_generates():
     # The example now drives the LMTrainer lifecycle: 2 epochs exercises
     # the loop contract (Step lines, perplexity eval) plus generation.

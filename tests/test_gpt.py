@@ -244,6 +244,7 @@ def test_dp_train_step_matches_single_device():
         )
 
 
+@pytest.mark.heavy  # round-14 audit: compile-tail; representative sibling stays fast-tier
 def test_sample_decode_topk1_is_greedy():
     model = _model()
     params = _noisy(model.init(seed=15))
@@ -362,6 +363,7 @@ def test_distributed_decode_matches_single_device():
     )
 
 
+@pytest.mark.heavy  # round-14 audit: compile-tail; representative sibling stays fast-tier
 def test_beam_decode():
     # Beam search over the KV cache: beam_size=1 is exactly greedy; with
     # K=V and max_new=2 the search is exhaustive over continuations, so
@@ -434,6 +436,7 @@ def test_beam_decode():
         small.beam_decode(sp, pr, 0, 2)
 
 
+@pytest.mark.heavy  # round-14 audit: compile-tail; representative sibling stays fast-tier
 def test_windowed_lm_decode_matches_reforward():
     # Sliding-window LM: the decode-path cache mask must reproduce exactly
     # the band the training mask applies, including once the context has
@@ -597,6 +600,7 @@ def test_zero_sharded_lm_step_matches_single_device():
         )
 
 
+@pytest.mark.heavy  # round-14 audit: compile-tail; representative sibling stays fast-tier
 def test_lm_checkpoint_resume_bitwise(tmp_path):
     # The Supervisor's orbax checkpointing is pytree-generic, so the LM's
     # (params, opt_state) composes unchanged: save mid-run, restore into a
@@ -652,6 +656,7 @@ def test_moe_lm_trains_on_copy_task():
     assert float(loss) < first * 0.8, (first, float(loss))
 
 
+@pytest.mark.heavy  # round-14 audit: compile-tail; representative sibling stays fast-tier
 def test_moe_lm_decode_matches_reforward():
     # The KV-cache decode path routes single-token batches through the same
     # switch FFN; decode never drops (capacity = tokens at L==1), so greedy

@@ -235,6 +235,13 @@ def _mode_trainer(mode, corpus, cfg_kw=None, **trainer_kw):
         # dp×sp: sequence over 4-way 'seq', batch over 2-way 'data'.
         trainer_kw.setdefault("mesh", _mesh8((2, 4), ("data", "seq")))
         cfg_kw.setdefault("dp_mode", "sp")
+    elif mode == "diloco":
+        # Local-SGD/DiLoCo outer loop (round 14, train/local_sgd.py):
+        # 8-worker gang, outer round every 3 steps.
+        trainer_kw.setdefault("mesh", _mesh8())
+        cfg_kw.setdefault("dp_mode", "diloco")
+        cfg_kw.setdefault("sync_every", 3)
+        cfg_kw.setdefault("outer_lr", 1.0)
     else:
         raise AssertionError(mode)
     trainer_kw.setdefault("print_fn", lambda *a: None)
@@ -258,6 +265,9 @@ def _mode_trainer(mode, corpus, cfg_kw=None, **trainer_kw):
         pytest.param("ep", marks=pytest.mark.heavy),
         pytest.param("pp", marks=pytest.mark.heavy),
         pytest.param("sp", marks=pytest.mark.heavy),
+        # round 14 — fast-tier coverage via tests/test_local_sgd.py's
+        # vmapped-engine lifecycle (runs even on degraded jax).
+        pytest.param("diloco", marks=pytest.mark.heavy),
     ],
 )
 def test_lifecycle_matrix(mode, corpus, tmp_path):
@@ -322,6 +332,7 @@ def test_lifecycle_matrix(mode, corpus, tmp_path):
         pytest.param("ep", marks=pytest.mark.heavy),
         pytest.param("pp", marks=pytest.mark.heavy),
         pytest.param("sp", marks=pytest.mark.heavy),
+        pytest.param("diloco", marks=pytest.mark.heavy),
     ],
 )
 def test_mode_scanned_equals_eager(mode, corpus):

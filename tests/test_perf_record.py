@@ -85,3 +85,22 @@ def test_lm_phases_docs_match_committed_artifact(tmp_path):
         (r.get("phase_ms") or {}).get("backward-selective") is not None
         for r in payload["rows"]
     )
+
+
+def test_diloco_docs_match_committed_artifact():
+    """docs/benchmarks/diloco.md is GENERATED from diloco.json
+    (diloco_bench.render_from_payload): re-rendering the committed JSON
+    must reproduce the committed md byte for byte — the lm_phases.md
+    staleness discipline for the round-14 DiLoCo record."""
+    from distributed_tensorflow_tpu.tools import diloco_bench
+
+    root = diloco_bench._docs_root()
+    with open(os.path.join(root, "diloco.json")) as f:
+        payload = json.load(f)
+    with open(os.path.join(root, "diloco.md")) as f:
+        committed = f.read()
+    assert diloco_bench.render_from_payload(payload) == committed, (
+        "docs/benchmarks/diloco.md is stale vs diloco.json; run "
+        "python -m distributed_tensorflow_tpu.tools.diloco_bench "
+        "--write-docs"
+    )

@@ -183,8 +183,14 @@ def test_default_buckets_cover_max_len():
     "mkw",
     [
         {},
-        dict(num_kv_heads=2, pos_embedding="rope"),
-        dict(window=6),
+        # Round-14 fast-tier audit: the non-dense variants are the
+        # compile tail of the parity matrix (~15-22 s each on 2 cores);
+        # [dense] stays the fast-tier representative, RUN_SLOW runs all.
+        pytest.param(
+            dict(num_kv_heads=2, pos_embedding="rope"),
+            marks=pytest.mark.heavy,
+        ),
+        pytest.param(dict(window=6), marks=pytest.mark.heavy),
     ],
     ids=["dense", "gqa-rope", "window"],
 )
@@ -301,8 +307,13 @@ def test_decode_slots_full_cache_raises():
     "mkw",
     [
         {},
-        dict(num_kv_heads=2, pos_embedding="rope"),
-        dict(window=6),
+        # Round-14 fast-tier audit (as in the slab matrix above):
+        # [chunked-dense] + [speculative-dense] stay fast-tier.
+        pytest.param(
+            dict(num_kv_heads=2, pos_embedding="rope"),
+            marks=pytest.mark.heavy,
+        ),
+        pytest.param(dict(window=6), marks=pytest.mark.heavy),
     ],
     ids=["dense", "gqa-rope", "window"],
 )
@@ -390,6 +401,88 @@ def test_paged_shared_prefix_batch_prefills_once():
     assert srv._alloc.used_blocks == len(srv._prefix._map) > 0
 
 
+def test_paged_cold_shared_prefix_one_round_prefills_once():
+    """Round 14 (round-11 GOTCHA closed): N COLD requests sharing a
+    prefix submitted and admitted in ONE round hit the radix too — the
+    planned prompt blocks register at admission time and dependent
+    members dispatch in a later prefill WAVE than the writer, so the
+    shared prefix prefills once without any staggering choreography.
+    Streams stay token-identical to in-process decode (the parity
+    contract is what makes the cached-K/V read observable as correct)."""
+    m = tiny_model()
+    p = m.init(3)
+    rng = np.random.default_rng(11)
+    sysp = rng.integers(0, m.vocab_size, (24,)).astype(np.int32)
+    tails = [
+        rng.integers(0, m.vocab_size, (k,)).astype(np.int32)
+        for k in (3, 5, 7)
+    ]
+    shared = [np.concatenate([sysp, t]) for t in tails]
+    srv = TextServer(
+        m, p, slots=3, chunk=4, buckets=(8, 16, 32), paged=True,
+        block_size=4,
+    )
+    rids = [srv.submit(pr, GenerationConfig(max_new=8)) for pr in shared]
+    while srv.step():  # ALL THREE admit in the first round — no stagger
+        pass
+    for pr, rid in zip(shared, rids):
+        out = srv.result(rid)
+        ref = m.greedy_decode(p, jnp.asarray(pr[None]), 8)
+        assert np.array_equal(out, np.asarray(ref)[0, pr.size :])
+    # The 24-token prefix (6 blocks of 4) was written once by request 0
+    # and HIT by requests 1 and 2 in the same round.
+    assert srv.metrics.counter("prefix_cache_hits").value == 12
+    assert srv.metrics.counter("prefix_cache_misses").value == 8
+    # One physical chain: the followers mapped request 0's blocks.
+    assert srv._alloc.used_blocks == len(srv._prefix._map) > 0
+
+
+def test_paged_cold_shared_prefix_wave_order_in_journal():
+    """The wave schedule itself, pinned via the admission journal: in a
+    one-round cold batch the prefix writer admits at wave 0 with zero
+    hit blocks; every same-prefix follower admits at a LATER wave with
+    the full prefix hit — the reader-after-writer dispatch order the
+    early radix registration depends on."""
+    events = []
+
+    class _Journal:
+        def emit(self, kind, **fields):
+            events.append({"kind": kind, **fields})
+            return fields
+
+        def flush(self):
+            pass
+
+    m = tiny_model()
+    p = m.init(3)
+    rng = np.random.default_rng(12)
+    sysp = rng.integers(0, m.vocab_size, (16,)).astype(np.int32)
+    shared = [
+        np.concatenate(
+            [sysp, rng.integers(0, m.vocab_size, (k,)).astype(np.int32)]
+        )
+        for k in (3, 4)
+    ]
+    other = rng.integers(0, m.vocab_size, (6,)).astype(np.int32)
+    srv = TextServer(
+        m, p, slots=3, chunk=4, buckets=(8, 16, 32), paged=True,
+        block_size=4, journal=_Journal(),
+    )
+    for pr in (shared[0], other, shared[1]):
+        srv.submit(pr, GenerationConfig(max_new=4))
+    while srv.step():
+        pass
+    adm = {e["prompt_len"]: e for e in events if e["kind"] == "admission"}
+    writer = adm[shared[0].size]
+    unrelated = adm[other.size]
+    follower = adm[shared[1].size]
+    assert writer["wave"] == 0 and writer["prefix_hit_blocks"] == 0
+    # An unrelated cold prompt shares no pending blocks — wave 0 too.
+    assert unrelated["wave"] == 0
+    assert follower["wave"] == 1
+    assert follower["prefix_hit_blocks"] == 4  # the full 16-token prefix
+
+
 def test_paged_admission_gated_on_blocks_not_slots():
     """Admission control in paged mode: a long-context request the pool
     cannot hold yet QUEUES while shorter requests behind it keep
@@ -427,6 +520,7 @@ def test_paged_admission_gated_on_blocks_not_slots():
     assert srv._alloc.free_blocks == 12
 
 
+@pytest.mark.heavy  # round-14 audit: compile-tail e2e; representative siblings stay fast-tier
 def test_spec_server_sampled_only_ticks_use_chunk_scan():
     """A spec_draft server whose resident slots are ALL sampled must not
     pay one verify dispatch per token: sampled slots ride speculation at
@@ -696,6 +790,7 @@ def test_checkpoint_round_trip_serves_identical_tokens(tmp_path):
     assert len(texts) == 2 and all(isinstance(t, str) for t in texts)
 
 
+@pytest.mark.heavy  # round-14 audit: compile-tail e2e; representative siblings stay fast-tier
 def test_non_dense_checkpoint_serves_via_canonical_layer(tmp_path):
     """A pipeline-layout checkpoint (staged [S, L/S, ...] block stacks +
     layout sidecar, the round-5 format) restores through the canonical
@@ -754,6 +849,7 @@ def test_non_dense_checkpoint_serves_via_canonical_layer(tmp_path):
     assert np.array_equal(out, np.asarray(ref)[0, pr.size :])
 
 
+@pytest.mark.heavy  # round-14 audit: compile-tail e2e; representative siblings stay fast-tier
 def test_byte_tokenizer_fallback_when_no_vocab_shipped(tmp_path):
     from distributed_tensorflow_tpu.data.text import ByteTokenizer
 

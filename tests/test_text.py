@@ -247,6 +247,7 @@ def test_bpe_scales_to_corpus():
         assert tok.decode(p) == d
 
 
+@pytest.mark.heavy  # round-14 audit: compile-tail; representative sibling stays fast-tier
 def test_text_lm_end_to_end():
     # The full text story: byte corpus → LMTrainer lifecycle → perplexity
     # falls well below the uniform-257 baseline (the chain's byte-level

@@ -53,3 +53,55 @@ def test_supervisor_stops_on_heartbeat_failure():
         w.stop()
         time.sleep(0.6)
         assert sup.should_stop  # dead worker detected → orderly stop
+
+
+def test_parity_converged_margin_events_feed_the_gate(tmp_path):
+    """Round 14: the paper-parity oracle margins become gate-covered
+    bench_point series. The emission half is mesh-free by the lean-import
+    convention (this test runs on degraded containers where the grid
+    itself cannot); margins and events are pinned against canned rows in
+    the committed-artifact shape, including the --from-json re-emission
+    path over the committed grid json."""
+    import json
+    import subprocess
+
+    from distributed_tensorflow_tpu.observability.journal import read_events
+    from distributed_tensorflow_tpu.tools.parity_converged import (
+        emit_bench_events,
+        oracle_margins,
+    )
+
+    rows = [
+        {"row": "single", "final_accuracy": 0.54, "epochs": 40, "device": "cpu"},
+        {"row": "sync-2-pw", "final_accuracy": 0.55, "epochs": 40, "device": "cpu"},
+        {"row": "async-2-pw", "final_accuracy": 0.76, "epochs": 40, "device": "cpu"},
+        {"row": "async-3-pw", "final_accuracy": 0.85, "epochs": 40, "device": "cpu"},
+    ]
+    m = oracle_margins(rows)
+    assert m["async2_minus_sync2"] == pytest.approx(0.21)
+    assert m["async3_minus_async2"] == pytest.approx(0.09)
+    ev = tmp_path / "events.jsonl"
+    n = emit_bench_events(rows, str(ev))
+    got = list(read_events(str(ev), kind="bench_point"))
+    assert n == len(got) == 6
+    by_name = {e["name"]: e for e in got}
+    assert by_name["async2_minus_sync2"]["value"] == pytest.approx(0.21)
+    # Accuracy unit → the round-12 gate fails LOW (an eroded margin is
+    # the regression; a wider one never is).
+    assert all(e["unit"] == "acc" and e["device"] == "cpu" for e in got)
+
+    # --from-json re-emission over a committed-shape artifact (no mesh,
+    # no measurement — the recompute-docs pattern).
+    grid = tmp_path / "grid.json"
+    grid.write_text(json.dumps({"rows": rows, "checks": []}))
+    ev2 = tmp_path / "events2.jsonl"
+    out = subprocess.run(
+        [
+            sys.executable, "-m",
+            "distributed_tensorflow_tpu.tools.parity_converged",
+            "--from-json", str(grid), "--events", str(ev2),
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert len(list(read_events(str(ev2), kind="bench_point"))) == 6
