@@ -35,6 +35,13 @@ from jax import lax
 
 from distributed_tensorflow_tpu.models.base import layernorm as _layernorm
 from distributed_tensorflow_tpu.ops.collectives import to_varying
+from distributed_tensorflow_tpu.ops.quantized import (
+    QuantizedLinear,
+    dequantize_kv,
+    kv_storage_dtype,
+    quantize_kv,
+    wo_dot,
+)
 from distributed_tensorflow_tpu.ops.ring_attention import dense_attention
 
 
@@ -114,11 +121,22 @@ class SlotKVCache(NamedTuple):
     continuous batching needs (slots free and refill at different times;
     a shared scalar length would drain the whole bank to the longest
     request). Written by :meth:`GPTLM.prefill_slots` /
-    :meth:`GPTLM.decode_slots`; the text layer on top is ``serve.py``."""
+    :meth:`GPTLM.decode_slots`; the text layer on top is ``serve.py``.
+
+    ``kv_dtype="int8"|"fp8"`` (round 15) stores the payload in 1-byte
+    elements with the per-row symmetric scales riding as the
+    ``k_scale``/``v_scale`` side tensors (``ops/quantized.quantize_kv``
+    granularity: one f32 per written position per KV head). Quantization
+    happens ON WRITE and dequantization ON READ inside the attention
+    math, so the contract stays "same math, fewer bytes" up to the
+    committed rounding; ``kv_dtype="bf16"`` (the default) keeps scales
+    ``None`` and is bitwise the round-9/11 layout."""
 
     k: jax.Array  # [num_layers, S, cache_len, Hkv, Dh]
     v: jax.Array  # [num_layers, S, cache_len, Hkv, Dh]
     lengths: jax.Array  # [S] int32 — tokens written into each slot's cache
+    k_scale: jax.Array | None = None  # [num_layers, S, cache_len, Hkv] f32
+    v_scale: jax.Array | None = None
 
 
 class PagedKVCache(NamedTuple):
@@ -135,12 +153,23 @@ class PagedKVCache(NamedTuple):
     :meth:`GPTLM.decode_paged`; device primitives in
     ``ops/paged_attention.py``. Unused table entries read garbage that
     the validity masks keep out of every softmax (the stale-bytes-
-    unreachable stance of :class:`SlotKVCache`)."""
+    unreachable stance of :class:`SlotKVCache`).
+
+    ``kv_dtype="int8"|"fp8"`` (round 15): payload blocks shrink to
+    1-byte elements and the per-row scales ride as ``k_scale``/
+    ``v_scale`` side pools indexed by the SAME (block, position, head)
+    coordinates — the block-table gather/scatter index math applies to
+    them unchanged, and COW prefix sharing shares a block's scales with
+    the block (one refcount covers both; scales are never packed into
+    the payload). ``kv_dtype="bf16"`` keeps scales ``None``: the
+    round-11 bitwise path."""
 
     k: jax.Array  # [num_layers, num_blocks, block_size, Hkv, Dh]
     v: jax.Array  # [num_layers, num_blocks, block_size, Hkv, Dh]
     block_tables: jax.Array  # [S, max_blocks] int32 — physical block ids
     lengths: jax.Array  # [S] int32 — tokens written for each slot
+    k_scale: jax.Array | None = None  # [num_layers, num_blocks, bs, Hkv] f32
+    v_scale: jax.Array | None = None
 
 
 class KVCache(NamedTuple):
@@ -439,12 +468,65 @@ class GPTLM:
         (quantizing the tied-embedding head measurably hurts loss), and
         MoE expert matmuls stay at compute_dtype (``_moe_block_ffn``
         routes through ops/moe, which the ``matmul_dtype`` contract
-        deliberately excludes — see __init__)."""
+        deliberately excludes — see __init__).
+
+        Round 15: a :class:`~ops.quantized.QuantizedLinear` leaf (the
+        pre-quantized weight-only serving params from
+        :meth:`decode_weights`) routes through
+        :func:`~ops.quantized.wo_dot` instead — full-precision
+        activations against 1-byte weights, forward-only, the same
+        exclusion rule (logits head and MoE experts never carry
+        QuantizedLinear leaves)."""
+        if isinstance(w, QuantizedLinear):
+            return wo_dot(x, w.qw, w.scale, self.compute_dtype)
         if self.matmul_dtype is None:
             return self._dot_full(x, w)
         from distributed_tensorflow_tpu.ops.quantized import quantized_dot
 
         return quantized_dot(self.matmul_dtype, x, w)
+
+    def decode_weights(self, params: GPTLMParams, dtype: str) -> GPTLMParams:
+        """Pre-quantize the decode projection weights ONCE (at restore):
+        the block QKV/out projections and — for dense blocks — the FFN
+        pair become :class:`~ops.quantized.QuantizedLinear` leaves
+        (int8/fp8 payload + per-output-column f32 scales), which
+        :meth:`_dot` routes through ``wo_dot`` wherever the returned
+        params run. The round-13 exclusion rule holds: the logits head
+        (tied embedding) and MoE expert matmuls stay full-precision —
+        MoE blocks quantize only their attention projections. Decode
+        reads every projection weight per token, so this halves (int8)
+        the weight half of decode's HBM traffic; the returned tree is a
+        SERVING artifact — it is not trainable (``wo_dot`` is
+        forward-only) and not checkpoint-compatible (quantize at restore
+        from the full-precision checkpoint, never persist)."""
+        from distributed_tensorflow_tpu.ops.quantized import (
+            MATMUL_DTYPES,
+            quantize_linear_columns,
+        )
+
+        if dtype not in MATMUL_DTYPES:
+            raise ValueError(
+                f"unknown decode weight dtype {dtype!r}; one of "
+                f"{MATMUL_DTYPES}"
+            )
+        names = ("wq", "wk", "wv", "wo")
+        if self.moe_experts is None:
+            names += ("w_up", "w_down")
+        repl = {
+            nm: quantize_linear_columns(getattr(params.blocks, nm), dtype)
+            for nm in names
+        }
+        return params._replace(blocks=params.blocks._replace(**repl))
+
+    def _kv_quant_dtype(self, cache) -> str | None:
+        """The serving cache's quantized-dtype name ("int8"/"fp8"), or
+        None for the bf16 identity layout — derived from the cache
+        itself (payload dtype + scale presence), so one model instance
+        serves every layout and the default path stays byte-identical
+        to round 11."""
+        if getattr(cache, "k_scale", None) is None:
+            return None
+        return "int8" if cache.k.dtype == jnp.int8 else "fp8"
 
     @property
     def _policy_remat(self) -> bool:
@@ -1126,11 +1208,16 @@ class GPTLM:
 
     # -- slot-wise decoding (the serving surface, serve.py) ----------------
 
-    def empty_slot_cache(self, slots: int) -> SlotKVCache:
+    def empty_slot_cache(
+        self, slots: int, kv_dtype: str = "bf16"
+    ) -> SlotKVCache:
         """A vacant ``slots``-row :class:`SlotKVCache` (lengths all zero —
         a zero-length slot is FREE; the decode mask treats only written
         positions as attendable, so vacant rows compute well-defined
-        garbage that the scheduler never reads)."""
+        garbage that the scheduler never reads). ``kv_dtype`` picks the
+        storage layout: "bf16" stores compute_dtype with no scales (the
+        default, bitwise round-9); int8/fp8 store 1-byte payloads plus
+        the per-row scale side tensors."""
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         shape = (
@@ -1140,8 +1227,19 @@ class GPTLM:
             self.num_kv_heads,
             self.head_dim,
         )
-        z = jnp.zeros(shape, self.compute_dtype)
-        return SlotKVCache(k=z, v=z, lengths=jnp.zeros((slots,), jnp.int32))
+        z = jnp.zeros(shape, kv_storage_dtype(kv_dtype, self.compute_dtype))
+        sc = (
+            None
+            if kv_dtype == "bf16"
+            else jnp.zeros(shape[:-1], jnp.float32)
+        )
+        return SlotKVCache(
+            k=z,
+            v=z,
+            lengths=jnp.zeros((slots,), jnp.int32),
+            k_scale=sc,
+            v_scale=sc,
+        )
 
     def reset_slots(self, cache: SlotKVCache, free: jax.Array) -> SlotKVCache:
         """Mark slots FREE (``free`` [S] bool): their lengths drop to 0.
@@ -1186,8 +1284,17 @@ class GPTLM:
         c = self.cache_len
         positions = jnp.arange(l)
         token_mask = positions[None, :] < lengths[:, None]  # [S, L]
+        qd = self._kv_quant_dtype(cache)
 
         def attend(q, k, v):
+            if qd is not None:
+                # Uniform quantized-cache rule (see extend_paged): the
+                # prompt's own K/V are round-tripped before the softmax
+                # so the prefill scores over exactly the values the
+                # cache write below stores — decode re-reading these
+                # positions sees the same math this pick saw.
+                k = dequantize_kv(*quantize_kv(k, qd), self.compute_dtype)
+                v = dequantize_kv(*quantize_kv(v, qd), self.compute_dtype)
             return self._attend(q, k, v, kv_lens=lengths)
 
         h = self._embed_tokens(params, tokens, positions)
@@ -1203,13 +1310,24 @@ class GPTLM:
             return h, kv
 
         h, (ks, vs) = lax.scan(body, h, params.blocks)
-        ks = ks.astype(self.compute_dtype)  # [n, S, L, Hkv, Dh]
-        vs = vs.astype(self.compute_dtype)
+        if qd is None:
+            ks = ks.astype(self.compute_dtype)  # [n, S, L, Hkv, Dh]
+            vs = vs.astype(self.compute_dtype)
+            ksc = vsc = None
+        else:
+            # Quantize-on-write (round 15): payload rows plus the per-
+            # (position, head) scale side tensors, which follow the same
+            # pad/rolling relayout minus the lane axis.
+            ks, ksc = quantize_kv(ks, qd)  # [n,S,L,Hkv,Dh] + [n,S,L,Hkv]
+            vs, vsc = quantize_kv(vs, qd)
         if l <= c:
             # Every prompt position p < lengths[s] <= c lands at slot
             # p % c = p: plain pad (the same layout prefill() writes).
             pad = [(0, 0), (0, 0), (0, c - l), (0, 0), (0, 0)]
             nk, nv = jnp.pad(ks, pad), jnp.pad(vs, pad)
+            if qd is not None:
+                nksc = jnp.pad(ksc, pad[:-1])
+                nvsc = jnp.pad(vsc, pad[:-1])
         else:
             # Rolling window (c < L): per ROW, keep that row's last
             # min(c, len) real positions at slots p % c. Cache slot j
@@ -1221,6 +1339,9 @@ class GPTLM:
             gather = jnp.clip(p, 0, l - 1)[None, :, :, None, None]
             nk = jnp.take_along_axis(ks, gather, axis=2)
             nv = jnp.take_along_axis(vs, gather, axis=2)
+            if qd is not None:
+                nksc = jnp.take_along_axis(ksc, gather[..., 0], axis=2)
+                nvsc = jnp.take_along_axis(vsc, gather[..., 0], axis=2)
             # p < 0 rows (len <= j and no earlier wrap) hold garbage —
             # unreachable: the decode mask derives validity from lengths.
         m = admit[None, :, None, None, None]
@@ -1228,6 +1349,16 @@ class GPTLM:
             k=jnp.where(m, nk, cache.k),
             v=jnp.where(m, nv, cache.v),
             lengths=jnp.where(admit, lengths, cache.lengths),
+            k_scale=(
+                None
+                if qd is None
+                else jnp.where(m[..., 0], nksc, cache.k_scale)
+            ),
+            v_scale=(
+                None
+                if qd is None
+                else jnp.where(m[..., 0], nvsc, cache.v_scale)
+            ),
         )
         h_last = jnp.take_along_axis(
             h, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
@@ -1277,7 +1408,9 @@ class GPTLM:
         ffn_out, _ = self._ffn(blk, hn2)  # aux unused: decode never drops
         return h + ffn_out, state
 
-    def _decode_block_slots(self, blk, h, ck0, cv0, lengths, act):
+    def _decode_block_slots(
+        self, blk, h, ck0, cv0, lengths, act, ks0=None, vs0=None, qd=None
+    ):
         """Per-slot single-token block step — :meth:`_decode_block` with a
         VECTOR of positions: h [S, 1, d], ck0/cv0 [S, cache_len, Hkv, Dh],
         ``lengths`` [S] (each row's write position), ``act`` [S] bool
@@ -1285,19 +1418,43 @@ class GPTLM:
         outputs are garbage the caller discards). Row-wise math is
         _decode_block's exactly (pinned by test_serve.py's token-parity
         tests); the scalar ``dynamic_update_slice`` becomes a per-row
-        scatter and the validity mask broadcasts per row."""
+        scatter and the validity mask broadcasts per row. Quantized
+        caches (``qd`` + ks0/vs0 scale rows) quantize the fresh row on
+        write and attend the dequantized view — same math, fewer bytes
+        resident."""
         s = h.shape[0]
         c = self.cache_len
 
         def cache_update(k, v):
-            k = k.astype(ck0.dtype)
-            v = v.astype(cv0.dtype)
             rows = jnp.arange(s)
             slot = lengths % c if self.window is not None else lengths
-            kw = jnp.where(act[:, None, None], k[:, 0], ck0[rows, slot])
-            vw = jnp.where(act[:, None, None], v[:, 0], cv0[rows, slot])
+            if qd is None:
+                kq, vq = k.astype(ck0.dtype)[:, 0], v.astype(cv0.dtype)[:, 0]
+            else:
+                kq, ksc = quantize_kv(k[:, 0], qd)  # [S,Hkv,Dh] + [S,Hkv]
+                vq, vsc = quantize_kv(v[:, 0], qd)
+            kw = jnp.where(act[:, None, None], kq, ck0[rows, slot])
+            vw = jnp.where(act[:, None, None], vq, cv0[rows, slot])
             ck = ck0.at[rows, slot].set(kw)
             cv = cv0.at[rows, slot].set(vw)
+            if qd is None:
+                ck_att, cv_att, state = ck, cv, (ck, cv, None, None)
+            else:
+                nks = ks0.at[rows, slot].set(
+                    jnp.where(act[:, None], ksc, ks0[rows, slot])
+                )
+                nvs = vs0.at[rows, slot].set(
+                    jnp.where(act[:, None], vsc, vs0[rows, slot])
+                )
+                # Dequantize to compute_dtype, NOT f32: a f32 view would
+                # double the compute-side intermediate and push the MXU
+                # onto its multi-pass f32 path — the bandwidth win this
+                # cache exists for (int8's |q| ≤ 127 and every e4m3
+                # value upcast to bf16 exactly, so the pow2 equality
+                # oracles survive the narrower view).
+                ck_att = dequantize_kv(ck, nks, self.compute_dtype)
+                cv_att = dequantize_kv(cv, nvs, self.compute_dtype)
+                state = (ck, cv, nks, nvs)
             idx = jnp.arange(c)[None, :]  # [1, c]
             if self.window is not None:
                 # Same rolling-buffer identity as _decode_block, per row.
@@ -1305,10 +1462,10 @@ class GPTLM:
                 valid = slot_pos >= 0  # [S, c]
             else:
                 valid = idx <= lengths[:, None]  # [S, c]
-            return ck, cv, valid, (ck, cv)
+            return ck_att, cv_att, valid, state
 
-        h, (ck, cv) = self._decode_block_step(blk, h, lengths, cache_update)
-        return h, ck, cv
+        h, state = self._decode_block_step(blk, h, lengths, cache_update)
+        return h, state
 
     def decode_slots(
         self,
@@ -1345,18 +1502,26 @@ class GPTLM:
         h = self._embed_tokens(
             params, token[:, None], cache.lengths[:, None]
         )
-        nks, nvs = [], []
+        qd = self._kv_quant_dtype(cache)
+        nks, nvs, nksc, nvsc = [], [], [], []
         for i in range(self.num_layers):
             blk = jax.tree.map(lambda x: x[i], params.blocks)
-            h, ck, cv = self._decode_block_slots(
-                blk, h, cache.k[i], cache.v[i], cache.lengths, act
+            h, (ck, cv, ksc, vsc) = self._decode_block_slots(
+                blk, h, cache.k[i], cache.v[i], cache.lengths, act,
+                None if qd is None else cache.k_scale[i],
+                None if qd is None else cache.v_scale[i],
+                qd,
             )
             nks.append(ck)
             nvs.append(cv)
+            nksc.append(ksc)
+            nvsc.append(vsc)
         new_cache = SlotKVCache(
             k=jnp.stack(nks),
             v=jnp.stack(nvs),
             lengths=cache.lengths + act.astype(jnp.int32),
+            k_scale=None if qd is None else jnp.stack(nksc),
+            v_scale=None if qd is None else jnp.stack(nvsc),
         )
         return self._logits(params, h)[:, 0], new_cache
 
@@ -1371,7 +1536,11 @@ class GPTLM:
         return -(-self.max_len // block_size)
 
     def empty_paged_cache(
-        self, slots: int, num_blocks: int, block_size: int = 16
+        self,
+        slots: int,
+        num_blocks: int,
+        block_size: int = 16,
+        kv_dtype: str = "bf16",
     ) -> PagedKVCache:
         """A vacant :class:`PagedKVCache`: ``num_blocks`` pool blocks of
         ``block_size`` positions each (the HBM actually reserved —
@@ -1379,7 +1548,11 @@ class GPTLM:
         (garbage mappings, unreachable while lengths are 0). Windowed
         models keep FULL history here — the paged layout addresses
         absolutely and windows by mask, trading the rolling buffer's
-        O(W) bound for block sharing (``serve_pool.PrefixCache``)."""
+        O(W) bound for block sharing (``serve_pool.PrefixCache``).
+        ``kv_dtype="int8"|"fp8"`` shrinks every pool block to 1-byte
+        elements with per-row scale side pools — the serving engine
+        derives MORE blocks from the same HBM budget
+        (``serve_pool.blocks_for_hbm_bytes``)."""
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if num_blocks < 1:
@@ -1392,12 +1565,19 @@ class GPTLM:
             self.num_kv_heads,
             self.head_dim,
         )
-        z = jnp.zeros(shape, self.compute_dtype)
+        z = jnp.zeros(shape, kv_storage_dtype(kv_dtype, self.compute_dtype))
+        sc = (
+            None
+            if kv_dtype == "bf16"
+            else jnp.zeros(shape[:-1], jnp.float32)
+        )
         return PagedKVCache(
             k=z,
             v=z,
             block_tables=jnp.zeros((slots, nb_slot), jnp.int32),
             lengths=jnp.zeros((slots,), jnp.int32),
+            k_scale=sc,
+            v_scale=sc,
         )
 
     def extend_paged(
@@ -1436,38 +1616,84 @@ class GPTLM:
         positions = prefix_lens[:, None] + jnp.arange(l)[None, :]  # [S, L]
         token_mask = jnp.arange(l)[None, :] < suffix_lens[:, None]
         h = self._embed_tokens(params, tokens, positions)
+        qd = self._kv_quant_dtype(cache)
 
-        def body(h, xs):
-            blk, pk, pv = xs
-
+        def make_attend(pk, pv, pks, pvs):
             def attend(q, k, v):
                 kview = paged.gather_block_view(pk, cache.block_tables)
                 vview = paged.gather_block_view(pv, cache.block_tables)
+                if qd is not None:
+                    # Dequantize-on-read: the scale side pools gather
+                    # through the SAME tables (identical index math,
+                    # one fewer axis), so cached-prefix K/V arrive as
+                    # values. The suffix's own fresh k/v are ROUND-
+                    # TRIPPED through the same quantizer before the
+                    # softmax — attention must see exactly the values
+                    # the scatter below will store, or a token scored
+                    # here (the speculative verify, a prefill pick)
+                    # could differ from the same position re-scored by
+                    # decode_paged reading the cache; the uniform rule
+                    # "a quantized cache attends quantized values
+                    # EVERYWHERE" is what keeps spec == non-spec and
+                    # paged == slab token-identical.
+                    kview = dequantize_kv(
+                        kview,
+                        paged.gather_block_view(pks, cache.block_tables),
+                        self.compute_dtype,
+                    )
+                    vview = dequantize_kv(
+                        vview,
+                        paged.gather_block_view(pvs, cache.block_tables),
+                        self.compute_dtype,
+                    )
+                    k = dequantize_kv(*quantize_kv(k, qd), self.compute_dtype)
+                    v = dequantize_kv(*quantize_kv(v, qd), self.compute_dtype)
                 return paged.paged_extend_attention(
                     q, k, v, kview, vview, positions, prefix_lens,
                     suffix_lens, window=self.window,
                 )
 
+            return attend
+
+        def body(h, xs):
+            blk, pk, pv = xs[0], xs[1], xs[2]
+            pks, pvs = (xs[3], xs[4]) if qd is not None else (None, None)
             h, kv, _ = self._block(
-                blk, h, attend=attend, positions=positions,
-                token_mask=token_mask,
+                blk, h, attend=make_attend(pk, pv, pks, pvs),
+                positions=positions, token_mask=token_mask,
             )
             return h, kv
 
-        h, (ks, vs) = lax.scan(body, h, (params.blocks, cache.k, cache.v))
-        ks = ks.astype(cache.k.dtype)  # [n, S, L, Hkv, Dh]
-        vs = vs.astype(cache.v.dtype)
+        xs_all = (params.blocks, cache.k, cache.v)
+        if qd is not None:
+            xs_all += (cache.k_scale, cache.v_scale)
+        h, (ks, vs) = lax.scan(body, h, xs_all)
         valid = token_mask & admit[:, None]
+        if qd is None:
+            ks = ks.astype(cache.k.dtype)  # [n, S, L, Hkv, Dh]
+            vs = vs.astype(cache.v.dtype)
+            nksc, nvsc = cache.k_scale, cache.v_scale
+        else:
+            ks, ksc = quantize_kv(ks, qd)  # + [n, S, L, Hkv] scales
+            vs, vsc = quantize_kv(vs, qd)
+            nksc = paged.scatter_token_kv_all_layers(
+                cache.k_scale, ksc, cache.block_tables, positions, valid
+            )
+            nvsc = paged.scatter_token_kv_all_layers(
+                cache.v_scale, vsc, cache.block_tables, positions, valid
+            )
         nk = paged.scatter_token_kv_all_layers(
             cache.k, ks, cache.block_tables, positions, valid
         )
         nv = paged.scatter_token_kv_all_layers(
             cache.v, vs, cache.block_tables, positions, valid
         )
-        return self._logits(params, h), cache._replace(k=nk, v=nv)
+        return self._logits(params, h), cache._replace(
+            k=nk, v=nv, k_scale=nksc, v_scale=nvsc
+        )
 
     def _decode_block_paged(self, blk, h, pk, pv, block_tables, lengths,
-                            act):
+                            act, pks=None, pvs=None, qd=None):
         """Per-slot single-token block step against the BLOCK POOL —
         :meth:`_decode_block_slots` with the slab row replaced by a
         scatter-then-gather through the block tables: the fresh K/V row
@@ -1475,12 +1701,20 @@ class GPTLM:
         at the sentinel), then the slot's contiguous view is gathered
         back and attended with the same ``idx <= lengths`` validity.
         Windowed models band by mask (``idx > lengths − W``) — absolute
-        addressing, no rolling arithmetic."""
+        addressing, no rolling arithmetic. Quantized pools (``qd`` +
+        pks/pvs scale pools) quantize the fresh row before its scatter
+        and dequantize the gathered view before the softmax — the scale
+        pools ride the same scatter/gather index math."""
         from distributed_tensorflow_tpu.ops import paged_attention as paged
 
         def cache_update(k, v):
-            k = k.astype(pk.dtype)
-            v = v.astype(pv.dtype)
+            if qd is None:
+                k = k.astype(pk.dtype)
+                v = v.astype(pv.dtype)
+                ksc = vsc = None
+            else:
+                k, ksc = quantize_kv(k, qd)  # [S,1,Hkv,Dh] + [S,1,Hkv]
+                v, vsc = quantize_kv(v, qd)
             nk = paged.scatter_token_kv(
                 pk, k, block_tables, lengths[:, None], act[:, None]
             )
@@ -1489,14 +1723,35 @@ class GPTLM:
             )
             ck = paged.gather_block_view(nk, block_tables)  # [S, C, Hkv, Dh]
             cv = paged.gather_block_view(nv, block_tables)
+            if qd is None:
+                state = (nk, nv, None, None)
+            else:
+                nks = paged.scatter_token_kv(
+                    pks, ksc, block_tables, lengths[:, None], act[:, None]
+                )
+                nvs = paged.scatter_token_kv(
+                    pvs, vsc, block_tables, lengths[:, None], act[:, None]
+                )
+                # compute_dtype view, not f32 (see _decode_block_slots).
+                ck = dequantize_kv(
+                    ck,
+                    paged.gather_block_view(nks, block_tables),
+                    self.compute_dtype,
+                )
+                cv = dequantize_kv(
+                    cv,
+                    paged.gather_block_view(nvs, block_tables),
+                    self.compute_dtype,
+                )
+                state = (nk, nv, nks, nvs)
             idx = jnp.arange(ck.shape[1])[None, :]  # [1, C] absolute
             valid = idx <= lengths[:, None]  # [S, C]
             if self.window is not None:
                 valid &= idx > lengths[:, None] - self.window
-            return ck, cv, valid, (nk, nv)
+            return ck, cv, valid, state
 
-        h, (nk, nv) = self._decode_block_step(blk, h, lengths, cache_update)
-        return h, nk, nv
+        h, state = self._decode_block_step(blk, h, lengths, cache_update)
+        return h, state
 
     def decode_paged(
         self,
@@ -1527,19 +1782,27 @@ class GPTLM:
         h = self._embed_tokens(
             params, token[:, None], cache.lengths[:, None]
         )
-        nks, nvs = [], []
+        qd = self._kv_quant_dtype(cache)
+        nks, nvs, nksc, nvsc = [], [], [], []
         for i in range(self.num_layers):
             blk = jax.tree.map(lambda x: x[i], params.blocks)
-            h, pk, pv = self._decode_block_paged(
+            h, (pk, pv, pks, pvs) = self._decode_block_paged(
                 blk, h, cache.k[i], cache.v[i], cache.block_tables,
                 cache.lengths, act,
+                None if qd is None else cache.k_scale[i],
+                None if qd is None else cache.v_scale[i],
+                qd,
             )
             nks.append(pk)
             nvs.append(pv)
+            nksc.append(pks)
+            nvsc.append(pvs)
         new_cache = cache._replace(
             k=jnp.stack(nks),
             v=jnp.stack(nvs),
             lengths=cache.lengths + act.astype(jnp.int32),
+            k_scale=None if qd is None else jnp.stack(nksc),
+            v_scale=None if qd is None else jnp.stack(nvsc),
         )
         return self._logits(params, h)[:, 0], new_cache
 

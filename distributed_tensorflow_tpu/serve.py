@@ -54,6 +54,7 @@ from distributed_tensorflow_tpu.observability import tracing
 from distributed_tensorflow_tpu.observability.exporter import MetricsExporter
 from distributed_tensorflow_tpu.observability.metrics import MetricsRegistry
 from distributed_tensorflow_tpu.observability.spans import SpanRecorder
+from distributed_tensorflow_tpu import serve_pool
 from distributed_tensorflow_tpu.serve_pool import (
     BlockAllocator,
     PrefixCache,
@@ -214,6 +215,10 @@ class _DecodeState(NamedTuple):
     temp: jax.Array  # [S] f32
     top_p: jax.Array  # [S] f32
     eos: jax.Array  # [S] i32 — -1: no EOS stop
+    # Quantized-cache scale side tensors (round 15; None on the bf16
+    # default — the pytree simply has no leaves there).
+    k_scale: jax.Array | None = None  # [layers, S, C, Hkv] f32
+    v_scale: jax.Array | None = None
 
 
 class _PagedState(NamedTuple):
@@ -234,6 +239,8 @@ class _PagedState(NamedTuple):
     temp: jax.Array  # [S] f32
     top_p: jax.Array  # [S] f32
     eos: jax.Array  # [S] i32 — -1: no EOS stop
+    k_scale: jax.Array | None = None  # [layers, NB, bs, Hkv] f32
+    v_scale: jax.Array | None = None
 
 
 class _Request:
@@ -284,6 +291,9 @@ class TextServer:
         paged: bool = False,
         block_size: int = 16,
         kv_blocks: int | None = None,
+        kv_hbm_bytes: int | None = None,
+        kv_dtype: str = "bf16",
+        decode_matmul_dtype: str | None = None,
         prefix_caching: bool = True,
         spec_draft: int = 0,
         spec_ngram: int = 2,
@@ -291,10 +301,36 @@ class TextServer:
         metrics: MetricsRegistry | None = None,
         metrics_port: int | None = None,
     ):
+        from distributed_tensorflow_tpu.ops.quantized import (
+            KV_DTYPES,
+            MATMUL_DTYPES,
+            kv_elem_bytes,
+        )
+
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
+        if kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"unknown kv_dtype {kv_dtype!r}; one of {KV_DTYPES}"
+            )
+        if decode_matmul_dtype is not None and (
+            decode_matmul_dtype not in MATMUL_DTYPES
+        ):
+            raise ValueError(
+                f"unknown decode_matmul_dtype {decode_matmul_dtype!r}; "
+                f"None or one of {MATMUL_DTYPES}"
+            )
+        if kv_hbm_bytes is not None and not paged:
+            raise ValueError(
+                "kv_hbm_bytes sizes the paged block pool; pass paged=True"
+            )
+        if kv_hbm_bytes is not None and kv_blocks is not None:
+            raise ValueError(
+                "pass kv_blocks or kv_hbm_bytes, not both (kv_hbm_bytes "
+                "derives kv_blocks from the element size)"
+            )
         if spec_draft < 0:
             raise ValueError(f"spec_draft must be >= 0, got {spec_draft}")
         if spec_ngram < 1:
@@ -306,10 +342,34 @@ class TextServer:
                 "tables"
             )
         self.model = model
+        # Weight-only quantized decode projections (round 15): quantize
+        # ONCE at construction (the restore-time artifact
+        # GPTLM.decode_weights documents) and serve the quantized tree
+        # through EVERY compiled graph — prefill, chunk decode, and the
+        # speculative verify all see one consistent set of weights, so
+        # served streams are exactly the greedy/sampled streams of the
+        # weight-quantized model (the parity tests pin this: weight-only
+        # quantization does not relax batch-invariance, only the values).
+        self.decode_matmul_dtype = decode_matmul_dtype
+        if decode_matmul_dtype is not None and params is not None:
+            params = model.decode_weights(params, decode_matmul_dtype)
         self.params = params
         self.tokenizer = tokenizer
         self.slots = slots
         self.chunk = chunk
+        self.kv_dtype = kv_dtype
+        # Element-size-aware cache accounting (serve_pool helpers): what
+        # one position / one block actually costs, scale side tensors
+        # included — the quantized pool's capacity gain is exactly this
+        # quotient, and obs_report renders it so a quantized pool reads
+        # as "smaller bytes", not "bigger chip".
+        self.kv_position_bytes = serve_pool.kv_position_bytes(
+            model.num_layers,
+            model.num_kv_heads,
+            model.head_dim,
+            kv_elem_bytes(kv_dtype, model.compute_dtype),
+            scale_bytes=0 if kv_dtype == "bf16" else 4,
+        )
         # Paged mode (round 11): KV lives in a shared pool of
         # `kv_blocks` blocks of `block_size` positions; slots map
         # logical positions through block tables, admission is gated on
@@ -331,11 +391,30 @@ class TextServer:
         self.spec_ngram = int(spec_ngram)
         self._alloc: BlockAllocator | None = None
         self._prefix: PrefixCache | None = None
+        self.kv_block_bytes = self.kv_position_bytes * self.block_size
         if paged:
             nb_slot = model.paged_blocks_per_slot(self.block_size)
-            self.kv_blocks = (
-                int(kv_blocks) if kv_blocks is not None else slots * nb_slot
-            )
+            if kv_hbm_bytes is not None:
+                # Byte-budget sizing (round 15): blocks-per-budget from
+                # the ELEMENT SIZE, so an int8/fp8 pool under the same
+                # budget holds ~2×/~2× the blocks — admission capacity
+                # actually grows instead of the dtype silently changing
+                # only the array layout.
+                self.kv_blocks = serve_pool.blocks_for_hbm_bytes(
+                    kv_hbm_bytes,
+                    self.block_size,
+                    num_layers=model.num_layers,
+                    kv_heads=model.num_kv_heads,
+                    head_dim=model.head_dim,
+                    elem_bytes=kv_elem_bytes(kv_dtype, model.compute_dtype),
+                    scale_bytes=0 if kv_dtype == "bf16" else 4,
+                )
+            else:
+                self.kv_blocks = (
+                    int(kv_blocks)
+                    if kv_blocks is not None
+                    else slots * nb_slot
+                )
             if self.kv_blocks < 1:
                 raise ValueError(
                     f"kv_blocks must be >= 1, got {self.kv_blocks}"
@@ -364,6 +443,33 @@ class TextServer:
             self._prefix = PrefixCache(
                 self._alloc, self.block_size, journal=self.journal
             )
+        # Cache-geometry record (round 15): dtype + honest byte
+        # accounting as ONE journal event at construction, so
+        # obs_report's serving-cache section can say "int8 pool,
+        # N bytes/slot" — without it a quantized pool's higher
+        # occupancy is indistinguishable from a bigger chip.
+        self.kv_slot_bytes = (
+            self.model.paged_blocks_per_slot(self.block_size)
+            * self.kv_block_bytes
+            if paged
+            else self.model.cache_len * self.kv_position_bytes
+        )
+        self.journal.emit(
+            "serving_cache_config",
+            kv_dtype=self.kv_dtype,
+            decode_matmul_dtype=self.decode_matmul_dtype,
+            paged=bool(paged),
+            block_size=int(self.block_size) if paged else None,
+            kv_blocks=int(self.kv_blocks) if paged else None,
+            position_bytes=int(self.kv_position_bytes),
+            block_bytes=int(self.kv_block_bytes) if paged else None,
+            pool_bytes=int(
+                self.kv_blocks * self.kv_block_bytes
+                if paged
+                else self.slots * self.kv_slot_bytes
+            ),
+            slot_bytes=int(self.kv_slot_bytes),
+        )
         if buckets is None:
             # Doubling buckets up to max_len-1 (a prompt always leaves at
             # least one position of generation room): 16, 32, ... — small
@@ -393,6 +499,11 @@ class TextServer:
         if paged:
             self.metrics.gauge("kv_blocks_total").set(self.kv_blocks)
             self.metrics.gauge("kv_blocks_used").set(0)
+            # Byte-honest pool size (round 15): block count × what a
+            # block actually costs at this kv_dtype, scales included.
+            self.metrics.gauge("kv_pool_bytes").set(
+                self.kv_blocks * self.kv_block_bytes
+            )
         # Live scrape surface (round 12, observability/exporter.py):
         # /metrics = the registry's Prometheus text, /healthz = engine
         # heartbeat (seconds since the last step() tick) + occupancy.
@@ -450,18 +561,25 @@ class TextServer:
         )
         if self.paged:
             cache = self.model.empty_paged_cache(
-                s, self.kv_blocks, self.block_size
+                s, self.kv_blocks, self.block_size, self.kv_dtype
             )
             return _PagedState(
                 k=cache.k,
                 v=cache.v,
                 block_tables=cache.block_tables,
                 lengths=cache.lengths,
+                k_scale=cache.k_scale,
+                v_scale=cache.v_scale,
                 **common,
             )
-        cache = self.model.empty_slot_cache(s)
+        cache = self.model.empty_slot_cache(s, self.kv_dtype)
         return _DecodeState(
-            k=cache.k, v=cache.v, lengths=cache.lengths, **common
+            k=cache.k,
+            v=cache.v,
+            lengths=cache.lengths,
+            k_scale=cache.k_scale,
+            v_scale=cache.v_scale,
+            **common,
         )
 
     def _pick(self, logits, key_data, greedy, temp, top_p):
@@ -524,8 +642,16 @@ class TextServer:
                 v=st.v,
                 block_tables=st.block_tables,
                 lengths=st.lengths,
+                k_scale=st.k_scale,
+                v_scale=st.v_scale,
             )
-        return SlotKVCache(k=st.k, v=st.v, lengths=st.lengths)
+        return SlotKVCache(
+            k=st.k,
+            v=st.v,
+            lengths=st.lengths,
+            k_scale=st.k_scale,
+            v_scale=st.v_scale,
+        )
 
     def _prefill_graph(
         self, params, st, tokens, plens, admit, key, budget, greedy, temp,
@@ -548,6 +674,8 @@ class TextServer:
         return st._replace(
             k=cache.k,
             v=cache.v,
+            k_scale=cache.k_scale,
+            v_scale=cache.v_scale,
             lengths=cache.lengths,
             last_tok=sel(first, st.last_tok),
             key=jnp.where(admit[:, None], carried, st.key),
@@ -590,6 +718,8 @@ class TextServer:
         return st._replace(
             k=cache.k,
             v=cache.v,
+            k_scale=cache.k_scale,
+            v_scale=cache.v_scale,
             block_tables=block_tables,
             lengths=sel(prefix_lens + suffix_lens, st.lengths),
             last_tok=sel(first, st.last_tok),
@@ -662,6 +792,8 @@ class TextServer:
         st = st._replace(
             k=cache.k,
             v=cache.v,
+            k_scale=cache.k_scale,
+            v_scale=cache.v_scale,
             lengths=st.lengths + n_emit,
             last_tok=jnp.where(act, last, st.last_tok),
             key=jnp.where(act[:, None], carried, st.key),
@@ -703,6 +835,8 @@ class TextServer:
             st = st._replace(
                 k=cache.k,
                 v=cache.v,
+                k_scale=cache.k_scale,
+                v_scale=cache.v_scale,
                 lengths=cache.lengths,
                 last_tok=nxt,
                 key=jnp.where(act[:, None], carried, st.key),

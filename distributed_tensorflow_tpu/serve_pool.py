@@ -47,6 +47,80 @@ def blocks_for(tokens: int, block_size: int) -> int:
     return -(-tokens // block_size)
 
 
+def kv_position_bytes(
+    num_layers: int,
+    kv_heads: int,
+    head_dim: int,
+    elem_bytes: int,
+    scale_bytes: int = 0,
+) -> int:
+    """HBM bytes ONE cached position occupies across the whole stack:
+    K + V payload rows plus (quantized caches, round 15) the per-row
+    scale side tensors — ``scale_bytes`` per KV head per tensor per
+    layer (4 for the f32 scales ``ops/quantized.quantize_kv`` emits,
+    0 for the bf16 identity layout). This is the element-size-aware
+    accounting the quantized pool's capacity claim rests on: admission
+    is gated on blocks, so blocks-per-budget MUST derive from what a
+    block actually costs, scales included — counting payload alone
+    would overstate int8 capacity by ~``head_dim·elem/4`` percent."""
+    if min(num_layers, kv_heads, head_dim, elem_bytes) < 1:
+        raise ValueError(
+            "num_layers/kv_heads/head_dim/elem_bytes must all be >= 1"
+        )
+    if scale_bytes < 0:
+        raise ValueError(f"scale_bytes must be >= 0, got {scale_bytes}")
+    return 2 * num_layers * kv_heads * (head_dim * elem_bytes + scale_bytes)
+
+
+def kv_block_bytes(
+    block_size: int,
+    *,
+    num_layers: int,
+    kv_heads: int,
+    head_dim: int,
+    elem_bytes: int,
+    scale_bytes: int = 0,
+) -> int:
+    """HBM bytes one pool block occupies (payload + scales)."""
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    return block_size * kv_position_bytes(
+        num_layers, kv_heads, head_dim, elem_bytes, scale_bytes
+    )
+
+
+def blocks_for_hbm_bytes(
+    budget_bytes: int,
+    block_size: int,
+    *,
+    num_layers: int,
+    kv_heads: int,
+    head_dim: int,
+    elem_bytes: int,
+    scale_bytes: int = 0,
+) -> int:
+    """Pool blocks a byte budget holds at the given element size — the
+    knob that turns "int8 halves the bytes" into "the pool admits ~2×
+    the positions": the SAME ``kv_hbm_bytes`` passed to two servers
+    yields ~``elem_ratio`` × the blocks for the smaller dtype (minus the
+    scale overhead, which this accounting charges honestly)."""
+    bb = kv_block_bytes(
+        block_size,
+        num_layers=num_layers,
+        kv_heads=kv_heads,
+        head_dim=head_dim,
+        elem_bytes=elem_bytes,
+        scale_bytes=scale_bytes,
+    )
+    n = int(budget_bytes) // bb
+    if n < 1:
+        raise ValueError(
+            f"HBM budget {budget_bytes} B holds no {bb} B block; raise the "
+            "budget or shrink block_size"
+        )
+    return n
+
+
 class BlockAllocator:
     """Refcounted free-list allocator over ``num_blocks`` physical KV
     blocks. Invariants (pinned by the randomized schedule in

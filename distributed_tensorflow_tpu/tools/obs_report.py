@@ -188,6 +188,22 @@ def summarize(events: list[dict]) -> dict:
                     used[0]["value"] / total[0]["value"], 3
                 ),
             }
+    # Cache geometry (round 15): dtype + honest byte accounting from the
+    # server's construction-time serving_cache_config event — a quantized
+    # pool must read as "int8, half the bytes/slot", not silently as a
+    # bigger chip. Last event wins (one journal can span several server
+    # incarnations; the newest geometry is the live one).
+    cfgs = by_kind.get("serving_cache_config", [])
+    if cfgs:
+        cfg = cfgs[-1]
+        cache_sec["geometry"] = {
+            "kv_dtype": cfg.get("kv_dtype"),
+            "decode_matmul_dtype": cfg.get("decode_matmul_dtype"),
+            "paged": cfg.get("paged"),
+            "position_bytes": cfg.get("position_bytes"),
+            "slot_bytes": cfg.get("slot_bytes"),
+            "pool_bytes": cfg.get("pool_bytes"),
+        }
     if cache_sec:
         out["serving_cache"] = cache_sec
 
@@ -324,6 +340,18 @@ def render_report(summary: dict) -> str:
             parts.append(
                 f"kv pool {kb['used']:.0f}/{kb['total']:.0f} blocks "
                 f"({kb['occupancy']})"
+            )
+        g = sc.get("geometry")
+        if g:
+            wo = (
+                f", weights {g['decode_matmul_dtype']}"
+                if g.get("decode_matmul_dtype")
+                else ""
+            )
+            parts.append(
+                f"cache {g.get('kv_dtype')}{wo}: "
+                f"{g.get('slot_bytes')} bytes/slot, "
+                f"{g.get('pool_bytes')} bytes pool"
             )
         lines.append("serving cache: " + "; ".join(parts))
     for cm in summary.get("comm", []):

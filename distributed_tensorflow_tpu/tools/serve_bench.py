@@ -194,6 +194,137 @@ def bench_paged_density(
     }
 
 
+def bench_quantized_density(
+    *,
+    bf16_blocks: int = 64,
+    block_size: int = 16,
+    n_requests: int = 16,
+    max_new: int = 144,
+    slots: int = 12,
+    kv_dtype: str = "int8",
+    model_kw=None,
+) -> dict:
+    """Quantized-vs-bf16 paged pools at EQUAL KV HBM **bytes** (round
+    15). The bf16 pool holds ``bf16_blocks``; the quantized pool gets
+    the SAME byte budget through ``kv_hbm_bytes``, so its block count
+    derives from the element size (int8 payload + the f32 per-row
+    scales, charged honestly by ``serve_pool.kv_block_bytes``) — ~1.8×
+    the blocks at these shapes. On a long-generation mix (every request
+    reserves the same worst-case block count) the byte-smaller blocks
+    also pack the bf16 pool's remainder, and the measured peak
+    occupancy doubles: the ``slot_density_q`` series. Peaks are counted
+    from dispatch-span ``active`` attrs exactly as
+    :func:`bench_paged_density` does; occupancy is admission-control
+    arithmetic (deterministic for a fixed workload), so the series is
+    stable under the regression gate even off-chip — only the wall
+    columns carry device provenance."""
+    from distributed_tensorflow_tpu import serve_pool
+    from distributed_tensorflow_tpu.ops.quantized import kv_elem_bytes
+    from distributed_tensorflow_tpu.serve import GenerationConfig, TextServer
+
+    model, params = _build(model_kw)
+    rng = np.random.default_rng(13)
+    prompts = [
+        rng.integers(0, model.vocab_size, (int(s),)).astype(np.int32)
+        for s in rng.integers(17, 33, n_requests)
+    ]
+    cfg = GenerationConfig(max_new=max_new)
+    budget = bf16_blocks * serve_pool.kv_block_bytes(
+        block_size,
+        num_layers=model.num_layers,
+        kv_heads=model.num_kv_heads,
+        head_dim=model.head_dim,
+        elem_bytes=kv_elem_bytes("bf16", model.compute_dtype),
+    )
+    kw = dict(
+        slots=slots, chunk=32, buckets=(32,), paged=True,
+        block_size=block_size,
+    )
+    bf16 = TextServer(model, params, kv_blocks=bf16_blocks, **kw)
+    quant = TextServer(
+        model, params, kv_hbm_bytes=budget, kv_dtype=kv_dtype, **kw
+    )
+    warm = [np.arange(1, 9, dtype=np.int32)] * 2
+    bf16.generate(warm, GenerationConfig(max_new=2))
+    quant.generate(warm, GenerationConfig(max_new=2))
+
+    bf16_wall, bf16_peak, _ = _serve_wall_tracked(bf16, prompts, cfg)
+    q_wall, q_peak, _ = _serve_wall_tracked(quant, prompts, cfg)
+    total_tokens = n_requests * max_new
+    device = jax.devices()[0].device_kind
+    return {
+        "device": device,
+        "kv_hbm_bytes": budget,
+        "block_size": block_size,
+        "workload": {
+            "requests": n_requests,
+            "prompt_range": [17, 32],
+            "max_new": max_new,
+        },
+        "bf16": {
+            "kv_blocks": bf16.kv_blocks,
+            "positions": bf16.kv_blocks * block_size,
+            "block_bytes": bf16.kv_block_bytes,
+            "peak_occupancy": int(bf16_peak),
+            "wall_s": round(bf16_wall, 4),
+            "tokens_per_s": round(total_tokens / bf16_wall, 1),
+        },
+        "quantized": {
+            "kv_dtype": kv_dtype,
+            "kv_blocks": quant.kv_blocks,
+            "positions": quant.kv_blocks * block_size,
+            "block_bytes": quant.kv_block_bytes,
+            "peak_occupancy": int(q_peak),
+            "wall_s": round(q_wall, 4),
+            "tokens_per_s": round(total_tokens / q_wall, 1),
+        },
+        "positions_x": round(quant.kv_blocks / bf16.kv_blocks, 2),
+        "density_q_x": round(q_peak / max(bf16_peak, 1), 2),
+    }
+
+
+def bench_weight_only_decode(
+    *,
+    n_requests: int = 8,
+    max_new: int = 64,
+    slots: int = 4,
+    chunk: int = 32,
+    dtype: str = "int8",
+    model_kw=None,
+) -> dict:
+    """Decode tokens/s A/B for the weight-only path: the same greedy
+    workload through a full-precision server and one with
+    ``decode_matmul_dtype`` set (projection weights pre-quantized at
+    construction, ``wo_dot`` at every block matmul). The claim is HBM
+    traffic — decode reads every weight per token — so CPU numbers are
+    provenance only (the dequant-and-dot emulation can even run SLOWER
+    there); the speedup column is a TUNNEL-TPU claim until the chip
+    rerun, exactly like the round-13 ``matmul_dtype`` row."""
+    from distributed_tensorflow_tpu.serve import GenerationConfig, TextServer
+
+    model, params = _build(model_kw)
+    prompts, cfg = _workload(model, n_requests, max_new, seed=3)
+    kw = dict(slots=slots, chunk=chunk, buckets=(64,))
+    base = TextServer(model, params, **kw)
+    wo = TextServer(model, params, decode_matmul_dtype=dtype, **kw)
+    warm = [np.arange(1, 9, dtype=np.int32)] * 2
+    base.generate(warm, GenerationConfig(max_new=4))
+    wo.generate(warm, GenerationConfig(max_new=4))
+    base_wall = min(_serve_wall(base, prompts, cfg) for _ in range(2))
+    wo_wall = min(_serve_wall(wo, prompts, cfg) for _ in range(2))
+    total_tokens = n_requests * max_new
+    return {
+        "device": jax.devices()[0].device_kind,
+        "dtype": dtype,
+        "workload": {"requests": n_requests, "max_new": max_new},
+        "baseline_tokens_per_s": round(total_tokens / base_wall, 1),
+        "wo_tokens_per_s": round(total_tokens / wo_wall, 1),
+        "baseline_wall_s": round(base_wall, 4),
+        "wo_wall_s": round(wo_wall, 4),
+        "speedup": round(base_wall / wo_wall, 2),
+    }
+
+
 def bench_speculation(
     *,
     n_requests: int = 8,
@@ -356,6 +487,8 @@ def bench(
         default=sweep[-1],
     )
     density = bench_paged_density(model_kw=model_kw)
+    quantized = bench_quantized_density(model_kw=model_kw)
+    weight_only = bench_weight_only_decode(model_kw=model_kw)
     speculation = bench_speculation(model_kw=model_kw)
     percentiles = bench_request_percentiles(
         model, params, n_requests=n_requests, max_new=max_new,
@@ -395,6 +528,8 @@ def bench(
         "marginal_token_ms": round(float(marg_t) * 1e3, 3),
         "per_request_ms": round(float(req_b) * 1e3, 3),
         "paged_density": density,
+        "quantized_density": quantized,
+        "weight_only_decode": weight_only,
         "speculation": speculation,
         **(
             {"request_percentiles": percentiles}
@@ -455,6 +590,46 @@ def emit_bench_events(payload: dict, events_path: str) -> list[dict]:
                 )
             ]
             if "paged_density" in payload
+            else []
+        ) + (
+            [
+                j.emit(
+                    "bench_point", name="slot_density_q",
+                    value=payload["quantized_density"]["density_q_x"],
+                    unit="x",  # unit-aware gate: "x" fails LOW
+                    kv_dtype=payload["quantized_density"]["quantized"][
+                        "kv_dtype"
+                    ],
+                    kv_hbm_bytes=payload["quantized_density"][
+                        "kv_hbm_bytes"
+                    ],
+                    **common,
+                ),
+                j.emit(
+                    "bench_point", name="quantized_positions_x",
+                    value=payload["quantized_density"]["positions_x"],
+                    unit="x", **common,
+                ),
+            ]
+            if "quantized_density" in payload
+            else []
+        ) + (
+            [
+                j.emit(
+                    "bench_point", name="wo_decode_speedup",
+                    value=payload["weight_only_decode"]["speedup"],
+                    unit="x",
+                    dtype=payload["weight_only_decode"]["dtype"],
+                    **common,
+                )
+            ]
+            # Gate this series ON-CHIP ONLY: the CPU number is a
+            # dequant-and-dot emulation the bench itself documents as
+            # meaningless off-chip (≈0.6-1.0× run to run) — a fail-low
+            # band over it would flag container noise, not regressions.
+            # The md row still carries the CPU A/B as provenance.
+            if "weight_only_decode" in payload
+            and payload["device"] != "cpu"
             else []
         ) + (
             [
@@ -553,6 +728,60 @@ def render(payload: dict) -> str:
             f"{payload['model']['max_len']}): the slab reserves "
             "worst-case slabs, the paged pool reserves actual "
             "footprints.",
+        ]
+    q = payload.get("quantized_density")
+    if q:
+        bq, qq = q["bf16"], q["quantized"]
+        dev = f" ({q['device']})" if q.get("device") else ""
+        lines += [
+            "",
+            "## Quantized KV cache: slot density at equal KV HBM bytes "
+            f"({q['kv_hbm_bytes']} B budget, block size {q['block_size']})",
+            "",
+            "| pool | blocks | positions | bytes/block | peak concurrent "
+            "| wall (s) | tokens/s |",
+            "|---|---|---|---|---|---|---|",
+            f"| bf16 | {bq['kv_blocks']} | {bq['positions']} "
+            f"| {bq['block_bytes']} | {bq['peak_occupancy']} "
+            f"| {bq['wall_s']}{dev} | {bq['tokens_per_s']} |",
+            f"| {qq['kv_dtype']} | {qq['kv_blocks']} | {qq['positions']} "
+            f"| {qq['block_bytes']} | {qq['peak_occupancy']} "
+            f"| {qq['wall_s']}{dev} | {qq['tokens_per_s']} |",
+            "",
+            f"**Quantized slot density: {q['density_q_x']}x** peak "
+            f"concurrent residents in the SAME byte budget "
+            f"({q['positions_x']}x the cached positions — int8 payload "
+            "plus the f32 per-row scales, charged honestly; the extra "
+            "density over the positions ratio is the byte-smaller "
+            "blocks packing the bf16 pool's remainder) on a "
+            "long-generation mix (prompts "
+            f"{q['workload']['prompt_range'][0]}-"
+            f"{q['workload']['prompt_range'][1]} + "
+            f"{q['workload']['max_new']} new). Occupancy is "
+            "admission-control arithmetic — the density column carries "
+            "over to the chip as-is; the wall columns are device-tagged "
+            "provenance.",
+        ]
+    wo = payload.get("weight_only_decode")
+    if wo:
+        dev = f" ({wo['device']})" if wo.get("device") else ""
+        lines += [
+            "",
+            "## Weight-only quantized decode (`decode_matmul_dtype`)",
+            "",
+            "| weights | tokens/s | wall (s) |",
+            "|---|---|---|",
+            f"| full precision | {wo['baseline_tokens_per_s']} "
+            f"| {wo['baseline_wall_s']}{dev} |",
+            f"| {wo['dtype']} (wo_dot) | {wo['wo_tokens_per_s']} "
+            f"| {wo['wo_wall_s']}{dev} |",
+            "",
+            f"**Decode A/B: {wo['speedup']}x wall** on this device. The "
+            "weight-only win is HBM traffic (decode reads every "
+            "projection weight per token), so the CPU dequant-and-dot "
+            "emulation understates — or inverts — the chip number; "
+            "treat the speedup as TUNNEL-TPU until the v5e rerun, like "
+            "the round-13 int8 training row.",
         ]
     sp = payload.get("speculation")
     if sp:

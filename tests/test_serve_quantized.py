@@ -1,0 +1,532 @@
+"""Quantized serving path (round 15, ISSUE 11): int8/fp8 KV cache +
+weight-only decode matmuls through TextServer.
+
+The parity ladder this module pins, from strict to budgeted:
+
+1. ``kv_dtype="bf16"`` (the default) is BITWISE the round-11 paged
+   path — streams equal a default server's and the in-process decode
+   loops token for token.
+2. Weight-only quantization (``decode_matmul_dtype``) does NOT relax
+   parity: every compiled graph serves the same pre-quantized tree, so
+   served streams equal the in-process decode of
+   ``GPTLM.decode_weights(params, dtype)`` exactly.
+3. A quantized PAGED pool equals a quantized SLAB cache token for token
+   (the round-11 layout-equality argument survives quantization: both
+   layouts dequantize to identical values), and the quantize → scatter
+   → gather → dequantize chain is EXACT when row scales are powers of
+   two (integer-valued ``x/scale`` round-trips bit-exactly).
+4. int8/fp8 KV relaxes the bf16 contract ONLY to a pinned quality
+   budget (the test_quantized.py methodology): greedy-stream divergence
+   rate and teacher-forced held-out ppl delta on the copy corpus.
+
+Single-device only — no conftest._CACHE_OPT_OUT_FIRST entry needed (the
+module compiles no multi-device scan programs; the round-14 audit rule
+heavy-marks the compile-tail dtype matrix, int8 stays the fast-tier
+representative).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.models.gpt import GPTLM
+from distributed_tensorflow_tpu.serve import GenerationConfig, TextServer
+
+# Pinned quality budgets (measured on the 40-step copy-corpus model:
+# int8 diverges ~2% of greedy tokens at ~0.05% relative ppl, fp8 ~4% at
+# ~0.2% — the budgets carry generous headroom because argmax flips near
+# ties are seed- and platform-sensitive, but an order-of-methodology
+# break (scales dropped, wrong rows dequantized) blows straight past
+# them).
+DIVERGENCE_BUDGET = {"int8": 0.15, "fp8": 0.25}
+PPL_DELTA_BUDGET = {"int8": 0.05, "fp8": 0.08}
+
+
+def tiny_model(**kw):
+    kw.setdefault("vocab_size", 97)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("model_dim", 32)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("compute_dtype", jnp.float32)
+    return GPTLM(**kw)
+
+
+def _prompts(vocab, sizes, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, (n,)).astype(np.int32) for n in sizes]
+
+
+def _mixed_cfgs(n):
+    return [
+        GenerationConfig(max_new=10, greedy=True)
+        if i % 2 == 0
+        else GenerationConfig(
+            max_new=10, greedy=False, temperature=0.8, top_p=0.9,
+            seed=50 + i,
+        )
+        for i in range(n)
+    ]
+
+
+@pytest.fixture(scope="module")
+def trained_copy_model():
+    """A GPT trained 40 steps on the synthetic copy corpus (the
+    test_quantized.py methodology): confident logits, so quantization-
+    induced argmax flips measure cache quality rather than tie noise.
+    Built inside the fixture (never at collection time — the round-14
+    module-scope jnp GC gotcha)."""
+    import optax
+
+    from distributed_tensorflow_tpu.models.gpt import make_lm_train_step
+
+    m = GPTLM(
+        vocab_size=61, max_len=48, model_dim=32, num_heads=4,
+        num_layers=2, compute_dtype=jnp.float32,
+    )
+    params = m.init(seed=1)
+    opt = optax.adam(3e-3)
+    step = make_lm_train_step(m, opt)
+    opt_state = opt.init(params)
+    rng = np.random.default_rng(0)
+    base = rng.integers(0, 30, size=(64, 8), dtype=np.int32)
+    toks = jnp.asarray(np.concatenate([base, base + 30], axis=1))
+    for _ in range(40):
+        params, opt_state, _ = step(params, opt_state, toks)
+    return m, params
+
+
+# -- 1: the bf16 default is bitwise round 11 --------------------------------
+
+
+def test_kv_dtype_bf16_bitwise_round11_paged():
+    """``kv_dtype="bf16"`` must be indistinguishable from the round-11
+    engine: scales stay None, no graph changes, streams equal a default
+    paged server's BITWISE (greedy and seeded nucleus, mid-flight
+    admissions). The default server's streams are themselves pinned
+    token-for-token against the in-process decode loops in
+    test_serve.py, so equality here closes the chain to round 11
+    without recompiling the in-process references."""
+    m = tiny_model()
+    p = m.init(3)
+    prompts = _prompts(m.vocab_size, [5, 9, 17, 3, 20, 8], seed=1)
+    cfgs = _mixed_cfgs(len(prompts))
+    kw = dict(slots=3, chunk=4, buckets=(8, 24), paged=True, block_size=4)
+    default = TextServer(m, p, **kw)
+    explicit = TextServer(m, p, kv_dtype="bf16", **kw)
+    assert explicit._state.k_scale is None  # the identity layout
+    out_d = default.generate(prompts, cfgs)
+    out_e = explicit.generate(prompts, cfgs)
+    for a, b in zip(out_d, out_e):
+        assert np.array_equal(a, b)
+
+
+# -- 3: layout equality + power-of-two exactness ----------------------------
+
+
+@pytest.mark.parametrize(
+    "kv_dtype",
+    [
+        "int8",
+        # Round-14 audit rule: one representative dtype fast-tier; fp8
+        # re-runs the same compile tail.
+        pytest.param("fp8", marks=pytest.mark.heavy),
+    ],
+)
+def test_quantized_paged_equals_quantized_slab(kv_dtype):
+    """The paged pool and the slab cache at the SAME kv_dtype serve
+    token-identical streams: gather/scatter through block tables and the
+    slab's row addressing dequantize to identical values, so the
+    round-11 layout-equality argument survives quantization verbatim
+    (mixed greedy/sampled, slot churn)."""
+    m = tiny_model()
+    p = m.init(3)
+    prompts = _prompts(m.vocab_size, [5, 9, 17, 3, 20, 8], seed=1)
+    cfgs = _mixed_cfgs(len(prompts))
+    slab = TextServer(
+        m, p, slots=3, chunk=4, buckets=(8, 24), kv_dtype=kv_dtype
+    )
+    paged = TextServer(
+        m, p, slots=3, chunk=4, buckets=(8, 24), paged=True, block_size=4,
+        kv_dtype=kv_dtype,
+    )
+    out_s = slab.generate(prompts, cfgs)
+    out_p = paged.generate(prompts, cfgs)
+    for a, b in zip(out_s, out_p):
+        assert np.array_equal(a, b)
+
+
+def test_quantize_scatter_gather_roundtrip_exact_pow2():
+    """Claim (3), primitive half: rows whose amax is qmax × 2^k quantize
+    with an exactly representable power-of-two scale, so integer-valued
+    ``x/scale`` survives quantize → pool scatter → block-table gather →
+    dequantize BIT-EXACTLY — the index machinery moves bytes, never
+    values."""
+    from distributed_tensorflow_tpu.ops import paged_attention as paged
+    from distributed_tensorflow_tpu.ops.quantized import (
+        dequantize_kv,
+        quantize_kv,
+    )
+
+    rng = np.random.default_rng(5)
+    s, l, hkv, dh, bs, nb = 2, 8, 2, 8, 4, 16
+    ints = rng.integers(-127, 128, (1, s, l, hkv, dh)).astype(np.float32)
+    ints[..., 0] = 127  # pin each row's amax to 127 → scale = 2^k exact
+    x = jnp.asarray(ints) * 0.125
+    q, sc = quantize_kv(x, "int8")
+    np.testing.assert_array_equal(np.asarray(dequantize_kv(q, sc)), x)
+
+    tables = jnp.asarray(
+        rng.permutation(nb)[: s * 2].reshape(s, 2), jnp.int32
+    )  # 2 blocks/slot, disjoint
+    positions = jnp.broadcast_to(jnp.arange(l)[None, :], (s, l))
+    valid = jnp.ones((s, l), bool)
+    pool = jnp.zeros((1, nb, bs, hkv, dh), jnp.int8)
+    spool = jnp.zeros((1, nb, bs, hkv), jnp.float32)
+    pool = paged.scatter_token_kv_all_layers(pool, q, tables, positions, valid)
+    spool = paged.scatter_token_kv_all_layers(
+        spool, sc, tables, positions, valid
+    )
+    view = paged.gather_block_view(pool[0], tables)[:, :l]
+    sview = paged.gather_block_view(spool[0], tables)[:, :l]
+    np.testing.assert_array_equal(
+        np.asarray(dequantize_kv(view, sview)), np.asarray(x[0])
+    )
+
+
+def test_paged_extend_attention_exact_on_pow2_quantized_prefix():
+    """Claim (3), attention half: extend attention over a quantized
+    prefix equals the dequantize-then-slab-attention reference EXACTLY
+    when the prefix rows carry power-of-two scales — the quantized path
+    feeds bitwise-identical values into the same softmax."""
+    from distributed_tensorflow_tpu.ops import paged_attention as paged
+    from distributed_tensorflow_tpu.ops.quantized import (
+        dequantize_kv,
+        quantize_kv,
+    )
+
+    rng = np.random.default_rng(7)
+    s, lpre, lsuf, hq, hkv, dh = 2, 6, 3, 4, 2, 8
+    ints = rng.integers(-127, 128, (2, s, lpre, hkv, dh)).astype(np.float32)
+    ints[..., 0] = 127
+    kv_pre = jnp.asarray(ints) * 0.0625  # exact-roundtrip prefix K and V
+    kq, ks = quantize_kv(kv_pre[0], "int8")
+    vq, vs = quantize_kv(kv_pre[1], "int8")
+    q = jnp.asarray(rng.normal(size=(s, lsuf, hq, dh)), jnp.float32)
+    k_new = jnp.asarray(rng.normal(size=(s, lsuf, hkv, dh)), jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(s, lsuf, hkv, dh)), jnp.float32)
+    prefix = jnp.full((s,), lpre, jnp.int32)
+    suffix = jnp.full((s,), lsuf, jnp.int32)
+    positions = prefix[:, None] + jnp.arange(lsuf)[None, :]
+
+    ref = paged.paged_extend_attention(
+        q, k_new, v_new, kv_pre[0], kv_pre[1], positions, prefix, suffix
+    )
+    got = paged.paged_extend_attention(
+        q, k_new, v_new,
+        dequantize_kv(kq, ks), dequantize_kv(vq, vs),
+        positions, prefix, suffix,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+# -- 4: the pinned quality budget (copy corpus) -----------------------------
+
+
+def _teacher_forced_ce(m, params, kv_dtype, toks):
+    """Held-out next-token CE measured THROUGH the serving cache:
+    prefill the first token, then teacher-force the rest one
+    decode_slots step at a time, scoring each true next token — the
+    quantity the KV dtype can actually perturb (the training loss never
+    touches the cache)."""
+    b, t = toks.shape
+    cache = m.empty_slot_cache(b, kv_dtype)
+    ones = jnp.ones((b,), bool)
+    logits, cache = m.prefill_slots(
+        params, cache, jnp.asarray(toks[:, :1]),
+        jnp.ones((b,), jnp.int32), ones,
+    )
+    rows = jnp.arange(b)
+    ces = [-jax.nn.log_softmax(logits, -1)[rows, toks[:, 1]]]
+    step = jax.jit(m.decode_slots)  # one compile, t-2 fast dispatches
+    for i in range(1, t - 1):
+        logits, cache = step(params, jnp.asarray(toks[:, i]), cache)
+        ces.append(-jax.nn.log_softmax(logits, -1)[rows, toks[:, i + 1]])
+    return float(jnp.mean(jnp.stack(ces)))
+
+
+@pytest.mark.parametrize(
+    "kv_dtype",
+    ["int8", pytest.param("fp8", marks=pytest.mark.heavy)],
+)
+def test_greedy_divergence_and_ppl_within_budget(
+    trained_copy_model, kv_dtype
+):
+    """The ONE place the parity contract relaxes, and by exactly how
+    much: greedy streams from a quantized paged pool may diverge from
+    the bf16 streams at most DIVERGENCE_BUDGET of token positions, and
+    teacher-forced held-out perplexity through the quantized cache stays
+    within PPL_DELTA_BUDGET relative of the bf16 cache's."""
+    m, params = trained_copy_model
+    rng = np.random.default_rng(3)
+    prompts = [
+        np.concatenate([b, b[:1] + 30]).astype(np.int32)
+        for b in rng.integers(0, 30, size=(8, 8), dtype=np.int32)
+    ]
+    cfg = GenerationConfig(max_new=12)
+    kw = dict(slots=4, chunk=4, buckets=(16,), paged=True, block_size=4)
+    out_ref = TextServer(m, params, kv_dtype="bf16", **kw).generate(
+        prompts, cfg
+    )
+    out_q = TextServer(m, params, kv_dtype=kv_dtype, **kw).generate(
+        prompts, cfg
+    )
+    total = same = 0
+    for a, b in zip(out_ref, out_q):
+        n = min(len(a), len(b))
+        total += n
+        same += int((a[:n] == b[:n]).sum())
+    divergence = 1.0 - same / total
+    assert divergence <= DIVERGENCE_BUDGET[kv_dtype], divergence
+
+    hb = rng.integers(0, 30, size=(8, 8), dtype=np.int32)
+    ht = np.concatenate([hb, hb + 30], axis=1)
+    ce_ref = _teacher_forced_ce(m, params, "bf16", ht)
+    ce_q = _teacher_forced_ce(m, params, kv_dtype, ht)
+    delta = abs(np.exp(ce_q) - np.exp(ce_ref)) / np.exp(ce_ref)
+    assert delta <= PPL_DELTA_BUDGET[kv_dtype], (ce_q, ce_ref)
+
+
+# -- 4b: radix prefix + speculation still function on quantized blocks ------
+
+
+def test_radix_prefix_and_speculation_on_quantized_blocks(
+    trained_copy_model,
+):
+    """COW prefix sharing and greedy-exact speculation run unchanged on
+    int8 blocks: the scales ride beside the block tables, so a shared
+    block's payload AND scales are read by every mapper. Pins: followers
+    HIT the radix (the shared prefix prefills once), drafts are
+    accepted (the drafter feeds on the copy-task's repetition),
+    acceptance never exceeds proposal, every stream completes at its
+    budget, and the pool drains to exactly the radix's residents."""
+    m, params = trained_copy_model
+    rng = np.random.default_rng(9)
+    sysp = rng.integers(0, 30, (12,)).astype(np.int32)
+    prompts = [
+        np.concatenate([sysp, t]).astype(np.int32)
+        for t in rng.integers(0, 30, size=(3, 3), dtype=np.int32)
+    ]
+    srv = TextServer(
+        m, params, slots=3, chunk=4, buckets=(8, 16), paged=True,
+        block_size=4, kv_dtype="int8", spec_draft=3,
+    )
+    r0 = srv.submit(prompts[0], GenerationConfig(max_new=8))
+    srv.step()  # leader prefills and registers the 12-token prefix
+    rids = [
+        srv.submit(p, GenerationConfig(max_new=8)) for p in prompts[1:]
+    ]
+    while srv.step():
+        pass
+    outs = [srv.result(r) for r in [r0] + rids]
+    assert all(len(o) == 8 for o in outs)
+    # 12-token prefix = 3 int8 blocks of 4, hit by both followers.
+    assert srv.metrics.counter("prefix_cache_hits").value == 6
+    prop = srv.metrics.counter("spec_tokens_proposed").value
+    acc = srv.metrics.counter("spec_tokens_accepted").value
+    assert 0 < acc <= prop  # the copy task feeds the n-gram drafter
+    # Pool hygiene: only radix-resident blocks stay live after drain.
+    assert srv._alloc.used_blocks == len(srv._prefix._map) > 0
+
+
+# -- 2: weight-only decode keeps EXACT parity -------------------------------
+
+
+@pytest.mark.parametrize(
+    "greedy",
+    [
+        True,
+        # Round-14 audit economy: the sampled reference compiles the
+        # full nucleus scan — greedy is the fast-tier representative.
+        pytest.param(False, marks=pytest.mark.heavy),
+    ],
+    ids=["greedy", "sampled"],
+)
+def test_weight_only_decode_streams_match_in_process_exactly(greedy):
+    """``decode_matmul_dtype`` quantizes the weights ONCE and serves the
+    same tree through every graph, so served streams equal the
+    in-process decode of ``decode_weights(params, dtype)`` token for
+    token — weight-only quantization changes the model being served,
+    never the batch-invariance contract."""
+    m = tiny_model()
+    p = m.init(3)
+    pr = _prompts(m.vocab_size, [5], seed=1)[0]
+    c = (
+        GenerationConfig(max_new=10)
+        if greedy
+        else GenerationConfig(
+            max_new=10, greedy=False, temperature=0.8, top_p=0.9, seed=51
+        )
+    )
+    srv = TextServer(
+        m, p, slots=2, chunk=4, buckets=(8,), paged=True, block_size=4,
+        decode_matmul_dtype="int8",
+    )
+    out = srv.generate([pr], [c])[0]
+    qp = m.decode_weights(p, "int8")
+    if greedy:
+        ref = m.greedy_decode(qp, jnp.asarray(pr[None]), c.max_new)
+    else:
+        ref = m.sample_decode(
+            qp, jnp.asarray(pr[None]), c.max_new, jax.random.key(c.seed),
+            temperature=c.temperature, top_p=c.top_p,
+        )
+    assert np.array_equal(out, np.asarray(ref)[0, pr.size:]), c
+
+
+def test_speculation_never_changes_quantized_stream(trained_copy_model):
+    """'A bad draft costs wasted compute, never a changed token' holds
+    ON the quantized cache: spec and non-spec servers at the same
+    kv_dtype emit identical greedy streams, because attention sees the
+    round-tripped (stored) values EVERYWHERE — the verify extend and
+    the chunk decode score every position with the same math (the
+    uniform quantized-cache rule in extend_paged/prefill_slots)."""
+    m, params = trained_copy_model
+    rng = np.random.default_rng(21)
+    prompts = [
+        np.concatenate([b, b[:1] + 30]).astype(np.int32)
+        for b in rng.integers(0, 30, size=(3, 8), dtype=np.int32)
+    ]
+    cfg = GenerationConfig(max_new=10)
+    kw = dict(
+        slots=3, chunk=4, buckets=(16,), paged=True, block_size=4,
+        kv_dtype="int8",
+    )
+    plain = TextServer(m, params, **kw).generate(prompts, cfg)
+    spec = TextServer(m, params, spec_draft=3, **kw).generate(prompts, cfg)
+    for a, b in zip(plain, spec):
+        assert np.array_equal(a, b)
+
+
+def test_combined_kv_and_weight_quantization_serves():
+    """The two knobs compose: QuantizedLinear leaves ride the
+    extend_paged layer scan as xs ALONGSIDE the quantized cache's scale
+    pools, and speculation's verify graph traces through both. A smoke
+    of the interaction surface — the per-knob contracts are pinned
+    above."""
+    m = tiny_model()
+    p = m.init(3)
+    pr = np.arange(1, 8, dtype=np.int32)
+    srv = TextServer(
+        m, p, slots=2, chunk=4, buckets=(8,), paged=True, block_size=4,
+        kv_dtype="int8", decode_matmul_dtype="int8", spec_draft=2,
+    )
+    out = srv.generate([pr], GenerationConfig(max_new=8))[0]
+    assert len(out) == 8
+    assert srv._alloc.used_blocks == len(srv._prefix._map)
+
+
+def test_decode_weights_exclusion_rules():
+    """The round-13 exclusion rule carries over: the logits head (tied
+    embedding) is never quantized, and MoE blocks quantize only their
+    attention projections (expert FFNs stay full precision)."""
+    from distributed_tensorflow_tpu.ops.quantized import QuantizedLinear
+
+    m = tiny_model()
+    p = m.init(5)
+    qp = m.decode_weights(p, "int8")
+    assert qp.embed is p.embed  # the head is untouched, not re-quantized
+    assert isinstance(qp.blocks.wq, QuantizedLinear)
+    assert isinstance(qp.blocks.w_up, QuantizedLinear)  # dense FFN: yes
+    assert qp.blocks.wq.qw.dtype == jnp.int8
+
+    moe = tiny_model(moe_experts=2)
+    pm = moe.init(5)
+    qm = moe.decode_weights(pm, "int8")
+    assert isinstance(qm.blocks.wo, QuantizedLinear)
+    assert not isinstance(qm.blocks.w_up, QuantizedLinear)  # experts: no
+    with pytest.raises(ValueError, match="decode weight dtype"):
+        m.decode_weights(p, "int4")
+
+
+# -- knobs, accounting, observability ---------------------------------------
+
+
+def test_server_knob_validation():
+    m = tiny_model()
+    with pytest.raises(ValueError, match="kv_dtype"):
+        TextServer(m, params=None, slots=1, kv_dtype="int4")
+    with pytest.raises(ValueError, match="decode_matmul_dtype"):
+        TextServer(m, params=None, slots=1, decode_matmul_dtype="int4")
+    with pytest.raises(ValueError, match="paged=True"):
+        TextServer(m, params=None, slots=1, kv_hbm_bytes=1 << 20)
+    with pytest.raises(ValueError, match="not both"):
+        TextServer(
+            m, params=None, slots=1, paged=True, kv_blocks=8,
+            kv_hbm_bytes=1 << 20,
+        )
+
+
+def test_equal_hbm_budget_grows_quantized_pool():
+    """The capacity claim in allocator arithmetic: the SAME byte budget
+    yields strictly more int8 blocks than bf16 blocks (scales charged),
+    and serve_pool's accounting is what the server actually allocates."""
+    from distributed_tensorflow_tpu import serve_pool
+    from distributed_tensorflow_tpu.ops.quantized import kv_elem_bytes
+
+    m = tiny_model()  # compute f32 here; elem_bytes follows compute_dtype
+    budget = 1 << 20
+    kw = dict(slots=2, buckets=(8,), paged=True, block_size=4)
+    srv_ref = TextServer(m, params=None, kv_hbm_bytes=budget, **kw)
+    srv_q = TextServer(
+        m, params=None, kv_hbm_bytes=budget, kv_dtype="int8", **kw
+    )
+    assert srv_q.kv_blocks > srv_ref.kv_blocks
+    for srv, kd, sb in ((srv_ref, "bf16", 0), (srv_q, "int8", 4)):
+        expect = serve_pool.blocks_for_hbm_bytes(
+            budget, 4,
+            num_layers=m.num_layers, kv_heads=m.num_kv_heads,
+            head_dim=m.head_dim,
+            elem_bytes=kv_elem_bytes(kd, m.compute_dtype),
+            scale_bytes=sb,
+        )
+        assert srv.kv_blocks == expect
+        assert srv.kv_blocks * srv.kv_block_bytes <= budget
+    with pytest.raises(ValueError, match="must all be >= 1"):
+        serve_pool.kv_position_bytes(0, 1, 1, 1)
+
+
+def test_serving_cache_config_event_and_obs_report(tmp_path):
+    """The fleet report names the cache dtype and honest bytes: server
+    construction emits serving_cache_config, and obs_report's
+    serving-cache section renders dtype + bytes/slot — a quantized pool
+    reads as 'smaller bytes', not 'bigger chip'."""
+    from distributed_tensorflow_tpu.observability.journal import (
+        EventJournal,
+        read_events,
+    )
+    from distributed_tensorflow_tpu.tools import obs_report
+
+    m = tiny_model()
+    j = EventJournal.in_dir(str(tmp_path))
+    srv = TextServer(
+        m, params=None, slots=2, buckets=(8,), paged=True, block_size=4,
+        kv_dtype="int8", decode_matmul_dtype="int8", journal=j,
+    )
+    j.close()
+    events = read_events(str(tmp_path))
+    cfgs = [e for e in events if e["kind"] == "serving_cache_config"]
+    assert len(cfgs) == 1
+    cfg = cfgs[0]
+    assert cfg["kv_dtype"] == "int8"
+    assert cfg["decode_matmul_dtype"] == "int8"
+    assert cfg["position_bytes"] == srv.kv_position_bytes
+    assert cfg["pool_bytes"] == srv.kv_blocks * srv.kv_block_bytes
+    assert cfg["slot_bytes"] == srv.kv_slot_bytes > 0
+    summary = obs_report.summarize(events)
+    g = summary["serving_cache"]["geometry"]
+    assert g["kv_dtype"] == "int8" and g["pool_bytes"] == cfg["pool_bytes"]
+    report = obs_report.render_report(summary)
+    assert "cache int8" in report and "bytes/slot" in report
