@@ -55,6 +55,11 @@ _LAZY_EXPORTS = {
         "distributed_tensorflow_tpu.serve",
         "GenerationConfig",
     ),
+    "ReplicaRouter": (
+        "distributed_tensorflow_tpu.serve_fleet",
+        "ReplicaRouter",
+    ),
+    "local_fleet": ("distributed_tensorflow_tpu.serve_fleet", "local_fleet"),
     "read_data_sets": ("distributed_tensorflow_tpu.data", "read_data_sets"),
     "make_mesh": ("distributed_tensorflow_tpu.parallel", "make_mesh"),
     "SingleDevice": ("distributed_tensorflow_tpu.parallel", "SingleDevice"),

@@ -40,13 +40,20 @@ from distributed_tensorflow_tpu.observability.journal import (
 
 # Kinds that name ONE physical gang-wide instant in every journal that
 # records them — the skew anchors, and the events mirrored onto every
-# rank track in the chrome trace.
+# rank track in the chrome trace. The serving-fleet router's lifecycle
+# kinds (round 16) ride along: they are recorded only in the router's
+# journal, so they never act as cross-journal anchors, but they ARE
+# fleet-wide moments the merged trace should show on every track.
 GANG_KINDS = (
     "restart",
     "restart_exhausted",
     "resize",
     "resize_denied",
     "gang_sync",
+    "replica_dead",
+    "replica_relaunch",
+    "replica_benched",
+    "fleet_below_floor",
 )
 
 _RANK_FILE = re.compile(r"^events-rank(\d+)\.jsonl$")
@@ -280,7 +287,9 @@ def fleet_summary(merged: dict) -> dict:
     lifecycle = []
     for ev in merged["events"]:
         kind = ev.get("kind")
-        if kind in GANG_KINDS or kind in ("preemption", "rollback", "restore"):
+        if kind in GANG_KINDS or kind in (
+            "preemption", "rollback", "restore", "weight_swap", "serve_drain",
+        ):
             try:
                 line = obs_format.render(kind, ev)[0]
             except KeyError:

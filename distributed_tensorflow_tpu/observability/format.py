@@ -117,6 +117,52 @@ def _restore(ev: dict) -> str:
     )
 
 
+def _replica_dead(ev: dict) -> str:
+    return (
+        f"Replica: dead name={ev['replica']} verdict={ev['verdict']} "
+        f"rerouted={ev['rerouted']} restart={ev['attempt']}/"
+        f"{ev['max_restarts']}"
+    )
+
+
+def _replica_relaunch(ev: dict) -> str:
+    return (
+        f"Replica: relaunch name={ev['replica']} "
+        f"restart={ev['attempt']}/{ev['max_restarts']} "
+        f"backoff_s={ev['backoff_s']:.1f}"
+    )
+
+
+def _replica_benched(ev: dict) -> str:
+    return (
+        f"Replica: benched name={ev['replica']} restarts={ev['restarts']}/"
+        f"{ev['max_restarts']} — fleet continues on the remaining replicas"
+    )
+
+
+def _fleet_below_floor(ev: dict) -> str:
+    return (
+        f"Fleet: below floor replicas={ev['replicas']} "
+        f"min_replicas={ev['min_replicas']} cause[{ev['cause']}] — "
+        "failing stop (unserved requests stay with the caller; nothing "
+        "durable is lost)"
+    )
+
+
+def _serve_drain(ev: dict) -> str:
+    return (
+        f"Drain: admission closed residents={ev.get('residents')} "
+        f"queued={ev.get('queued')}"
+    )
+
+
+def _weight_swap(ev: dict) -> str:
+    return (
+        f"Swap: weights step={ev.get('step')} from_step={ev.get('from_step')}"
+        f" source={ev.get('source')}"
+    )
+
+
 RENDERERS = {
     "step": _step,
     "epoch": _epoch,
@@ -129,6 +175,12 @@ RENDERERS = {
     "rollback_compiled": _rollback_compiled,
     "preemption": _preemption,
     "restore": _restore,
+    "replica_dead": _replica_dead,
+    "replica_relaunch": _replica_relaunch,
+    "replica_benched": _replica_benched,
+    "fleet_below_floor": _fleet_below_floor,
+    "serve_drain": _serve_drain,
+    "weight_swap": _weight_swap,
 }
 
 

@@ -58,9 +58,16 @@ from distributed_tensorflow_tpu import serve_pool
 from distributed_tensorflow_tpu.serve_pool import (
     BlockAllocator,
     PrefixCache,
+    QueueFull,
+    RequestCancelled,
     blocks_for,
     lookup_draft,
 )
+
+__all__ = [  # noqa: F822 — QueueFull/RequestCancelled re-exported above
+    "GenerationConfig", "QueueFull", "RequestCancelled", "TextServer",
+    "canonical_lm_params", "load_tokenizer",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -245,23 +252,30 @@ class _PagedState(NamedTuple):
 
 class _Request:
     __slots__ = (
-        "rid", "tokens", "config", "out", "done", "trace",
-        "t_submit", "t_admit", "t_first",
+        "rid", "tokens", "config", "out", "done", "trace", "cancelled",
+        "deadline", "t_submit", "t_admit", "t_first",
     )
 
-    def __init__(self, rid, tokens, config):
+    def __init__(self, rid, tokens, config, *, trace=None, deadline_s=None):
         self.rid = rid
         self.tokens = tokens
         self.config = config
         self.out: list[int] = []
         self.done = False
+        self.cancelled = False
         # Trace id (round 12, observability/tracing.py): joins every
         # journal event of this request's life — request_submit →
         # admission → prefill/decode spans (by rid) → completion — so
         # obs_report --requests rebuilds the per-request timeline from
-        # the journal alone.
-        self.trace = tracing.new_trace_id()
+        # the journal alone. A caller-supplied trace (the fleet router)
+        # wins, so one logical request keeps ONE id across replicas.
+        self.trace = trace if trace else tracing.new_trace_id()
         self.t_submit = time.perf_counter()
+        # Absolute deadline on the submit clock; None = no deadline. An
+        # overdue request is cancelled at the next chunk boundary.
+        self.deadline = (
+            None if deadline_s is None else self.t_submit + float(deadline_s)
+        )
         self.t_admit = None  # set at slot admission
         self.t_first = None  # set when the first token lands (TTFT)
 
@@ -297,6 +311,7 @@ class TextServer:
         prefix_caching: bool = True,
         spec_draft: int = 0,
         spec_ngram: int = 2,
+        queue_limit: int | None = None,
         journal=None,
         metrics: MetricsRegistry | None = None,
         metrics_port: int | None = None,
@@ -341,7 +356,29 @@ class TextServer:
                 "(paged=True): the verify pass extends through block "
                 "tables"
             )
+        if queue_limit is not None and queue_limit < 1:
+            raise ValueError(
+                f"queue_limit must be >= 1 (or None), got {queue_limit}"
+            )
         self.model = model
+        # Bounded admission queue (round 16): submit() raises QueueFull
+        # past this depth instead of growing without bound; /healthz
+        # surfaces the saturation so a router can route around a
+        # backed-up replica. None = unbounded (the round-9 behavior).
+        self.queue_limit = queue_limit
+        # Drain / live-weight-swap state (round 16, docs/serving.md
+        # §fleet): draining closes admission permanently (residents
+        # finish); a pending swap pauses admission until the last
+        # old-weight resident completes, then the whole param tree is
+        # replaced between dispatches — params are runtime args of every
+        # compiled graph, so a swap recompiles NOTHING.
+        self._draining = False
+        self._pending_swap: tuple | None = None
+        # Provenance of the served weights (set by from_checkpoint; swap
+        # staleness checks compare against checkpoint_step).
+        self.checkpoint_dir: str | None = None
+        self.checkpoint_step: int | None = None
+        self._restore_optimizer = None
         # Weight-only quantized decode projections (round 15): quantize
         # ONCE at construction (the restore-time artifact
         # GPTLM.decode_weights documents) and serve the quantized tree
@@ -516,7 +553,7 @@ class TextServer:
         self.exporter: MetricsExporter | None = None
         if metrics_port:
             self.exporter = MetricsExporter(
-                self.metrics, port=int(metrics_port), health_fn=self._health
+                self.metrics, port=int(metrics_port), health_fn=self.health
             )
             self.exporter.start()
 
@@ -534,14 +571,22 @@ class TextServer:
     ) -> "TextServer":
         """Serve the newest valid checkpoint in ``checkpoint_dir`` — any
         mode layout (:func:`canonical_lm_params`), with the shipped
-        ``tokenizer.json`` unless an explicit tokenizer is passed."""
-        params, _ = canonical_lm_params(
+        ``tokenizer.json`` unless an explicit tokenizer is passed. The
+        restored step and directory are recorded so
+        :meth:`swap_from_checkpoint` can later adopt a NEWER step from
+        the same directory (the live-weight-swap half of the
+        train→publish→serve loop)."""
+        params, step = canonical_lm_params(
             model, checkpoint_dir, optimizer=optimizer
         )
         tok = tokenizer if tokenizer is not None else load_tokenizer(
             checkpoint_dir
         )
-        return cls(model, params, tok, **kw)
+        srv = cls(model, params, tok, **kw)
+        srv.checkpoint_dir = checkpoint_dir
+        srv.checkpoint_step = int(step)
+        srv._restore_optimizer = optimizer
+        return srv
 
     # -- compiled graphs ---------------------------------------------------
 
@@ -852,12 +897,28 @@ class TextServer:
 
     # -- the scheduler (host side) -----------------------------------------
 
-    def submit(self, tokens, config: GenerationConfig | None = None) -> int:
+    def submit(
+        self,
+        tokens,
+        config: GenerationConfig | None = None,
+        *,
+        deadline_s: float | None = None,
+        trace: str | None = None,
+    ) -> int:
         """Queue one request (prompt as a 1-D int token array). Returns a
         request id for :meth:`result`. Validates against the bucket/cache
         geometry up front: the prompt must fit a bucket and
         ``len + max_new`` must fit ``max_len`` (the KV cache is the slot's
-        whole memory — vLLM's fixed-slot discipline)."""
+        whole memory — vLLM's fixed-slot discipline).
+
+        ``deadline_s`` (round 16): wall-clock budget from NOW; an overdue
+        request — queued or resident — is cancelled at the next chunk
+        boundary (slot/blocks freed, ``request_cancelled`` journal event,
+        :meth:`result` raises :class:`RequestCancelled`). ``trace``
+        overrides the generated trace id so a fleet router's retries keep
+        one id across replicas. Raises :class:`QueueFull` when the queue
+        is at ``queue_limit`` and RuntimeError once :meth:`drain` closed
+        admission."""
         config = config or GenerationConfig()
         config.validate(self.model.vocab_size)
         tokens = np.asarray(tokens, np.int32).reshape(-1)
@@ -883,9 +944,30 @@ class TextServer:
                     f"{self.kv_blocks}; raise kv_blocks or shrink the "
                     "request"
                 )
+        if self._draining:
+            raise RuntimeError(
+                "server is draining: admission is closed (residents are "
+                "being finished; route new requests to another replica)"
+            )
+        if (
+            self.queue_limit is not None
+            and len(self._queue) >= self.queue_limit
+        ):
+            self.metrics.counter("queue_rejections_total").inc()
+            self.journal.emit(
+                "queue_reject",
+                prompt_len=int(tokens.size),
+                queue_depth=len(self._queue),
+                queue_limit=int(self.queue_limit),
+                **({"trace": trace} if trace else {}),
+            )
+            raise QueueFull(
+                f"admission queue is at queue_limit={self.queue_limit}; "
+                "retry later or route to another replica"
+            )
         rid = self._next_rid
         self._next_rid += 1
-        req = _Request(rid, tokens, config)
+        req = _Request(rid, tokens, config, trace=trace, deadline_s=deadline_s)
         self._queue.append(req)
         self._results[rid] = req
         self.metrics.counter("requests_submitted_total").inc()
@@ -1190,22 +1272,70 @@ class TextServer:
                 self._record_first_token(slot, req, first, fin, t_first)
         self.metrics.gauge("queue_depth").set(len(self._queue))
 
+    def _release_slot(self, slot: int) -> None:
+        """Return a slot (and, paged, its block references) to the free
+        pool — the shared half of completion AND cancellation. Prefix-
+        cached blocks keep the radix's own reference and stay resident
+        for future hits."""
+        self._slot_req[slot] = None
+        if self.paged and self._slot_blocks[slot] is not None:
+            for b in self._slot_blocks[slot]:
+                self._alloc.release(b)
+            self._slot_blocks[slot] = None
+            self.metrics.gauge("kv_blocks_used").set(
+                self._alloc.used_blocks
+            )
+
+    def _cancel(self, req: _Request, *, slot: int | None = None) -> None:
+        """Cancel one overdue request at a chunk boundary. Resident
+        requests free their slot/blocks (the device-side ``finished``
+        flag masks the slot out of the next dispatch exactly as a normal
+        completion would); queued requests just leave the queue. The
+        structured ``request_cancelled`` event + counter is the record a
+        router keys on — a cancelled request must never be resurrected
+        by a failover retry."""
+        req.cancelled = True
+        if slot is not None:
+            fin = np.asarray(self._state.finished).copy()
+            fin[slot] = True
+            self._state = self._state._replace(finished=fin)
+            self._release_slot(slot)
+        self.metrics.counter("cancellations_total").inc()
+        self.journal.emit(
+            "request_cancelled",
+            rid=req.rid,
+            trace=req.trace,
+            resident=slot is not None,
+            slot=None if slot is None else int(slot),
+            tokens=len(req.out),
+            age_s=round(time.perf_counter() - req.t_submit, 6),
+        )
+
+    def _cancel_overdue(self) -> None:
+        """Deadline enforcement at the chunk boundary: cancel queued and
+        resident requests whose ``deadline_s`` budget has elapsed."""
+        now = time.perf_counter()
+        if any(r.deadline is not None and now > r.deadline for r in self._queue):
+            keep: deque[_Request] = deque()
+            for req in self._queue:
+                if req.deadline is not None and now > req.deadline:
+                    self._cancel(req)
+                else:
+                    keep.append(req)
+            self._queue = keep
+            self.metrics.gauge("queue_depth").set(len(self._queue))
+        for slot, req in enumerate(self._slot_req):
+            if req is not None and req.deadline is not None and now > req.deadline:
+                self._cancel(req, slot=slot)
+
     def _finish(self, slot: int) -> None:
         req = self._slot_req[slot]
         if req is not None:
             req.done = True
-            self._slot_req[slot] = None
-            if self.paged and self._slot_blocks[slot] is not None:
-                # Completion IS block eviction: every reference this
-                # request held returns before the next chunk boundary's
-                # admissions (prefix-cached blocks keep the cache's own
-                # reference and stay resident for future hits).
-                for b in self._slot_blocks[slot]:
-                    self._alloc.release(b)
-                self._slot_blocks[slot] = None
-                self.metrics.gauge("kv_blocks_used").set(
-                    self._alloc.used_blocks
-                )
+            # Completion IS the block eviction: every reference this
+            # request held returns before the next chunk boundary's
+            # admissions.
+            self._release_slot(slot)
             now = time.perf_counter()
             latency = now - req.t_submit
             self.metrics.counter("completions_total").inc()
@@ -1301,9 +1431,19 @@ class TextServer:
         bucket prefill dispatches), then — if any slot is mid-generation —
         ONE compiled ``chunk``-token decode dispatch, then collect
         finished requests so their slots free for the next tick's
-        admissions. Returns True while there is work left."""
+        admissions. Returns True while there is work left.
+
+        Chunk boundaries are also where the lifecycle levers act (round
+        16): overdue requests are cancelled first (freeing their slots),
+        a pending weight swap applies once the last old-weight resident
+        has finished, and admission is skipped while draining or while a
+        swap is pending — so residents ALWAYS complete under the weights
+        they were admitted with (the parity contract is per-admission)."""
         self._last_tick = time.time()  # /healthz heartbeat: engine ticking
-        self._admit()
+        self._cancel_overdue()
+        self._maybe_apply_swap()
+        if not self._draining and self._pending_swap is None:
+            self._admit()
         occupied = sum(r is not None for r in self._slot_req)
         self.metrics.gauge("slots_busy").set(occupied)
         if occupied:
@@ -1348,33 +1488,159 @@ class TextServer:
     def idle(self) -> bool:
         return not self._queue and all(r is None for r in self._slot_req)
 
-    def _health(self) -> dict:
+    # -- drain + live weight swap (round 16, docs/serving.md §fleet) -------
+
+    def drain(self) -> None:
+        """Graceful stop: close admission (``submit()`` raises from now
+        on; queued-but-unadmitted requests stay queued for the caller to
+        re-route) and run the engine until every RESIDENT request has
+        finished. Idempotent — a second call returns immediately once
+        the slots are empty. This is the graceful half of both failover
+        (a replica told to retire finishes what it holds, loses nothing)
+        and weight swap."""
+        if not self._draining:
+            self._draining = True
+            self.journal.emit(
+                "serve_drain",
+                residents=sum(r is not None for r in self._slot_req),
+                queued=len(self._queue),
+            )
+        while any(r is not None for r in self._slot_req):
+            self.step()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def request_swap(self, params, *, step=None, source=None) -> None:
+        """Arm a live weight swap: ``params`` replaces the served tree at
+        the first chunk boundary with NO residents (admission pauses
+        until then, so every request completes under the weights it was
+        admitted with — the parity contract is per-admission). Nothing
+        recompiles: params are runtime arguments of every compiled
+        graph. ``decode_matmul_dtype`` re-quantizes the incoming tree,
+        keeping the weight-only discipline across swaps."""
+        if self.decode_matmul_dtype is not None:
+            params = self.model.decode_weights(
+                params, self.decode_matmul_dtype
+            )
+        self._pending_swap = (params, step, source)
+        self.journal.emit(
+            "weight_swap_requested",
+            step=None if step is None else int(step),
+            source=source,
+        )
+        self._maybe_apply_swap()  # an idle server swaps immediately
+
+    def swap_from_checkpoint(
+        self, checkpoint_dir: str | None = None, *, optimizer=None
+    ) -> int | None:
+        """Adopt the newest CRC-verified checkpoint under
+        ``checkpoint_dir`` (default: the directory this server restored
+        from) if it is NEWER than the served step — the serving end of
+        the train→publish→serve loop (a DiLoCo trainer keeps
+        checkpointing; replicas pick the steps up without dropping a
+        single resident). Returns the adopted step, or None when there
+        is nothing newer (no swap armed). Restores through
+        :func:`canonical_lm_params`, so any training layout publishes."""
+        d = checkpoint_dir or self.checkpoint_dir
+        if d is None:
+            raise ValueError(
+                "no checkpoint_dir: construct via from_checkpoint or pass "
+                "one explicitly"
+            )
+        opt = optimizer if optimizer is not None else self._restore_optimizer
+        params, step = canonical_lm_params(self.model, d, optimizer=opt)
+        if self.checkpoint_step is not None and step <= self.checkpoint_step:
+            return None
+        self.checkpoint_dir = d
+        self.request_swap(params, step=int(step), source=d)
+        return int(step)
+
+    def _maybe_apply_swap(self) -> None:
+        if self._pending_swap is None:
+            return
+        if any(r is not None for r in self._slot_req):
+            return  # old-weight residents still decoding: wait
+        params, step, source = self._pending_swap
+        self._pending_swap = None
+        old = self.checkpoint_step
+        self.params = params
+        if self._prefix is not None:
+            # The radix caches K/V computed under the OLD weights; a
+            # post-swap prefix hit would splice stale keys into a
+            # new-weights stream and silently break the parity contract.
+            # No residents exist here, so every cached block is
+            # cache-only (refcount 1) and evictable — flush them all.
+            self._prefix.evict(self._prefix.evictable_blocks())
+            self.metrics.gauge("kv_blocks_used").set(
+                self._alloc.used_blocks
+            )
+        if step is not None:
+            self.checkpoint_step = int(step)
+        self.metrics.counter("weight_swaps_total").inc()
+        self.journal.emit(
+            "weight_swap",
+            step=None if step is None else int(step),
+            from_step=old,
+            source=source,
+        )
+
+    def health(self) -> dict:
         """The /healthz payload: engine heartbeat age (seconds since the
         last ``step()`` tick — an idle-but-alive server reads old, a
-        wedged one reads ancient; the scraper applies the SLO) plus the
-        occupancy the admission controller sees."""
+        wedged one reads ancient; the scraper applies the SLO), the
+        occupancy the admission controller sees, and the round-16
+        routing signals (queue saturation, draining, swap state, served
+        checkpoint step)."""
         return {
             "heartbeat_age_s": round(time.time() - self._last_tick, 3),
             "slots_busy": sum(r is not None for r in self._slot_req),
             "slots": self.slots,
             "queue_depth": len(self._queue),
+            "queue_limit": self.queue_limit,
+            "queue_saturation": (
+                round(len(self._queue) / self.queue_limit, 3)
+                if self.queue_limit
+                else 0.0
+            ),
+            "draining": self._draining,
+            "swap_pending": self._pending_swap is not None,
+            "checkpoint_step": self.checkpoint_step,
             "kv_blocks_free": (
                 self._alloc.free_blocks if self._alloc is not None else None
             ),
         }
 
     def shutdown(self) -> None:
-        """Stop the live exporter (if armed). The engine itself holds no
-        threads — jit caches and device state die with the object."""
+        """Graceful stop: :meth:`drain` (admission closed, residents
+        finished — nothing in flight is dropped), then stop the live
+        exporter (if armed). The engine itself holds no threads — jit
+        caches and device state die with the object."""
+        self.drain()
         if self.exporter is not None:
             self.exporter.stop()
             self.exporter = None
 
+    def done(self, rid: int) -> bool:
+        """True once the request reached a terminal state (finished or
+        cancelled) — the poll half of the submit/step/result cycle a
+        replica worker loop drives."""
+        return self._results[rid].done or self._results[rid].cancelled
+
     def result(self, rid: int) -> np.ndarray:
         """Generated tokens of a finished request (prompt excluded).
         Consumes the record — a second read raises — so a long-lived
-        server does not accumulate every request it ever served."""
+        server does not accumulate every request it ever served. A
+        deadline-cancelled request raises :class:`RequestCancelled`
+        (record consumed too)."""
         req = self._results[rid]
+        if req.cancelled:
+            del self._results[rid]
+            raise RequestCancelled(
+                f"request {rid} was cancelled at a chunk boundary "
+                "(deadline exceeded)"
+            )
         if not req.done:
             raise RuntimeError(f"request {rid} is not finished")
         del self._results[rid]

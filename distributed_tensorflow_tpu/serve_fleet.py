@@ -1,0 +1,1236 @@
+"""Serving fleet: a health-checked replica router with zero-loss failover.
+
+The reference's availability story was training-side only (a restarted
+worker re-attached to live PS state, reference tfdist_between.py:83);
+rounds 6-14 rebuilt and surpassed it for training (durable checkpoints,
+elastic gang resize, DiLoCo through failures). Serving — the surface the
+north-star's "millions of users" actually touch — was still ONE Python
+loop: a dead TextServer lost every resident request. This module is the
+serving twin of that machinery, grounded in the paper's async-beats-sync
+thesis: replicas fail and recover INDEPENDENTLY while the fleet keeps
+serving, exactly as the reference's async PS workers did for training —
+serving replicas share no collectives, so nothing gang-restarts.
+
+Topology
+--------
+A :class:`ReplicaRouter` supervises N serving replicas. Each replica is a
+:class:`ReplicaHandle` bundling the round-7 elastic primitives
+(train/elastic.py — the reuse is deliberate, one supervision vocabulary
+for training and serving):
+
+- an ``ElasticAgent`` (spawn / poll the exit code / kill) over the
+  replica process — ``run_replica`` below, a TextServer restored from
+  ``checkpoint_dir`` driving submit/step/result against a filesystem
+  mailbox;
+- an ``HttpHealth`` probe over the replica's ``/healthz``
+  (observability/exporter.py): dead / stalled verdicts mirror the
+  heartbeat detector's, and the last good document carries the ROUTING
+  signals (``queue_saturation``, ``slots_busy``, ``draining``);
+- a :class:`MailboxClient`: requests in, results out, every file written
+  atomically (tmp + ``os.replace``). The mailbox OUTLIVES the process —
+  results a replica committed before dying are still collected, and
+  anything without a result re-admits elsewhere.
+
+Zero-loss failover
+------------------
+The router keeps the AUTHORITATIVE request table: every request carries
+its trace id and full generation config end-to-end, so when a replica
+dies (exit code, dead, or stalled verdict) its uncollected in-flight
+requests are re-admitted to a healthy replica and re-served FROM SCRATCH.
+Continuous batching makes chunk-boundary re-admission safe, and the
+round-9 parity contract (greedy and seeded-sampling streams are
+deterministic functions of prompt + config) makes the retried stream
+token-identical — the client observes a latency blip, never a changed or
+lost stream. Duplicate results (a slow replica finishing after its work
+was re-served) deduplicate on the trace id: first terminal result wins.
+A request the deadline cancelled is terminal — retries never resurrect
+it (``request_cancelled`` is the record).
+
+Failed replicas relaunch under a restart budget with jittered backoff
+(``resilience.backoff_delay`` — the gang's own formula; members restart
+independently, so there is no single retry() call to wrap). A replica
+over budget is BENCHED; when the non-benched roster would fall below
+``min_replicas`` the router fail-stops (:class:`FleetBelowFloor`, the
+serving analog of ``GangBelowFloor`` — unserved requests stay with the
+caller, nothing durable is lost).
+
+Routing is prefix-cache-aware: same-prefix sessions stick to the replica
+holding the warm radix (first ``affinity_tokens`` tokens key a sticky
+map), spilling to the least-loaded replica when the sticky target is
+saturated (``/healthz`` ``queue_saturation`` ≥ ``spill_threshold``) —
+backed by TextServer's bounded admission queue, which rejects loudly
+instead of growing without bound.
+
+Live weight swap
+----------------
+``ReplicaRouter.swap_weights()`` sends each replica a swap control; the
+replica adopts the newest CRC-verified checkpoint between chunk
+boundaries (``TextServer.swap_from_checkpoint``: admission pauses, the
+last old-weight resident finishes, the param tree is replaced — params
+are runtime args of every compiled graph, so NOTHING recompiles) —
+closing the DiLoCo train→publish→serve loop. Residents admitted before
+the swap complete under the old weights' parity contract; new admissions
+serve the new weights; no request is dropped.
+
+Out of scope (deliberately): sharded (tensor-parallel) serving and the
+HTTP/SSE streaming frontend — both gate on the partition-rule engine
+(ROADMAP item 2) and deserve their own PR.
+
+jax-free at import (the lean-import convention): the router runs on a
+driver host with no accelerator stack; only ``run_replica`` (the spawned
+worker) imports the engine. Proofs: tests/test_serve_fleet.py pins the
+router state machine on a fake replica table (the test_elastic.py
+pattern); tests/integration/test_serve_fleet_failover.py SIGKILLs a
+replica of a live ≥3-replica fleet mid-decode and asserts zero failed
+requests + token-identical streams (RUN_SLOW). docs/serving.md §fleet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+from collections import deque
+from typing import Sequence
+
+from distributed_tensorflow_tpu.observability import journal as obs_journal
+from distributed_tensorflow_tpu.observability import tracing
+from distributed_tensorflow_tpu.observability.metrics import MetricsRegistry
+from distributed_tensorflow_tpu.serve_pool import RequestCancelled
+from distributed_tensorflow_tpu.train import resilience
+from distributed_tensorflow_tpu.train.elastic import (
+    ElasticAgent,
+    HttpHealth,
+    WorkerFailure,
+)
+from distributed_tensorflow_tpu.utils.summary import lifecycle_event
+
+
+# GenerationConfig's field names, mirrored here so the jax-free router
+# can refuse a malformed config at submit time instead of shipping it to
+# a replica whose constructor would die on it (tests/test_serve_fleet.py
+# pins the mirror against the real dataclass).
+CONFIG_KEYS = ("max_new", "greedy", "temperature", "top_p", "seed", "eos_id")
+
+
+class FleetBelowFloor(WorkerFailure):
+    """Fewer than ``min_replicas`` non-benched replicas remain: the
+    router fail-stops (the serving analog of ``GangBelowFloor``) rather
+    than pretend a one-replica rump is the fleet the operator asked for."""
+
+
+# ---------------------------------------------------------------------------
+# Filesystem mailbox: the router<->replica transport.
+# ---------------------------------------------------------------------------
+
+
+# The one atomic-JSON primitive (checkpoint manifests, layout sidecars,
+# and this mailbox all share it): tmp + os.replace, so a reader never
+# sees a torn file and a writer killed mid-write leaves only a ``.tmp``
+# that readers skip.
+write_json_atomic = resilience.write_json_atomic
+
+
+def _read_dir(dirpath: str) -> list[dict]:
+    """Read-and-remove every committed JSON file in ``dirpath``, oldest
+    first (filenames carry a zero-padded sequence)."""
+    out = []
+    try:
+        names = sorted(os.listdir(dirpath))
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(".json"):
+            continue  # .tmp.* in flight
+        path = os.path.join(dirpath, name)
+        try:
+            with open(path, encoding="utf-8") as f:
+                out.append(json.load(f))
+            os.remove(path)
+        except (OSError, ValueError):  # pragma: no cover — racing writer
+            continue
+    return out
+
+
+class MailboxClient:
+    """One replica's mailbox: ``<root>/inbox`` (router → replica:
+    requests and control messages, one FIFO stream) and ``<root>/outbox``
+    (replica → router: results). Both sides write atomically; the
+    directories outlive the replica process — that persistence is the
+    storage half of the zero-loss contract (committed results survive a
+    crash; everything else visibly lacks a result and re-admits)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.inbox = os.path.join(root, "inbox")
+        self.outbox = os.path.join(root, "outbox")
+        os.makedirs(self.inbox, exist_ok=True)
+        os.makedirs(self.outbox, exist_ok=True)
+        self._seq = 0
+
+    def _next(self, dirpath: str, tag: str) -> str:
+        self._seq += 1
+        return os.path.join(dirpath, f"{self._seq:08d}-{tag}.json")
+
+    # -- router side -------------------------------------------------------
+
+    def submit(self, payload: dict) -> None:
+        write_json_atomic(
+            self._next(self.inbox, payload.get("trace", "req")), payload
+        )
+
+    def control(self, payload: dict) -> None:
+        """Control messages ride the same FIFO stream as requests, so a
+        swap lands AFTER everything routed before it."""
+        write_json_atomic(
+            self._next(self.inbox, f"ctl-{payload.get('control')}"), payload
+        )
+
+    def poll_results(self) -> list[dict]:
+        return _read_dir(self.outbox)
+
+    def clear_inbox(self) -> None:
+        """Drop undelivered requests (before relaunching a replica: the
+        router re-routes its in-flight itself; a fresh incarnation must
+        not re-serve work that already failed over elsewhere)."""
+        for name in os.listdir(self.inbox):
+            try:
+                os.remove(os.path.join(self.inbox, name))
+            except OSError:  # pragma: no cover
+                pass
+
+    # -- replica side ------------------------------------------------------
+
+    def take_inbox(self) -> list[dict]:
+        return _read_dir(self.inbox)
+
+    def put_result(self, payload: dict) -> None:
+        write_json_atomic(
+            self._next(self.outbox, payload.get("trace", "res")), payload
+        )
+
+
+# ---------------------------------------------------------------------------
+# The router.
+# ---------------------------------------------------------------------------
+
+
+class _FleetRequest:
+    __slots__ = (
+        "rid", "trace", "tokens", "config", "deadline", "deadline_s",
+        "t_submit", "replica", "attempts", "done", "cancelled", "failed",
+        "out", "t_done",
+    )
+
+    def __init__(self, rid, trace, tokens, config, deadline, deadline_s, now):
+        self.rid = rid
+        self.trace = trace
+        self.tokens = tokens
+        self.config = config
+        self.deadline = deadline  # absolute, router clock; None = none
+        self.deadline_s = deadline_s
+        self.t_submit = now
+        self.replica: str | None = None
+        self.attempts = 0  # times (re)routed
+        self.done = False
+        self.cancelled = False
+        self.failed: str | None = None  # terminal rejection (error text)
+        self.out: list[int] | None = None
+        self.t_done: float | None = None
+
+    @property
+    def terminal(self) -> bool:
+        return self.done or self.cancelled or self.failed is not None
+
+
+class ReplicaHandle:
+    """One replica under router supervision: the elastic agent (process
+    lifecycle), the mailbox client (transport), the /healthz probe
+    (verdicts + routing signals), and the router-side supervision state —
+    ``starting`` (spawned, health not yet confirmed), ``up``, ``backoff``
+    (dead, relaunch scheduled), ``benched`` (restart budget exhausted).
+    ``agent``/``health`` are optional so the fast-tier tests drive the
+    whole state machine with fakes (the test_elastic.py pattern)."""
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        client,
+        agent: ElasticAgent | None = None,
+        health: HttpHealth | None = None,
+    ):
+        self.name = name
+        self.client = client
+        self.agent = agent
+        self.health = health
+        self.state = "starting"
+        self.attempts = 0  # restarts charged
+        self.relaunch_at: float | None = None
+        self.backoff_s = 0.0
+        self.inflight: dict[str, _FleetRequest] = {}
+        self.cooldown_until = 0.0  # QueueFull backpressure hold-off
+        self._next_probe = 0.0
+
+    @property
+    def routable(self) -> bool:
+        if self.state != "up":
+            return False
+        doc = self.health.last if self.health is not None else None
+        return not (doc and doc.get("draining"))
+
+
+class ReplicaRouter:
+    """N serving replicas behind one submit/result surface (module
+    docstring for the full contract). Drive with :meth:`step` ticks (or
+    :meth:`run_until_done`); ``clock``/``sleep``/``rng`` are injectable
+    so the fast-tier tests run the state machine without wall time,
+    processes, or sockets."""
+
+    def __init__(
+        self,
+        replicas: Sequence[ReplicaHandle],
+        *,
+        min_replicas: int = 1,
+        max_restarts: int = 2,
+        backoff: float = 1.0,
+        max_backoff: float = 30.0,
+        jitter: float = 0.25,
+        affinity_tokens: int = 16,
+        affinity_cap: int = 4096,
+        spill_threshold: float = 0.75,
+        max_reroutes: int = 8,
+        probe_interval_s: float = 0.5,
+        poll_interval: float = 0.05,
+        journal=None,
+        metrics: MetricsRegistry | None = None,
+        print_fn=print,
+        clock=time.monotonic,
+        sleep=time.sleep,
+        rng=None,
+    ):
+        self.replicas = {h.name: h for h in replicas}
+        if len(self.replicas) != len(replicas):
+            raise ValueError("replica names must be unique")
+        self.min_replicas = int(min_replicas)
+        if not 1 <= self.min_replicas <= len(replicas):
+            raise ValueError(
+                f"min_replicas must be in [1, {len(replicas)}], got "
+                f"{min_replicas}"
+            )
+        self.max_restarts = int(max_restarts)
+        self.backoff = float(backoff)
+        self.max_backoff = float(max_backoff)
+        self.jitter = float(jitter)
+        self.affinity_tokens = int(affinity_tokens)
+        self.affinity_cap = int(affinity_cap)
+        self.spill_threshold = float(spill_threshold)
+        self.max_reroutes = int(max_reroutes)
+        self.probe_interval_s = float(probe_interval_s)
+        self.poll_interval = float(poll_interval)
+        self.journal = (
+            journal if journal is not None else obs_journal.get_journal()
+        )
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.print_fn = print_fn
+        self.clock = clock
+        self.sleep = sleep
+        self.rng = rng
+        self._queue: deque[_FleetRequest] = deque()
+        self._by_rid: dict[int, _FleetRequest] = {}
+        self._by_trace: dict[str, _FleetRequest] = {}
+        self._affinity: dict[tuple, str] = {}
+        self._next_rid = 0
+        self._started = False
+        self._draining = False
+        # The checkpoint directory the fleet currently serves when a
+        # swap ever pointed it AWAY from the replicas' spawn-time
+        # default; re-sent to every replica as it comes (back) up, so a
+        # relaunch cannot quietly revert to stale weights. Same-dir
+        # swaps need none of this: a restarting replica restores the
+        # newest CRC-verified step of its own directory anyway.
+        self.current_checkpoint_dir: str | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Spawn every replica (no-op for externally-managed handles)."""
+        if self._started:
+            return
+        self._started = True
+        for h in self.replicas.values():
+            if h.agent is not None:
+                h.agent.start()
+            if h.health is None:
+                h.state = "up"  # nothing to confirm: trust the spawn
+        self.metrics.gauge("replicas_total").set(len(self.replicas))
+
+    def submit(self, tokens, config=None, *, deadline_s=None) -> int:
+        """Queue one request fleet-wide. ``config`` is a GenerationConfig
+        dataclass or a plain dict of its fields (the router is jax-free
+        and never imports the engine); the FULL config travels with the
+        request so a failover re-serves the identical stream. Returns a
+        router-scope request id for :meth:`result`."""
+        if self._draining:
+            raise RuntimeError("router is draining: admission closed")
+        if dataclasses.is_dataclass(config) and not isinstance(config, type):
+            config = dataclasses.asdict(config)
+        config = dict(config or {})
+        unknown = sorted(set(config) - set(CONFIG_KEYS))
+        if unknown:
+            raise ValueError(
+                f"unknown generation config keys {unknown}; valid: "
+                f"{list(CONFIG_KEYS)}"
+            )
+        tokens = [int(t) for t in tokens]
+        if not tokens:
+            raise ValueError("empty prompt")
+        now = self.clock()
+        rid = self._next_rid
+        self._next_rid += 1
+        trace = tracing.new_trace_id()
+        req = _FleetRequest(
+            rid, trace, tokens, config,
+            None if deadline_s is None else now + float(deadline_s),
+            deadline_s, now,
+        )
+        self._queue.append(req)
+        self._by_rid[rid] = req
+        self._by_trace[trace] = req
+        self.metrics.counter("fleet_submitted_total").inc()
+        self.journal.emit(
+            "request_submit",
+            rid=rid,
+            trace=trace,
+            prompt_len=len(tokens),
+            max_new=int(config.get("max_new", 64)),
+            greedy=bool(config.get("greedy", True)),
+        )
+        return rid
+
+    def step(self) -> bool:
+        """One router tick: collect results (every mailbox, dead
+        replicas included — committed results survive their writer),
+        supervise (verdicts → failover + relaunch scheduling), relaunch
+        due members, cancel overdue queued requests, route. Returns True
+        while requests are outstanding."""
+        if not self._started:
+            self.start()
+        now = self.clock()
+        self._collect()
+        self._supervise(now)
+        self._relaunch_due(now)
+        self._cancel_overdue(now)
+        self._route(now)
+        return not self.done_all()
+
+    def wait_until_up(
+        self, n: int | None = None, *, timeout_s: float = 600.0
+    ) -> None:
+        """Block until ``n`` replicas (default: all) have confirmed a
+        good /healthz — the readiness gate between spawning a fleet and
+        pointing traffic at it (replica startup is a jax import + restore
+        + first compile; measuring it into TTFT would misstate serving)."""
+        want = len(self.replicas) if n is None else int(n)
+        deadline = self.clock() + timeout_s
+        while True:
+            self.step()
+            up = sum(h.state == "up" for h in self.replicas.values())
+            if up >= want:
+                return
+            if self.clock() > deadline:
+                raise TimeoutError(
+                    f"only {up}/{want} replicas up after {timeout_s}s "
+                    f"({ {h.name: h.state for h in self.replicas.values()} })"
+                )
+            self.sleep(self.poll_interval)
+
+    def done_all(self) -> bool:
+        return not self._queue and all(
+            r.terminal for r in self._by_rid.values()
+        )
+
+    def run_until_done(self, *, timeout_s: float | None = None) -> None:
+        deadline = None if timeout_s is None else self.clock() + timeout_s
+        while self.step():
+            if deadline is not None and self.clock() > deadline:
+                raise TimeoutError(
+                    f"fleet did not finish within {timeout_s}s "
+                    f"({self.stats()})"
+                )
+            self.sleep(self.poll_interval)
+
+    def done(self, rid: int) -> bool:
+        return self._by_rid[rid].terminal
+
+    def result(self, rid: int) -> list[int]:
+        """The served stream (router copy; consumes the record). Raises
+        the same typed :class:`~serve_pool.RequestCancelled` as
+        ``TextServer.result`` for a deadline-cancelled request, and a
+        RuntimeError naming the replica's error for a terminally
+        rejected one."""
+        req = self._by_rid[rid]
+        if req.cancelled:
+            del self._by_rid[rid]
+            self._by_trace.pop(req.trace, None)
+            raise RequestCancelled(
+                f"request {rid} was cancelled (deadline)"
+            )
+        if req.failed is not None:
+            del self._by_rid[rid]
+            self._by_trace.pop(req.trace, None)
+            raise RuntimeError(f"request {rid} was rejected: {req.failed}")
+        if not req.done:
+            raise RuntimeError(f"request {rid} is not finished")
+        del self._by_rid[rid]
+        self._by_trace.pop(req.trace, None)
+        return list(req.out)
+
+    def generate(self, prompts, configs=None, *, timeout_s=None):
+        """Submit a batch and serve it to completion (bench/test sugar)."""
+        if configs is None or isinstance(configs, dict) or (
+            dataclasses.is_dataclass(configs) and not isinstance(configs, type)
+        ):
+            configs = [configs] * len(prompts)
+        rids = [
+            self.submit(p, c) for p, c in zip(prompts, configs, strict=True)
+        ]
+        self.run_until_done(timeout_s=timeout_s)
+        return [self.result(r) for r in rids]
+
+    def swap_weights(self, checkpoint_dir: str | None = None) -> None:
+        """Tell every live replica to adopt the newest CRC-verified
+        checkpoint (optionally from a new directory) between chunk
+        boundaries — the publish step of train→publish→serve. Each
+        replica swaps independently; residents finish on old weights."""
+        if checkpoint_dir is not None:
+            self.current_checkpoint_dir = checkpoint_dir
+        targets = [
+            h for h in self.replicas.values() if h.state != "benched"
+        ]
+        for h in targets:
+            payload: dict = {"control": "swap"}
+            if checkpoint_dir is not None:
+                payload["checkpoint_dir"] = checkpoint_dir
+            h.client.control(payload)
+        self.journal.emit(
+            "weight_swap_requested",
+            source=checkpoint_dir,
+            replicas=[h.name for h in targets],
+        )
+
+    def drain(self, *, timeout_s: float | None = None) -> None:
+        """Close router admission and serve everything outstanding."""
+        self._draining = True
+        self.run_until_done(timeout_s=timeout_s)
+
+    def shutdown(self) -> None:
+        """Stop the fleet: ask every replica to exit its loop (graceful —
+        the worker drains residents first), then reap/kill."""
+        for h in self.replicas.values():
+            try:
+                h.client.control({"control": "stop"})
+            except OSError:  # pragma: no cover — mailbox dir removed
+                pass
+        deadline = self.clock() + 30.0
+        for h in self.replicas.values():
+            if h.agent is None:
+                continue
+            while h.agent.poll() is None and self.clock() < deadline:
+                self.sleep(self.poll_interval)
+            h.agent.kill()
+        self.journal.flush()
+
+    def stats(self) -> dict:
+        reqs = list(self._by_rid.values())
+        return {
+            "submitted": self._next_rid,
+            "done": sum(r.done for r in reqs),
+            "cancelled": sum(r.cancelled for r in reqs),
+            "failed": sum(r.failed is not None for r in reqs),
+            "queued": len(self._queue),
+            "inflight": sum(
+                len(h.inflight) for h in self.replicas.values()
+            ),
+            "failovers": int(
+                self.metrics.counter("failovers_total").value
+            ),
+            "reroutes": int(self.metrics.counter("reroutes_total").value),
+            "replicas": {
+                h.name: h.state for h in self.replicas.values()
+            },
+        }
+
+    # -- the state machine -------------------------------------------------
+
+    def _collect(self) -> None:
+        for h in self.replicas.values():
+            for payload in h.client.poll_results():
+                trace = payload.get("trace")
+                # Pop BEFORE the dedupe check: a duplicate result (the
+                # request already completed elsewhere) must still clear
+                # this replica's inflight entry, or phantom load
+                # accumulates and the replica reads saturated forever.
+                h.inflight.pop(trace, None)
+                req = self._by_trace.get(trace)
+                if req is None or req.terminal:
+                    continue  # dedupe: first terminal result won
+                if payload.get("rejected"):
+                    # A stale bounce (the request already failed over to
+                    # another replica) must not re-queue a request that
+                    # is live elsewhere — only the current owner's
+                    # rejection counts. Stale COMPLETED results below
+                    # are different: a committed stream is valid
+                    # whoever serves the request now (first wins).
+                    if req.replica == h.name:
+                        self._rejected(h, req, payload)
+                elif payload.get("cancelled"):
+                    req.cancelled = True
+                    req.t_done = self.clock()
+                    self.metrics.counter("fleet_cancelled_total").inc()
+                    self.journal.emit(
+                        "fleet_result",
+                        trace=trace,
+                        rid=req.rid,
+                        replica=h.name,
+                        status="cancelled",
+                    )
+                else:
+                    req.out = [int(t) for t in payload.get("tokens", [])]
+                    req.done = True
+                    req.t_done = self.clock()
+                    self.metrics.counter("fleet_completions_total").inc()
+                    self.journal.emit(
+                        "fleet_result",
+                        trace=trace,
+                        rid=req.rid,
+                        replica=h.name,
+                        status="done",
+                        tokens=len(req.out),
+                        latency_s=round(req.t_done - req.t_submit, 6),
+                        reroutes=max(req.attempts - 1, 0),
+                    )
+
+    def _rejected(self, h: ReplicaHandle, req, payload: dict) -> None:
+        """A replica bounced the request. QueueFull is pure BACKPRESSURE:
+        re-queue, cool the replica for a probe interval (the health doc
+        the router routed on was stale), and charge NO budget — a
+        saturated-but-healthy fleet holds requests, it never fails them.
+        PERMANENT rejections (the replica's validation — geometry no
+        replica will ever accept) and unknown rejection kinds past the
+        re-route budget fail TERMINALLY: retrying a deterministic
+        refusal forever would spin the router and never finish
+        ``drain()``."""
+        kind = payload.get("error_kind")
+        if kind == "QueueFull":
+            h.cooldown_until = self.clock() + self.probe_interval_s
+            self.metrics.counter("reroutes_total").inc()
+            self.journal.emit(
+                "request_reroute",
+                trace=req.trace,
+                rid=req.rid,
+                from_replica=h.name,
+                attempt=req.attempts,
+                reason="backpressure",
+            )
+            req.replica = None
+            self._queue.appendleft(req)
+            return
+        permanent = kind in ("ValueError", "TypeError")
+        if permanent or req.attempts > self.max_reroutes:
+            req.failed = payload.get("error") or (
+                f"routed {req.attempts} times (budget {self.max_reroutes})"
+            )
+            req.t_done = self.clock()
+            self.metrics.counter("fleet_failed_total").inc()
+            self.journal.emit(
+                "fleet_result",
+                trace=req.trace,
+                rid=req.rid,
+                replica=h.name,
+                status="rejected",
+                error=req.failed,
+            )
+            return
+        self.metrics.counter("reroutes_total").inc()
+        self.journal.emit(
+            "request_reroute",
+            trace=req.trace,
+            rid=req.rid,
+            from_replica=h.name,
+            attempt=req.attempts,
+            reason="rejected",
+        )
+        req.replica = None
+        self._queue.appendleft(req)  # older than anything queued behind it
+
+    def _supervise(self, now: float) -> None:
+        for h in self.replicas.values():
+            if h.state not in ("starting", "up"):
+                continue
+            verdict = None
+            rc = h.agent.poll() if h.agent is not None else None
+            if rc is not None:
+                # A serving replica has no legitimate self-exit while
+                # supervised — rc 0 (a stop it was never sent) is as dead
+                # as a SIGKILL.
+                verdict = f"rc={rc}"
+            elif h.health is not None and now >= h._next_probe:
+                h._next_probe = now + self.probe_interval_s
+                v = h.health.classify()
+                if v != "ok":
+                    verdict = v
+                elif h.state == "starting" and h.health.last is not None:
+                    h.state = "up"  # first good /healthz: routable
+                    if self.current_checkpoint_dir is not None:
+                        # Swap durability across relaunches: a fresh
+                        # incarnation restored from its spawn-time
+                        # directory and cleared its inbox — re-send the
+                        # fleet's current serve dir (a replica already
+                        # on it no-ops: swap_from_checkpoint adopts
+                        # only NEWER steps).
+                        h.client.control(
+                            {
+                                "control": "swap",
+                                "checkpoint_dir":
+                                    self.current_checkpoint_dir,
+                            }
+                        )
+            if verdict is not None:
+                self._fail(h, verdict)
+        self.metrics.gauge("replicas_up").set(
+            sum(h.state == "up" for h in self.replicas.values())
+        )
+
+    def _fail(self, h: ReplicaHandle, verdict: str) -> None:
+        if h.agent is not None:
+            h.agent.kill()  # stalled/health-dead: make the death real
+        rerouted = [r for r in h.inflight.values() if not r.terminal]
+        for req in reversed(rerouted):
+            # Zero-loss re-admission: full config + the SAME trace id go
+            # back to the queue front (original relative order kept), so
+            # the retried stream is token-identical and the journal shows
+            # one request across replicas. attempts counts ROUTES only
+            # (incremented in _route) — one number, one meaning.
+            req.replica = None
+            self.metrics.counter("reroutes_total").inc()
+            self.journal.emit(
+                "request_reroute",
+                trace=req.trace,
+                rid=req.rid,
+                from_replica=h.name,
+                attempt=req.attempts,
+                reason="replica_dead",
+            )
+            self._queue.appendleft(req)
+        h.inflight.clear()
+        h.attempts += 1
+        self.metrics.counter("failovers_total").inc()
+        lifecycle_event(
+            "replica_dead",
+            print_fn=self.print_fn,
+            journal=self.journal,
+            replica=h.name,
+            verdict=verdict,
+            rerouted=len(rerouted),
+            attempt=h.attempts,
+            max_restarts=self.max_restarts,
+        )
+        if h.attempts > self.max_restarts or h.agent is None:
+            h.state = "benched"
+            lifecycle_event(
+                "replica_benched",
+                print_fn=self.print_fn,
+                journal=self.journal,
+                replica=h.name,
+                restarts=h.attempts,
+                max_restarts=self.max_restarts,
+            )
+            active = [
+                x for x in self.replicas.values() if x.state != "benched"
+            ]
+            if len(active) < self.min_replicas:
+                lifecycle_event(
+                    "fleet_below_floor",
+                    print_fn=self.print_fn,
+                    journal=self.journal,
+                    replicas=len(active),
+                    min_replicas=self.min_replicas,
+                    cause=f"{h.name}={verdict}",
+                )
+                raise FleetBelowFloor({h.name: verdict})
+        else:
+            h.backoff_s = resilience.backoff_delay(
+                h.attempts - 1,
+                backoff=self.backoff,
+                max_backoff=self.max_backoff,
+                jitter=self.jitter,
+                rng=self.rng,
+            )
+            h.state = "backoff"
+            h.relaunch_at = self.clock() + h.backoff_s
+
+    def _relaunch_due(self, now: float) -> None:
+        for h in self.replicas.values():
+            if h.state != "backoff" or now < (h.relaunch_at or 0.0):
+                continue
+            clear = getattr(h.client, "clear_inbox", None)
+            if clear is not None:
+                clear()  # stale routed work already failed over
+            if h.health is not None:
+                h.health.reset()  # fresh grace clock for the new process
+            h.agent.start()
+            h.state = "starting" if h.health is not None else "up"
+            h.relaunch_at = None
+            self.metrics.counter("relaunches_total").inc()
+            lifecycle_event(
+                "replica_relaunch",
+                print_fn=self.print_fn,
+                journal=self.journal,
+                replica=h.name,
+                attempt=h.attempts,
+                max_restarts=self.max_restarts,
+                backoff_s=h.backoff_s,
+            )
+
+    def _cancel_overdue(self, now: float) -> None:
+        """Router-side deadline enforcement for QUEUED requests (resident
+        ones are cancelled replica-side and report back as cancelled).
+        A cancelled request is terminal: failover never resurrects it."""
+        if not any(
+            r.deadline is not None and now > r.deadline for r in self._queue
+        ):
+            return
+        keep: deque[_FleetRequest] = deque()
+        for req in self._queue:
+            if req.deadline is not None and now > req.deadline:
+                req.cancelled = True
+                req.t_done = now
+                self.metrics.counter("fleet_cancelled_total").inc()
+                self.journal.emit(
+                    "request_cancelled",
+                    rid=req.rid,
+                    trace=req.trace,
+                    resident=False,
+                    tokens=0,
+                    age_s=round(now - req.t_submit, 6),
+                )
+            else:
+                keep.append(req)
+        self._queue = keep
+
+    def _saturated(self, h: ReplicaHandle) -> bool:
+        if self.clock() < h.cooldown_until:
+            return True  # it just bounced a request: let it drain a beat
+        doc = h.health.last if h.health is not None else None
+        if not doc:
+            return False
+        sat = doc.get("queue_saturation")
+        if isinstance(sat, (int, float)) and sat >= self.spill_threshold:
+            return True
+        lim = doc.get("queue_limit")
+        if lim:
+            # Router-side view: everything we routed and have not seen a
+            # result for occupies a slot or a queue position there.
+            return len(h.inflight) >= int(doc.get("slots", 0)) + int(lim)
+        return False
+
+    def _affinity_key(self, req: _FleetRequest):
+        if self.affinity_tokens <= 0:
+            return None
+        return tuple(req.tokens[: self.affinity_tokens])
+
+    def _pick(self, req: _FleetRequest) -> ReplicaHandle | None:
+        routable = [h for h in self.replicas.values() if h.routable]
+        if not routable:
+            return None
+        key = self._affinity_key(req)
+        if key is not None:
+            sticky = self.replicas.get(self._affinity.get(key, ""), None)
+            if (
+                sticky is not None
+                and sticky.routable
+                and not self._saturated(sticky)
+            ):
+                self._affinity.pop(key, None)  # LRU refresh on hit
+                self._affinity[key] = sticky.name
+                return sticky
+        open_ = [h for h in routable if not self._saturated(h)]
+        if not open_:
+            return None  # whole fleet saturated: hold at the router
+        pick = min(open_, key=lambda h: len(h.inflight))
+        if key is not None:
+            # (Re)stick the prefix to the replica now warming its radix —
+            # a dead sticky target is reassigned here, not mourned. The
+            # map is LRU-bounded: unique-prompt traffic must not grow a
+            # long-lived router's memory without limit.
+            self._affinity.pop(key, None)
+            self._affinity[key] = pick.name  # newest at the end
+            while len(self._affinity) > self.affinity_cap:
+                self._affinity.pop(next(iter(self._affinity)))
+        return pick
+
+    def _route(self, now: float) -> None:
+        while self._queue:
+            req = self._queue[0]
+            if req.terminal:
+                # Became terminal while queued (a dead replica's
+                # committed result arrived after the failover re-queue):
+                # routing it again would re-serve a DONE request.
+                self._queue.popleft()
+                continue
+            h = self._pick(req)
+            if h is None:
+                return
+            self._queue.popleft()
+            req.replica = h.name
+            req.attempts += 1
+            h.inflight[req.trace] = req
+            payload = {
+                "trace": req.trace,
+                "tokens": req.tokens,
+                "config": req.config,
+            }
+            if req.deadline is not None:
+                payload["deadline_s"] = max(req.deadline - now, 0.0)
+            h.client.submit(payload)
+            self.metrics.counter("routed_total").inc()
+            self.journal.emit(
+                "request_route",
+                trace=req.trace,
+                rid=req.rid,
+                replica=h.name,
+                attempt=req.attempts,
+                queue_wait_s=round(now - req.t_submit, 6),
+            )
+
+
+# ---------------------------------------------------------------------------
+# Local subprocess fleet (the launch_local analog for serving).
+# ---------------------------------------------------------------------------
+
+
+def port_file(replica_dir: str) -> str:
+    """Where a replica publishes its ephemeral /healthz port."""
+    return os.path.join(replica_dir, "port.json")
+
+
+def replica_url(replica_dir: str) -> str | None:
+    """The replica's /healthz URL, or None until the port is published."""
+    try:
+        with open(port_file(replica_dir), encoding="utf-8") as f:
+            port = json.load(f)["port"]
+    except (OSError, ValueError, KeyError):
+        return None
+    return f"http://127.0.0.1:{port}/healthz"
+
+
+def local_fleet(
+    model_kw: dict,
+    checkpoint_dir: str,
+    fleet_dir: str,
+    *,
+    replicas: int = 3,
+    slots: int = 4,
+    chunk: int = 8,
+    queue_limit: int = 32,
+    buckets: tuple[int, ...] | None = None,
+    poll_s: float = 0.005,
+    warm: bool = True,
+    env: dict | None = None,
+    grace_s: float = 300.0,
+    dead_after_s: float = 10.0,
+    print_fn=print,
+    **router_kw,
+) -> ReplicaRouter:
+    """Build a router over N subprocess replicas on this host, each a
+    ``run_replica`` worker (TextServer restored from ``checkpoint_dir``).
+    ``model_kw`` are GPTLM constructor kwargs (JSON-serialized onto the
+    worker's argv; ``compute_dtype`` as a dtype NAME string). Per-replica
+    journals land at ``<fleet_dir>/events-<name>.jsonl`` (via
+    ``DTF_EVENTS_PATH``) beside the router's ``events.jsonl`` — the files
+    ``obs_report --fleet`` merges into one cross-replica timeline. The
+    startup grace is generous by default: a cold jax import + restore on
+    a loaded host must not read as death (CLAUDE.md's integration-test
+    lesson)."""
+    from distributed_tensorflow_tpu.observability.journal import EventJournal
+
+    os.makedirs(fleet_dir, exist_ok=True)
+    run_id = f"fleet-{os.getpid()}"
+    journal = EventJournal.in_dir(fleet_dir, run_id=run_id)
+    handles = []
+    for i in range(replicas):
+        name = f"replica{i}"
+        rdir = os.path.join(fleet_dir, name)
+        os.makedirs(rdir, exist_ok=True)
+        renv = dict(os.environ)
+        renv.update(env or {})
+        renv["DTF_EVENTS_PATH"] = os.path.join(
+            fleet_dir, f"events-{name}.jsonl"
+        )
+        renv["DTF_RUN_ID"] = run_id
+        cmd = [
+            sys.executable, "-m", "distributed_tensorflow_tpu.serve_fleet",
+            "--replica", "--dir", rdir,
+            "--checkpoint-dir", checkpoint_dir,
+            "--model", json.dumps(model_kw),
+            "--slots", str(slots), "--chunk", str(chunk),
+            "--queue-limit", str(queue_limit), "--poll-s", str(poll_s),
+        ]
+        if buckets:
+            cmd += ["--buckets", ",".join(str(b) for b in buckets)]
+        if warm:
+            cmd += ["--warm"]
+
+        def _spawn(cmd=cmd, renv=renv, rdir=rdir, name=name):
+            try:  # a relaunch must not probe the dead incarnation's port
+                os.remove(port_file(rdir))
+            except OSError:
+                pass
+            log = open(os.path.join(fleet_dir, f"{name}.log"), "ab")
+            try:
+                return subprocess.Popen(
+                    cmd, stdout=log, stderr=subprocess.STDOUT, env=renv
+                )
+            finally:
+                log.close()
+
+        handles.append(
+            ReplicaHandle(
+                name,
+                client=MailboxClient(rdir),
+                agent=ElasticAgent(name, _spawn),
+                health=HttpHealth(
+                    (lambda rdir=rdir: replica_url(rdir)),
+                    grace_s=grace_s,
+                    dead_after_s=dead_after_s,
+                ),
+            )
+        )
+    return ReplicaRouter(
+        handles, journal=journal, print_fn=print_fn, **router_kw
+    )
+
+
+def publish_checkpoint(model, params, checkpoint_dir: str, step: int = 1):
+    """Publish ``params`` as a dense, CRC-manifested ``step_N`` checkpoint
+    that ``canonical_lm_params`` (and therefore every fleet replica)
+    restores — the publish edge of train→publish→serve for callers that
+    are not an LMTrainer: benches, tests, external trainers. Uses the
+    reference-SGD optimizer whose slot state is empty, matching the
+    serving restore default."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.ops import optim as optim_lib
+    from distributed_tensorflow_tpu.parallel.strategy import TrainState
+    from distributed_tensorflow_tpu.train.supervisor import Supervisor
+
+    opt = optim_lib.sgd(0.001)
+    Supervisor(checkpoint_dir=checkpoint_dir).save(
+        TrainState(params, opt.init(params), jnp.asarray(step, jnp.int32)),
+        int(step),
+    )
+
+
+# ---------------------------------------------------------------------------
+# The replica worker (the only half that imports the engine / jax).
+# ---------------------------------------------------------------------------
+
+_DTYPES = {
+    "float32": "float32",
+    "bfloat16": "bfloat16",
+    "float16": "float16",
+}
+
+
+def _model_from_kw(model_kw: dict):
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models.gpt import GPTLM
+
+    kw = dict(model_kw)
+    cd = kw.get("compute_dtype")
+    if isinstance(cd, str):
+        if cd not in _DTYPES:
+            raise ValueError(f"unknown compute_dtype {cd!r}")
+        kw["compute_dtype"] = jnp.dtype(_DTYPES[cd])
+    return GPTLM(**kw)
+
+
+def run_replica(args) -> int:
+    """One serving replica: TextServer from ``checkpoint_dir``, driven
+    against the mailbox — admit at chunk boundaries, one ``step()`` per
+    loop turn, results committed atomically the tick they finish (the
+    zero-loss contract's write-before-crash half). SIGTERM is graceful:
+    the loop exits, residents drain, results flush, rc 0 — the same
+    preemption stance as the trainers (train/resilience.py)."""
+    import signal
+
+    from distributed_tensorflow_tpu.observability import (
+        journal as obs_journal_mod,
+    )
+    from distributed_tensorflow_tpu.observability.exporter import (
+        MetricsExporter,
+    )
+    from distributed_tensorflow_tpu.serve import (
+        GenerationConfig,
+        QueueFull,
+        RequestCancelled,
+        TextServer,
+    )
+
+    obs_journal_mod.configure_from_env(announce=True)
+    model = _model_from_kw(json.loads(args.model))
+    buckets = (
+        tuple(int(b) for b in args.buckets.split(","))
+        if args.buckets
+        else None
+    )
+    srv = TextServer.from_checkpoint(
+        model,
+        args.checkpoint_dir,
+        slots=args.slots,
+        chunk=args.chunk,
+        buckets=buckets,
+        queue_limit=args.queue_limit or None,
+    )
+    box = MailboxClient(args.dir)
+    # A fresh incarnation serves only newly routed work: anything in the
+    # inbox predates this process and already failed over elsewhere.
+    box.clear_inbox()
+    if args.warm:
+        # Pre-warm every compiled surface (one prefill per bucket + the
+        # chunk executable) BEFORE publishing the health port: a replica
+        # that reads "up" is ready to serve at serving speed, and first-
+        # request TTFT is not a compile measurement.
+        import numpy as _np
+
+        for b in srv.buckets:
+            if b + 2 > model.max_len:
+                continue
+            srv.generate(
+                [_np.arange(1, b + 1, dtype=_np.int32)],
+                GenerationConfig(max_new=2),
+            )
+    exporter = MetricsExporter(srv.metrics, port=args.port, health_fn=srv.health)
+    write_json_atomic(port_file(args.dir), {"port": exporter.start()})
+
+    stop: list[int] = []
+    prev = signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+
+    def _flush_done(rids: dict) -> None:
+        for rid in list(rids):
+            if srv.done(rid):
+                trace = rids.pop(rid)
+                try:
+                    toks = srv.result(rid)
+                    box.put_result(
+                        {"trace": trace, "tokens": [int(t) for t in toks]}
+                    )
+                except RequestCancelled:
+                    box.put_result({"trace": trace, "cancelled": True})
+
+    rids: dict[int, str] = {}
+    try:
+        while not stop:
+            for payload in box.take_inbox():
+                ctl = payload.get("control")
+                if ctl == "stop":
+                    stop.append(1)
+                elif ctl == "swap":
+                    # A bad publish (typo'd dir, all-corrupt steps) must
+                    # cost the SWAP, never the replica: journal the
+                    # failure and keep serving the current weights — the
+                    # same stance the submit guard below takes for
+                    # poison requests.
+                    try:
+                        srv.swap_from_checkpoint(
+                            payload.get("checkpoint_dir")
+                        )
+                    except Exception as exc:  # noqa: BLE001
+                        obs_journal_mod.get_journal().emit(
+                            "weight_swap_failed",
+                            source=payload.get("checkpoint_dir"),
+                            error=f"{type(exc).__name__}: {exc}",
+                        )
+                elif ctl is not None:
+                    continue  # unknown control: ignore, stay alive
+                else:
+                    # TypeError covers a malformed config dict (unknown
+                    # GenerationConfig keys): reject it back to the
+                    # router — a poison request must cost ITSELF, never
+                    # the replica process (the router fails it terminally
+                    # on the error_kind, so it cannot cascade either).
+                    try:
+                        rid = srv.submit(
+                            payload["tokens"],
+                            GenerationConfig(**(payload.get("config") or {})),
+                            deadline_s=payload.get("deadline_s"),
+                            trace=payload.get("trace"),
+                        )
+                    except (
+                        QueueFull, ValueError, TypeError, RuntimeError,
+                    ) as exc:
+                        box.put_result(
+                            {
+                                "trace": payload.get("trace"),
+                                "rejected": True,
+                                "error_kind": type(exc).__name__,
+                                "error": f"{type(exc).__name__}: {exc}",
+                            }
+                        )
+                    else:
+                        rids[rid] = payload["trace"]
+            srv.step()
+            _flush_done(rids)
+            if srv.idle():
+                time.sleep(args.poll_s)
+        srv.drain()  # graceful: residents finish, nothing dropped
+        _flush_done(rids)
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+        exporter.stop()
+        obs_journal_mod.get_journal().flush()
+    return 0
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--replica", action="store_true",
+        help="run as a replica worker (spawned by local_fleet)",
+    )
+    ap.add_argument("--dir", help="replica mailbox directory")
+    ap.add_argument("--checkpoint-dir")
+    ap.add_argument("--model", help="GPTLM constructor kwargs as JSON")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--chunk", type=int, default=8)
+    ap.add_argument("--queue-limit", type=int, default=32)
+    ap.add_argument("--buckets", default=None, help="comma-separated")
+    ap.add_argument(
+        "--port", type=int, default=0,
+        help="/healthz port (0 = ephemeral, published to <dir>/port.json)",
+    )
+    ap.add_argument("--poll-s", type=float, default=0.005)
+    ap.add_argument(
+        "--warm", action="store_true",
+        help="compile every prefill bucket + the chunk executable before "
+        "publishing the health port (readiness == serving-ready)",
+    )
+    args = ap.parse_args(argv)
+    if not args.replica:
+        ap.error("only --replica mode has a CLI; drive routers in-process "
+                 "(serve_fleet.local_fleet)")
+    for req in ("dir", "checkpoint_dir", "model"):
+        if getattr(args, req) in (None, ""):
+            ap.error(f"--replica requires --{req.replace('_', '-')}")
+    return run_replica(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

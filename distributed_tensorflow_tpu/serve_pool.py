@@ -40,6 +40,23 @@ from collections import deque
 import numpy as np
 
 
+class QueueFull(RuntimeError):
+    """``submit()`` refused: the admission queue is at ``queue_limit``.
+    The loud alternative to unbounded growth — a caller (or the fleet
+    router, serve_fleet.py) is expected to retry later or route the
+    request to a less-saturated replica (``/healthz`` surfaces
+    ``queue_saturation`` exactly for that decision). Lives here (not in
+    serve.py) so the jax-free router shares ONE exception surface with
+    the engine; serve.py re-exports it."""
+
+
+class RequestCancelled(RuntimeError):
+    """``result()`` for a request cancelled at a chunk boundary (deadline
+    expiry): the slot/blocks were freed and no tokens are returned.
+    Raised by TextServer.result AND ReplicaRouter.result — one typed
+    contract for both surfaces (re-exported from serve.py)."""
+
+
 def blocks_for(tokens: int, block_size: int) -> int:
     """Blocks needed to hold ``tokens`` positions."""
     if tokens < 0:

@@ -12,6 +12,7 @@ reconstructs here WITHOUT grep'ing stdout::
     python -m distributed_tensorflow_tpu.tools.obs_report run/ --trace t.json
     python -m distributed_tensorflow_tpu.tools.obs_report run/ --requests
     python -m distributed_tensorflow_tpu.tools.obs_report gang_logdir/ --gang
+    python -m distributed_tensorflow_tpu.tools.obs_report fleet_dir/ --fleet
 
 ``--trace`` exports the journal's ``span`` events in the chrome trace
 event format (load in Perfetto / chrome://tracing). ``--json`` prints the
@@ -21,7 +22,11 @@ queue wait, prefill, decode chunks, TTFT, latency, all from the journal
 alone. ``--gang`` treats the path as a GANG logdir: every rank's journal
 is merged into one skew-aligned fleet timeline
 (observability/aggregate.py); with ``--trace`` the export has one track
-per rank, restarts/resizes visible on all of them.
+per rank, restarts/resizes visible on all of them. ``--fleet`` (round
+16) is the serving twin: the router's journal + every replica's merge,
+and per-request timelines join on TRACE ids — submit on the router,
+admission on replica A, completion on replica B after a failover, one
+id throughout (serve_fleet.py; docs/serving.md §fleet).
 
 jax-free (lean-import convention): runs anywhere the journal was written,
 including degraded containers and machines with no accelerator stack.
@@ -498,6 +503,158 @@ def render_requests(records: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def reconstruct_fleet_requests(merged: dict) -> list[dict]:
+    """Fleet-wide per-request timelines (round 16): the router journal
+    and every replica journal merged (observability/aggregate.py), then
+    joined on the TRACE id — the one identity a request keeps across
+    replicas. A failover shows as one trace submitted on the router,
+    admitted on replica A, re-routed, and completed on replica B::
+
+        {rid, trace, prompt_len, replicas: [admission hosts in order],
+         completed_on, failovers, reroutes, tokens, ttft_s, latency_s,
+         done, cancelled}
+
+    ``latency_s``/``ttft_s`` are FLEET quantities on the merged (skew-
+    adjusted) clock: router submit → the serving replica's completion /
+    first token — queue wait, routing, any failover latency included.
+    Requests with no terminal event render as in flight (a fleet that
+    lost one would show it here — the zero-loss proof's observable)."""
+    driver = "driver" if "driver" in merged["ranks"] else (
+        merged["ranks"][0] if merged["ranks"] else None
+    )
+    recs: dict = {}
+    order: list = []
+
+    def rec(trace) -> dict:
+        if trace not in recs:
+            order.append(trace)
+            recs[trace] = {
+                "rid": None,
+                "trace": trace,
+                "prompt_len": None,
+                "replicas": [],
+                "completed_on": None,
+                "failovers": 0,
+                "reroutes": 0,
+                "tokens": None,
+                "ttft_s": None,
+                "latency_s": None,
+                "submit_ts": None,
+                "done": False,
+                "cancelled": False,
+                "rejected": False,
+            }
+        return recs[trace]
+
+    for ev in merged["events"]:
+        trace = ev.get("trace")
+        if not trace:
+            continue
+        kind = ev.get("kind")
+        src = ev.get("_src")
+        if kind == "request_submit":
+            r = rec(trace)
+            if src == driver or r["submit_ts"] is None:
+                r["submit_ts"] = ev.get("ts")
+                r["prompt_len"] = ev.get("prompt_len", r["prompt_len"])
+            if src == driver:
+                r["rid"] = ev.get("rid")
+        elif kind == "request_reroute" and src == driver:
+            r = rec(trace)
+            r["reroutes"] += 1
+            if ev.get("reason") == "replica_dead":
+                r["failovers"] += 1
+        elif kind == "admission" and src != driver:
+            rec(trace)["replicas"].append(src)
+        elif kind == "completion" and src != driver:
+            r = rec(trace)
+            r["completed_on"] = src
+            r["tokens"] = ev.get("tokens")
+            r["done"] = True
+            ts, lat, ttft = ev.get("ts"), ev.get("latency_s"), ev.get("ttft_s")
+            if r["submit_ts"] is not None and isinstance(ts, (int, float)):
+                r["latency_s"] = round(ts - r["submit_ts"], 6)
+                if isinstance(lat, (int, float)) and isinstance(
+                    ttft, (int, float)
+                ):
+                    # The replica's first-token instant on the wall clock
+                    # (completion ts − replica latency + replica TTFT),
+                    # re-anchored to the ROUTER's submit.
+                    r["ttft_s"] = round(
+                        (ts - lat + ttft) - r["submit_ts"], 6
+                    )
+        elif kind == "request_cancelled":
+            rec(trace)["cancelled"] = True
+        elif kind == "fleet_result" and ev.get("status") == "rejected":
+            # A terminal router-side rejection (replica validation or
+            # re-route budget) is a deliberate, journaled outcome — it
+            # must not render as a LOST request.
+            rec(trace)["rejected"] = True
+    out = [recs[t] for t in order]
+    out.sort(key=lambda r: (r["rid"] is None, r["rid"], r["trace"]))
+    for r in out:
+        del r["submit_ts"]
+    return out
+
+
+def render_fleet_requests(records: list[dict]) -> str:
+    lines = [
+        "rid  trace             path                    failover  ttft(s)"
+        "  latency(s)  tokens  status",
+    ]
+    fmt = lambda v, spec: ("-" if v is None else format(v, spec))  # noqa: E731
+    for r in records:
+        path = "->".join(r["replicas"]) or "-"
+        if r["cancelled"]:
+            status = "cancelled"
+        elif r["done"]:
+            status = "done"
+        elif r.get("rejected"):
+            status = "rejected"
+        else:
+            status = "IN FLIGHT"
+        lines.append(
+            f"{fmt(r['rid'], 'd'):<4} {str(r['trace'] or '-'):<17} "
+            f"{path:<23} {r['failovers']:>8}  {fmt(r['ttft_s'], '.4f'):>7}"
+            f"  {fmt(r['latency_s'], '.4f'):>10}  {fmt(r['tokens'], 'd'):>6}"
+            f"  {status}"
+        )
+    # rid None = replica-LOCAL traffic (warmup requests a replica served
+    # before joining the fleet): rendered above for completeness, but the
+    # fleet summary must not fold multi-second compile warmups into the
+    # percentiles the readiness gate exists to exclude.
+    fleet = [r for r in records if r["rid"] is not None]
+    local = len(records) - len(fleet)
+    done = [r for r in fleet if r["done"]]
+    lost = [
+        r
+        for r in fleet
+        if not r["done"] and not r["cancelled"] and not r.get("rejected")
+    ]
+    failovers = sum(r["failovers"] for r in fleet)
+    tail = (
+        f"{len(fleet)} requests: {len(done)} done, "
+        f"{sum(r['cancelled'] for r in fleet)} cancelled, "
+        f"{sum(bool(r.get('rejected')) for r in fleet)} rejected, "
+        f"{len(lost)} in flight/lost; {failovers} failover(s)"
+        + (f" (+{local} replica-local)" if local else "")
+    )
+    pct = request_percentiles(
+        [
+            {"done": True, "ttft_s": r["ttft_s"], "latency_s": r["latency_s"]}
+            for r in done
+        ]
+    )
+    if pct:
+        tail += (
+            f"; fleet TTFT p50/p95 = {pct['ttft_s']['p50']}/"
+            f"{pct['ttft_s']['p95']}s, latency p50/p95 = "
+            f"{pct['latency_s']['p50']}/{pct['latency_s']['p95']}s"
+        )
+    lines.append(tail)
+    return "\n".join(lines)
+
+
 def render_gang(summary: dict) -> str:
     lines = [
         f"fleet: {len(summary['ranks'])} journals, "
@@ -545,7 +702,31 @@ def main(argv=None) -> int:
         "into one fleet timeline (--trace then exports one track per "
         "rank)",
     )
+    ap.add_argument(
+        "--fleet",
+        action="store_true",
+        help="treat PATH as a serving-fleet logdir (serve_fleet.py): "
+        "merge the router + per-replica journals and render per-request "
+        "timelines joined on trace ids — a failover shows as one trace "
+        "admitted on replica A and completed on replica B",
+    )
     args = ap.parse_args(argv)
+    if args.fleet:
+        merged = aggregate.merge(args.path)
+        records = reconstruct_fleet_requests(merged)
+        if args.json:
+            print(json.dumps(records))
+        else:
+            print(render_gang(aggregate.fleet_summary(merged)))
+            print(render_fleet_requests(records))
+        if args.trace:
+            with open(args.trace, "w", encoding="utf-8") as f:
+                json.dump(aggregate.gang_chrome_trace(merged), f)
+            print(
+                f"wrote fleet trace ({len(merged['ranks'])} tracks) to "
+                f"{args.trace}"
+            )
+        return 0
     if args.gang:
         merged = aggregate.merge(args.path)
         summary = aggregate.fleet_summary(merged)

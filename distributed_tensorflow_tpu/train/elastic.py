@@ -75,6 +75,18 @@ replacement that registers INSIDE the rejoin window keeps round 7's
 fixed-size restart path bit-for-bit (identical spawns, no ``Resize:``
 line). ``min_workers`` defaults to the full gang size, which disables
 resizing entirely — the round-7 machine, unchanged.
+
+Serving-fleet reuse (round 16)
+------------------------------
+``serve_fleet.py`` supervises N TextServer replicas with the SAME
+primitives — one :class:`ElasticAgent` per replica (spawn/poll/kill),
+:class:`HttpHealth` verdicts over each replica's ``/healthz``,
+``resilience.backoff_delay`` for the jittered relaunch schedule, the
+same restart budget + bench-below-floor discipline — but WITHOUT gang
+semantics: serving replicas share no collectives, so one death never
+poisons the others, and members fail and restart independently while
+the fleet keeps serving (the paper's async-beats-sync thesis applied
+to the serving tier; docs/serving.md §fleet).
 """
 
 from __future__ import annotations
@@ -165,6 +177,97 @@ class HeartbeatHealth:
 
     def stop(self) -> None:
         self._coord.stop()
+
+
+class HttpHealth:
+    """:class:`HeartbeatHealth`'s verdicts over an HTTP ``/healthz``
+    endpoint (observability/exporter.py) instead of the UDP detector —
+    the probe the serving fleet router (serve_fleet.py) runs against its
+    replicas, usable against any exporter-armed process.
+
+    ``probe()`` fetches and parses the health document (returns None on
+    any failure; the last good document stays at ``.last`` — it carries
+    the ROUTING signals: ``queue_saturation``, ``slots_busy``,
+    ``draining``). ``classify()`` mirrors the heartbeat verdicts:
+
+    - ``"dead"`` — was reachable then unreachable past ``dead_after_s``,
+      or never reachable and the startup ``grace_s`` elapsed (restore +
+      first compile must not read as death);
+    - ``"stalled"`` — reachable, but the payload's ``heartbeat_age_s``
+      (time since the engine's last tick) exceeds ``stall_after_s``
+      (0 disables) — the exporter thread answering while the engine loop
+      is wedged, liveness without progress;
+    - ``"ok"`` — otherwise.
+
+    ``url`` may be a callable returning the URL (or None while unknown) —
+    replicas that bind an ephemeral port publish it after startup, and an
+    unknown URL counts as never-reachable. ``fetch``/``clock`` are
+    injectable so the fast-tier router tests run without sockets."""
+
+    def __init__(
+        self,
+        url,
+        *,
+        timeout_s: float = 2.0,
+        dead_after_s: float = 5.0,
+        grace_s: float = 60.0,
+        stall_after_s: float = 0.0,
+        fetch=None,
+        clock=time.monotonic,
+    ):
+        self._url = url
+        self._timeout_s = float(timeout_s)
+        self._dead_after_s = float(dead_after_s)
+        self._grace_s = float(grace_s)
+        self._stall_after_s = float(stall_after_s)
+        self._fetch = fetch if fetch is not None else self._http_fetch
+        self._clock = clock
+        self.last: dict | None = None
+        self._last_ok: float | None = None
+        self._start = clock()
+
+    def _http_fetch(self, url: str) -> dict:
+        import json as _json
+        import urllib.request
+
+        with urllib.request.urlopen(url, timeout=self._timeout_s) as resp:
+            return _json.load(resp)
+
+    def reset(self) -> None:
+        """Fresh incarnation (a relaunched replica): forget the old
+        endpoint's history and restart the never-reachable grace clock."""
+        self.last = None
+        self._last_ok = None
+        self._start = self._clock()
+
+    def probe(self) -> dict | None:
+        url = self._url() if callable(self._url) else self._url
+        if not url:
+            return None
+        try:
+            doc = self._fetch(url)
+        except Exception:  # noqa: BLE001 — any probe failure is "no answer"
+            return None
+        if not isinstance(doc, dict):
+            return None
+        self.last = doc
+        self._last_ok = self._clock()
+        return doc
+
+    def classify(self) -> str:
+        doc = self.probe()
+        now = self._clock()
+        if doc is None:
+            if self._last_ok is None:
+                return "dead" if now - self._start > self._grace_s else "ok"
+            return (
+                "dead" if now - self._last_ok > self._dead_after_s else "ok"
+            )
+        if self._stall_after_s > 0:
+            age = doc.get("heartbeat_age_s")
+            if isinstance(age, (int, float)) and age > self._stall_after_s:
+                return "stalled"
+        return "ok"
 
 
 class ElasticAgent:

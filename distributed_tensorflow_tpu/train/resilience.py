@@ -116,6 +116,19 @@ def crc32c_file(path: str) -> int:
 # ---------------------------------------------------------------------------
 
 
+def write_json_atomic(path: str, obj: dict) -> None:
+    """Atomic JSON write (tmp name + ``os.replace``): a reader never sees
+    a torn file, and a writer killed mid-write leaves only a ``.tmp``.
+    THE crash-consistency primitive — the checkpoint manifests, the
+    layout sidecars (train/supervisor.py), and the serving fleet's
+    mailbox (serve_fleet.py) all write through here; a future hardening
+    (fsync-before-replace, tmp collision handling) lands once."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
 def manifest_path(checkpoint_dir: str, step: int) -> str:
     return os.path.join(checkpoint_dir, f"step_{step}.manifest.json")
 
@@ -177,11 +190,7 @@ def write_manifest(checkpoint_dir: str, step: int, state=None) -> dict:
         }
     if state is not None:
         manifest["leaves"], manifest["leaves_complete"] = leaf_checksums(state)
-    path = manifest_path(checkpoint_dir, step)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(manifest, f)
-    os.replace(tmp, path)
+    write_json_atomic(manifest_path(checkpoint_dir, step), manifest)
     return manifest
 
 
@@ -258,6 +267,32 @@ def verify_leaves(state, manifest: dict) -> bool:
 # ---------------------------------------------------------------------------
 
 
+def backoff_delay(
+    attempt: int,
+    *,
+    backoff: float,
+    max_backoff: float = 30.0,
+    jitter: float = 0.0,
+    rng=None,
+) -> float:
+    """The one backoff formula: delay before retry ``attempt + 1`` is
+    ``min(backoff * 2**attempt, max_backoff)``, multiplied by
+    ``1 + jitter*u`` with ``u`` uniform in [0, 1). Shared by
+    :func:`retry` (checkpoint I/O, the elastic gang cycle) and the
+    serving fleet's per-replica relaunch scheduler (serve_fleet.py),
+    which cannot use :func:`retry` directly — its members restart
+    INDEPENDENTLY while the rest of the fleet keeps serving, so there is
+    no single call to wrap."""
+    if rng is None:
+        import random as _random
+
+        rng = _random
+    delay = min(backoff * (2**attempt), max_backoff)
+    if jitter:
+        delay *= 1.0 + jitter * rng.random()
+    return delay
+
+
 def retry(
     fn,
     *,
@@ -283,10 +318,6 @@ def retry(
     agent's ``Restart:`` line + tfevents scalar hang off it); ``sleep`` and
     ``rng`` are injectable so the state machine tests run without wall time.
     """
-    if rng is None:
-        import random as _random
-
-        rng = _random
     last = None
     for attempt in range(max(1, attempts)):
         try:
@@ -295,9 +326,13 @@ def retry(
             last = exc
             if attempt + 1 >= attempts:
                 raise
-            delay = min(backoff * (2**attempt), max_backoff)
-            if jitter:
-                delay *= 1.0 + jitter * rng.random()
+            delay = backoff_delay(
+                attempt,
+                backoff=backoff,
+                max_backoff=max_backoff,
+                jitter=jitter,
+                rng=rng,
+            )
             if on_retry is not None:
                 on_retry(exc, attempt, delay)
             sleep(delay)

@@ -233,11 +233,7 @@ class Supervisor:
         with self._span("checkpoint_save", step=int(step)):
             self._retry(_write, f"save step_{step}")
             if layout is not None:
-                side = f"{path}.layout.json"
-                tmp = f"{side}.tmp.{os.getpid()}"
-                with open(tmp, "w") as f:
-                    json.dump(layout, f)
-                os.replace(tmp, side)
+                resilience.write_json_atomic(f"{path}.layout.json", layout)
             manifest = self._retry(
                 lambda: resilience.write_manifest(
                     self.checkpoint_dir, step, state

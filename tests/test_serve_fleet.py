@@ -1,0 +1,1042 @@
+"""Serving fleet (serve_fleet.py) + TextServer lifecycle surfaces — fast
+tier, the test_elastic.py pattern: the router's whole state machine
+(verdicts, zero-loss re-admission, dedupe, budget/backoff/bench, floor,
+affinity + spill, deadlines) runs over a FAKE replica table with injected
+clock/sleep — no subprocesses, no sockets, no wall time. The TextServer
+halves (queue_limit backpressure, deadline cancel, drain, live weight
+swap) run on the numpy fake engine or a tiny real model (single-device,
+so no slot in conftest._CACHE_OPT_OUT_FIRST). The end-to-end SIGKILL
+proof over real replica processes is RUN_SLOW:
+tests/integration/test_serve_fleet_failover.py.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.observability.journal import NullJournal
+from distributed_tensorflow_tpu.serve import (
+    GenerationConfig,
+    QueueFull,
+    RequestCancelled,
+    TextServer,
+)
+from distributed_tensorflow_tpu.serve_fleet import (
+    FleetBelowFloor,
+    MailboxClient,
+    ReplicaHandle,
+    ReplicaRouter,
+)
+from distributed_tensorflow_tpu.train.elastic import ElasticAgent, HttpHealth
+
+from test_serve import _FakeEngine, _prompts, tiny_model
+
+
+class _RecordingJournal(NullJournal):
+    def __init__(self):
+        self.events: list[dict] = []
+
+    def emit(self, kind, **fields):
+        ev = super().emit(kind, **fields)
+        self.events.append(ev)
+        return ev
+
+    def kinds(self, kind):
+        return [e for e in self.events if e["kind"] == kind]
+
+
+# ---------------------------------------------------------------------------
+# TextServer: bounded admission queue (satellite).
+# ---------------------------------------------------------------------------
+
+
+def test_queue_limit_rejects_loudly_and_journals():
+    m = tiny_model()
+    j = _RecordingJournal()
+    srv = TextServer(
+        m, params=None, slots=1, chunk=4, buckets=(8,), queue_limit=2,
+        journal=j,
+    )
+    _FakeEngine(srv, m.vocab_size)
+    prompts = _prompts(m.vocab_size, [4, 4, 4, 4])
+    srv.submit(prompts[0], GenerationConfig(max_new=4))
+    srv.submit(prompts[1], GenerationConfig(max_new=4))
+    with pytest.raises(QueueFull, match="queue_limit=2"):
+        srv.submit(prompts[2], GenerationConfig(max_new=4))
+    assert srv.metrics.counter("queue_rejections_total").value == 1
+    assert len(j.kinds("queue_reject")) == 1
+    hz = srv.health()
+    assert hz["queue_limit"] == 2 and hz["queue_saturation"] == 1.0
+    # Serving drains the queue; capacity reopens.
+    while srv.step():
+        pass
+    srv.submit(prompts[3], GenerationConfig(max_new=4))  # accepted again
+
+    with pytest.raises(ValueError, match="queue_limit"):
+        TextServer(m, params=None, slots=1, queue_limit=0)
+
+
+# ---------------------------------------------------------------------------
+# TextServer: per-request deadline (satellite).
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_cancels_queued_request_at_chunk_boundary():
+    m = tiny_model()
+    j = _RecordingJournal()
+    srv = TextServer(m, params=None, slots=1, chunk=4, buckets=(8,), journal=j)
+    _FakeEngine(srv, m.vocab_size)
+    pr = _prompts(m.vocab_size, [4])[0]
+    rid = srv.submit(pr, GenerationConfig(max_new=8), deadline_s=0.0)
+    ok = srv.submit(pr, GenerationConfig(max_new=3))
+    while srv.step():
+        pass
+    assert srv.done(rid) and srv.done(ok)
+    with pytest.raises(RequestCancelled):
+        srv.result(rid)
+    assert len(srv.result(ok)) == 3  # the deadline-free request is intact
+    evs = j.kinds("request_cancelled")
+    assert len(evs) == 1 and evs[0]["resident"] is False
+    assert srv.metrics.counter("cancellations_total").value == 1
+
+
+def test_deadline_cancels_resident_and_frees_slot():
+    m = tiny_model()
+    j = _RecordingJournal()
+    srv = TextServer(m, params=None, slots=1, chunk=2, buckets=(8,), journal=j)
+    _FakeEngine(srv, m.vocab_size)
+    pr = _prompts(m.vocab_size, [4])[0]
+    rid = srv.submit(pr, GenerationConfig(max_new=50), deadline_s=0.05)
+    queued = srv.submit(pr, GenerationConfig(max_new=3))
+    srv.step()  # admits rid (resident, far from budget)
+    assert srv._slot_req[0] is not None
+    time.sleep(0.06)
+    srv.step()  # chunk boundary past the deadline: cancelled, slot freed
+    assert srv.done(rid)
+    with pytest.raises(RequestCancelled):
+        srv.result(rid)
+    evs = j.kinds("request_cancelled")
+    assert len(evs) == 1 and evs[0]["resident"] is True and evs[0]["slot"] == 0
+    # The freed slot serves the queued request to completion.
+    while srv.step():
+        pass
+    assert len(srv.result(queued)) == 3
+
+
+def test_deadline_paged_releases_blocks():
+    """A resident cancellation on the paged engine returns every reserved
+    block to the pool (the _release_slot path the completion uses)."""
+    m = tiny_model(max_len=32)
+    p = m.init(3)
+    srv = TextServer(
+        m, p, slots=2, chunk=2, buckets=(8,), paged=True, block_size=8,
+    )
+    pr = _prompts(m.vocab_size, [5])[0]
+    used0 = srv._alloc.used_blocks
+    rid = srv.submit(pr, GenerationConfig(max_new=20), deadline_s=0.05)
+    srv.step()
+    assert srv._alloc.used_blocks > used0  # blocks reserved at admission
+    time.sleep(0.06)
+    srv.step()
+    assert srv.done(rid)
+    # Prompt blocks may stay radix-cached (refcount 1, evictable); the
+    # request's own references are all gone.
+    assert srv._slot_blocks[0] is None and srv._slot_req[0] is None
+    with pytest.raises(RequestCancelled):
+        srv.result(rid)
+
+
+# ---------------------------------------------------------------------------
+# TextServer: drain (satellite).
+# ---------------------------------------------------------------------------
+
+
+def test_drain_finishes_residents_closes_admission_idempotent():
+    m = tiny_model()
+    j = _RecordingJournal()
+    srv = TextServer(m, params=None, slots=1, chunk=4, buckets=(8,), journal=j)
+    _FakeEngine(srv, m.vocab_size)
+    pr = _prompts(m.vocab_size, [4])[0]
+    resident = srv.submit(pr, GenerationConfig(max_new=10))
+    queued = srv.submit(pr, GenerationConfig(max_new=4))
+    srv.step()  # resident admitted, queued waits
+    srv.drain()
+    assert srv.done(resident) and len(srv.result(resident)) == 10
+    # Queued-but-unadmitted work is NOT served (the router re-routes it);
+    # admission is closed loudly; drain is idempotent.
+    assert not srv.done(queued) and srv.draining
+    with pytest.raises(RuntimeError, match="draining"):
+        srv.submit(pr, GenerationConfig(max_new=2))
+    srv.drain()  # second call: immediate no-op
+    assert len(j.kinds("serve_drain")) == 1
+    srv.shutdown()  # routes through drain; no residents left — fine
+
+
+# ---------------------------------------------------------------------------
+# TextServer: live weight swap (tentpole half, in-process).
+# ---------------------------------------------------------------------------
+
+
+def test_live_weight_swap_residents_old_weights_new_admissions_new():
+    """The swap protocol on a REAL model: a resident admitted before the
+    swap completes under the old weights' parity contract; a request
+    submitted after the swap request serves the new weights; nothing is
+    dropped and nothing recompiles (params are runtime args)."""
+    m = tiny_model()
+    p0, p1 = m.init(0), m.init(1)
+    j = _RecordingJournal()
+    srv = TextServer(m, p0, slots=1, chunk=4, buckets=(8,), journal=j)
+    pr_a, pr_b = _prompts(m.vocab_size, [5, 7], seed=3)
+    a = srv.submit(pr_a, GenerationConfig(max_new=10))
+    srv.step()  # A resident under p0
+    srv.request_swap(p1, step=2)
+    assert srv._pending_swap is not None  # resident holds the swap
+    b = srv.submit(pr_b, GenerationConfig(max_new=6))
+    while srv.step():
+        pass
+    out_a, out_b = srv.result(a), srv.result(b)
+    ref_a = m.greedy_decode(p0, jnp.asarray(pr_a[None]), 10)
+    ref_b = m.greedy_decode(p1, jnp.asarray(pr_b[None]), 6)
+    assert np.array_equal(out_a, np.asarray(ref_a)[0, pr_a.size:])
+    assert np.array_equal(out_b, np.asarray(ref_b)[0, pr_b.size:])
+    swaps = j.kinds("weight_swap")
+    assert len(swaps) == 1 and swaps[0]["step"] == 2
+    assert srv.checkpoint_step == 2
+    assert srv.metrics.counter("weight_swaps_total").value == 1
+
+
+def test_swap_flushes_stale_prefix_cache_on_paged_server():
+    """A paged server's radix caches K/V computed under the OLD weights;
+    the swap must flush it, or a post-swap prefix HIT would splice stale
+    keys into a new-weights stream (parity-breaking, review finding)."""
+    m = tiny_model(max_len=32)
+    p0, p1 = m.init(0), m.init(1)
+    srv = TextServer(
+        m, p0, slots=2, chunk=4, buckets=(8,), paged=True, block_size=4,
+    )
+    pr = _prompts(m.vocab_size, [6], seed=11)[0]  # one full prompt block
+    out0 = srv.generate([pr], GenerationConfig(max_new=6))[0]
+    assert np.array_equal(
+        out0, np.asarray(m.greedy_decode(p0, jnp.asarray(pr[None]), 6))[0, 6:]
+    )
+    srv.request_swap(p1, step=2)  # idle: applied (and radix flushed) now
+    out1 = srv.generate([pr], GenerationConfig(max_new=6))[0]
+    ref1 = m.greedy_decode(p1, jnp.asarray(pr[None]), 6)
+    assert np.array_equal(out1, np.asarray(ref1)[0, 6:])
+
+
+def test_swap_from_checkpoint_adopts_only_newer_steps(tmp_path):
+    """swap_from_checkpoint is the train→publish→serve edge: it restores
+    the newest CRC-verified step and swaps ONLY when it is newer than the
+    served one (a republished old step is a no-op, not a regression)."""
+    from distributed_tensorflow_tpu.ops import optim as optim_lib
+    from distributed_tensorflow_tpu.parallel.strategy import TrainState
+    from distributed_tensorflow_tpu.train.supervisor import Supervisor
+
+    pytest.importorskip("orbax.checkpoint")
+    m = tiny_model()
+    opt = optim_lib.sgd(0.001)
+    ckpt = str(tmp_path / "ck")
+    sup = Supervisor(checkpoint_dir=ckpt)
+
+    def save(params, step):
+        sup.save(
+            TrainState(params, opt.init(params), jnp.asarray(step, jnp.int32)),
+            step,
+        )
+
+    p1, p2 = m.init(0), m.init(1)
+    save(p1, 1)
+    srv = TextServer.from_checkpoint(m, ckpt, slots=1, chunk=4, buckets=(8,))
+    assert srv.checkpoint_step == 1
+    assert srv.swap_from_checkpoint() is None  # nothing newer: no swap
+    save(p2, 2)
+    assert srv.swap_from_checkpoint() == 2  # idle server: applied at once
+    assert srv.checkpoint_step == 2
+    pr = _prompts(m.vocab_size, [6], seed=5)[0]
+    out = srv.generate([pr], GenerationConfig(max_new=5))[0]
+    ref = m.greedy_decode(p2, jnp.asarray(pr[None]), 5)
+    assert np.array_equal(out, np.asarray(ref)[0, pr.size:])
+
+
+# ---------------------------------------------------------------------------
+# The fake replica table (the test_elastic.py pattern, serving flavor).
+# ---------------------------------------------------------------------------
+
+
+class FakeProc:
+    """poll() pops a scripted sequence (last value repeats); kill pins -9."""
+
+    def __init__(self, script=(None,)):
+        self.script = list(script)
+        self.killed = False
+
+    def poll(self):
+        if self.killed:
+            return -9
+        if len(self.script) > 1:
+            return self.script.pop(0)
+        return self.script[0]
+
+    def kill(self):
+        self.killed = True
+
+    def wait(self, timeout=None):
+        return -9
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+class FakeHealth:
+    """Injectable HttpHealth stand-in: verdict + routing doc scripted."""
+
+    def __init__(self, doc=None):
+        self.verdict = "ok"
+        self.doc = dict(doc or {"slots": 4, "queue_limit": 8,
+                                "queue_saturation": 0.0})
+        self.last = None
+        self.resets = 0
+
+    def classify(self):
+        if self.verdict == "ok":
+            self.last = dict(self.doc)
+        return self.verdict
+
+    def reset(self):
+        self.last = None
+        self.resets += 1
+        self.verdict = "ok"
+
+
+class FakeReplica:
+    """Mailbox client + deterministic engine in one: a routed request
+    completes with the stream ``(last+1+i) % vocab`` after ``ticks``
+    result polls — the same stream for the same prompt on ANY replica,
+    which is exactly the determinism the zero-loss contract leans on."""
+
+    def __init__(self, vocab=97, ticks=1):
+        self.vocab = vocab
+        self.ticks = ticks
+        self.active: dict[str, list] = {}  # trace -> [payload, countdown]
+        self.ready: list[dict] = []
+        self.frozen = False  # a dead replica stops serving, mailbox stays
+        self.submitted: list[dict] = []
+        self.controls: list[dict] = []
+        self.cleared = 0
+
+    def submit(self, payload):
+        self.submitted.append(payload)
+        self.active[payload["trace"]] = [payload, self.ticks]
+
+    def control(self, payload):
+        self.controls.append(payload)
+
+    def clear_inbox(self):
+        self.cleared += 1
+        self.active.clear()
+
+    @staticmethod
+    def stream(tokens, max_new, vocab):
+        last = int(tokens[-1])
+        return [(last + 1 + i) % vocab for i in range(max_new)]
+
+    def poll_results(self):
+        out, self.ready = self.ready, []
+        if self.frozen:
+            return out
+        for trace in list(self.active):
+            payload, left = self.active[trace]
+            if left > 1:
+                self.active[trace][1] = left - 1
+                continue
+            del self.active[trace]
+            cfg = payload.get("config") or {}
+            dl = payload.get("deadline_s")
+            if dl is not None and dl <= 0:
+                out.append({"trace": trace, "cancelled": True})
+            else:
+                out.append(
+                    {
+                        "trace": trace,
+                        "tokens": self.stream(
+                            payload["tokens"], int(cfg.get("max_new", 4)),
+                            self.vocab,
+                        ),
+                    }
+                )
+        return out
+
+
+def make_router(n=2, *, scripts=None, ticks=1, docs=None, **kw):
+    clock = FakeClock()
+    handles = []
+    for i in range(n):
+        script_seq = (scripts or {}).get(i, [[None]])
+        scripts_iter = iter(script_seq)
+
+        def spawn(it=scripts_iter):
+            try:
+                return FakeProc(next(it))
+            except StopIteration:
+                return FakeProc([None])
+
+        handles.append(
+            ReplicaHandle(
+                f"r{i}",
+                client=FakeReplica(ticks=ticks),
+                agent=ElasticAgent(f"r{i}", spawn),
+                health=FakeHealth((docs or {}).get(i)),
+            )
+        )
+    j = _RecordingJournal()
+    kw.setdefault("backoff", 1.0)
+    kw.setdefault("jitter", 0.0)
+    kw.setdefault("probe_interval_s", 0.0)
+    router = ReplicaRouter(
+        handles,
+        journal=j,
+        print_fn=lambda *a: None,
+        clock=clock,
+        sleep=clock.sleep,
+        **kw,
+    )
+    return router, clock, j
+
+
+def _drive(router, clock, *, max_ticks=200):
+    for _ in range(max_ticks):
+        if not router.step():
+            return
+        clock.sleep(0.1)
+    raise AssertionError(f"fleet never finished: {router.stats()}")
+
+
+def _expect(tokens, max_new, vocab=97):
+    return FakeReplica.stream(tokens, max_new, vocab)
+
+
+def test_router_routes_completes_and_balances():
+    router, clock, _ = make_router(2)
+    prompts = [[1, 2, 3], [9, 9], [4], [7, 8]]
+    rids = [router.submit(p, {"max_new": 5}) for p in prompts]
+    _drive(router, clock)
+    for p, rid in zip(prompts, rids):
+        assert router.result(rid) == _expect(p, 5)
+    # Least-loaded routing spread the 4 requests over both replicas.
+    loads = [
+        len(h.client.submitted) for h in router.replicas.values()
+    ]
+    assert sorted(loads) == [2, 2]
+
+
+def test_failover_reroutes_inflight_zero_loss_and_relaunches():
+    """The robustness contract on fakes: r0 dies (rc=-9) holding two
+    in-flight requests; both re-admit to r1 with the SAME trace and
+    complete with the identical deterministic stream; r0 relaunches
+    after the jittered backoff and serves again."""
+    # r0 incarnation 1 dies after two polls; incarnation 2 lives.
+    router, clock, j = make_router(
+        2, scripts={0: [[None, None, -9], [None]]}, ticks=10,
+        max_restarts=2,
+    )
+    router.start()
+    r1 = router.replicas["r1"]
+    r1.health.doc["queue_saturation"] = 1.0  # force everything to r0 first
+    router.step()
+    prompts = [[5, 6], [7]]
+    rids = [router.submit(p, {"max_new": 4}) for p in prompts]
+    router.step()  # routes both to r0 (r1 saturated)
+    r0 = router.replicas["r0"]
+    assert len(r0.inflight) == 2
+    r1.health.doc["queue_saturation"] = 0.0
+    router.step()  # r0's rc lands: failover
+    assert r0.state == "backoff" and len(r0.inflight) == 0
+    dead = j.kinds("replica_dead")
+    assert len(dead) == 1 and dead[0]["rerouted"] == 2
+    reroutes = j.kinds("request_reroute")
+    assert {e["trace"] for e in reroutes} == {
+        router._by_rid[r].trace for r in rids
+    }
+    _drive(router, clock)
+    for p, rid in zip(prompts, rids):
+        assert router.result(rid) == _expect(p, 4)
+    clock.sleep(1.1)  # past the backoff, in case the fleet finished first
+    router.step()  # relaunch fires
+    router.step()  # first good probe flips starting -> up
+    assert router.replicas["r0"].state == "up"  # relaunched + probed ok
+    assert len(j.kinds("replica_relaunch")) == 1
+    assert router.stats()["failovers"] == 1
+
+
+def test_duplicate_late_result_deduplicates_on_trace():
+    """A replica that was declared dead but had already committed its
+    result (the mailbox outlives the process) must not double-complete a
+    request that failed over: first terminal result wins."""
+    router, clock, j = make_router(2, ticks=1, max_restarts=2)
+    router.start()
+    router.step()
+    rid = router.submit([3, 4], {"max_new": 4})
+    router.step()  # routed somewhere
+    req = router._by_rid[rid]
+    holder = router.replicas[req.replica]
+    other = next(
+        h for h in router.replicas.values() if h.name != req.replica
+    )
+    # The holder dies; its (unfinished) request fails over to `other`.
+    holder.client.frozen = True
+    holder.agent.handle.script = [-9]
+    router.step()
+    _drive(router, clock)
+    out = router._by_rid[rid].out
+    # A late duplicate surfaces from the dead replica's mailbox.
+    holder.client.frozen = False
+    holder.client.ready.append({"trace": req.trace, "tokens": [1, 2, 3]})
+    router.step()
+    assert router._by_rid[rid].out == out  # unchanged: dedupe held
+    assert router.result(rid) == _expect([3, 4], 4)
+
+
+def test_cancelled_request_is_never_resurrected_by_failover():
+    """Satellite contract: a deadline-cancelled request is terminal —
+    the replica reports it cancelled, and when that replica later dies
+    the router must NOT re-admit it."""
+    router, clock, j = make_router(1, ticks=1, max_restarts=2)
+    router.start()
+    router.step()
+    rid = router.submit([2, 2], {"max_new": 4}, deadline_s=0.0)
+    live = router.submit([8], {"max_new": 3})
+    router.step()  # routed with deadline_s=0 -> fake cancels it
+    _drive(router, clock)
+    assert router.done(rid) and router._by_rid[rid].cancelled
+    # Now the replica dies: nothing to reroute for the cancelled trace.
+    r0 = router.replicas["r0"]
+    r0.agent.handle.script = [-9]
+    router.step()
+    assert all(
+        e["trace"] != router._by_rid[rid].trace
+        for e in j.kinds("request_reroute")
+    )
+    with pytest.raises(RuntimeError, match="cancelled"):
+        router.result(rid)
+    assert router.result(live) == _expect([8], 3)
+
+
+def test_router_cancels_overdue_queued_requests():
+    """A request the router never managed to place (whole fleet
+    saturated) still honors its deadline at the router."""
+    router, clock, j = make_router(1, docs={0: {"queue_saturation": 1.0}})
+    router.start()
+    router.step()
+    rid = router.submit([1], {"max_new": 2}, deadline_s=5.0)
+    router.step()
+    assert router.stats()["queued"] == 1  # held: replica saturated
+    clock.sleep(6.0)
+    router.step()
+    assert router.done(rid) and router._by_rid[rid].cancelled
+    assert len(j.kinds("request_cancelled")) == 1
+
+
+def test_restart_budget_bench_and_below_floor():
+    """Budget exhaustion benches a replica (fleet continues above the
+    floor); the LAST replica benching below min_replicas fail-stops with
+    FleetBelowFloor — the serving GangBelowFloor."""
+    router, clock, j = make_router(
+        2,
+        scripts={0: [[-9]], 1: [[None, None, None, -9], [-9], [-9]]},
+        max_restarts=1,
+        min_replicas=1,
+    )
+    router.start()
+    # r0 dies instantly, relaunch 1 (budget 1): second incarnation lives?
+    # scripts: r0 second incarnation defaults to alive.
+    router.step()
+    assert router.replicas["r0"].state == "backoff"
+    clock.sleep(1.1)
+    router.step()  # relaunch r0
+    assert router.replicas["r0"].state in ("starting", "up")
+    # r1 dies; relaunch; dies again -> over budget -> benched (floor ok:
+    # r0 is still active).
+    for _ in range(12):
+        if router.replicas["r1"].state == "benched":
+            break
+        router.step()
+        clock.sleep(1.1)
+    assert router.replicas["r1"].state == "benched"
+    assert j.kinds("replica_benched")
+    # Now r0 dies over budget too: below the floor -> fail-stop.
+    router.replicas["r0"].attempts = router.max_restarts
+    router.replicas["r0"].agent.handle.script = [-9]
+    with pytest.raises(FleetBelowFloor):
+        for _ in range(4):
+            router.step()
+            clock.sleep(1.1)
+    assert j.kinds("fleet_below_floor")
+
+
+def test_prefix_affinity_sticks_and_spills_on_pressure():
+    """Same-prefix sessions stick to one replica (the warm radix);
+    pressure on the sticky target spills to the least-loaded one."""
+    router, clock, j = make_router(2, ticks=50, affinity_tokens=4)
+    router.start()
+    router.step()
+    prefix = [11, 12, 13, 14]
+    router.submit(prefix + [1], {"max_new": 2})
+    router.submit(prefix + [2, 3], {"max_new": 2})
+    router.step()
+    homes = {
+        h.name for h in router.replicas.values() if h.client.submitted
+    }
+    assert len(homes) == 1  # both stuck to the same (warm) replica
+    home = router.replicas[homes.pop()]
+    home.health.doc["queue_saturation"] = 1.0
+    router.step()  # refresh the probe doc
+    router.submit(prefix + [4], {"max_new": 2})
+    router.step()
+    spilled = [
+        h
+        for h in router.replicas.values()
+        if h.name != home.name and h.client.submitted
+    ]
+    assert spilled, "saturated sticky target must spill"
+
+
+def test_replica_rejection_reroutes_to_another_replica():
+    """Replica-side QueueFull surfaces as a rejected result; the router
+    re-routes instead of losing the request."""
+    router, clock, j = make_router(2, ticks=1)
+    router.start()
+    router.step()
+    rid = router.submit([5], {"max_new": 3})
+    router.step()
+    req = router._by_rid[rid]
+    holder = router.replicas[req.replica]
+    # Simulate the replica bouncing it (backpressure race).
+    del holder.client.active[req.trace]
+    holder.client.ready.append({"trace": req.trace, "rejected": True})
+    _drive(router, clock)
+    assert router.result(rid) == _expect([5], 3)
+    rr = j.kinds("request_reroute")
+    assert len(rr) == 1 and rr[0]["reason"] == "rejected"
+
+
+def test_drain_closes_router_admission():
+    router, clock, _ = make_router(1)
+    rid = router.submit([1, 2], {"max_new": 2})
+    router._draining = True
+    with pytest.raises(RuntimeError, match="draining"):
+        router.submit([3], {"max_new": 2})
+    router._draining = False
+    _drive(router, clock)
+    assert router.result(rid) == _expect([1, 2], 2)
+
+
+def test_late_result_for_requeued_request_is_not_rerouted():
+    """A dead replica's committed result arriving AFTER the failover
+    re-queue makes the request terminal while queued — routing must drop
+    it instead of re-serving a done request on a healthy replica."""
+    router, clock, _ = make_router(2, ticks=50, max_restarts=2)
+    router.start()
+    r1 = router.replicas["r1"]
+    r1.health.doc["queue_saturation"] = 1.0  # everything lands on r0
+    router.step()
+    rid = router.submit([4, 5], {"max_new": 3})
+    router.step()
+    req = router._by_rid[rid]
+    r0 = router.replicas["r0"]
+    assert req.replica == "r0"
+    # r0 dies; the request re-queues. r1 stays saturated, so it cannot
+    # route this tick — and r0's pre-death result then surfaces.
+    r0.client.frozen = True
+    r0.agent.handle.script = [-9]
+    router.step()
+    assert router.stats()["queued"] == 1
+    r0.client.frozen = False
+    r0.client.active.clear()
+    r0.client.ready.append(
+        {"trace": req.trace, "tokens": _expect([4, 5], 3)}
+    )
+    r1.health.doc["queue_saturation"] = 0.0
+    router.step()  # collect makes it terminal; route must drop, not ship
+    assert router.done(rid)
+    assert r1.client.submitted == [] and r1.inflight == {}
+    assert router.result(rid) == _expect([4, 5], 3)
+
+
+def test_cross_dir_swap_resent_when_replica_comes_back_up():
+    """A swap to a NEW directory must survive a replica relaunch: the
+    fresh incarnation restores from its spawn-time dir and cleared its
+    inbox, so the router re-sends the fleet's current serve dir at the
+    starting→up transition."""
+    router, clock, j = make_router(
+        2, scripts={0: [[-9], [None]]}, max_restarts=2,
+    )
+    router.start()
+    router.step()
+    router.swap_weights("/published/v2")
+    r0 = router.replicas["r0"]
+    n_before = len(r0.client.controls)
+    router.step()  # r0's rc lands: failover + backoff
+    clock.sleep(1.1)
+    router.step()  # relaunch
+    router.step()  # first good probe: starting -> up + swap re-send
+    assert r0.state == "up"
+    resent = r0.client.controls[n_before:]
+    assert {"control": "swap", "checkpoint_dir": "/published/v2"} in resent
+    # A same-dir swap (checkpoint_dir=None) needs no re-send: restart
+    # restores the newest step of its own directory anyway.
+    router2, clock2, _ = make_router(1)
+    router2.start()
+    router2.step()
+    router2.swap_weights()
+    assert router2.current_checkpoint_dir is None
+
+
+def test_swap_weights_sends_control_to_live_replicas():
+    router, clock, j = make_router(2)
+    router.start()
+    router.step()
+    router.replicas["r1"].state = "benched"
+    router.swap_weights("/new/ckpt")
+    assert router.replicas["r0"].client.controls == [
+        {"control": "swap", "checkpoint_dir": "/new/ckpt"}
+    ]
+    assert router.replicas["r1"].client.controls == []
+    evs = j.kinds("weight_swap_requested")
+    assert evs and evs[0]["replicas"] == ["r0"]
+
+
+def test_config_keys_mirror_generation_config():
+    """The jax-free router validates config dicts against CONFIG_KEYS —
+    this pin keeps the mirror honest against the real dataclass."""
+    import dataclasses as dc
+
+    from distributed_tensorflow_tpu import serve_fleet
+
+    assert set(serve_fleet.CONFIG_KEYS) == {
+        f.name for f in dc.fields(GenerationConfig)
+    }
+
+
+def test_router_rejects_malformed_config_at_submit():
+    router, clock, _ = make_router(1)
+    with pytest.raises(ValueError, match="unknown generation config"):
+        router.submit([1, 2], {"max_tokens": 8})  # typo'd key
+    router.submit([1, 2], {"max_new": 2})  # valid keys pass
+
+
+def test_permanent_rejection_fails_terminally_not_forever():
+    """A replica-side ValueError (geometry no replica will ever accept)
+    must terminate the request, not ping-pong it router<->replica until
+    the end of time (drain()/run_until_done must finish)."""
+    router, clock, j = make_router(2, ticks=1)
+    router.start()
+    router.step()
+    rid = router.submit([5], {"max_new": 3})
+    router.step()
+    req = router._by_rid[rid]
+    holder = router.replicas[req.replica]
+    del holder.client.active[req.trace]
+    holder.client.ready.append(
+        {
+            "trace": req.trace,
+            "rejected": True,
+            "error_kind": "ValueError",
+            "error": "ValueError: prompt length 999 exceeds the largest "
+            "bucket 64",
+        }
+    )
+    _drive(router, clock)  # terminates — the request is terminal
+    assert router.done(rid) and router._by_rid[rid].failed
+    assert router.stats()["failed"] == 1
+    with pytest.raises(RuntimeError, match="rejected.*largest bucket"):
+        router.result(rid)
+    assert holder.inflight == {}
+
+
+def test_unknown_rejections_capped_by_reroute_budget():
+    """Rejections of unknown kind cannot loop forever: past max_reroutes
+    the request fails terminally instead of spinning the router."""
+    # ticks=50: the fake never completes, so every cycle is a bounce.
+    router, clock, j = make_router(1, ticks=50, max_reroutes=2)
+    router.start()
+    router.step()
+    rid = router.submit([5], {"max_new": 3})
+    req = router._by_rid[rid]
+    holder = router.replicas["r0"]
+    for _ in range(4):
+        router.step()  # route
+        if req.terminal:
+            break
+        if req.trace in holder.client.active:
+            del holder.client.active[req.trace]
+        holder.client.ready.append(
+            {"trace": req.trace, "rejected": True,
+             "error_kind": "RuntimeError", "error": "RuntimeError: odd"}
+        )
+        router.step()  # collect the bounce
+        clock.sleep(0.1)
+    # attempts counts ROUTES: bounces at attempts 1 and 2 re-queue
+    # (two reroute events); the bounce at attempts 3 > max_reroutes=2
+    # fails terminally.
+    assert req.failed is not None
+    assert len(j.kinds("request_reroute")) == 2
+    with pytest.raises(RuntimeError, match="rejected"):
+        router.result(rid)
+
+
+def test_queuefull_backpressure_holds_without_burning_budget():
+    """QueueFull is backpressure, not failure: the request re-queues with
+    NO terminal budget charge, the bouncing replica cools for a probe
+    interval (so the router stops hot-looping it), and the request still
+    completes once the replica drains — a saturated-but-healthy fleet
+    must never fail a well-formed request."""
+    router, clock, j = make_router(
+        1, ticks=1, max_reroutes=1, probe_interval_s=0.5,
+    )
+    router.start()
+    router.step()
+    rid = router.submit([5], {"max_new": 3})
+    req = router._by_rid[rid]
+    holder = router.replicas["r0"]
+    for _ in range(4):  # bounce far past max_reroutes=1
+        router.step()
+        if req.trace in holder.client.active:
+            del holder.client.active[req.trace]
+        holder.client.ready.append(
+            {"trace": req.trace, "rejected": True, "error_kind": "QueueFull",
+             "error": "QueueFull: full"}
+        )
+        router.step()
+        assert req.failed is None  # never terminal
+        assert clock() < holder.cooldown_until  # cooled, not hammered
+        assert router.stats()["queued"] == 1  # held at the router
+        clock.sleep(0.6)  # past the cooldown
+    _drive(router, clock)  # replica "drained": the request completes
+    assert router.result(rid) == _expect([5], 3)
+    assert all(
+        e["reason"] == "backpressure" for e in j.kinds("request_reroute")
+    )
+
+
+def test_stale_rejection_from_failed_replica_is_ignored():
+    """A rejection committed by replica A surfacing AFTER the request
+    failed over to replica B must be ignored — re-queuing would serve
+    the request concurrently on two replicas."""
+    router, clock, j = make_router(2, ticks=50, max_restarts=2)
+    router.start()
+    r1 = router.replicas["r1"]
+    r1.health.doc["queue_saturation"] = 1.0
+    router.step()
+    rid = router.submit([6], {"max_new": 3})
+    router.step()  # lands on r0
+    req = router._by_rid[rid]
+    r0 = router.replicas["r0"]
+    assert req.replica == "r0"
+    # r0 commits a bounce, then dies before the router reads it.
+    r0.client.frozen = True
+    r0.agent.handle.script = [-9]
+    r1.health.doc["queue_saturation"] = 0.0
+    router.step()  # failover: request re-queues, routes to r1
+    router.step()
+    assert req.replica == "r1"
+    r0.client.frozen = False
+    r0.client.active.clear()
+    r0.client.ready.append(
+        {"trace": req.trace, "rejected": True, "error_kind": "QueueFull",
+         "error": "QueueFull: full"}
+    )
+    router.step()  # stale bounce ignored: still live on r1, not queued
+    assert req.replica == "r1" and router.stats()["queued"] == 0
+    assert req.trace in r1.inflight
+
+
+def test_duplicate_result_clears_stale_inflight_entry():
+    """A late duplicate for an already-terminal request still clears the
+    replica's inflight entry — phantom load must not accumulate."""
+    router, clock, _ = make_router(2, ticks=1)
+    router.start()
+    router.step()
+    rid = router.submit([3], {"max_new": 2})
+    _drive(router, clock)
+    assert router.done(rid)
+    other = router.replicas["r1"]
+    trace = router._by_rid[rid].trace
+    other.inflight[trace] = router._by_rid[rid]  # simulate stale failover
+    other.client.ready.append({"trace": trace, "tokens": [1]})
+    router.step()
+    assert other.inflight == {}  # popped even though the result deduped
+
+
+def test_affinity_map_is_lru_bounded():
+    router, clock, _ = make_router(1, ticks=1, affinity_tokens=2,
+                                   affinity_cap=3)
+    router.start()
+    router.step()
+    for i in range(6):
+        router.submit([i, i, 1], {"max_new": 2})
+    _drive(router, clock)
+    assert len(router._affinity) <= 3
+
+
+# ---------------------------------------------------------------------------
+# HttpHealth (the /healthz verdict half, no sockets).
+# ---------------------------------------------------------------------------
+
+
+def test_http_health_verdicts_grace_dead_stalled():
+    clock = FakeClock()
+    doc = {"heartbeat_age_s": 0.1}
+    fail = []
+
+    def fetch(url):
+        if fail:
+            raise OSError("probe failed")
+        return dict(doc)
+
+    h = HttpHealth(
+        "http://x/healthz", dead_after_s=5.0, grace_s=30.0,
+        stall_after_s=2.0, fetch=fetch, clock=clock,
+    )
+    # Unreachable inside the startup grace: ok; past it: dead.
+    fail.append(1)
+    assert h.classify() == "ok"
+    clock.t = 31.0
+    assert h.classify() == "dead"
+    # Reachable: ok, and the doc is cached for routing.
+    del fail[:]
+    assert h.classify() == "ok" and h.last == doc
+    # Reachable-then-silent past dead_after_s: dead.
+    fail.append(1)
+    clock.t += 4.0
+    assert h.classify() == "ok"
+    clock.t += 2.0
+    assert h.classify() == "dead"
+    # reset(): fresh incarnation, grace clock restarts.
+    h.reset()
+    assert h.classify() == "ok" and h.last is None
+    # Stall: endpoint answers but the engine stopped ticking.
+    del fail[:]
+    doc["heartbeat_age_s"] = 3.0
+    assert h.classify() == "stalled"
+    # URL not yet published (callable returning None): never-reachable.
+    h2 = HttpHealth(lambda: None, grace_s=10.0, fetch=fetch, clock=clock)
+    assert h2.classify() == "ok"
+    clock.t += 11.0
+    assert h2.classify() == "dead"
+
+
+# ---------------------------------------------------------------------------
+# Mailbox transport (real files, no processes).
+# ---------------------------------------------------------------------------
+
+
+def test_mailbox_round_trip_order_and_crash_persistence(tmp_path):
+    box = MailboxClient(str(tmp_path))
+    box.submit({"trace": "a", "tokens": [1]})
+    box.control({"control": "swap"})
+    box.submit({"trace": "b", "tokens": [2]})
+    taken = box.take_inbox()
+    assert [t.get("trace", t.get("control")) for t in taken] == [
+        "a", "swap", "b",
+    ]  # FIFO: controls ride the same ordered stream
+    assert box.take_inbox() == []  # consumed
+    box.put_result({"trace": "a", "tokens": [4, 5]})
+    # Results survive "the process" (there is none): the router collects
+    # them whenever it polls — the zero-loss storage half.
+    assert MailboxClient(str(tmp_path)).poll_results() == [
+        {"trace": "a", "tokens": [4, 5]}
+    ]
+    box.submit({"trace": "stale", "tokens": [9]})
+    box.clear_inbox()
+    assert box.take_inbox() == []
+
+
+# ---------------------------------------------------------------------------
+# obs_report --fleet: the per-request join across journals (satellite).
+# ---------------------------------------------------------------------------
+
+
+def test_obs_report_fleet_reconstruction_spans_failover():
+    from distributed_tensorflow_tpu.observability import aggregate
+    from distributed_tensorflow_tpu.tools import obs_report
+
+    t0 = 1000.0
+    driver = [
+        {"ts": t0, "kind": "request_submit", "rid": 0, "trace": "tr-1",
+         "prompt_len": 5, "max_new": 8, "greedy": True},
+        {"ts": t0 + 0.01, "kind": "request_route", "rid": 0, "trace": "tr-1",
+         "replica": "replica0", "attempt": 1},
+        {"ts": t0 + 0.5, "kind": "replica_dead", "replica": "replica0",
+         "verdict": "rc=-9", "rerouted": 1, "attempt": 1, "max_restarts": 2},
+        {"ts": t0 + 0.5, "kind": "request_reroute", "rid": 0, "trace": "tr-1",
+         "from_replica": "replica0", "attempt": 2, "reason": "replica_dead"},
+        {"ts": t0 + 0.6, "kind": "request_route", "rid": 0, "trace": "tr-1",
+         "replica": "replica1", "attempt": 2},
+    ]
+    replica0 = [
+        {"ts": t0 + 0.02, "kind": "request_submit", "rid": 0, "trace": "tr-1",
+         "prompt_len": 5},
+        {"ts": t0 + 0.05, "kind": "admission", "rid": 0, "trace": "tr-1",
+         "slot": 0, "bucket": 8, "prompt_len": 5, "queue_wait_s": 0.03},
+    ]
+    replica1 = [
+        {"ts": t0 + 0.62, "kind": "admission", "rid": 0, "trace": "tr-1",
+         "slot": 1, "bucket": 8, "prompt_len": 5, "queue_wait_s": 0.01},
+        {"ts": t0 + 1.0, "kind": "completion", "rid": 0, "trace": "tr-1",
+         "slot": 1, "tokens": 8, "latency_s": 0.39, "ttft_s": 0.05},
+    ]
+    merged = aggregate.merge(
+        {"driver": driver, "replica0": replica0, "replica1": replica1}
+    )
+    [rec] = obs_report.reconstruct_fleet_requests(merged)
+    assert rec["trace"] == "tr-1" and rec["rid"] == 0
+    assert rec["replicas"] == ["replica0", "replica1"]  # spans the failover
+    assert rec["completed_on"] == "replica1" and rec["failovers"] == 1
+    assert rec["done"] and rec["tokens"] == 8
+    assert rec["latency_s"] == pytest.approx(1.0, abs=1e-6)
+    # first token on replica1 = completion - latency + ttft, vs router t0
+    assert rec["ttft_s"] == pytest.approx(0.66, abs=1e-6)
+    text = obs_report.render_fleet_requests([rec])
+    assert "replica0->replica1" in text and "1 failover(s)" in text
+
+
+def test_obs_report_fleet_cli_on_real_fleet_dir(tmp_path, capsys):
+    """--fleet end to end on journal FILES in the fleet-dir layout the
+    router writes (driver events.jsonl + events-replica<k>.jsonl)."""
+    import json as _json
+
+    from distributed_tensorflow_tpu.observability.journal import EventJournal
+    from distributed_tensorflow_tpu.tools import obs_report
+
+    d = EventJournal.in_dir(str(tmp_path))
+    d.emit("request_submit", rid=0, trace="t", prompt_len=3, max_new=2,
+           greedy=True)
+    d.emit("request_route", rid=0, trace="t", replica="replica0", attempt=1)
+    d.close()
+    r = EventJournal(str(tmp_path / "events-replica0.jsonl"))
+    r.emit("admission", rid=0, trace="t", slot=0, bucket=8, prompt_len=3,
+           queue_wait_s=0.0)
+    r.emit("completion", rid=0, trace="t", slot=0, tokens=2, latency_s=0.1,
+           ttft_s=0.02)
+    r.close()
+    assert obs_report.main([str(tmp_path), "--fleet", "--json"]) == 0
+    [rec] = _json.loads(capsys.readouterr().out)
+    assert rec["completed_on"] == "replica0" and rec["tokens"] == 2
+    assert obs_report.main([str(tmp_path), "--fleet"]) == 0
+    out = capsys.readouterr().out
+    assert "1 requests: 1 done" in out
