@@ -52,8 +52,11 @@ def config_from_env(base: TrainConfig | None = None) -> TrainConfig:
     perf knobs: DTF_REMAT (0 | 1 | selective) and DTF_MATMUL_DTYPE
     (int8 | fp8, empty → off), and the DiLoCo outer-loop knobs
     (train/local_sgd.py): DTF_SYNC_EVERY (H inner steps per outer
-    round), DTF_OUTER_LR (empty → the worker-count default) and
-    DTF_OUTER_MOMENTUM. Invalid values
+    round), DTF_OUTER_LR (empty → the worker-count default),
+    DTF_OUTER_MOMENTUM, and the round-17 streaming/compressed levers:
+    DTF_DELTA_DTYPE (int8 | fp8, empty → full-precision deltas) and
+    DTF_STALE_LIMIT (stale-tolerant gang window in outer rounds; 0 =
+    same-round deltas only). Invalid values
     raise ValueError naming the knob — a scheduler typo must fail the
     launch, not silently train with defaults (TrainConfig.__post_init__
     validates the perf-knob values the same way)."""
@@ -106,6 +109,12 @@ def config_from_env(base: TrainConfig | None = None) -> TrainConfig:
         kw["outer_lr"] = _parse("DTF_OUTER_LR", float) if raw else None
     if "DTF_OUTER_MOMENTUM" in os.environ:
         kw["outer_momentum"] = _parse("DTF_OUTER_MOMENTUM", float)
+    if "DTF_DELTA_DTYPE" in os.environ:
+        # Empty = full-precision deltas (the unset-style contract, like
+        # DTF_MATMUL_DTYPE); bad names fail in TrainConfig.__post_init__.
+        kw["delta_dtype"] = os.environ["DTF_DELTA_DTYPE"] or None
+    if "DTF_STALE_LIMIT" in os.environ:
+        kw["stale_limit"] = _parse("DTF_STALE_LIMIT", int)
     if "DTF_REMAT" in os.environ:
         raw = os.environ["DTF_REMAT"]
         # Empty/0/1 keep the boolean surface (empty = off, matching the

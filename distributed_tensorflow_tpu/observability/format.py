@@ -51,10 +51,16 @@ def _final(ev: dict) -> list[str]:
 
 
 def _restart(ev: dict) -> str:
-    return (
+    line = (
         f"Restart: restart={ev['restart']}/{ev['max_restarts']} "
         f"cause[{ev['cause']}] backoff_s={ev['backoff_s']:.1f}"
     )
+    # Round 17 (independent members, train/elastic.py): which members
+    # relaunched ALONE. Absent on gang restarts — round-7 lines stay
+    # byte-identical.
+    if ev.get("independent"):
+        line += f" independent=True members=[{','.join(ev['members'])}]"
+    return line
 
 
 def _restart_exhausted(ev: dict) -> str:

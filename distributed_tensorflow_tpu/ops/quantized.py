@@ -135,6 +135,40 @@ def _qdot_bwd(dtype, res, g):
 quantized_dot.defvjp(_qdot_fwd, _qdot_bwd)
 
 
+# -- per-tensor delta compression (round 17) -------------------------------
+# The coarsest scale granularity in the family: ONE symmetric f32 scale
+# per tensor. Too coarse for weights/activations (a single outlier row
+# crushes resolution — hence the per-row/per-column training scales
+# above), but exactly right for the DiLoCo outer pseudo-gradient
+# (train/local_sgd.py delta_dtype=): the payload crossing the gang's
+# wire is a whole parameter tree whose per-tensor dynamic range is
+# narrow, the scale overhead must stay negligible (4 bytes per TENSOR,
+# not per row), and the error-feedback residual re-injects whatever the
+# coarse scale loses.
+
+
+def quantize_tensor(x, dtype: str):
+    """Quantize a whole tensor symmetrically: ``x`` → ``(q, scale)`` with
+    ONE f32 scale (amax over every element, floored at eps so an all-zero
+    tensor quantizes to zeros). ``dtype`` is ``"int8"`` or ``"fp8"``;
+    rounding semantics are the shared :func:`_quantize` step, so this
+    cannot drift from the training dot or the KV cache."""
+    if dtype not in _QMAX:
+        raise ValueError(
+            f"unknown tensor dtype {dtype!r}; one of {MATMUL_DTYPES}"
+        )
+    qmax = _QMAX[dtype]
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    scale = jnp.maximum(amax, _EPS) / qmax
+    return _quantize(x.astype(jnp.float32) / scale, dtype, qmax), scale
+
+
+def dequantize_tensor(q, scale, out_dtype=jnp.float32):
+    """Inverse of :func:`quantize_tensor`: ``q × scale`` in
+    ``out_dtype``."""
+    return (q.astype(jnp.float32) * scale).astype(out_dtype)
+
+
 # -- inference-side KV-cache quantization (round 15) -----------------------
 
 # Serving cache dtypes: "bf16" is the identity layout (the cache stores

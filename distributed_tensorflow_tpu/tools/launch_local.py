@@ -116,6 +116,7 @@ def _launch_elastic(
     poll_interval: float,
     min_workers: int | None = None,
     rejoin_timeout_s: float = 30.0,
+    independent: bool = False,
     drive_mode: str | None = None,
     drive_after_s: float = 8.0,
     drive_replace_after_s: float = 10.0,
@@ -241,6 +242,7 @@ def _launch_elastic(
         poll_interval=poll_interval,
         min_workers=min_workers if elastic_resize else None,
         rejoin_timeout_s=rejoin_timeout_s,
+        independent=independent,
         print_fn=print_fn,
         summary_writer=summary_writer,
         journal=journal,
@@ -323,6 +325,11 @@ def launch(
     # disables: the round-7 fixed-size gang.
     min_workers: int | None = None,
     rejoin_timeout_s: float = 30.0,
+    # Independent member supervision (round 17, train/elastic.py): a
+    # failed member relaunches ALONE while the others keep running — for
+    # collective-free gangs (the stale-tolerant DiLoCo mailbox). Does
+    # not compose with min_workers resizing.
+    independent: bool = False,
     drive_mode: str | None = None,
     drive_after_s: float = 8.0,
     drive_replace_after_s: float = 10.0,
@@ -379,6 +386,7 @@ def launch(
             poll_interval=poll_interval,
             min_workers=min_workers,
             rejoin_timeout_s=rejoin_timeout_s,
+            independent=independent,
             drive_mode=drive_mode,
             drive_after_s=drive_after_s,
             drive_replace_after_s=drive_replace_after_s,
@@ -467,6 +475,13 @@ def main(argv=None) -> int:
         "resizes without it (default: $DTF_REJOIN_TIMEOUT_S or 30)",
     )
     parser.add_argument(
+        "--independent",
+        action="store_true",
+        help="relaunch failed members ALONE instead of restarting the "
+        "gang (round 17 — collective-free gangs like the stale-tolerant "
+        "DiLoCo mailbox; needs --max-restarts, excludes --min-workers)",
+    )
+    parser.add_argument(
         "--drive-mode",
         choices=("none", "kill-without-replace", "kill-then-replace"),
         default="none",
@@ -505,6 +520,7 @@ def main(argv=None) -> int:
         backoff=args.backoff,
         min_workers=args.min_workers or None,
         rejoin_timeout_s=args.rejoin_timeout_s,
+        independent=args.independent,
         drive_mode=args.drive_mode,
         drive_after_s=args.drive_after_s,
         drive_replace_after_s=args.drive_replace_after_s,

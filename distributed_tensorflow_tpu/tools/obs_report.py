@@ -213,34 +213,51 @@ def summarize(events: list[dict]) -> dict:
         out["serving_cache"] = cache_sec
 
     # -- comm/compute (round 14: dp vs diloco sync-round accounting) ------
-    # Grouped per (mode, sync_every): one journal can span a mode change
-    # (cross-topology resume) or a sync_every change (a POLICY key — a
-    # resume under a new H is explicitly allowed), and a blended ratio
-    # would misstate the H× headline each segment exists to show.
+    # Grouped per (mode, sync_every, delta_dtype): one journal can span a
+    # mode change (cross-topology resume), a sync_every change (a POLICY
+    # key — a resume under a new H is explicitly allowed), or a
+    # delta-compression change, and a blended ratio would misstate the
+    # H× / compression headlines each segment exists to show.
     comm = by_kind.get("comm_stats", [])
     if comm:
         segs: dict = {}
         for e in comm:
-            key = (e.get("mode"), e.get("sync_every"))
+            key = (e.get("mode"), e.get("sync_every"), e.get("delta_dtype"))
             s = segs.setdefault(
                 key,
                 {
                     "mode": key[0],
                     "sync_every": key[1],
+                    "delta_dtype": key[2],
                     "steps": 0,
                     "sync_rounds": 0,
                     "allreduce_bytes": 0,
+                    "payload_bytes": 0,
                 },
             )
             s["steps"] += int(e.get("steps", 0))
             s["sync_rounds"] += int(e.get("sync_rounds", 0))
             s["allreduce_bytes"] += int(e.get("allreduce_bytes", 0))
+            # Round-14 journals predate the payload field: the wire
+            # payload WAS the dense all-reduce.
+            s["payload_bytes"] += int(
+                e.get("payload_bytes", e.get("allreduce_bytes", 0))
+            )
         for s in segs.values():
             # Steps of compute per gang sync round — dp is 1.0 by
             # construction; diloco's value IS the H× comm-reduction
             # headline (measured from the journal, not asserted).
             s["steps_per_round"] = round(
                 s["steps"] / max(s["sync_rounds"], 1), 2
+            )
+            # Round 17: bytes actually on the wire per round, and the
+            # effective compression vs the dense payload (1.0 = full
+            # precision).
+            s["bytes_per_round"] = round(
+                s["payload_bytes"] / max(s["sync_rounds"], 1), 1
+            )
+            s["compression_x"] = round(
+                s["allreduce_bytes"] / max(s["payload_bytes"], 1), 2
             )
         out["comm"] = list(segs.values())
 
@@ -366,6 +383,16 @@ def render_report(summary: dict) -> str:
             f"({cm['steps_per_round']} steps/round), "
             f"{cm['allreduce_bytes']} bytes all-reduced"
         )
+        # Round 17: wire payload beside the dense accounting — only when
+        # the journal carries the compressed-delta fields (old journals
+        # and full-precision runs render exactly the round-14 line).
+        if cm.get("delta_dtype"):
+            lines.append(
+                f"comm payload: {cm['delta_dtype']} deltas — "
+                f"{cm['payload_bytes']} bytes on the wire "
+                f"({cm['bytes_per_round']} bytes/round, "
+                f"{cm['compression_x']}x compressed)"
+            )
     for b in summary.get("bench_points", []):
         lines.append(
             f"bench: {b.get('tool')}/{b.get('name')} = {b.get('value')} "

@@ -51,8 +51,13 @@ import sys
 
 # Units where smaller is better: only an INCREASE past the band fails.
 # ``requests`` counts FAILED requests (serve_bench fleet row): the whole
-# point of that series is catching the count going UP from 0.
-LOWER_IS_BETTER_UNITS = ("ms", "s", "ms/token", "ms/dispatch", "requests")
+# point of that series is catching the count going UP from 0. ``bytes``/
+# ``bytes/token`` are comm payloads (diloco_bench's comm_bytes_per_token,
+# round 17): traffic creeping back UP past the compressed record is the
+# regression.
+LOWER_IS_BETTER_UNITS = (
+    "ms", "s", "ms/token", "ms/dispatch", "requests", "bytes", "bytes/token"
+)
 
 DEFAULT_TOLERANCE = 0.5
 

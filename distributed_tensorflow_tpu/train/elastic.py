@@ -87,6 +87,25 @@ semantics: serving replicas share no collectives, so one death never
 poisons the others, and members fail and restart independently while
 the fleet keeps serving (the paper's async-beats-sync thesis applied
 to the serving tier; docs/serving.md §fleet).
+
+Independent members (round 17)
+------------------------------
+``ElasticGang(independent=True)`` imports that serving-fleet discipline
+back into TRAINING gangs whose members share no collectives — the
+stale-tolerant DiLoCo mailbox gang (train/local_sgd.DeltaExchange):
+members exchange outer deltas through a filesystem mailbox at their own
+pace, so one member's death cannot wedge a peer in a collective. A
+failure verdict therefore relaunches ONLY the failed members (the
+survivors keep training; the relaunched member resumes from its
+checkpoint and rejoins the mailbox at the current round, its first
+contribution staleness-weighted like any late delta). The restart
+budget is charged per relaunch batch and exhaustion fail-stops exactly
+like the gang path; resizing (``min_workers < len(agents)``) does not
+compose — an independent member that never comes back is simply a peer
+that stops posting. Drain/straggler verdicts are off (a slow member
+finishing after its peers is the POINT); health-based verdicts get a
+``member_grace_s`` window after each relaunch so a restarting member's
+silence is not immediately re-verdicted.
 """
 
 from __future__ import annotations
@@ -397,6 +416,8 @@ class ElasticGang:
         drain_timeout: float = 300.0,
         min_workers: int | None = None,
         rejoin_timeout_s: float = 0.0,
+        independent: bool = False,
+        member_grace_s: float = 60.0,
         print_fn=print,
         summary_writer=None,
         journal=None,
@@ -426,6 +447,19 @@ class ElasticGang:
             raise ValueError(
                 f"rejoin_timeout_s must be >= 0, got {self.rejoin_timeout_s}"
             )
+        self.independent = bool(independent)
+        self.member_grace_s = float(member_grace_s)
+        if self.independent and self._elastic:
+            raise ValueError(
+                "independent=True does not compose with shrink-to-fit "
+                "resizing (min_workers < gang size): independent members "
+                "relaunch alone — a member that never comes back is a "
+                "peer that stops posting, not a smaller mesh"
+            )
+        # clock() time until which each member's health verdicts are
+        # suppressed (armed at its independent relaunch — a restarting
+        # member's silence must not read as a fresh death).
+        self._member_grace_until: dict[str, float] = {}
         self.print_fn = print_fn
         self.summary_writer = summary_writer
         # Telemetry (round 10): Restart:/Resize: lines become journal
@@ -514,9 +548,26 @@ class ElasticGang:
                                     "heartbeat_age_ms",
                                     labels={"worker": a.name},
                                 ).set(health.age_ms(wid))
+                            if (
+                                self.independent
+                                and self._member_grace_until.get(a.name, 0)
+                                > self.clock()
+                            ):
+                                continue  # relaunching: not judged yet
                             v = health.classify(wid)
                             if v != "ok":
                                 verdicts[a.name] = v
+                if verdicts and self.independent:
+                    # Independent members (module docstring): relaunch
+                    # ONLY the failed members; survivors keep running.
+                    # Budget exhaustion falls through to the gang-kill
+                    # fail-stop below.
+                    if self.restarts < self.max_restarts:
+                        self._restart_members(verdicts)
+                        continue
+                    for a in self.agents:
+                        a.kill()
+                    raise WorkerFailure(verdicts)
                 # Grow trigger: a benched slot's replacement registered
                 # while the gang ran degraded. Retire the incarnation
                 # (kill + relaunch at the bigger world) — unless someone
@@ -535,8 +586,15 @@ class ElasticGang:
                 # in a collective the finished member will never rejoin
                 # would otherwise beat forever ("ok" to health) and hang
                 # the gang with no verdict at all. Staggered-but-honest
-                # completion finishes well inside the window.
-                if not verdicts and any(rc == 0 for rc in rcs.values()):
+                # completion finishes well inside the window. OFF for
+                # independent members: they share no collectives, and a
+                # slow member finishing long after its peers is exactly
+                # the staleness the mailbox gang tolerates.
+                if (
+                    not verdicts
+                    and not self.independent
+                    and any(rc == 0 for rc in rcs.values())
+                ):
                     if first_done is None:
                         first_done = self.clock()
                     elif self.clock() - first_done > self.drain_timeout:
@@ -568,6 +626,43 @@ class ElasticGang:
         finally:
             if health is not None:
                 health.stop()
+
+    def _restart_members(self, verdicts: dict) -> None:
+        """Independent-mode relaunch: kill + backoff + respawn ONLY the
+        verdicted members (one restart charged for the batch); arms each
+        member's health grace window. Callers have already checked the
+        budget."""
+        self.restarts += 1
+        self.metrics.counter("restarts_total").inc()
+        delay = resilience.backoff_delay(
+            self.restarts - 1,
+            backoff=self.backoff,
+            max_backoff=self.max_backoff,
+            jitter=self.jitter,
+            rng=self.rng,
+        )
+        lifecycle_event(
+            "restart",
+            print_fn=self.print_fn,
+            journal=self.journal,
+            writer=self.summary_writer,
+            scalar=("restart", float(self.restarts), self.restarts),
+            restart=self.restarts,
+            max_restarts=self.max_restarts,
+            cause=str(WorkerFailure(verdicts)),
+            backoff_s=float(delay),
+            independent=True,
+            members=sorted(verdicts),
+        )
+        failed = [a for a in self.active if a.name in verdicts]
+        for a in failed:
+            a.kill()
+        self.sleep(delay)
+        for a in failed:
+            a.start()
+            self._member_grace_until[a.name] = (
+                self.clock() + self.member_grace_s
+            )
 
     def _plan_topology(self, exc: WorkerFailure) -> None:
         """Recompute the roster after a failure verdict (no-op unless
@@ -690,6 +785,13 @@ class ElasticGang:
                 "world_size", float(len(self.active)), 0
             )
         try:
+            if self.independent:
+                # One incarnation for the whole run: member failures are
+                # handled INSIDE _cycle (relaunch-alone) under the same
+                # budget; a WorkerFailure escaping means the budget is
+                # spent — the except below fail-stops it like an
+                # exhausted retry loop.
+                return self._cycle()
             return resilience.retry(
                 self._cycle,
                 attempts=self.max_restarts + 1,

@@ -136,6 +136,7 @@ class LMTrainer:
         tokenizer=None,
         journal=None,
         metrics: MetricsRegistry | None = None,
+        delta_exchange=None,
     ):
         self.datasets = datasets
         self.config = config or TrainConfig()
@@ -181,6 +182,26 @@ class LMTrainer:
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.spans = SpanRecorder(journal=self.journal)
         self._ragged = datasets.train.lengths is not None
+        # Stale-tolerant mailbox gang (round 17, local_sgd.DeltaExchange):
+        # one member per process, outer rounds exchanged host-side with
+        # staleness-weighted peer deltas. The exchange's own knobs must
+        # agree with the config's (config_from_env is the single config
+        # surface — a drifted pair would compress with one dtype and
+        # decode with another).
+        self.delta_exchange = delta_exchange
+        if delta_exchange is not None:
+            if delta_exchange.delta_dtype != self.config.delta_dtype:
+                raise ValueError(
+                    f"delta_exchange.delta_dtype="
+                    f"{delta_exchange.delta_dtype!r} disagrees with "
+                    f"config.delta_dtype={self.config.delta_dtype!r}"
+                )
+            if delta_exchange.stale_limit != self.config.stale_limit:
+                raise ValueError(
+                    f"delta_exchange.stale_limit="
+                    f"{delta_exchange.stale_limit} disagrees with "
+                    f"config.stale_limit={self.config.stale_limit}"
+                )
         self.mode = self._resolve_mode()
 
         self.state = self._init_state(model.init(seed=self.config.seed))
@@ -251,12 +272,26 @@ class LMTrainer:
                     # verbatim — the next outer round's pseudo-gradient
                     # is computed against the SAVED anchor over the
                     # survivor gang ("the outer update proceeds over
-                    # survivors", docs/parallelism.md §local-SGD).
+                    # survivors", docs/parallelism.md §local-SGD). The
+                    # round-17 lever state (EF residual, in-flight
+                    # delta) is world-invariant too and carries the same
+                    # way — when both sides run the lever; a lever
+                    # flipped across the resize keeps the target's fresh
+                    # zeros (residual) / drops the saved one (the
+                    # compression error it deferred is lost once, not
+                    # corrupted).
+                    carry = dict(
+                        theta=raw.opt_state.theta,
+                        momentum=raw.opt_state.momentum,
+                    )
+                    if self.config.delta_dtype and src.get(
+                        "delta_dtype"
+                    ) == self.config.delta_dtype:
+                        carry["residual"] = raw.opt_state.residual
+                    if self.config.delta_overlap and src.get("overlap"):
+                        carry["inflight"] = raw.opt_state.inflight
                     restored = restored._replace(
-                        opt_state=restored.opt_state._replace(
-                            theta=raw.opt_state.theta,
-                            momentum=raw.opt_state.momentum,
-                        )
+                        opt_state=restored.opt_state._replace(**carry)
                     )
                 self.state = self._place_state(restored)
                 self.start_step = step
@@ -306,6 +341,11 @@ class LMTrainer:
             # dispatch latency per step (CLAUDE.md); scan the epoch.
             scan_epoch = jax.default_backend() != "cpu"
         self._scan = bool(scan_epoch)
+        if self.delta_exchange is not None:
+            # The mailbox round is a HOST decision point every
+            # sync_every steps (post + gather + apply) — it cannot ride
+            # inside a scanned-epoch dispatch.
+            self._scan = False
 
         self.last_cost = None
         self._epoch_costs = None  # per-step costs of the last scanned epoch
@@ -352,6 +392,13 @@ class LMTrainer:
                 f"unknown dp_mode {cfg.dp_mode!r}; "
                 "replicated|zero|tp|ep|pp|sp|diloco"
             )
+        if cfg.dp_mode != "diloco" and (
+            self.delta_exchange is not None
+        ):
+            raise ValueError(
+                "delta_exchange is the diloco mailbox gang: it requires "
+                f"dp_mode='diloco', got {cfg.dp_mode!r}"
+            )
         if cfg.dp_mode == "diloco":
             if not cfg.sync:
                 raise ValueError(
@@ -360,6 +407,35 @@ class LMTrainer:
                     "use sync=False + async_avg_every for the HOGWILD "
                     "emulation instead"
                 )
+            if self.delta_exchange is not None:
+                # Mailbox gang: one member per PROCESS — the gang is the
+                # set of processes sharing the exchange directory, not a
+                # mesh axis or an in-process emulation.
+                if self.mesh is not None:
+                    raise ValueError(
+                        "delta_exchange runs one gang member per process "
+                        "(the outer round is a host decision point): "
+                        "pass mesh=None with diloco_workers=1"
+                    )
+                if cfg.diloco_workers != 1:
+                    raise ValueError(
+                        "delta_exchange needs diloco_workers=1 (each "
+                        f"process is ONE member), got {cfg.diloco_workers}"
+                    )
+                if cfg.delta_overlap:
+                    raise ValueError(
+                        "delta_overlap does not compose with "
+                        "delta_exchange: the mailbox gang never waits on "
+                        "the exchange — staleness tolerance IS its "
+                        "overlap"
+                    )
+                if cfg.epochs_per_dispatch:
+                    raise ValueError(
+                        "epochs_per_dispatch does not compose with "
+                        "delta_exchange: the outer round is a host "
+                        "decision point inside every epoch"
+                    )
+                return "diloco"
             if self.mesh is not None:
                 if self.data_axis not in self.mesh.shape:
                     raise ValueError(
@@ -559,10 +635,21 @@ class LMTrainer:
             )
 
             kw = dict(
-                sync_every=self.config.sync_every,
+                # Mailbox gang: the in-graph exchange must never fire —
+                # the boundary is a host decision point (an unreachable
+                # period, the async avg_every=0 trick); the engine still
+                # allocates the EF residual (it checkpoints with the
+                # state), which the host round updates.
+                sync_every=(
+                    (1 << 30)
+                    if self.delta_exchange is not None
+                    else self.config.sync_every
+                ),
                 outer_lr=self.config.outer_lr,
                 outer_momentum=self.config.outer_momentum,
                 ragged=self._ragged,
+                delta_dtype=self.config.delta_dtype,
+                overlap=self.config.delta_overlap,
             )
             if self.mesh is not None:
                 init_state, self._diloco_mapped = make_lm_diloco_parts(
@@ -650,16 +737,22 @@ class LMTrainer:
             )
         if self.mode == "diloco":
             # Worker copies + inner opt slots stacked over the gang; the
-            # outer state (θ_start, momentum) replicated — it is ONE
-            # gang-level quantity, not per-worker.
+            # outer state (θ_start, momentum, and the round-17 EF
+            # residual / in-flight delta when present) replicated — each
+            # is ONE gang-level quantity, not per-worker.
             stacked = NamedSharding(self.mesh, P(self.data_axis))
             d = state.opt_state
+            put_repl = lambda t: (  # noqa: E731 — None = lever off
+                None if t is None else jax.device_put(t, repl)
+            )
             return TrainState(
                 jax.device_put(state.params, stacked),
                 d._replace(
                     inner=jax.device_put(d.inner, stacked),
                     theta=jax.device_put(d.theta, repl),
                     momentum=jax.device_put(d.momentum, repl),
+                    residual=put_repl(d.residual),
+                    inflight=put_repl(d.inflight),
                 ),
                 jax.device_put(state.step, repl),
             )
@@ -719,12 +812,29 @@ class LMTrainer:
         if self.mode == "async":
             meta["replicas"] = int(self.mesh.shape[self.data_axis])
         if self.mode == "diloco":
-            meta["replicas"] = int(self._gang_size())
+            # replicas = the LOCAL stacked width (what the saved arrays'
+            # leading axis is): the mailbox gang stacks ONE member per
+            # process regardless of how many peers share the exchange.
+            meta["replicas"] = (
+                1
+                if self.delta_exchange is not None
+                else int(self._gang_size())
+            )
             # POLICY key (like world/global_batch): the outer-round
             # length is a schedule knob, not a shape — layout_shape
             # ignores it, so resuming under a different H keeps the
             # bitwise same-layout path.
             meta["sync_every"] = int(self.config.sync_every)
+            # Round-17 lever keys, present only when ON (lever-off metas
+            # stay byte-identical to round 14). These ARE shape keys
+            # (supervisor.LAYOUT_SHAPE_KEYS): the EF residual and the
+            # in-flight delta are extra DiLoCoState nodes, so flipping a
+            # lever between save and resume must route through the
+            # cross-topology path, never the bitwise one.
+            if self.config.delta_dtype:
+                meta["delta_dtype"] = self.config.delta_dtype
+            if self.config.delta_overlap:
+                meta["overlap"] = True
         meta["world"] = int(
             1 if self.mesh is None else self.mesh.size
         )
@@ -737,6 +847,8 @@ class LMTrainer:
         if self.mesh is not None and self.data_axis in self.mesh.shape:
             return int(self.mesh.shape[self.data_axis])
         if self.mode == "diloco":
+            if self.delta_exchange is not None:
+                return int(self.delta_exchange.world)
             return int(self.config.diloco_workers)
         return 1
 
@@ -809,10 +921,21 @@ class LMTrainer:
                 )
 
                 # Outer anchor + momentum carry DENSE parameter shapes
-                # regardless of the gang size (world-invariant).
+                # regardless of the gang size (world-invariant) — and so
+                # do the round-17 EF residual / in-flight delta, present
+                # exactly when the saving config had the lever on (the
+                # sidecar's shape keys say so).
                 return TrainState(
                     stack(params),
-                    DiLoCoState(stack(opt), params, params),
+                    DiLoCoState(
+                        stack(opt),
+                        params,
+                        params,
+                        params if src.get("delta_dtype") else None,
+                        {"delta": params, "landing": params}
+                        if src.get("overlap")
+                        else None,
+                    ),
                     step,
                 )
             return TrainState(stack(params), stack(opt), step)
@@ -894,19 +1017,34 @@ class LMTrainer:
                 DiLoCoState,
             )
 
-            n = self._gang_size()
+            # Mailbox gangs stack ONE member per process regardless of
+            # the gang's world size.
+            n = 1 if self.delta_exchange is not None else self._gang_size()
             bcast = lambda t: jax.tree.map(  # noqa: E731
                 lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), t
             )
+            zeros = lambda: jax.tree.map(  # noqa: E731
+                jnp.zeros_like, c.params
+            )
             # Fresh outer round from the canonical point: anchor at the
-            # restored params, zero momentum (the diloco→diloco resize
-            # overwrites both with the saved outer state — __init__).
+            # restored params, zero momentum — and zero EF residual /
+            # in-flight delta when this trainer's levers are on (a dense
+            # source has none to carry; the diloco→diloco resize
+            # overwrites all of them with the saved outer state —
+            # __init__).
             return TrainState(
                 bcast(c.params),
                 DiLoCoState(
                     bcast(c.opt_state),
                     c.params,
-                    jax.tree.map(jnp.zeros_like, c.params),
+                    zeros(),
+                    zeros() if self.config.delta_dtype else None,
+                    # Nothing in flight; every copy lands on the
+                    # restored point (a copy — aliasing theta would
+                    # donate the same buffer twice under the scan).
+                    {"delta": zeros(), "landing": jax.tree.map(jnp.copy, c.params)}
+                    if self.config.delta_overlap
+                    else None,
                 ),
                 c.step,
             )
@@ -1191,6 +1329,12 @@ class LMTrainer:
         inside it."""
         from distributed_tensorflow_tpu.observability import tracing
 
+        if self.delta_exchange is not None:
+            raise ValueError(
+                "run_compiled does not compose with delta_exchange: the "
+                "mailbox round is a host decision point inside every "
+                "epoch; use run()"
+            )
         with tracing.trace(tracing.current_trace()):
             return self._run_compiled(
                 epochs, epoch_offset=epoch_offset, finalize=finalize
@@ -1502,6 +1646,10 @@ class LMTrainer:
                 self.state = TrainState(
                     params, opt_state, self.state.step + 1
                 )
+                if self.delta_exchange is not None:
+                    # Host-side count: the device scalar would cost a
+                    # blocking D2H fetch per inner step.
+                    self._maybe_mailbox_round(step_before + i + 1)
                 self.last_cost = cost
                 if self.summary_writer is not None and self.is_chief:
                     summaries.append((step_before + i + 1, cost))
@@ -1553,13 +1701,21 @@ class LMTrainer:
             rounds = steps
         if not hasattr(self, "_dense_param_nbytes"):
             from distributed_tensorflow_tpu.train.local_sgd import (
+                delta_payload_nbytes,
                 params_nbytes,
             )
 
-            self._dense_param_nbytes = params_nbytes(
-                jax.eval_shape(lambda: self.model.init(seed=0))
+            shapes = jax.eval_shape(lambda: self.model.init(seed=0))
+            self._dense_param_nbytes = params_nbytes(shapes)
+            # What ONE round actually puts on the wire (round 17): the
+            # dense payload, or its per-tensor-quantized form under
+            # delta_dtype. dp always moves dense gradients.
+            self._delta_payload_nbytes = delta_payload_nbytes(
+                shapes,
+                self.config.delta_dtype if self.mode == "diloco" else None,
             )
         nbytes = rounds * self._dense_param_nbytes
+        payload = rounds * self._delta_payload_nbytes
         self.journal.emit(
             "comm_stats",
             epoch=int(epoch),
@@ -1568,10 +1724,125 @@ class LMTrainer:
             sync_every=int(h),
             sync_rounds=int(rounds),
             allreduce_bytes=int(nbytes),
+            payload_bytes=int(payload),
+            delta_dtype=(
+                self.config.delta_dtype if self.mode == "diloco" else None
+            ),
+            overlap=bool(
+                self.mode == "diloco" and self.config.delta_overlap
+            ),
             workers=int(self._gang_size()),
         )
         self.metrics.counter("sync_rounds_total").inc(int(rounds))
         self.metrics.counter("allreduce_bytes_total").inc(int(nbytes))
+        self.metrics.counter("payload_bytes_total").inc(int(payload))
+
+    def _maybe_mailbox_round(self, count: int) -> None:
+        """Host-side outer round of the stale-tolerant mailbox gang
+        (round 17; ``local_sgd.DeltaExchange``), fired on the same
+        cadence as the in-graph exchange (step ``t`` fires iff ``(t+1) %
+        sync_every == 0`` — ``count`` is the HOST-side post-step counter:
+        fetching ``int(self.state.step)`` here would block on a device
+        scalar every inner step, ~100 ms of pure synchronization per
+        step on the tunneled TPU). Post this member's (EF-compressed)
+        pseudo-gradient, assemble the staleness-weighted mean from
+        whatever peers have posted — NEVER waiting — and apply the outer
+        update locally; ``outer_lr=None`` scales by the round's ACTUAL
+        total contributor weight (the variable-gang form of the η=N
+        convention — see ``DeltaExchange.weighted_delta``). A
+        ``delta_exchange`` journal event records the contributors and
+        their ages; the on-disk payload size is the measured wire
+        cost."""
+        h = self.config.sync_every
+        if h < 1 or count % h:
+            return
+        from distributed_tensorflow_tpu.train.local_sgd import (
+            DiLoCoState,
+            outer_apply,
+            resolve_outer_lr,
+        )
+
+        t0 = time.perf_counter()
+        round_idx = count // h - 1  # rounds are 0-based
+        d: DiLoCoState = self.state.opt_state
+        p = jax.tree.map(lambda x: x[0], self.state.params)
+        delta = jax.tree.map(lambda t, q: t - q, d.theta, p)
+        leaves, treedef = jax.tree.flatten(delta)
+        np_leaves = [np.asarray(jax.device_get(x)) for x in leaves]
+        residual = d.residual
+        if self.config.delta_dtype is not None:
+            r_leaves = [
+                np.asarray(jax.device_get(x))
+                for x in jax.tree.leaves(residual)
+            ]
+            corr = [a + b for a, b in zip(np_leaves, r_leaves)]
+            # post() returns the DEQUANTIZED wire values — the residual
+            # must see what peers read, not what we meant to send.
+            own = self.delta_exchange.post(round_idx, corr)
+            residual = jax.tree.unflatten(
+                treedef,
+                [
+                    jnp.asarray(a - b)
+                    for a, b in zip(corr, own)
+                ],
+            )
+        else:
+            own = self.delta_exchange.post(round_idx, np_leaves)
+        mean, total_weight, contributors = (
+            self.delta_exchange.weighted_delta(round_idx, own)
+        )
+        mean_delta = jax.tree.unflatten(
+            treedef, [jnp.asarray(x) for x in mean]
+        )
+        # outer_lr=None → the round's actual total contributor weight,
+        # NOT the fixed world size: η=N compensates an exact 1/N mean of
+        # N contributions; a member alone in the mailbox applies its own
+        # delta exactly once (weighted_delta docstring).
+        eta = (
+            float(total_weight)
+            if self.config.outer_lr is None
+            else resolve_outer_lr(self.config.outer_lr, self._gang_size())
+        )
+        theta2, m2 = outer_apply(
+            d.theta,
+            mean_delta,
+            d.momentum,
+            outer_lr=eta,
+            outer_momentum=self.config.outer_momentum,
+        )
+        new_p = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (1,) + x.shape), theta2
+        )
+        self.state = TrainState(
+            new_p,
+            d._replace(theta=theta2, momentum=m2, residual=residual),
+            self.state.step,
+        )
+        stale = [c for c in contributors if c[1] > 0]
+        self.journal.emit(
+            "delta_exchange",
+            round=int(round_idx),
+            rank=int(self.delta_exchange.rank),
+            world=int(self.delta_exchange.world),
+            contributors=[
+                [int(r), int(age), float(w)] for r, age, w in contributors
+            ],
+            total_weight=float(total_weight),
+            outer_lr=float(eta),
+            stale_contributions=len(stale),
+            delta_dtype=self.config.delta_dtype,
+            payload_nbytes=self.delta_exchange.payload_nbytes(round_idx),
+            # Host cost of the whole boundary (post + gather + apply) —
+            # the gang bench's outer-round wall share reads THIS: the
+            # mailbox never waits on a peer, so this is the entire
+            # non-overlapped cost of an outer round.
+            wall_ms=round((time.perf_counter() - t0) * 1000, 3),
+        )
+        self.metrics.counter("mailbox_rounds_total").inc()
+        if stale:
+            self.metrics.counter("stale_contributions_total").inc(
+                len(stale)
+            )
 
     def _observe_step_time(self, avg_ms: float) -> None:
         """Per-epoch average step time into the metrics registry (mirror

@@ -51,7 +51,12 @@ _STEP_DIR = re.compile(r"^step_(\d+)$")
 # sidecar is restore POLICY — e.g. round 8's "world"/"global_batch", which
 # the elastic resize path reads to preserve the global batch across a
 # world-size change — and must not break same-layout compatibility checks.
-LAYOUT_SHAPE_KEYS = ("mode", "replicas", "stages")
+# Round 17: "delta_dtype"/"overlap" are SHAPE keys — the compressed-delta
+# residual and the in-flight delta are extra pytree nodes in DiLoCoState,
+# so a checkpoint written with a lever on has a different structure than
+# one without (the keys are only present when the lever is on, so old
+# sidecars keep comparing equal to lever-off metas).
+LAYOUT_SHAPE_KEYS = ("mode", "replicas", "stages", "delta_dtype", "overlap")
 
 
 def layout_shape(layout: dict | None) -> dict:

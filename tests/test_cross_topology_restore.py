@@ -76,6 +76,25 @@ _MODES = {
         dict(dp_mode="diloco", sync_every=3, outer_lr=1.0),
         lambda: make_mesh((4,), ("data",)),
     ),
+    # Round 17: both streaming/compressed levers armed — the EF residual
+    # and the in-flight {delta, landing} state ride DiLoCoState (extra
+    # pytree nodes ⇒ "delta_dtype"/"overlap" are SHAPE keys in the
+    # layout sidecar). sync_every=3 keeps the checkpoint mid-round with
+    # a live residual, like the plain diloco fixtures.
+    "diloco_q": (
+        dict(
+            dp_mode="diloco", sync_every=3, outer_lr=1.0,
+            outer_momentum=0.4, delta_dtype="int8", delta_overlap=True,
+        ),
+        lambda: make_mesh((8,), ("data",)),
+    ),
+    "diloco_q4": (
+        dict(
+            dp_mode="diloco", sync_every=3, outer_lr=1.0,
+            outer_momentum=0.4, delta_dtype="int8", delta_overlap=True,
+        ),
+        lambda: make_mesh((4,), ("data",)),
+    ),
 }
 
 
@@ -255,6 +274,81 @@ def test_cross_world_diloco_resize_carries_outer_state(tmp_path):
     res = tr_b.run()
     assert np.isfinite(res["perplexity"])
     assert tr_b.global_step == 2 * tr_a.global_step
+
+
+def test_cross_world_diloco_resize_carries_lever_state(tmp_path):
+    # Round-17 acceptance: the error-feedback residual AND the in-flight
+    # exchange state ({delta, landing}) survive a diloco→diloco
+    # cross-world resize BITWISE — world-invariant dense trees, exactly
+    # like θ_start/momentum (the vmapped twins live in
+    # tests/test_local_sgd.py and run on degraded containers).
+    tr_a = _trainer("diloco_q", tmp_path)
+    tr_a.run()
+    assert any(
+        float(np.abs(np.asarray(jax.device_get(l))).max()) > 0
+        for l in jax.tree.leaves(tr_a.state.opt_state.residual)
+    )
+    tr_b = _trainer("diloco_q4", tmp_path)
+    assert tr_b.start_step == tr_a.global_step
+    _assert_trees_equal(
+        tr_b.state.opt_state.theta, tr_a.state.opt_state.theta
+    )
+    _assert_trees_equal(
+        tr_b.state.opt_state.momentum, tr_a.state.opt_state.momentum
+    )
+    _assert_trees_equal(
+        tr_b.state.opt_state.residual, tr_a.state.opt_state.residual
+    )
+    _assert_trees_equal(
+        tr_b.state.opt_state.inflight, tr_a.state.opt_state.inflight
+    )
+    res = tr_b.run()
+    assert np.isfinite(res["perplexity"])
+    assert tr_b.global_step == 2 * tr_a.global_step
+
+
+def test_dense_to_lever_diloco_restores_zero_lever_state(tmp_path):
+    # dense → diloco-with-levers: a fresh outer round — zero residual,
+    # nothing in flight, landing at the restored canonical point; the
+    # sidecar of the SOURCE carries no lever keys, so the restore routes
+    # through the cross-topology path by mode alone.
+    tr_a = _trainer("dp", tmp_path)
+    tr_a.run()
+    tr_b = _trainer("diloco_q", tmp_path)
+    assert tr_b.start_step == tr_a.global_step
+    assert all(
+        float(np.abs(np.asarray(jax.device_get(l))).max()) == 0
+        for l in jax.tree.leaves(tr_b.state.opt_state.residual)
+    )
+    assert all(
+        float(np.abs(np.asarray(jax.device_get(l))).max()) == 0
+        for l in jax.tree.leaves(tr_b.state.opt_state.inflight["delta"])
+    )
+    canonical = jax.device_get(_canonical_of(tr_a))
+    _assert_trees_equal(
+        tr_b.state.opt_state.inflight["landing"], canonical.params
+    )
+    res = tr_b.run()
+    assert np.isfinite(res["perplexity"])
+
+
+@pytest.mark.heavy  # round-14 audit: compile-tail; the resize-carry case is the fast-tier representative
+def test_lever_sidecar_keys_are_shape_keys(tmp_path):
+    # A lever flipped between save and resume must route cross-topology
+    # (the state STRUCTURE differs), never the bitwise path — and the
+    # lever-off diloco sidecar must carry NO round-17 keys (round-14
+    # metas byte-identical).
+    tr_a = _trainer("diloco_q", tmp_path)
+    tr_a.run()
+    meta = tr_a.supervisor.saved_layout(tr_a.supervisor.latest_step())
+    assert meta["delta_dtype"] == "int8" and meta["overlap"] is True
+    tr_b = _trainer("diloco", tmp_path)  # levers off: cross path
+    assert tr_b.start_step == tr_a.global_step
+    assert tr_b.state.opt_state.residual is None
+    assert tr_b.state.opt_state.inflight is None
+    _assert_trees_equal(
+        tr_b.state.opt_state.theta, tr_a.state.opt_state.theta
+    )
 
 
 def test_layout_sidecar_written_and_read(tmp_path):

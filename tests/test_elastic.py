@@ -237,6 +237,88 @@ def test_gang_staggered_completion_inside_drain_window_is_clean():
     assert gang.restarts == 0
 
 
+def test_independent_member_relaunches_alone():
+    # Round 17: worker1 dies rc=9; with independent=True ONLY worker1
+    # relaunches — worker0's incarnation-0 process keeps running to its
+    # clean exit (never killed), no gang restart.
+    t = FakeTable({
+        0: [[None, None, None, None, 0]],
+        1: [[None, 9], [None, 0]],
+    })
+    lines = []
+    gang = t.gang(2, max_restarts=2, independent=True, print_fn=lines.append)
+    assert gang.run() == 0
+    assert gang.restarts == 1
+    assert t.spawned == [(0, 0), (1, 0), (1, 1)]  # worker0 spawned ONCE
+    assert not t.procs[(0, 0)].killed
+    (line,) = [l for l in lines if l.startswith("Restart: restart=")]
+    assert "independent=True" in line and "members=[worker1]" in line
+
+
+def test_independent_budget_exhausted_fails_stop():
+    # Budget spent by per-member relaunches: the next failure kills the
+    # survivors and fail-stops (rc 1) like an exhausted gang retry loop.
+    t = FakeTable({
+        0: [[None, None, None, None, None, None]],
+        1: [[None, 7], [None, 7]],
+    })
+    lines = []
+    gang = t.gang(2, max_restarts=1, independent=True, print_fn=lines.append)
+    assert gang.run() == 1
+    assert gang.restarts == 1
+    assert t.spawned == [(0, 0), (1, 0), (1, 1)]
+    assert t.procs[(0, 0)].killed  # fail-stop kills the survivor
+    assert any("budget exhausted" in l for l in lines)
+
+
+def test_independent_skips_straggler_verdict():
+    # A member finishing long after its peers is the POINT of a
+    # collective-free gang — no drain-window straggler kill.
+    t = FakeTable({0: [[0]], 1: [[None] * 50 + [0]]})
+    now = {"t": 0.0}
+    gang = t.gang(
+        2, max_restarts=1, independent=True, poll_interval=1.0,
+        drain_timeout=5.0, clock=lambda: now["t"],
+        print_fn=lambda *a: None,
+    )
+    gang.sleep = lambda s: now.__setitem__("t", now["t"] + max(s, 1.0))
+    assert gang.run() == 0
+    assert gang.restarts == 0
+    assert not t.procs[(1, 0)].killed
+
+
+def test_independent_health_grace_after_relaunch():
+    # After an independent relaunch the member's health verdicts are
+    # suppressed for member_grace_s — a restarting member's silence must
+    # not be re-verdicted into a restart loop.
+    class DeadHealth:
+        def classify(self, wid):
+            return "dead" if wid == 1 else "ok"
+
+        def stop(self):
+            pass
+
+    t = FakeTable({0: [[0]], 1: [[None], [None, None, 0]]})
+    now = {"t": 0.0}
+    gang = t.gang(
+        2, max_restarts=3, independent=True, member_grace_s=100.0,
+        health_factory=lambda: DeadHealth(), poll_interval=1.0,
+        clock=lambda: now["t"], print_fn=lambda *a: None,
+    )
+    gang.sleep = lambda s: now.__setitem__("t", now["t"] + max(s, 1.0))
+    assert gang.run() == 0
+    # Exactly one restart: the relaunched member finished inside its
+    # grace window despite the detector still reporting it dead.
+    assert gang.restarts == 1
+    assert t.spawned == [(0, 0), (1, 0), (1, 1)]
+
+
+def test_independent_refuses_resize_composition():
+    t = FakeTable({0: [[0]], 1: [[0]], 2: [[0]]})
+    with pytest.raises(ValueError, match="independent"):
+        t.gang(3, max_restarts=2, independent=True, min_workers=1)
+
+
 def test_gang_kills_workers_when_detector_setup_fails():
     """A non-verdict failure (detector port grabbed between incarnations,
     spawn raising) must not orphan already-started workers: they hold the

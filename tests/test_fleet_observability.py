@@ -734,6 +734,70 @@ def test_gate_band_logic_directions():
     assert res["checked"] == 0 and res["skipped"][0]["name"] == "solo"
 
 
+def test_gate_bytes_units_fail_high():
+    # Round 17: comm payloads ("bytes", "bytes/token") are
+    # lower-is-better like ms/s — traffic creeping back UP past the
+    # compressed record is the regression; a further reduction never is.
+    mk = lambda vals, unit: [  # noqa: E731
+        (i, v, unit) for i, v in enumerate(vals)
+    ]
+    res = regression_gate.check_series(
+        {("diloco_bench", "comm_bytes_per_token"): mk(
+            [3.4, 3.4, 13.5], "bytes/token"
+        )},
+        tolerance=0.5,
+    )
+    [f] = res["failures"]
+    assert f["direction"] == "above" and f["unit"] == "bytes/token"
+    assert not regression_gate.check_series(
+        {("diloco_bench", "comm_bytes_per_token"): mk(
+            [3.4, 3.4, 0.9], "bytes/token"
+        )},
+        tolerance=0.5,
+    )["failures"]
+    res = regression_gate.check_series(
+        {("t", "payload"): mk([100.0, 100.0, 400.0], "bytes")},
+        tolerance=0.5,
+    )
+    assert res["failures"][0]["direction"] == "above"
+
+
+def test_obs_report_comm_payload_rendering():
+    # Round 17: bytes/round + effective compression beside the
+    # steps-per-round line; full-precision segments render exactly the
+    # round-14 surface (no payload line).
+    events = [
+        {
+            "kind": "comm_stats", "epoch": e, "mode": "diloco",
+            "steps": 10, "sync_every": 4, "sync_rounds": r,
+            "allreduce_bytes": r * 1000, "payload_bytes": r * 250,
+            "delta_dtype": "int8", "overlap": False, "workers": 4,
+        }
+        for e, r in ((0, 2), (1, 3))
+    ] + [
+        {
+            "kind": "comm_stats", "epoch": 2, "mode": "dp", "steps": 10,
+            "sync_every": 1, "sync_rounds": 10,
+            "allreduce_bytes": 10_000, "workers": 4,
+        }
+    ]
+    summary = obs_report.summarize(events)
+    segs = {s["mode"]: s for s in summary["comm"]}
+    assert segs["diloco"]["payload_bytes"] == 1250
+    assert segs["diloco"]["bytes_per_round"] == 250.0
+    assert segs["diloco"]["compression_x"] == 4.0
+    # Pre-round-17 journals: payload defaults to the dense all-reduce.
+    assert segs["dp"]["payload_bytes"] == 10_000
+    assert segs["dp"]["compression_x"] == 1.0
+    report = obs_report.render_report(summary)
+    assert (
+        "comm payload: int8 deltas — 1250 bytes on the wire "
+        "(250.0 bytes/round, 4.0x compressed)" in report
+    )
+    # The dp segment renders only the round-14 line.
+    assert report.count("comm payload:") == 1
+
+
 def test_gate_fails_on_injected_out_of_band_point(tmp_path, capsys):
     """Acceptance: nonzero exit naming the offending (tool, metric)."""
     path = str(tmp_path / "events.jsonl")

@@ -17,7 +17,32 @@ Equality chain anchoring the mode (module docstring of local_sgd.py):
 The vmapped single-device engine (the bench/degraded-container gang)
 shares the inner-step function with the mesh engine and is pinned
 against the same anchors — those tests run even where the mesh APIs are
-unavailable (jax 0.4.37 containers)."""
+unavailable (jax 0.4.37 containers).
+
+Round-17 extension of the chain (streaming/compressed levers, all
+default-off):
+
+4. ``delta_dtype=None, delta_overlap=False, stale_limit=0`` routes
+   through a trace-time Python branch straight into the SAME
+   ``outer_update`` call as round 14 — anchors 1-3 above run unchanged
+   through the new code, which IS the bitwise pin; the lever state
+   (``DiLoCoState.residual``/``inflight``) is ``None`` (empty pytree
+   nodes), so checkpoints carry byte-identical leaves and the layout
+   sidecar gains no keys (pinned below);
+5. ``delta_dtype=`` compresses the outer pseudo-gradient per-tensor with
+   error feedback: Δ̂ = Q(Δ + r), r' = (Δ + r) − Δ̂ — the applied delta
+   is exactly what a peer would decode from the wire (the numpy mailbox
+   codec is pinned bit-equal to the jax quantizer), and the residual
+   algebra is pinned exactly;
+6. ``delta_overlap=True`` applies the in-flight delta one round late
+   (streaming-DiLoCo): pseudo-gradient = mean round MOVEMENT (landing
+   based), workers MERGE toward the stale-applied anchor
+   (``OVERLAP_MERGE``) — the one-round-late apply and the merge
+   arithmetic are pinned against hand-computed recurrences;
+7. the stale-tolerant mailbox (``DeltaExchange``) weights a peer delta
+   ``age`` rounds old by ``1/(1+age)`` and never waits — a member alone
+   in the mailbox still completes every round (pinned at the trainer
+   level; the throttled-gang proof is RUN_SLOW fault injection)."""
 
 import numpy as np
 import pytest
@@ -391,6 +416,36 @@ def test_config_from_env_diloco_knobs(monkeypatch):
         config_from_env()
 
 
+def test_config_from_env_round17_knobs(monkeypatch):
+    # Round-8 pattern: valid values land, empty = unset-style off, and a
+    # scheduler typo fails the launch loudly instead of silently training
+    # with defaults.
+    from distributed_tensorflow_tpu.launch import config_from_env
+
+    base = TrainConfig(dp_mode="diloco", diloco_workers=4)
+    monkeypatch.setenv("DTF_DELTA_DTYPE", "int8")
+    monkeypatch.setenv("DTF_STALE_LIMIT", "3")
+    cfg = config_from_env(base)
+    assert cfg.delta_dtype == "int8" and cfg.stale_limit == 3
+    monkeypatch.setenv("DTF_DELTA_DTYPE", "")  # empty → full precision
+    assert config_from_env(base).delta_dtype is None
+    monkeypatch.setenv("DTF_DELTA_DTYPE", "int4")
+    with pytest.raises(ValueError, match="delta_dtype"):
+        config_from_env(base)
+    monkeypatch.setenv("DTF_DELTA_DTYPE", "fp8")
+    monkeypatch.setenv("DTF_STALE_LIMIT", "many")
+    with pytest.raises(ValueError, match="DTF_STALE_LIMIT"):
+        config_from_env(base)
+    monkeypatch.setenv("DTF_STALE_LIMIT", "-1")
+    with pytest.raises(ValueError, match="stale_limit"):
+        config_from_env(base)
+    # A lever exported at a NON-diloco job fails the launch rather than
+    # silently training full-precision with the knob ignored.
+    monkeypatch.setenv("DTF_STALE_LIMIT", "3")
+    with pytest.raises(ValueError, match="silently ignored"):
+        config_from_env()
+
+
 # -- mesh engine (shard_map gang — skips on degraded jax) -------------------
 
 
@@ -673,6 +728,630 @@ def test_ckpt_diloco_to_dense_and_dense_to_diloco(tmp_path):
         for l in jax.tree.leaves(c.state.opt_state.momentum)
     )
     res = c.run()
+    assert np.isfinite(res["perplexity"])
+
+
+# -- round 17: compressed / overlapped / stale levers -----------------------
+
+
+def test_outer_apply_is_outer_update_tail():
+    from distributed_tensorflow_tpu.train.local_sgd import outer_apply
+
+    rng = np.random.default_rng(3)
+    theta = jnp.asarray(rng.standard_normal((8,)).astype(np.float32))
+    mean_p = jnp.asarray(rng.standard_normal((8,)).astype(np.float32))
+    m = jnp.asarray(rng.standard_normal((8,)).astype(np.float32))
+    for mu, eta, nesterov in [(0.9, 0.7, True), (0.5, 2.0, False)]:
+        t_u, m_u = outer_update(
+            theta, mean_p, m, outer_lr=eta, outer_momentum=mu,
+            nesterov=nesterov,
+        )
+        t_a, m_a = outer_apply(
+            theta, theta - mean_p, m, outer_lr=eta, outer_momentum=mu,
+            nesterov=nesterov,
+        )
+        np.testing.assert_array_equal(np.asarray(t_u), np.asarray(t_a))
+        np.testing.assert_array_equal(np.asarray(m_u), np.asarray(m_a))
+
+
+def test_compress_delta_error_feedback_algebra():
+    # Δ̂ = Q(Δ + r) per-tensor (bit-equal to quantize_tensor's roundtrip)
+    # and r' = (Δ + r) − Δ̂ EXACTLY: nothing is lost, only deferred.
+    from distributed_tensorflow_tpu.ops.quantized import (
+        dequantize_tensor,
+        quantize_tensor,
+    )
+    from distributed_tensorflow_tpu.train.local_sgd import compress_delta
+
+    rng = np.random.default_rng(4)
+    delta = {
+        "a": jnp.asarray(rng.standard_normal((6, 5)).astype(np.float32)),
+        "b": jnp.asarray(rng.standard_normal((3,)).astype(np.float32)),
+    }
+    residual = jax.tree.map(
+        lambda x: jnp.asarray(
+            rng.standard_normal(x.shape).astype(np.float32) * 0.01
+        ),
+        delta,
+    )
+    dhat, new_r = compress_delta(delta, residual, "int8")
+    for k in delta:
+        corr = np.asarray(delta[k] + residual[k])
+        q, s = quantize_tensor(jnp.asarray(corr), "int8")
+        want = np.asarray(dequantize_tensor(q, s))
+        np.testing.assert_array_equal(np.asarray(dhat[k]), want)
+        np.testing.assert_array_equal(
+            np.asarray(new_r[k]), corr - want
+        )
+
+
+def test_np_mailbox_codec_matches_jax_quantizer():
+    # The DeltaExchange wire codec is numpy-only (jax-free readers); it
+    # must be BIT-equal to the in-graph quantizer or the mailbox gang's
+    # EF residual would see different values than its peers decode.
+    from distributed_tensorflow_tpu.ops.quantized import (
+        dequantize_tensor,
+        quantize_tensor,
+    )
+    from distributed_tensorflow_tpu.train.local_sgd import (
+        _np_decode_delta,
+        _np_encode_delta,
+    )
+
+    x = np.random.default_rng(5).standard_normal((16, 8)).astype(np.float32)
+    for dt in ("int8", "fp8"):
+        q, s = quantize_tensor(jnp.asarray(x), dt)
+        want = np.asarray(dequantize_tensor(q, s))
+        stored, scales, deq = _np_encode_delta([x], dt)
+        np.testing.assert_array_equal(deq[0], want)
+        np.testing.assert_array_equal(
+            _np_decode_delta(stored, scales, dt)[0], want
+        )
+    # delta_dtype=None is the identity codec.
+    stored, scales, deq = _np_encode_delta([x], None)
+    assert scales is None
+    np.testing.assert_array_equal(deq[0], x)
+
+
+def test_delta_payload_nbytes_and_schedule():
+    from distributed_tensorflow_tpu.train.local_sgd import (
+        delta_payload_nbytes,
+        streaming_schedule,
+    )
+
+    params = jax.eval_shape(lambda: _model().init(seed=0))
+    dense = params_nbytes(params)
+    leaves = jax.tree.leaves(params)
+    q = delta_payload_nbytes(params, "int8")
+    assert q == sum(x.size for x in leaves) + 4 * len(leaves)
+    assert delta_payload_nbytes(params, None) == dense
+    # ~4x minus the per-tensor scale overhead (<0.5% at these shapes).
+    assert 3.9 < dense / q <= 4.0
+    with pytest.raises(ValueError, match="delta_dtype"):
+        delta_payload_nbytes(params, "int4")
+    # The overlapped comm plan: layer-contiguous partitions covering
+    # every byte, issue offsets spread across the round.
+    plan = streaming_schedule(params, 8)
+    assert sum(p["nbytes"] for p in plan) == dense
+    assert sum(p["leaves"] for p in plan) == len(leaves)
+    assert all(0 <= p["issue_step"] < 8 for p in plan)
+    assert plan[0]["issue_step"] == 0
+    assert len(streaming_schedule(params, 8, partitions=3)) == 3
+
+
+def test_staleness_weight_window():
+    from distributed_tensorflow_tpu.train.local_sgd import staleness_weight
+
+    assert staleness_weight(0, 0) == 1.0
+    assert staleness_weight(1, 0) == 0.0
+    assert staleness_weight(1, 2) == 0.5
+    assert staleness_weight(2, 2) == pytest.approx(1 / 3)
+    assert staleness_weight(3, 2) == 0.0
+    assert staleness_weight(-1, 2) == 0.0
+
+
+def test_vmapped_levers_off_state_is_round14():
+    # Anchor #4: lever-off DiLoCoState carries None (empty pytree nodes)
+    # in the new slots — same leaves as round 14, same checkpoint bytes.
+    model = _model()
+    params = model.init(seed=0)
+    opt = optim_lib.make("sgd", 0.01)
+    init_state, _ = make_lm_diloco_vmapped(model, opt, 4, sync_every=2)
+    _, d, _ = init_state(params, opt.init(params))
+    assert d.residual is None and d.inflight is None
+    old_style = DiLoCoState(d.inner, d.theta, d.momentum)
+    assert len(jax.tree.leaves(d)) == len(jax.tree.leaves(old_style))
+
+
+def test_vmapped_compressed_round_matches_hand_math():
+    # H=1, outer_lr=1, μ=0, int8: θ' = θ − Q(Δ + r), r' = (Δ + r) − Q(·)
+    # with Δ = θ − mean_w(θ_w) — checked against quantize_tensor by hand.
+    from distributed_tensorflow_tpu.ops.quantized import (
+        dequantize_tensor,
+        quantize_tensor,
+    )
+
+    model = _model()
+    params = model.init(seed=28)
+    opt = optim_lib.make("sgd", 0.01)
+    toks = _tokens(np.random.default_rng(28), 8, 16)
+    init_state, mapped = make_lm_diloco_vmapped(
+        model, opt, 4, sync_every=1, outer_lr=1.0, outer_momentum=0.0,
+        delta_dtype="int8",
+    )
+    st = init_state(params, opt.init(params))
+    assert st[1].inflight is None  # overlap off
+    # Reference: the uncompressed engine gives mean_w(θ_w) == pbar.
+    ref_init, ref_mapped = make_lm_diloco_vmapped(
+        model, opt, 4, sync_every=1, outer_lr=1.0, outer_momentum=0.0
+    )
+    rs = ref_init(params, opt.init(params))
+    rp, rd, _ = jax.jit(ref_mapped)(rs[0], rs[1], toks, None, rs[2])
+    pbar = rd.theta  # identity corner: θ' IS the mean
+    p, d, _ = jax.jit(mapped)(st[0], st[1], toks, None, st[2])
+    for k_theta, k_pbar, k_res, k_new in zip(
+        jax.tree.leaves(params),
+        jax.tree.leaves(pbar),
+        jax.tree.leaves(d.residual),
+        jax.tree.leaves(d.theta),
+    ):
+        delta = np.asarray(k_theta) - np.asarray(k_pbar)
+        q, s = quantize_tensor(jnp.asarray(delta), "int8")
+        dhat = np.asarray(dequantize_tensor(q, s))
+        np.testing.assert_allclose(
+            np.asarray(k_new), np.asarray(k_theta) - dhat,
+            rtol=1e-6, atol=1e-7,
+        )
+        np.testing.assert_allclose(
+            np.asarray(k_res), delta - dhat, rtol=1e-5, atol=1e-7
+        )
+
+
+def test_vmapped_overlap_applies_one_round_late_with_merge():
+    # H=1, μ=0, η=1, overlap: boundary 0 applies the ZERO in-flight delta
+    # (θ unchanged), stashes Δ_0 = L_0 − mean_0 (landing-based) and lands
+    # every copy at (1−α)·θ_w + α·θ; boundary 1 applies Δ_0.
+    from distributed_tensorflow_tpu.train.local_sgd import OVERLAP_MERGE
+
+    model = _model()
+    params = model.init(seed=29)
+    opt = optim_lib.make("sgd", 0.01)
+    rng = np.random.default_rng(29)
+    init_state, mapped = make_lm_diloco_vmapped(
+        model, opt, 4, sync_every=1, outer_lr=1.0, outer_momentum=0.0,
+        overlap=True,
+    )
+    st = init_state(params, opt.init(params))
+    step = jax.jit(mapped)
+    p1, d1, _ = step(st[0], st[1], _tokens(rng, 8, 16), None, st[2])
+    # θ unchanged at the first boundary (zero in-flight applied).
+    _trees_equal(d1.theta, params)
+    # Stashed delta: landing_0 (= θ_0) − mean of the stepped copies;
+    # nonzero because the copies moved.
+    assert any(
+        float(np.abs(np.asarray(x)).max()) > 0
+        for x in jax.tree.leaves(d1.inflight["delta"])
+    )
+    a = OVERLAP_MERGE
+    # Copies merged toward θ (α of the way); landing = mean of copies.
+    _trees_equal(
+        jax.tree.map(lambda x: jnp.mean(x, axis=0), p1),
+        d1.inflight["landing"],
+        rtol=1e-6, atol=1e-7,
+    )
+    p2, d2, _ = step(p1, d1, _tokens(rng, 8, 16), None, st[2] + 1)
+    # Boundary 1: θ' = θ − Δ_0 (μ=0, η=1 ⇒ apply the stale delta as-is).
+    want = jax.tree.map(
+        lambda t, dd: t - dd, d1.theta, d1.inflight["delta"]
+    )
+    _trees_equal(d2.theta, want, rtol=1e-6, atol=1e-7)
+    assert 0.0 < a < 1.0
+
+
+def test_trainer_compressed_comm_stats_payload():
+    events = []
+
+    class _Journal:
+        def emit(self, kind, **fields):
+            events.append({"kind": kind, **fields})
+            return fields
+
+        def flush(self):
+            pass
+
+    tr = LMTrainer(
+        _model(),
+        _corpus(),
+        _cfg(
+            epochs=2, dp_mode="diloco", diloco_workers=4, sync_every=4,
+            outer_lr=1.0, delta_dtype="int8",
+        ),
+        print_fn=lambda *a: None,
+        journal=_Journal(),
+    )
+    res = tr.run()
+    assert np.isfinite(res["perplexity"])
+    from distributed_tensorflow_tpu.train.local_sgd import (
+        delta_payload_nbytes,
+    )
+
+    shapes = jax.eval_shape(lambda: _model().init(seed=0))
+    pb, qb = params_nbytes(shapes), delta_payload_nbytes(shapes, "int8")
+    comm = [e for e in events if e["kind"] == "comm_stats"]
+    assert [e["sync_rounds"] for e in comm] == [2, 3]
+    for e in comm:
+        assert e["allreduce_bytes"] == e["sync_rounds"] * pb
+        assert e["payload_bytes"] == e["sync_rounds"] * qb
+        assert e["delta_dtype"] == "int8" and e["overlap"] is False
+    assert tr.metrics.counter("payload_bytes_total").value == 5 * qb
+    # The EF residual rides the state and is live after a round.
+    assert any(
+        float(np.abs(np.asarray(x)).max()) > 0
+        for x in jax.tree.leaves(tr.state.opt_state.residual)
+    )
+
+
+@pytest.mark.heavy  # round-14 audit: compile-tail; int8 sibling above is the representative
+def test_trainer_overlap_scanned_equals_eager():
+    def run(scan):
+        tr = LMTrainer(
+            _model(),
+            _corpus(),
+            _cfg(
+                epochs=2, scan_epoch=scan, dp_mode="diloco",
+                diloco_workers=4, sync_every=3, outer_lr=1.0,
+                outer_momentum=0.4, delta_dtype="int8",
+                delta_overlap=True,
+            ),
+            print_fn=lambda *a: None,
+        )
+        tr.run()
+        return tr
+
+    a, b = run(True), run(False)
+    _trees_equal(a.state.params, b.state.params, rtol=1e-6, atol=1e-7)
+    _trees_equal(
+        a.state.opt_state.residual, b.state.opt_state.residual,
+        rtol=1e-6, atol=1e-7,
+    )
+    _trees_equal(
+        a.state.opt_state.inflight, b.state.opt_state.inflight,
+        rtol=1e-6, atol=1e-7,
+    )
+
+
+def test_round17_validation():
+    with pytest.raises(ValueError, match="delta_dtype"):
+        TrainConfig(delta_dtype="int4")
+    with pytest.raises(ValueError, match="stale_limit"):
+        TrainConfig(stale_limit=-1)
+    # Valid lever values on a non-diloco mode are refused loudly — they
+    # would otherwise be silently ignored (the launch.py contract).
+    for kw in (
+        {"delta_dtype": "int8"},
+        {"delta_overlap": True},
+        {"stale_limit": 2},
+    ):
+        with pytest.raises(ValueError, match="silently ignored"):
+            TrainConfig(**kw)
+    # Exchange knob drift is refused loudly.
+    from distributed_tensorflow_tpu.train.local_sgd import DeltaExchange
+
+    with pytest.raises(ValueError, match="delta_dtype"):
+        DeltaExchange("/tmp/x", 0, 2, delta_dtype="int4")
+    with pytest.raises(ValueError, match="rank"):
+        DeltaExchange("/tmp/x", 2, 2)
+
+
+# -- round 17: stale-tolerant mailbox gang ----------------------------------
+
+
+def _exchange(tmp_path, rank, world=2, **kw):
+    from distributed_tensorflow_tpu.train.local_sgd import DeltaExchange
+
+    kw.setdefault("stale_limit", 2)
+    return DeltaExchange(str(tmp_path), rank, world, **kw)
+
+
+def test_delta_exchange_post_gather_weights(tmp_path):
+    a = _exchange(tmp_path, 0)
+    b = _exchange(tmp_path, 1)
+    rng = np.random.default_rng(6)
+    la = [rng.standard_normal((4, 3)).astype(np.float32)]
+    lb = [rng.standard_normal((4, 3)).astype(np.float32)]
+    a.post(0, la)
+    # Same-round peer: weight 1; weighted mean == plain mean; the total
+    # weight is what outer_lr=None scales by (the variable-gang η=N).
+    mean, tw, contrib = b.weighted_delta(0, lb)
+    assert contrib == [(1, 0, 1.0), (0, 0, 1.0)] and tw == 2.0
+    np.testing.assert_allclose(mean[0], (la[0] + lb[0]) / 2, rtol=1e-6)
+    # Consumed: a delta is ONE round of movement — the same post never
+    # re-applies at later boundaries (async-PS: each update exactly
+    # once); the total weight drops with it (a lone member must NOT be
+    # scaled by the world size).
+    mean2, tw2, contrib2 = b.weighted_delta(1, lb)
+    assert contrib2 == [(1, 0, 1.0)] and tw2 == 1.0
+    np.testing.assert_allclose(mean2[0], lb[0], rtol=1e-6)
+    # A FRESH member (no consumed watermark) sees the round-0 post
+    # age-discounted: age 2 → weight 1/3.
+    b2 = _exchange(tmp_path, 1)
+    mean3, tw3, contrib3 = b2.weighted_delta(2, lb)
+    assert contrib3 == [(1, 0, 1.0), (0, 2, pytest.approx(1 / 3))]
+    assert tw3 == pytest.approx(1 + 1 / 3)
+    w = 1 / 3
+    np.testing.assert_allclose(
+        mean3[0], (lb[0] + w * la[0]) / (1 + w), rtol=1e-6
+    )
+    # Past the window: dropped forever — never a stall.
+    b3 = _exchange(tmp_path, 1)
+    mean4, tw4, contrib4 = b3.weighted_delta(3, lb)
+    assert contrib4 == [(1, 0, 1.0)] and tw4 == 1.0
+    # Catch-up: a peer that missed boundaries contributes each missed
+    # round's movement exactly once, at its own staleness weight.
+    a.post(1, la)
+    a.post(2, la)
+    mean5, tw5, contrib5 = b.weighted_delta(2, lb)
+    assert contrib5 == [
+        (1, 0, 1.0), (0, 1, 0.5), (0, 0, 1.0)
+    ]
+    assert tw5 == pytest.approx(2.5)
+    # A peer AHEAD of this member clamps to age 0.
+    b.post(7, lb)
+    a2 = _exchange(tmp_path, 0)
+    _, _, contrib6 = a2.weighted_delta(5, la)
+    assert (1, 0, 1.0) in contrib6
+
+
+def test_delta_exchange_quantized_wire_and_gc(tmp_path):
+    import os
+
+    a = _exchange(tmp_path, 0, delta_dtype="int8")
+    b = _exchange(tmp_path, 1, delta_dtype="int8")
+    x = np.random.default_rng(7).standard_normal((32, 16)).astype(np.float32)
+    deq = a.post(0, [x])
+    # The poster's returned values ARE what the peer decodes (the EF
+    # residual must see the wire, not the intent).
+    got = b.gather(0)
+    assert [(r, age, w) for r, age, w, _ in got] == [(0, 0, 1.0)]
+    np.testing.assert_array_equal(got[0][3][0], deq[0])
+    # Quantized payloads are ~4x smaller on disk than f32 (npz overhead
+    # aside — compare against a full-precision post of the same tensor).
+    f = _exchange(tmp_path, 1)
+    f.post(0, [x])
+    qsize = a.payload_nbytes(0)
+    fsize = f.payload_nbytes(0)
+    assert qsize < 0.5 * fsize
+    # GC: posting round R drops own files older than R − stale_limit − 1.
+    for r in range(1, 6):
+        a.post(r, [x])
+    rounds = a._rounds_of(0)
+    assert min(rounds) >= 5 - a.stale_limit - 1 and max(rounds) == 5
+    # Torn tmp files are invisible to readers.
+    open(os.path.join(str(tmp_path), a._fname(0, 9) + ".tmp123"), "wb").close()
+    assert a._rounds_of(0) == rounds
+
+
+def test_trainer_mailbox_gang_members_share_rounds(tmp_path):
+    # Two members run SEQUENTIALLY (fast-tier determinism; concurrent
+    # throttled members are the RUN_SLOW fault-injection proof): the
+    # second member's boundaries pick up the first's posted deltas with
+    # clamped-fresh ages; a member alone in the mailbox still completes
+    # every round.
+    events = []
+
+    class _Journal:
+        def emit(self, kind, **fields):
+            events.append({"kind": kind, **fields})
+            return fields
+
+        def flush(self):
+            pass
+
+    def member(rank, seed):
+        cfg = _cfg(
+            epochs=1, scan_epoch=False, dp_mode="diloco",
+            diloco_workers=1, sync_every=5, outer_lr=1.0,
+            delta_dtype="int8", stale_limit=2,
+        )
+        return LMTrainer(
+            _model(),
+            copy_corpus(
+                num=768, half_len=8, vocab=61, n_val=64, n_test=64,
+                seed=seed,
+            ),
+            cfg,
+            print_fn=lambda *a: None,
+            delta_exchange=_exchange(
+                tmp_path, rank, stale_limit=2, delta_dtype="int8"
+            ),
+            journal=_Journal(),
+        )
+
+    w0 = member(0, 0)
+    assert w0._scan is False  # the mailbox round is a host decision point
+    r0 = w0.run()
+    assert np.isfinite(r0["perplexity"])
+    dx0 = [e for e in events if e["kind"] == "delta_exchange"]
+    assert [e["round"] for e in dx0] == [0, 1]  # 10 steps at H=5
+    assert all(e["contributors"] == [[0, 0, 1.0]] for e in dx0)
+    assert all(e["payload_nbytes"] > 0 and e["wall_ms"] >= 0 for e in dx0)
+    w1 = member(1, 1)
+    r1 = w1.run()
+    assert np.isfinite(r1["perplexity"])
+    dx1 = [
+        e for e in events if e["kind"] == "delta_exchange" and e["rank"] == 1
+    ]
+    # w1's FIRST boundary consumes both of w0's posts (ahead-of-round,
+    # clamped fresh — each applied exactly once); its second finds
+    # nothing new and runs alone, never waiting.
+    assert [len(e["contributors"]) for e in dx1] == [3, 1]
+    assert w1.metrics.counter("mailbox_rounds_total").value == 2
+
+
+def test_trainer_mailbox_default_outer_lr_scales_by_contributors(tmp_path):
+    # outer_lr=None (the η=N convention) on the mailbox gang must scale
+    # by the round's ACTUAL total contributor weight, not the fixed
+    # world size: a member alone in a world=4 mailbox applies its own
+    # delta exactly ONCE (η=1), not 4× (which swings the effective
+    # outer LR with peer arrival timing and diverges when peers die).
+    events = []
+
+    class _Journal:
+        def emit(self, kind, **fields):
+            events.append({"kind": kind, **fields})
+            return fields
+
+        def flush(self):
+            pass
+
+    tr = LMTrainer(
+        _model(),
+        _corpus(),
+        _cfg(
+            epochs=1, scan_epoch=False, dp_mode="diloco",
+            diloco_workers=1, sync_every=5, outer_lr=None,
+            outer_momentum=0.0, stale_limit=2,
+        ),
+        print_fn=lambda *a: None,
+        delta_exchange=_exchange(tmp_path, 0, world=4, stale_limit=2),
+        journal=_Journal(),
+    )
+    theta0 = jax.device_get(tr.state.opt_state.theta)
+    tr.run()
+    dx = [e for e in events if e["kind"] == "delta_exchange"]
+    assert all(
+        e["total_weight"] == 1.0 and e["outer_lr"] == 1.0 for e in dx
+    )
+    # η=1 over a lone member ⇒ θ after round 0 IS the member's params at
+    # that boundary (θ − 1·(θ − p) = p): the trajectory stayed sane —
+    # finite and in the same ballpark as the start, not 4×-overshot.
+    assert all(
+        np.isfinite(np.asarray(x)).all()
+        for x in jax.tree.leaves(jax.device_get(tr.state.opt_state.theta))
+    )
+    drift = max(
+        float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+        for a, b in zip(
+            jax.tree.leaves(jax.device_get(tr.state.opt_state.theta)),
+            jax.tree.leaves(theta0),
+        )
+    )
+    assert drift < 1.0, drift
+
+
+def test_trainer_mailbox_validation(tmp_path):
+    ex = _exchange(tmp_path, 0, stale_limit=2, delta_dtype="int8")
+    base = dict(print_fn=lambda *a: None, delta_exchange=ex)
+    with pytest.raises(ValueError, match="dp_mode='diloco'"):
+        # Knob-compatible exchange, wrong mode.
+        LMTrainer(
+            _model(), _corpus(), _cfg(),
+            print_fn=lambda *a: None,
+            delta_exchange=_exchange(tmp_path / "p", 0, stale_limit=0),
+        )
+    with pytest.raises(ValueError, match="stale_limit"):
+        # Exchange says 2, config says 0: refused (config_from_env is
+        # the single config surface).
+        LMTrainer(
+            _model(), _corpus(),
+            _cfg(dp_mode="diloco", diloco_workers=1, delta_dtype="int8"),
+            **base,
+        )
+    good = _cfg(
+        dp_mode="diloco", diloco_workers=1, delta_dtype="int8",
+        stale_limit=2,
+    )
+    with pytest.raises(ValueError, match="delta_dtype"):
+        LMTrainer(
+            _model(), _corpus(), good.replace(delta_dtype="fp8"), **base
+        )
+    with pytest.raises(ValueError, match="diloco_workers=1"):
+        LMTrainer(
+            _model(), _corpus(), good.replace(diloco_workers=4), **base
+        )
+    with pytest.raises(ValueError, match="delta_overlap"):
+        LMTrainer(
+            _model(), _corpus(), good.replace(delta_overlap=True), **base
+        )
+    tr = LMTrainer(_model(), _corpus(), good, **base)
+    with pytest.raises(ValueError, match="run_compiled"):
+        tr.run_compiled()
+
+
+# -- round 17: lever state across checkpoint/restore ------------------------
+
+
+def _lever_kw(**over):
+    kw = _diloco_kw(delta_dtype="int8", delta_overlap=True)
+    kw.update(over)
+    return kw
+
+
+def test_ckpt_lever_same_layout_resume_bitwise(tmp_path):
+    a = _ckpt_trainer(tmp_path, **_lever_kw())
+    a.run()
+    meta = a.supervisor.saved_layout(a.supervisor.latest_step())
+    # Lever keys are SHAPE keys, present only when on (round-14 metas
+    # stay byte-identical — pinned by the lever-off sibling above).
+    assert meta["delta_dtype"] == "int8" and meta["overlap"] is True
+    b = _ckpt_trainer(tmp_path, **_lever_kw())
+    assert b.start_step == a.global_step
+    _trees_equal(a.state, b.state)
+
+
+def test_ckpt_lever_cross_world_resize_carries_residual_inflight(tmp_path):
+    # The acceptance contract: EF residual and in-flight partition state
+    # survive a diloco→diloco cross-world resize BITWISE (they are
+    # world-invariant dense trees, like θ_start/momentum).
+    a = _ckpt_trainer(tmp_path, **_lever_kw())
+    a.run()
+    assert any(
+        float(np.abs(np.asarray(x)).max()) > 0
+        for x in jax.tree.leaves(a.state.opt_state.residual)
+    )
+    b = _ckpt_trainer(tmp_path, **_lever_kw(diloco_workers=2))
+    assert b.start_step == a.global_step
+    _trees_equal(a.state.opt_state.theta, b.state.opt_state.theta)
+    _trees_equal(a.state.opt_state.momentum, b.state.opt_state.momentum)
+    _trees_equal(a.state.opt_state.residual, b.state.opt_state.residual)
+    _trees_equal(a.state.opt_state.inflight, b.state.opt_state.inflight)
+    res = b.run()
+    assert np.isfinite(res["perplexity"])
+
+
+def test_ckpt_dense_to_lever_diloco_starts_at_zero(tmp_path):
+    # dense → diloco-with-levers: fresh outer round — residual zero,
+    # nothing in flight, landing at the restored point.
+    a = _ckpt_trainer(tmp_path)
+    a.run()
+    b = _ckpt_trainer(tmp_path, **_lever_kw(sync_every=2))
+    assert b.start_step == a.global_step
+    assert all(
+        float(np.abs(np.asarray(x)).max()) == 0
+        for x in jax.tree.leaves(b.state.opt_state.residual)
+    )
+    assert all(
+        float(np.abs(np.asarray(x)).max()) == 0
+        for x in jax.tree.leaves(b.state.opt_state.inflight["delta"])
+    )
+    _trees_equal(b.state.opt_state.inflight["landing"], a.state.params)
+    res = b.run()
+    assert np.isfinite(res["perplexity"])
+
+
+@pytest.mark.heavy  # round-14 audit: compile-tail; the carry/zero pair above is the fast-tier representative
+def test_ckpt_lever_flip_routes_cross_topology_and_drops_cleanly(tmp_path):
+    # delta_dtype flipped OFF between save and resume: the sidecar's
+    # shape keys differ → cross-topology path → the residual drops
+    # cleanly (compression error deferred once, never corrupted), the
+    # outer anchor/momentum still carry.
+    a = _ckpt_trainer(tmp_path, **_diloco_kw(delta_dtype="int8"))
+    a.run()
+    b = _ckpt_trainer(tmp_path, **_diloco_kw())
+    assert b.start_step == a.global_step
+    assert b.state.opt_state.residual is None
+    _trees_equal(a.state.opt_state.theta, b.state.opt_state.theta)
+    res = b.run()
     assert np.isfinite(res["perplexity"])
 
 

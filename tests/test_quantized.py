@@ -86,6 +86,56 @@ def test_unknown_dtype_rejected():
         quantized_dot("int4", x, w)
 
 
+# -- per-tensor delta compression (round 17) --------------------------------
+
+
+@pytest.mark.parametrize("dtype", MATMUL_DTYPES)
+def test_quantize_tensor_roundtrip(dtype):
+    from distributed_tensorflow_tpu.ops.quantized import (
+        dequantize_tensor,
+        quantize_tensor,
+    )
+
+    x = jax.random.normal(jax.random.key(5), (16, 8), jnp.float32)
+    q, scale = quantize_tensor(x, dtype)
+    back = dequantize_tensor(q, scale)
+    # One scale per TENSOR: resolution bounded by the global amax.
+    tol = {"int8": 1.0 / 127, "fp8": 1.0 / 8}[dtype]
+    assert float(jnp.max(jnp.abs(back - x))) <= tol * float(
+        jnp.max(jnp.abs(x))
+    ) + 1e-7
+    assert q.shape == x.shape and scale.shape == ()
+
+
+def test_quantize_tensor_pow2_amax_is_exact():
+    # Integer-valued tensor whose amax is a power of two: the scale is
+    # exactly representable, so the roundtrip is bit-exact (the same
+    # equality oracle the KV cache uses).
+    x = jnp.asarray(
+        np.random.default_rng(0).integers(-127, 128, (8, 8)), jnp.float32
+    )
+    x = x.at[0, 0].set(127.0)  # amax = 127 → scale exactly 1.0
+    from distributed_tensorflow_tpu.ops.quantized import (
+        dequantize_tensor,
+        quantize_tensor,
+    )
+
+    q, scale = quantize_tensor(x, "int8")
+    assert float(scale) == 1.0
+    np.testing.assert_array_equal(
+        np.asarray(dequantize_tensor(q, scale)), np.asarray(x)
+    )
+
+
+def test_quantize_tensor_zero_and_validation():
+    from distributed_tensorflow_tpu.ops.quantized import quantize_tensor
+
+    q, scale = quantize_tensor(jnp.zeros((4, 4)), "int8")
+    assert np.all(np.asarray(q) == 0) and np.isfinite(float(scale))
+    with pytest.raises(ValueError, match="tensor dtype"):
+        quantize_tensor(jnp.zeros((2,)), "int4")
+
+
 # -- model-level opt-in ------------------------------------------------------
 
 
