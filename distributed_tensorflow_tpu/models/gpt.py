@@ -45,6 +45,20 @@ from distributed_tensorflow_tpu.ops.quantized import (
 from distributed_tensorflow_tpu.ops.ring_attention import dense_attention
 
 
+# Decode-path implementations (round 18): see GPTLM.__init__'s
+# decode_engine comment and ops/pallas_decode.py.
+DECODE_ENGINES = ("auto", "pallas", "xla")
+
+# VMEM budget for one block's weights under the fused decode kernel
+# (~10·d² + 2·d·Hkv·Dh elements at compute dtype, all resident across
+# the launch). 8 MiB keeps serving widths (d ≤ ~512 bf16) fused and
+# refuses widths whose FFN pair alone would blow the ~16 MiB VMEM —
+# "auto" silently falls back to XLA there, an explicit "pallas" raises.
+# PROVISIONAL until the chip session measures where the fused win stops
+# (the _FUSED_DQ_CAP_BYTES convention, ops/pallas_attention.py).
+_DECODE_VMEM_WEIGHT_CAP = 8 << 20
+
+
 def _rope(x, positions, base: float = 10000.0):
     """Rotary position embedding on [B, L, H, Dh] at absolute ``positions``
     [L] (shared across the batch) or [B, L] (per-row — the slot-decode
@@ -211,6 +225,7 @@ class GPTLM:
         remat: bool | str = False,
         flash_min_len: int | None = None,
         matmul_dtype: str | None = None,
+        decode_engine: str = "auto",
     ):
         assert model_dim % num_heads == 0
         if attention_impl not in ("xla", "flash"):
@@ -340,6 +355,34 @@ class GPTLM:
                     f"of {MATMUL_DTYPES}"
                 )
         self.matmul_dtype = matmul_dtype
+        # Round 18: which implementation serves the single-token decode
+        # paths (decode_step / decode_slots / decode_paged).
+        #   "xla"    — the unrolled per-op path (rounds 5-15, bitwise
+        #              unchanged; the default everywhere off-TPU).
+        #   "pallas" — the fused decode-step kernel
+        #              (ops/pallas_decode.py): one Pallas launch per
+        #              block per token, weights VMEM-resident, int8/fp8
+        #              KV dequantized in-kernel. Refused LOUDLY at
+        #              construction/call time for unsupported configs
+        #              (MoE FFNs, quantized projection weights, blocks
+        #              too wide for VMEM) instead of silently degrading.
+        #   "auto"   — pallas on TPU when the config is supported, else
+        #              xla (off-TPU auto is ALWAYS xla: the interpreter
+        #              kernel is a correctness tool, not a serving path).
+        # Per-call override: decode_*(..., engine=) — TextServer threads
+        # its own knob through the chunk scan this way.
+        if decode_engine not in DECODE_ENGINES:
+            raise ValueError(
+                f"unknown decode_engine {decode_engine!r}; one of "
+                f"{DECODE_ENGINES}"
+            )
+        self.decode_engine = decode_engine
+        if decode_engine == "pallas":
+            reason = self._decode_unsupported_reason()
+            if reason is not None:
+                raise ValueError(
+                    f"decode_engine='pallas' unsupported: {reason}"
+                )
 
     # -- init --------------------------------------------------------------
 
@@ -1066,6 +1109,135 @@ class GPTLM:
 
     # -- KV-cache decoding -------------------------------------------------
 
+    def _decode_unsupported_reason(self) -> str | None:
+        """Why the fused Pallas decode kernel cannot serve this model
+        CONFIG, or None when it can. Static (config-only) half of the
+        support check; the params half (weight-only quantized trees) is
+        :meth:`_resolve_decode_engine`'s, because params arrive at call
+        time. Supported: dense FFN blocks, MHA/GQA, full or sliding
+        window (rolling slab and absolute paged layouts), learned or
+        rope positions, bf16/int8/fp8 KV caches."""
+        if self.moe_experts is not None:
+            return (
+                "MoE blocks route through ops/moe (expert dispatch is not "
+                "a single-launch shape); serve MoE models on the XLA "
+                "engine"
+            )
+        if self.matmul_dtype is not None:
+            return (
+                "matmul_dtype projections route through "
+                "ops/quantized.quantized_dot; the fused kernel runs "
+                "compute-dtype weights only"
+            )
+        d = self.model_dim
+        elem = jnp.dtype(self.compute_dtype).itemsize
+        weight_bytes = (
+            10 * d * d + 2 * d * self.num_kv_heads * self.head_dim
+        ) * elem
+        if weight_bytes > _DECODE_VMEM_WEIGHT_CAP:
+            return (
+                f"block weights ({weight_bytes} B at compute dtype) exceed "
+                f"the fused kernel's VMEM-residency cap "
+                f"({_DECODE_VMEM_WEIGHT_CAP} B); the XLA engine streams "
+                "them instead"
+            )
+        return None
+
+    def _resolve_decode_engine(self, engine: str | None, params) -> str:
+        """Resolve the per-call ``engine`` override (None → the model's
+        ``decode_engine`` knob) to "pallas" or "xla". "pallas" with an
+        unsupported config/params RAISES (a serving deployment must not
+        silently run a different engine than it asked for); "auto" is
+        pallas only on a real TPU backend with a supported config —
+        off-TPU auto always resolves to xla (pinned in
+        tests/test_pallas_decode.py)."""
+        e = self.decode_engine if engine is None else engine
+        if e not in DECODE_ENGINES:
+            raise ValueError(
+                f"unknown decode engine {e!r}; one of {DECODE_ENGINES}"
+            )
+        if e == "xla":
+            return "xla"
+        reason = self._decode_unsupported_reason()
+        if reason is None and any(
+            isinstance(getattr(params.blocks, nm, None), QuantizedLinear)
+            for nm in ("wq", "wk", "wv", "wo", "w_up", "w_down")
+        ):
+            reason = (
+                "weight-only quantized decode params (QuantizedLinear "
+                "leaves from decode_weights) route through wo_dot; the "
+                "fused kernel runs compute-dtype weights only"
+            )
+        if e == "pallas":
+            if reason is not None:
+                raise ValueError(f"decode_engine='pallas' unsupported: {reason}")
+            return "pallas"
+        # auto
+        if reason is not None or jax.default_backend() != "tpu":
+            return "xla"
+        return "pallas"
+
+    def _commit_slot_rows(
+        self, ck0, cv0, ks0, vs0, kq, vq, ksc, vsc, lengths, act
+    ):
+        """The ONE slab fresh-row commit (per-row scatter at
+        ``lengths % C`` / ``lengths``; inactive rows write their old
+        value back — a no-op) — shared by the XLA engine
+        (``_decode_block_slots``) and the fused Pallas engine
+        (``_decode_slots_pallas``), so the two engines write identical
+        caches BY CONSTRUCTION, not by copy discipline. ``kq``/``vq``
+        [S, Hkv, Dh] storage-dtype rows, ``ksc``/``vsc`` [S, Hkv] f32
+        scales or None (bf16 layout). Returns (ck, cv, nks, nvs)."""
+        rows = jnp.arange(ck0.shape[0])
+        c = self.cache_len
+        slot = lengths % c if self.window is not None else lengths
+        kw = jnp.where(act[:, None, None], kq, ck0[rows, slot])
+        vw = jnp.where(act[:, None, None], vq, cv0[rows, slot])
+        ck = ck0.at[rows, slot].set(kw)
+        cv = cv0.at[rows, slot].set(vw)
+        if ks0 is None:
+            return ck, cv, None, None
+        nks = ks0.at[rows, slot].set(
+            jnp.where(act[:, None], ksc, ks0[rows, slot])
+        )
+        nvs = vs0.at[rows, slot].set(
+            jnp.where(act[:, None], vsc, vs0[rows, slot])
+        )
+        return ck, cv, nks, nvs
+
+    def _commit_paged_rows(
+        self, pk, pv, pks, pvs, kq, vq, ksc, vsc, tables, lengths, act
+    ):
+        """The ONE paged fresh-row commit (scatter through the block
+        tables at position ``lengths[s]``; inactive rows drop at the
+        sentinel) — shared by the XLA engine (``_decode_block_paged``)
+        and the fused Pallas engine (``_decode_paged_pallas``), same
+        by-construction guarantee as :meth:`_commit_slot_rows`.
+        Row/scale shapes as there. Returns (nk, nv, nks, nvs)."""
+        from distributed_tensorflow_tpu.ops import paged_attention as paged
+
+        pos = lengths[:, None]
+        valid = act[:, None]
+        nk = paged.scatter_token_kv(pk, kq[:, None], tables, pos, valid)
+        nv = paged.scatter_token_kv(pv, vq[:, None], tables, pos, valid)
+        if pks is None:
+            return nk, nv, None, None
+        nks = paged.scatter_token_kv(pks, ksc[:, None], tables, pos, valid)
+        nvs = paged.scatter_token_kv(pvs, vsc[:, None], tables, pos, valid)
+        return nk, nv, nks, nvs
+
+    def _decode_kernel_weights(self, blk) -> dict:
+        """One layer's raw (f32) block weights as the plain dict
+        ops/pallas_decode consumes (cast + layout happen inside the
+        launch builder)."""
+        return {
+            nm: getattr(blk, nm)
+            for nm in (
+                "ln1_scale", "ln1_bias", "wq", "wk", "wv", "wo",
+                "ln2_scale", "ln2_bias", "w_up", "b_up", "w_down", "b_down",
+            )
+        }
+
     @property
     def cache_len(self) -> int:
         """Static KV-cache length per layer: ``min(window, max_len)`` for
@@ -1166,7 +1338,14 @@ class GPTLM:
         ffn_out, _ = self._ffn(blk, hn2)  # aux unused: decode never drops
         return h + ffn_out, ck, cv
 
-    def decode_step(self, params: GPTLMParams, token: jax.Array, cache: KVCache):
+    def decode_step(
+        self,
+        params: GPTLMParams,
+        token: jax.Array,
+        cache: KVCache,
+        *,
+        engine: str | None = None,
+    ):
         """Append one token [B] int32; returns (logits [B, vocab], cache).
 
         The cache is full at ``length == max_len``; stepping past it would
@@ -1183,7 +1362,11 @@ class GPTLM:
         "15× decode-full cliff" was this, not physics — unrolled, config
         gaps match their cache-traffic ratios). Decode graphs are tiny
         (~20 ops/layer, forward-only), so unrolling costs no meaningful
-        compile time; :meth:`prefill` and training keep their scans."""
+        compile time; :meth:`prefill` and training keep their scans.
+
+        ``engine`` (round 18, default: the model's ``decode_engine``
+        knob): "pallas" runs each block as ONE fused kernel launch
+        (ops/pallas_decode.py) — same math, one dispatch per layer."""
         if not isinstance(cache.length, jax.core.Tracer):
             if int(cache.length) >= self.max_len:
                 raise ValueError(
@@ -1193,6 +1376,44 @@ class GPTLM:
         h = self._embed_tokens(
             params, token[:, None], jnp.reshape(cache.length, (1,))
         )
+        if self._resolve_decode_engine(engine, params) == "pallas":
+            from distributed_tensorflow_tpu.ops.pallas_decode import (
+                decode_block_slab,
+            )
+
+            b = token.shape[0]
+            c = self.cache_len
+            lengths = jnp.broadcast_to(
+                jnp.asarray(cache.length, jnp.int32), (b,)
+            )
+            slot = cache.length % c if self.window is not None else cache.length
+            hr = h[:, 0]
+            nks, nvs = [], []
+            for i in range(self.num_layers):
+                blk = jax.tree.map(lambda x: x[i], params.blocks)
+                hr, kq, vq, _, _ = decode_block_slab(
+                    hr, self._decode_kernel_weights(blk),
+                    cache.k[i], cache.v[i], None, None, lengths,
+                    num_heads=self.num_heads, window=self.window,
+                    kv_dtype="bf16", compute_dtype=self.compute_dtype,
+                    rope=self.pos_embedding == "rope",
+                )
+                # Commit with the XLA engine's exact index math (the
+                # scalar-slot dynamic_update_slice of _decode_block).
+                nks.append(
+                    lax.dynamic_update_slice(
+                        cache.k[i], kq[:, None], (0, slot, 0, 0)
+                    )
+                )
+                nvs.append(
+                    lax.dynamic_update_slice(
+                        cache.v[i], vq[:, None], (0, slot, 0, 0)
+                    )
+                )
+            new_cache = KVCache(
+                k=jnp.stack(nks), v=jnp.stack(nvs), length=cache.length + 1
+            )
+            return self._logits(params, hr[:, None])[:, 0], new_cache
         nks, nvs = [], []
         for i in range(self.num_layers):
             blk = jax.tree.map(lambda x: x[i], params.blocks)
@@ -1422,30 +1643,26 @@ class GPTLM:
         caches (``qd`` + ks0/vs0 scale rows) quantize the fresh row on
         write and attend the dequantized view — same math, fewer bytes
         resident."""
-        s = h.shape[0]
         c = self.cache_len
 
         def cache_update(k, v):
-            rows = jnp.arange(s)
             slot = lengths % c if self.window is not None else lengths
             if qd is None:
                 kq, vq = k.astype(ck0.dtype)[:, 0], v.astype(cv0.dtype)[:, 0]
+                ksc = vsc = None
             else:
                 kq, ksc = quantize_kv(k[:, 0], qd)  # [S,Hkv,Dh] + [S,Hkv]
                 vq, vsc = quantize_kv(v[:, 0], qd)
-            kw = jnp.where(act[:, None, None], kq, ck0[rows, slot])
-            vw = jnp.where(act[:, None, None], vq, cv0[rows, slot])
-            ck = ck0.at[rows, slot].set(kw)
-            cv = cv0.at[rows, slot].set(vw)
+            # The shared commit (round 18: also the Pallas engine's) —
+            # per-row scatter, inactive rows writing their old value
+            # back.
+            ck, cv, nks, nvs = self._commit_slot_rows(
+                ck0, cv0, ks0, vs0, kq, vq, ksc, vsc, lengths, act
+            )
+            state = (ck, cv, nks, nvs)
             if qd is None:
-                ck_att, cv_att, state = ck, cv, (ck, cv, None, None)
+                ck_att, cv_att = ck, cv
             else:
-                nks = ks0.at[rows, slot].set(
-                    jnp.where(act[:, None], ksc, ks0[rows, slot])
-                )
-                nvs = vs0.at[rows, slot].set(
-                    jnp.where(act[:, None], vsc, vs0[rows, slot])
-                )
                 # Dequantize to compute_dtype, NOT f32: a f32 view would
                 # double the compute-side intermediate and push the MXU
                 # onto its multi-pass f32 path — the bandwidth win this
@@ -1454,7 +1671,6 @@ class GPTLM:
                 # oracles survive the narrower view).
                 ck_att = dequantize_kv(ck, nks, self.compute_dtype)
                 cv_att = dequantize_kv(cv, nvs, self.compute_dtype)
-                state = (ck, cv, nks, nvs)
             idx = jnp.arange(c)[None, :]  # [1, c]
             if self.window is not None:
                 # Same rolling-buffer identity as _decode_block, per row.
@@ -1473,6 +1689,8 @@ class GPTLM:
         token: jax.Array,
         cache: SlotKVCache,
         active: jax.Array | None = None,
+        *,
+        engine: str | None = None,
     ):
         """Append one token per SLOT: token [S] int32 at each slot's own
         position. Returns (logits [S, vocab], cache with ``lengths``
@@ -1503,6 +1721,8 @@ class GPTLM:
             params, token[:, None], cache.lengths[:, None]
         )
         qd = self._kv_quant_dtype(cache)
+        if self._resolve_decode_engine(engine, params) == "pallas":
+            return self._decode_slots_pallas(params, h, cache, act, qd)
         nks, nvs, nksc, nvsc = [], [], [], []
         for i in range(self.num_layers):
             blk = jax.tree.map(lambda x: x[i], params.blocks)
@@ -1524,6 +1744,48 @@ class GPTLM:
             v_scale=None if qd is None else jnp.stack(nvsc),
         )
         return self._logits(params, h)[:, 0], new_cache
+
+    def _decode_slots_pallas(self, params, h, cache, act, qd):
+        """Fused-kernel half of :meth:`decode_slots`: one
+        ``ops/pallas_decode.decode_block_slab`` launch per layer, then
+        the fresh row committed through :meth:`_commit_slot_rows` — the
+        SAME helper the XLA engine's ``cache_update`` calls, so the two
+        engines' caches (and therefore their token streams) stay in
+        step by construction."""
+        from distributed_tensorflow_tpu.ops.pallas_decode import (
+            decode_block_slab,
+        )
+
+        lengths = cache.lengths
+        hr = h[:, 0]  # [S, d]
+        nks, nvs, nksc, nvsc = [], [], [], []
+        for i in range(self.num_layers):
+            blk = jax.tree.map(lambda x: x[i], params.blocks)
+            ck0, cv0 = cache.k[i], cache.v[i]
+            ks0 = None if qd is None else cache.k_scale[i]
+            vs0 = None if qd is None else cache.v_scale[i]
+            hr, kq, vq, ksc, vsc = decode_block_slab(
+                hr, self._decode_kernel_weights(blk), ck0, cv0, ks0, vs0,
+                lengths,
+                num_heads=self.num_heads, window=self.window,
+                kv_dtype=qd or "bf16", compute_dtype=self.compute_dtype,
+                rope=self.pos_embedding == "rope",
+            )
+            ck, cv, ksn, vsn = self._commit_slot_rows(
+                ck0, cv0, ks0, vs0, kq, vq, ksc, vsc, lengths, act
+            )
+            nks.append(ck)
+            nvs.append(cv)
+            nksc.append(ksn)
+            nvsc.append(vsn)
+        new_cache = SlotKVCache(
+            k=jnp.stack(nks),
+            v=jnp.stack(nvs),
+            lengths=lengths + act.astype(jnp.int32),
+            k_scale=None if qd is None else jnp.stack(nksc),
+            v_scale=None if qd is None else jnp.stack(nvsc),
+        )
+        return self._logits(params, hr[:, None])[:, 0], new_cache
 
     # -- paged decoding (block-table cache, serve.py paged=True) -----------
 
@@ -1709,29 +1971,23 @@ class GPTLM:
 
         def cache_update(k, v):
             if qd is None:
-                k = k.astype(pk.dtype)
-                v = v.astype(pv.dtype)
+                kq = k.astype(pk.dtype)[:, 0]
+                vq = v.astype(pv.dtype)[:, 0]
                 ksc = vsc = None
             else:
-                k, ksc = quantize_kv(k, qd)  # [S,1,Hkv,Dh] + [S,1,Hkv]
-                v, vsc = quantize_kv(v, qd)
-            nk = paged.scatter_token_kv(
-                pk, k, block_tables, lengths[:, None], act[:, None]
+                kq, ksc = quantize_kv(k[:, 0], qd)  # [S,Hkv,Dh] + [S,Hkv]
+                vq, vsc = quantize_kv(v[:, 0], qd)
+            # The shared commit (round 18: also the Pallas engine's) —
+            # scatter through the block tables, inactive rows dropping
+            # at the sentinel.
+            nk, nv, nks, nvs = self._commit_paged_rows(
+                pk, pv, pks, pvs, kq, vq, ksc, vsc, block_tables,
+                lengths, act,
             )
-            nv = paged.scatter_token_kv(
-                pv, v, block_tables, lengths[:, None], act[:, None]
-            )
+            state = (nk, nv, nks, nvs)
             ck = paged.gather_block_view(nk, block_tables)  # [S, C, Hkv, Dh]
             cv = paged.gather_block_view(nv, block_tables)
-            if qd is None:
-                state = (nk, nv, None, None)
-            else:
-                nks = paged.scatter_token_kv(
-                    pks, ksc, block_tables, lengths[:, None], act[:, None]
-                )
-                nvs = paged.scatter_token_kv(
-                    pvs, vsc, block_tables, lengths[:, None], act[:, None]
-                )
+            if qd is not None:
                 # compute_dtype view, not f32 (see _decode_block_slots).
                 ck = dequantize_kv(
                     ck,
@@ -1743,7 +1999,6 @@ class GPTLM:
                     paged.gather_block_view(nvs, block_tables),
                     self.compute_dtype,
                 )
-                state = (nk, nv, nks, nvs)
             idx = jnp.arange(ck.shape[1])[None, :]  # [1, C] absolute
             valid = idx <= lengths[:, None]  # [S, C]
             if self.window is not None:
@@ -1759,6 +2014,8 @@ class GPTLM:
         token: jax.Array,
         cache: PagedKVCache,
         active: jax.Array | None = None,
+        *,
+        engine: str | None = None,
     ):
         """Append one token per slot through the block tables — the
         paged counterpart of :meth:`decode_slots` (same masking
@@ -1783,6 +2040,8 @@ class GPTLM:
             params, token[:, None], cache.lengths[:, None]
         )
         qd = self._kv_quant_dtype(cache)
+        if self._resolve_decode_engine(engine, params) == "pallas":
+            return self._decode_paged_pallas(params, h, cache, act, qd)
         nks, nvs, nksc, nvsc = [], [], [], []
         for i in range(self.num_layers):
             blk = jax.tree.map(lambda x: x[i], params.blocks)
@@ -1805,6 +2064,51 @@ class GPTLM:
             v_scale=None if qd is None else jnp.stack(nvsc),
         )
         return self._logits(params, h)[:, 0], new_cache
+
+    def _decode_paged_pallas(self, params, h, cache, act, qd):
+        """Fused-kernel half of :meth:`decode_paged`: one
+        ``ops/pallas_decode.decode_block_paged`` launch per layer (the
+        block tables ride as scalar-prefetch args — the pool is read
+        block-by-block in the grid, no contiguous ``gather_block_view``
+        copy), then the fresh row committed through
+        :meth:`_commit_paged_rows` — the SAME helper the XLA engine's
+        ``cache_update`` calls, so both engines write identical pools
+        by construction."""
+        from distributed_tensorflow_tpu.ops.pallas_decode import (
+            decode_block_paged,
+        )
+
+        lengths = cache.lengths
+        tables = cache.block_tables
+        hr = h[:, 0]  # [S, d]
+        nks, nvs, nksc, nvsc = [], [], [], []
+        for i in range(self.num_layers):
+            blk = jax.tree.map(lambda x: x[i], params.blocks)
+            pk, pv = cache.k[i], cache.v[i]
+            pks = None if qd is None else cache.k_scale[i]
+            pvs = None if qd is None else cache.v_scale[i]
+            hr, kq, vq, ksc, vsc = decode_block_paged(
+                hr, self._decode_kernel_weights(blk), pk, pv, pks, pvs,
+                tables, lengths,
+                num_heads=self.num_heads, window=self.window,
+                kv_dtype=qd or "bf16", compute_dtype=self.compute_dtype,
+                rope=self.pos_embedding == "rope",
+            )
+            nk, nv, ksn, vsn = self._commit_paged_rows(
+                pk, pv, pks, pvs, kq, vq, ksc, vsc, tables, lengths, act
+            )
+            nks.append(nk)
+            nvs.append(nv)
+            nksc.append(ksn)
+            nvsc.append(vsn)
+        new_cache = cache._replace(
+            k=jnp.stack(nks),
+            v=jnp.stack(nvs),
+            lengths=lengths + act.astype(jnp.int32),
+            k_scale=None if qd is None else jnp.stack(nksc),
+            v_scale=None if qd is None else jnp.stack(nvsc),
+        )
+        return self._logits(params, hr[:, None])[:, 0], new_cache
 
     def _check_decode_bounds(self, prompt, max_new):
         """Shared generation-length validation (every decode entry point:

@@ -1,0 +1,563 @@
+"""Pallas TPU kernel: fused single-token decode step for one GPT block.
+
+The reference has no generative path at all (its one "inference" is the
+in-loop accuracy fetch, reference tfsingle.py:94); serving decode is this
+framework's hottest un-kerneled path. At L=1 each transformer block of
+``models/gpt.py`` lowers to ~20 small XLA ops (the ``decode_step``
+docstring), so per-token time is dominated by per-op dispatch overhead
+and KV-cache HBM traffic, not FLOPs — the round-5 unroll fix
+(939→306 µs/token) showed decode gaps track cache-traffic ratios. This
+module collapses one block's whole single-token step into ONE Pallas
+launch per layer:
+
+    layernorm₁ → QKV projection → RoPE → quantize-on-write of the fresh
+    K/V row → online-softmax attention over the resident cache →
+    output projection → residual → layernorm₂ → dense FFN → residual
+
+with the block's weights and the token's activations VMEM-resident
+across the launch, and the KV cache read block-by-block straight from
+the slab rows or the paged pool (block tables ride as scalar-prefetch
+arguments, so the pool gather is grid index-map arithmetic — no XLA
+gather materializes a contiguous view). Quantized caches (round 15)
+dequantize int8/fp8 payload blocks *inside* the kernel — the launch
+reads 1-byte elements plus the per-row f32 scales and upcasts in VMEM,
+which is where the 2× HBM-bytes claim becomes a latency claim. Per the
+round-15 rule, dequantization targets the COMPUTE dtype, never f32
+storage (the f32 view exists only as the transient dot operand).
+
+Grid: ``(S, Hkv·nc + 1)`` — per serving slot, one step per
+(KV head, cache block) pair plus one finalize step. TPU grids run
+sequentially with the minor dimension fastest, so VMEM scratch carries
+the layernormed token row, the current head's online-softmax state
+(m/l/acc as [g, 1]/[g, Dh] 2-D tiles — 1-D vectors trip Mosaic relayout
+bugs, CLAUDE.md), and the per-head attention outputs across the slot's
+steps. Weight refs use constant index maps, so Mosaic fetches them once
+per launch and re-uses the resident copy every step.
+
+The fresh K/V row is folded into the attention ONLINE-SOFTMAX INIT
+(m = s_fresh, l = 1, acc = v_fresh — exactly one unmasked entry) after
+a round-trip through the cache's storage dtype, so the kernel attends
+precisely the values the cache will hold — the round-15 uniform rule
+("a quantized cache attends stored values EVERYWHERE") that keeps the
+fused engine token-compatible with the XLA engine. The cache blocks
+themselves are attended with the fresh position masked OUT
+(``idx != slot`` / ``idx < length``): the kernel reads the PRE-write
+cache, so the write's slot must come from registers, not memory.
+
+The one-row cache COMMIT stays outside the launch (models/gpt.py applies
+the same ``.at[rows, slot].set`` / ``scatter_token_kv`` index math as
+the XLA engine): TPU output blocks may only be revisited on consecutive
+grid steps, so an in-kernel scatter would either copy the whole cache
+through an aliased output (doubling the HBM traffic this kernel exists
+to remove) or need a manual-DMA HBM path; the row is S·Hkv·Dh elements
+— negligible next to the cache read — and XLA fuses the scatter with
+the launch's epilogue. Same division of labor as the fused flash
+backward's dq-partial sum (ops/pallas_attention.py).
+
+``interpret=None`` auto-selects the Pallas interpreter off-TPU and the
+Mosaic compiler on TPU (the ops/pallas_attention.py convention); parity
+vs the XLA engine is pinned in tests/test_pallas_decode.py (interpreter)
+and recorded on-chip by ``tools/attention_parity.py --write-docs``
+(``decode-fused-vs-xla:*`` rows).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_EPS = 1e-12
+# qmax per quantized KV dtype — MUST match ops/quantized._QMAX (the
+# kernel re-derives the same symmetric per-row scales the XLA engine
+# commits, so both engines attend identical stored values).
+_QMAX = {"int8": 127.0, "fp8": 448.0}
+_STORAGE = {"int8": jnp.int8, "fp8": jnp.float8_e4m3fn}
+
+
+def _pick_cache_block(c: int, requested: int | None) -> int:
+    """Largest power-of-two divisor of the cache length ≤ 512 (one score
+    tile is [g, bc] — tiny; the cap bounds the resident KV block at
+    bc·Dh elements), or ``c`` itself for short/odd caches (Mosaic pads
+    non-tile-multiple shapes; serving caches are small enough that a
+    single whole-cache block is fine)."""
+    if requested is not None:
+        if c % requested:
+            raise ValueError(f"block {requested} must divide cache {c}")
+        return requested
+    for cand in (512, 256, 128, 64, 32, 16, 8):
+        if c % cand == 0 and cand <= c:
+            return cand
+    return c
+
+
+def _ln_row(x, scale_ref, bias_ref):
+    """f32 layernorm on a [1, d] row — the models/base.layernorm
+    arithmetic verbatim (eps included), so the fused block cannot drift
+    numerically from the XLA block."""
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) * lax.rsqrt(var + 1e-5)) * scale_ref[:] + bias_ref[:]
+
+
+def _rope_rows(x, pos_f, dh: int, base: float):
+    """Rotary embedding on [rows, Dh] at one shared position (all rows
+    of a decode step sit at the slot's own position) — the
+    models/gpt._rope pair rotation in f32."""
+    half = dh // 2
+    io = lax.broadcasted_iota(jnp.float32, (1, half), 1)
+    # base ** (-i/half) in the models/gpt._rope evaluation order (the
+    # exp(-ln·i/half) refactoring differs in the last ulp, which the
+    # parity tests would otherwise have to budget for).
+    freqs = jnp.power(base, -io / half)
+    ang = pos_f * freqs
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[:, :half], x[:, half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+
+
+def _quant_row(x, kv_q: str):
+    """Symmetric per-row quantization of [rows, Dh] — the
+    ops/quantized.quantize_kv recipe (amax over the lane dim, eps floor,
+    int8 round-and-clip / fp8 cast) re-derived in-kernel so the fused
+    engine commits bit-identical rows to the XLA engine."""
+    qmax = _QMAX[kv_q]
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, _EPS) / qmax
+    xs = x.astype(jnp.float32) / scale
+    if kv_q == "int8":
+        q = jnp.clip(jnp.round(xs), -qmax, qmax).astype(jnp.int8)
+    else:
+        q = xs.astype(jnp.float8_e4m3fn)
+    return q, scale
+
+
+def _fused_decode_kernel(
+    *refs,
+    nc: int, hkv_n: int, g: int, dh: int, bc: int, cache_len: int,
+    window: int | None, rolling: bool, kv_q: str | None, cd,
+    rope: bool, rope_base: float, n_prefetch: int,
+):
+    lens_ref = refs[0]
+    i = n_prefetch  # tables (paged) are consumed by index maps only
+    (h_ref, wq_ref, wk_ref, wv_ref, wo_ref, ln1s_ref, ln1b_ref,
+     ln2s_ref, ln2b_ref, wup_ref, bup_ref, wdn_ref, bdn_ref,
+     ck_ref, cv_ref) = refs[i:i + 15]
+    i += 15
+    if kv_q is not None:
+        ks_ref, vs_ref = refs[i:i + 2]
+        i += 2
+        ho_ref, kq_ref, vq_ref, ksc_ref, vsc_ref = refs[i:i + 5]
+        i += 5
+    else:
+        ho_ref, kq_ref, vq_ref = refs[i:i + 3]
+        i += 3
+    hn_scr, q_scr, m_scr, l_scr, acc_scr, attn_scr = refs[i:i + 6]
+
+    s_i = pl.program_id(0)
+    j = pl.program_id(1)
+    t_att = hkv_n * nc
+    jc = jnp.minimum(j, t_att - 1)
+    hkv = jc // nc
+    ic = jc % nc
+    length = lens_ref[s_i]
+    scale = 1.0 / math.sqrt(dh)
+
+    @pl.when(j == 0)
+    def _ln1():
+        hn_scr[:] = _ln_row(h_ref[:], ln1s_ref, ln1b_ref)
+
+    @pl.when((j < t_att) & (ic == 0))
+    def _head_start():
+        # This KV head's projections: hn @ per-head weight columns, in
+        # the compute dtype with f32 accumulation (GPTLM._dot). The g
+        # query rows are produced one static slice at a time — a
+        # [1, g·Dh] → [g, Dh] reshape would cross the lane/sublane
+        # boundary, the relayout class CLAUDE.md warns about.
+        hn = hn_scr[:].astype(cd)
+        for gi in range(g):
+            q_scr[gi:gi + 1, :] = jnp.dot(
+                hn, wq_ref[:, gi * dh:(gi + 1) * dh],
+                preferred_element_type=jnp.float32,
+            )
+        kf = jnp.dot(hn, wk_ref[:], preferred_element_type=jnp.float32)
+        vf = jnp.dot(hn, wv_ref[:], preferred_element_type=jnp.float32)
+        if rope:
+            pos_f = length.astype(jnp.float32)
+            q_scr[:] = _rope_rows(q_scr[:], pos_f, dh, rope_base)
+            kf = _rope_rows(kf, pos_f, dh, rope_base)
+        # Quantize-on-write, then attend the ROUND-TRIPPED values — the
+        # round-15 uniform rule: position `length` must score exactly as
+        # a later decode re-reading it from the cache will.
+        if kv_q is None:
+            kq_row = kf.astype(kq_ref.dtype)
+            vq_row = vf.astype(vq_ref.dtype)
+            kf_att = kq_row.astype(jnp.float32)
+            vf_att = vq_row.astype(jnp.float32)
+        else:
+            kq_row, k_sc = _quant_row(kf, kv_q)
+            vq_row, v_sc = _quant_row(vf, kv_q)
+            kf_att = (kq_row.astype(jnp.float32) * k_sc).astype(cd).astype(
+                jnp.float32
+            )
+            vf_att = (vq_row.astype(jnp.float32) * v_sc).astype(cd).astype(
+                jnp.float32
+            )
+            ksc_ref[0, 0] = k_sc[0, 0]
+            vsc_ref[0, 0] = v_sc[0, 0]
+        kq_ref[:] = kq_row
+        vq_ref[:] = vq_row
+        # Online-softmax INIT from the fresh row: exactly one unmasked
+        # entry, so m = its score, l = exp(0) = 1, acc = its value.
+        sf = jnp.sum(q_scr[:] * kf_att, axis=-1, keepdims=True) * scale
+        m_scr[:] = sf
+        l_scr[:] = jnp.ones_like(l_scr)
+        acc_scr[:] = jnp.broadcast_to(vf_att, acc_scr.shape)
+
+    def _attend():
+        kblk = ck_ref[0, :, 0, :]  # [bc, Dh]
+        vblk = cv_ref[0, :, 0, :]
+        if kv_q is None:
+            kb = kblk.astype(jnp.float32)
+            vb = vblk.astype(jnp.float32)
+        else:
+            # Per-block scales arrive as [bc, Hkv] (all heads — a 2-D
+            # tile); this head's column is selected by an iota mask, the
+            # lane-dynamic-index-free idiom.
+            hsel = (
+                lax.broadcasted_iota(jnp.int32, (1, hkv_n), 1) == hkv
+            ).astype(jnp.float32)
+            ksc = jnp.sum(ks_ref[0] * hsel, axis=-1, keepdims=True)
+            vsc = jnp.sum(vs_ref[0] * hsel, axis=-1, keepdims=True)
+            # Dequantize to the COMPUTE dtype (round-15 rule); the f32
+            # upcast after is the transient dot operand, matching the
+            # XLA engine's f32-promoted score einsum.
+            kb = (kblk.astype(jnp.float32) * ksc).astype(cd).astype(
+                jnp.float32
+            )
+            vb = (vblk.astype(jnp.float32) * vsc).astype(cd).astype(
+                jnp.float32
+            )
+        sblk = jnp.dot(
+            q_scr[:], kb.T, preferred_element_type=jnp.float32
+        ) * scale  # [g, bc]
+        idx = ic * bc + lax.broadcasted_iota(jnp.int32, (g, bc), 1)
+        if rolling:
+            # Rolling slab (windowed models): slot i holds absolute
+            # position length − ((slot − i) mod C) — the
+            # models/gpt._decode_block identity — minus the write slot
+            # itself (handled exactly at init; the cache block read here
+            # predates the write).
+            slot = length % cache_len
+            slot_pos = length - jnp.mod(slot - idx, cache_len)
+            valid = (slot_pos >= 0) & (idx != slot)
+        else:
+            valid = idx < length
+            if window is not None:
+                valid &= idx > length - window
+        sblk = jnp.where(valid, sblk, _NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(sblk, axis=-1, keepdims=True))
+        # m is always finite (the fresh-row init), so exp underflows
+        # masked entries to exact zeros; the where is belt-and-braces.
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.where(valid, jnp.exp(sblk - m_new), 0.0)
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jnp.dot(
+            p, vb, preferred_element_type=jnp.float32
+        )
+        m_scr[:] = m_new
+
+    # Skip cache blocks that cannot hold a valid position (absolute
+    # layouts: written positions are 0..length-1, windowed also
+    # > length-W). Rolling slabs interleave positions across blocks, so
+    # every block is live there.
+    if rolling:
+        live = j < t_att
+    else:
+        live = (j < t_att) & (ic * bc < length)
+        if window is not None:
+            live &= (ic + 1) * bc - 1 > length - window
+    pl.when(live)(_attend)
+
+    @pl.when((j < t_att) & (ic == nc - 1))
+    def _head_end():
+        out_h = acc_scr[:] / l_scr[:]  # l >= exp(m_f - m) > 0 always
+        pl.store(attn_scr, (pl.ds(hkv * g, g), slice(None)), out_h)
+
+    @pl.when(j == t_att)
+    def _final():
+        attn = attn_scr[:].astype(cd)  # [Hq, Dh]
+        d = wo_ref.shape[1]
+        out = jnp.zeros((1, d), jnp.float32)
+        # attn·wo as a static per-head sum of [1, Dh]·[Dh, d] dots — the
+        # [Hq, Dh] → [1, Hq·Dh] flatten it avoids is a cross-tile
+        # relayout.
+        for h in range(hkv_n * g):
+            out = out + jnp.dot(
+                attn[h:h + 1, :], wo_ref[h * dh:(h + 1) * dh, :],
+                preferred_element_type=jnp.float32,
+            )
+        h1 = h_ref[:].astype(jnp.float32) + out
+        hn2 = _ln_row(h1, ln2s_ref, ln2b_ref)
+        up = jnp.dot(
+            hn2.astype(cd), wup_ref[:], preferred_element_type=jnp.float32
+        ) + bup_ref[:]
+        dn = jnp.dot(
+            jax.nn.gelu(up).astype(cd), wdn_ref[:],
+            preferred_element_type=jnp.float32,
+        ) + bdn_ref[:]
+        ho_ref[:] = (h1 + dn).astype(ho_ref.dtype)
+
+
+def _weight_inputs(w: dict, cd):
+    """Order + cast the block weights for the kernel call: projections
+    and FFN weights to the compute dtype (GPTLM._dot's operand cast),
+    layernorm params and biases f32 as [1, n] rows."""
+    row = lambda a: a.astype(jnp.float32).reshape(1, -1)  # noqa: E731
+    return [
+        w["wq"].astype(cd), w["wk"].astype(cd), w["wv"].astype(cd),
+        w["wo"].astype(cd),
+        row(w["ln1_scale"]), row(w["ln1_bias"]),
+        row(w["ln2_scale"]), row(w["ln2_bias"]),
+        w["w_up"].astype(cd), row(w["b_up"]),
+        w["w_down"].astype(cd), row(w["b_down"]),
+    ]
+
+
+def _fused_call(
+    h, w, ck, cv, k_scale, v_scale, lengths, tables,
+    *, num_heads, window, rolling, kv_dtype, compute_dtype,
+    rope, rope_base, block_c, cache_len, interpret,
+):
+    """Shared launch builder for both cache layouts. ``tables`` is None
+    for the slab (cache indexed [S, C, ...] by slot) or [S, nc] int32
+    for the paged pool (cache indexed [NB, bs, ...] through the
+    scalar-prefetched tables)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    s, d = h.shape
+    hkv_n, dh = ck.shape[-2], ck.shape[-1]
+    g = num_heads // hkv_n
+    kv_q = None if kv_dtype == "bf16" else kv_dtype
+    paged = tables is not None
+    if paged:
+        bc = ck.shape[1]  # pool block size
+        nc = tables.shape[1]
+    else:
+        bc = _pick_cache_block(ck.shape[1], block_c)
+        nc = ck.shape[1] // bc
+    t_total = hkv_n * nc + 1
+    t_att = hkv_n * nc
+
+    def _hkv_ic(j):
+        jc = jnp.minimum(j, t_att - 1)
+        return jc // nc, jc % nc
+
+    n_prefetch = 2 if paged else 1
+
+    if paged:
+        def cmap(s_i, j, lens, tab):
+            hkv, ic = _hkv_ic(j)
+            return (tab[s_i, ic], 0, hkv, 0)
+
+        def smap(s_i, j, lens, tab):
+            _, ic = _hkv_ic(j)
+            return (tab[s_i, ic], 0, 0)
+    else:
+        def cmap(s_i, j, lens):
+            hkv, ic = _hkv_ic(j)
+            return (s_i, ic, hkv, 0)
+
+        def smap(s_i, j, lens):
+            _, ic = _hkv_ic(j)
+            return (s_i, ic, 0)
+
+    def hmap(s_i, j, *pref):
+        return (s_i, 0)
+
+    def headmap(s_i, j, *pref):
+        return (0, _hkv_ic(j)[0])
+
+    def const(s_i, j, *pref):
+        return (0, 0)
+
+    def freshmap(s_i, j, *pref):
+        return (s_i * hkv_n + _hkv_ic(j)[0], 0)
+
+    in_specs = [
+        pl.BlockSpec((1, d), hmap),
+        pl.BlockSpec((d, g * dh), headmap),   # wq columns of this head group
+        pl.BlockSpec((d, dh), headmap),       # wk column
+        pl.BlockSpec((d, dh), headmap),       # wv column
+        pl.BlockSpec((d, d), const),          # wo
+        pl.BlockSpec((1, d), const),          # ln1 scale
+        pl.BlockSpec((1, d), const),          # ln1 bias
+        pl.BlockSpec((1, d), const),          # ln2 scale
+        pl.BlockSpec((1, d), const),          # ln2 bias
+        pl.BlockSpec((d, w["w_up"].shape[-1]), const),
+        pl.BlockSpec((1, w["w_up"].shape[-1]), const),
+        pl.BlockSpec((w["w_down"].shape[-2], d), const),
+        pl.BlockSpec((1, d), const),          # b_down
+        pl.BlockSpec((1, bc, 1, dh), cmap),   # cache K block
+        pl.BlockSpec((1, bc, 1, dh), cmap),   # cache V block
+    ]
+    inputs = [h.astype(jnp.float32)] + _weight_inputs(w, compute_dtype) + [
+        ck, cv,
+    ]
+    if kv_q is not None:
+        in_specs += [
+            pl.BlockSpec((1, bc, hkv_n), smap),
+            pl.BlockSpec((1, bc, hkv_n), smap),
+        ]
+        inputs += [k_scale, v_scale]
+
+    out_specs = [
+        pl.BlockSpec((1, d), hmap),
+        pl.BlockSpec((1, dh), freshmap),
+        pl.BlockSpec((1, dh), freshmap),
+    ]
+    storage = ck.dtype
+    out_shape = [
+        jax.ShapeDtypeStruct((s, d), jnp.float32),
+        jax.ShapeDtypeStruct((s * hkv_n, dh), storage),
+        jax.ShapeDtypeStruct((s * hkv_n, dh), storage),
+    ]
+    if kv_q is not None:
+        out_specs += [
+            pl.BlockSpec((1, 1), freshmap),
+            pl.BlockSpec((1, 1), freshmap),
+        ]
+        out_shape += [
+            jax.ShapeDtypeStruct((s * hkv_n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((s * hkv_n, 1), jnp.float32),
+        ]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=n_prefetch,
+        grid=(s, t_total),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=[
+            pltpu.VMEM((1, d), jnp.float32),        # hn (post-LN1 row)
+            pltpu.VMEM((g, dh), jnp.float32),       # q of the current head
+            pltpu.VMEM((g, 1), jnp.float32),        # m
+            pltpu.VMEM((g, 1), jnp.float32),        # l
+            pltpu.VMEM((g, dh), jnp.float32),       # acc
+            pltpu.VMEM((num_heads, dh), jnp.float32),  # per-head attn out
+        ],
+    )
+    kern = partial(
+        _fused_decode_kernel,
+        nc=nc, hkv_n=hkv_n, g=g, dh=dh, bc=bc, cache_len=cache_len,
+        window=window, rolling=rolling, kv_q=kv_q, cd=compute_dtype,
+        rope=rope, rope_base=rope_base, n_prefetch=n_prefetch,
+    )
+    prefetch = (lengths.astype(jnp.int32),)
+    if paged:
+        prefetch += (tables.astype(jnp.int32),)
+    outs = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=tuple(out_shape),
+        interpret=interpret,
+    )(*prefetch, *inputs)
+    if kv_q is not None:
+        ho, kq, vq, ksc, vsc = outs
+        return (
+            ho,
+            kq.reshape(s, hkv_n, dh),
+            vq.reshape(s, hkv_n, dh),
+            ksc.reshape(s, hkv_n),
+            vsc.reshape(s, hkv_n),
+        )
+    ho, kq, vq = outs
+    return ho, kq.reshape(s, hkv_n, dh), vq.reshape(s, hkv_n, dh), None, None
+
+
+def decode_block_slab(
+    h: jax.Array,
+    weights: dict,
+    ck: jax.Array,
+    cv: jax.Array,
+    k_scale: jax.Array | None,
+    v_scale: jax.Array | None,
+    lengths: jax.Array,
+    *,
+    num_heads: int,
+    window: int | None = None,
+    kv_dtype: str = "bf16",
+    compute_dtype=jnp.bfloat16,
+    rope: bool = False,
+    rope_base: float = 10000.0,
+    block_c: int | None = None,
+    interpret: bool | None = None,
+):
+    """One GPT block's fused single-token step over a SLAB cache layer.
+
+    ``h`` [S, d] f32 residual rows (one token per slot), ``weights`` the
+    block's parameter dict (raw f32 leaves — cast happens inside),
+    ``ck``/``cv`` [S, C, Hkv, Dh] (this layer's cache, PRE-write),
+    ``k_scale``/``v_scale`` [S, C, Hkv] f32 or None (bf16), ``lengths``
+    [S] int32 write positions. Windowed models pass their rolling-buffer
+    cache (C = min(window, max_len)); the in-kernel validity reproduces
+    the ``models/gpt._decode_block`` rolling identity.
+
+    Returns ``(h_out [S, d] f32, k_fresh [S, Hkv, Dh] storage-dtype,
+    v_fresh, k_fresh_scale [S, Hkv] f32 | None, v_fresh_scale)`` — the
+    caller commits the fresh row with the SAME scatter index math as the
+    XLA engine (``models/gpt.py``), which is what keeps the two engines
+    attending identical caches."""
+    return _fused_call(
+        h, weights, ck, cv, k_scale, v_scale, lengths, None,
+        num_heads=num_heads, window=window, rolling=window is not None,
+        kv_dtype=kv_dtype, compute_dtype=compute_dtype, rope=rope,
+        rope_base=rope_base, block_c=block_c, cache_len=ck.shape[1],
+        interpret=interpret,
+    )
+
+
+def decode_block_paged(
+    h: jax.Array,
+    weights: dict,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    k_scale: jax.Array | None,
+    v_scale: jax.Array | None,
+    tables: jax.Array,
+    lengths: jax.Array,
+    *,
+    num_heads: int,
+    window: int | None = None,
+    kv_dtype: str = "bf16",
+    compute_dtype=jnp.bfloat16,
+    rope: bool = False,
+    rope_base: float = 10000.0,
+    interpret: bool | None = None,
+):
+    """One GPT block's fused single-token step against the PAGED pool:
+    ``pool_k``/``pool_v`` [NB, bs, Hkv, Dh] (this layer's pool),
+    ``k_scale``/``v_scale`` [NB, bs, Hkv] f32 or None, ``tables``
+    [S, max_blocks] int32. The block tables ride as scalar-prefetch
+    arguments and the pool gather happens in the grid index maps — the
+    kernel DMAs exactly the slot's blocks, no contiguous view is ever
+    materialized (the XLA engine's ``gather_block_view`` copy). Validity
+    is the absolute-position rule of ``models/gpt._decode_block_paged``
+    (``idx < length``, windowed ``idx > length − W``); unused table
+    entries gather garbage blocks the mask keeps out of the softmax.
+    Return contract matches :func:`decode_block_slab` (the caller
+    commits via ``ops/paged_attention.scatter_token_kv``)."""
+    return _fused_call(
+        h, weights, pool_k, pool_v, k_scale, v_scale, lengths, tables,
+        num_heads=num_heads, window=window, rolling=False,
+        kv_dtype=kv_dtype, compute_dtype=compute_dtype, rope=rope,
+        rope_base=rope_base, block_c=None, cache_len=pool_k.shape[1],
+        interpret=interpret,
+    )

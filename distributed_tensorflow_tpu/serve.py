@@ -308,6 +308,7 @@ class TextServer:
         kv_hbm_bytes: int | None = None,
         kv_dtype: str = "bf16",
         decode_matmul_dtype: str | None = None,
+        decode_engine: str | None = None,
         prefix_caching: bool = True,
         spec_draft: int = 0,
         spec_ngram: int = 2,
@@ -391,6 +392,20 @@ class TextServer:
         if decode_matmul_dtype is not None and params is not None:
             params = model.decode_weights(params, decode_matmul_dtype)
         self.params = params
+        # Decode-engine knob (round 18, docs/serving.md §decode-kernel):
+        # None defers to the model's own ``decode_engine``; "pallas"
+        # runs the k-token chunk scan's per-layer step as ONE fused
+        # kernel launch (ops/pallas_decode.py). The EFFECTIVE engine
+        # (explicit knob OR the model's) is resolved ONCE here so an
+        # unsupported pairing (e.g. decode_matmul_dtype's
+        # QuantizedLinear tree + a pallas model knob) refuses at
+        # construction, not first dispatch. Prefill/extend/spec-verify
+        # stay on XLA — they are batched-L graphs the flash/dense
+        # attention already serves; the kernel's domain is the L=1
+        # chunk scan.
+        self.decode_engine = decode_engine
+        if params is not None:
+            model._resolve_decode_engine(decode_engine, params)
         self.tokenizer = tokenizer
         self.slots = slots
         self.chunk = chunk
@@ -495,6 +510,7 @@ class TextServer:
             "serving_cache_config",
             kv_dtype=self.kv_dtype,
             decode_matmul_dtype=self.decode_matmul_dtype,
+            decode_engine=self.decode_engine,
             paged=bool(paged),
             block_size=int(self.block_size) if paged else None,
             kv_blocks=int(self.kv_blocks) if paged else None,
@@ -863,7 +879,8 @@ class TextServer:
         def body(st, _):
             act = ~st.finished & (st.lengths < max_len)
             logits, cache = decode(
-                params, st.last_tok, self._cache(st), active=act
+                params, st.last_tok, self._cache(st), active=act,
+                engine=self.decode_engine,
             )
             carried, sub = self._split_keys(st.key)
             nxt = self._pick(logits, sub, st.greedy, st.temp, st.top_p)

@@ -12,6 +12,14 @@ on, and emits one JSON line with per-case max errors and pass/fail.
 Round 13 adds a ``fused-vs-split:*`` row per case: the one-pass fused
 dq+dk+dv backward (the new default) against the two-kernel split on the
 same forward, so the on-chip record covers the fused kernel explicitly.
+Round 18 adds ``decode-fused-vs-xla:*`` rows: the fused Pallas
+decode-step kernel (ops/pallas_decode.py, ``decode_engine="pallas"``)
+against the unrolled XLA decode engine over a short greedy decode —
+max logit error across steps plus the greedy-token agreement fraction,
+per serving-config feature (dense / GQA / rolling window / paged /
+int8 / fp8 KV). The round-3 lesson applies to these too: the CPU
+interpreter tolerates Mosaic-only bugs, so the rows only count as a
+kernel proof when the header says Mosaic.
 
 Usage (on the TPU)::
 
@@ -56,6 +64,93 @@ CASES = [
     _case("kv-lens-gqa", h=8, hkv=2, kv_lens=(301, 444)),
     _case("offset-shifted-band", window=96, offset=256, l=512),
 ]
+
+
+def _decode_case(name, *, kv_dtype="bf16", heads=4, kv_heads=None,
+                 window=None, paged=False):
+    return dict(
+        name=name, kv_dtype=kv_dtype, heads=heads,
+        kv_heads=kv_heads or heads, window=window, paged=paged,
+    )
+
+
+DECODE_CASES = [
+    _decode_case("decode-fused-vs-xla:dense-bf16"),
+    _decode_case("decode-fused-vs-xla:dense-int8", kv_dtype="int8"),
+    _decode_case("decode-fused-vs-xla:dense-fp8", kv_dtype="fp8"),
+    _decode_case("decode-fused-vs-xla:gqa", heads=8, kv_heads=2),
+    _decode_case("decode-fused-vs-xla:window-rolling", window=16),
+    _decode_case(
+        "decode-fused-vs-xla:paged-int8", kv_dtype="int8", paged=True
+    ),
+]
+
+
+def run_decode_case(c: dict) -> dict:
+    """One serving config's fused-vs-XLA decode parity: prefill three
+    ragged prompts into slots, then 8 greedy decode steps with BOTH
+    engines fed the XLA engine's token stream (teacher-forced) — so
+    every step scores the same prefix and the max logit error stays a
+    kernel-parity measurement even after a budgeted argmax flip (self-
+    fed streams would diverge at the first flip and the error metric
+    would measure different prefixes, not the kernel). Token agreement
+    is the per-step argmax match under those identical prefixes; ``ok``
+    needs logit error under the shared tolerance bar and ≥ 90% token
+    agreement (bf16 compute — flips at near-ties are the budgeted
+    residual; tests/test_pallas_decode.py pins the tight f32
+    contract)."""
+    import numpy as np
+
+    from distributed_tensorflow_tpu.models.gpt import GPTLM
+
+    m = GPTLM(
+        vocab_size=97, max_len=64, model_dim=32, num_heads=c["heads"],
+        num_kv_heads=c["kv_heads"], num_layers=2, pos_embedding="rope",
+        window=c["window"],
+    )
+    params = m.init(seed=1)
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, 97, (3, 8)), jnp.int32)
+    lens = jnp.asarray([8, 5, 3], jnp.int32)
+    admit = jnp.ones((3,), bool)
+    if c["paged"]:
+        cache = m.empty_paged_cache(3, 24, block_size=8, kv_dtype=c["kv_dtype"])
+        tables = np.zeros((3, m.paged_blocks_per_slot(8)), np.int32)
+        nb = m.paged_blocks_per_slot(8)
+        for s in range(3):
+            tables[s] = np.arange(1 + s * nb, 1 + (s + 1) * nb) % 24
+        cache = cache._replace(block_tables=jnp.asarray(tables))
+        _, cache = m.extend_paged(
+            params, cache, toks, lens, jnp.zeros((3,), jnp.int32), admit
+        )
+        cache = cache._replace(lengths=lens)
+        decode = m.decode_paged
+    else:
+        cache = m.empty_slot_cache(3, c["kv_dtype"])
+        _, cache = m.prefill_slots(params, cache, toks, lens, admit)
+        decode = m.decode_slots
+    tok = jnp.asarray([1, 2, 3], jnp.int32)
+    cx = cp = cache
+    tx = tok
+    steps, agree, err = 8, 0, 0.0
+    for _ in range(steps):
+        lx, cx = decode(params, tx, cx, engine="xla")
+        lp, cp = decode(params, tx, cp, engine="pallas")
+        err = max(err, float(jnp.max(jnp.abs(
+            lx.astype(jnp.float32) - lp.astype(jnp.float32)
+        ))))
+        nx = jnp.argmax(lx, -1).astype(jnp.int32)
+        npal = jnp.argmax(lp, -1).astype(jnp.int32)
+        agree += int((np.asarray(nx) == np.asarray(npal)).sum())
+        tx = nx  # teacher-force the XLA stream into BOTH engines
+    tok_match = agree / (steps * 3)
+    tol = ATOL + RTOL
+    return {
+        "case": c["name"],
+        "fwd_max_err": round(err, 5),
+        "tok_match": round(tok_match, 4),
+        "ok": bool(err < tol and tok_match >= 0.9),
+    }
 
 
 def run_case(c: dict) -> dict:
@@ -206,7 +301,7 @@ def main(argv=None) -> int:
     ap.add_argument("--write-docs", action="store_true")
     ap.add_argument("--cases", nargs="+", default=None)
     args = ap.parse_args(argv)
-    known = {c["name"] for c in CASES}
+    known = {c["name"] for c in CASES} | {c["name"] for c in DECODE_CASES}
     if args.cases:
         unknown = set(args.cases) - known
         if unknown:
@@ -227,6 +322,16 @@ def main(argv=None) -> int:
                     {"case": label, "ok": False,
                      "error": f"{type(exc).__name__}: {exc}"[:200]}
                 )
+    for c in DECODE_CASES:
+        if args.cases and c["name"] not in args.cases:
+            continue
+        try:
+            rows.append(run_decode_case(c))
+        except Exception as exc:  # noqa: BLE001
+            rows.append(
+                {"case": c["name"], "ok": False,
+                 "error": f"{type(exc).__name__}: {exc}"[:200]}
+            )
     device = jax.devices()[0].device_kind
     backend = jax.default_backend()
     all_ok = bool(rows) and all(r["ok"] for r in rows)
@@ -235,16 +340,17 @@ def main(argv=None) -> int:
         f"mode: {'Mosaic' if backend == 'tpu' else 'interpreter'}"
     )
     print(header)
-    cols = ["case", "fwd", "dq", "dk", "dv", "ok"]
+    cols = ["case", "fwd", "dq", "dk", "dv", "tok", "ok"]
     lines = ["| " + " | ".join(cols) + " |", "|" + "---|" * len(cols)]
     for r in rows:
         if "error" in r:
-            lines.append(f"| {r['case']} | error: {r['error']} |" + " |" * 4)
+            lines.append(f"| {r['case']} | error: {r['error']} |" + " |" * 5)
             continue
         lines.append(
-            f"| {r['case']} | {r['fwd_max_err']} | {r['dq_max_err']} | "
-            f"{r['dk_max_err']} | {r['dv_max_err']} | "
-            f"{'PASS' if r['ok'] else 'FAIL'} |"
+            f"| {r['case']} | {r['fwd_max_err']} "
+            f"| {r.get('dq_max_err', '-')} | {r.get('dk_max_err', '-')} "
+            f"| {r.get('dv_max_err', '-')} | {r.get('tok_match', '-')} "
+            f"| {'PASS' if r['ok'] else 'FAIL'} |"
         )
     table = "\n".join(lines)
     print(table)
@@ -265,7 +371,11 @@ def main(argv=None) -> int:
                 f"attention_parity --write-docs` — {header}. Forward and\n"
                 "q/k/v gradient max-abs errors vs the dense oracle, bf16\n"
                 "inputs, per feature (causal/window/banding/GQA/kv_lens/"
-                "offset).\n\n" + table + "\n"
+                "offset).\n`decode-fused-vs-xla:*` rows (round 18): the "
+                "fused Pallas decode-step\nkernel vs the unrolled XLA "
+                "decode engine — max logit error over an\n8-step greedy "
+                "decode plus the token-agreement fraction (`tok`).\n\n"
+                + table + "\n"
             )
         print(f"wrote {root}/attention_parity.md")
     return 0 if all_ok else 1

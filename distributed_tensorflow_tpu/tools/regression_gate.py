@@ -54,9 +54,14 @@ import sys
 # point of that series is catching the count going UP from 0. ``bytes``/
 # ``bytes/token`` are comm payloads (diloco_bench's comm_bytes_per_token,
 # round 17): traffic creeping back UP past the compressed record is the
-# regression.
+# regression. ``us``/``µs`` variants (round 18): the decode-latency
+# series (serve_bench's decode_us_per_token) are microsecond-scale —
+# before this entry a us-unit latency series silently gated FAIL-LOW,
+# i.e. it would have flagged an IMPROVEMENT and waved regressions
+# through (direction pinned in tests/test_fleet_observability.py).
 LOWER_IS_BETTER_UNITS = (
-    "ms", "s", "ms/token", "ms/dispatch", "requests", "bytes", "bytes/token"
+    "ms", "s", "ms/token", "ms/dispatch", "requests", "bytes",
+    "bytes/token", "us", "µs", "us/token", "µs/token",
 )
 
 DEFAULT_TOLERANCE = 0.5

@@ -388,6 +388,151 @@ def bench_speculation(
     }
 
 
+def _decode_two_point(model, params, cache0, tok0, engine, *, k=16, reps=3):
+    """Per-decode-step seconds via the TWO-POINT method (CLAUDE.md
+    TIMING TRAP 2): time a warm k-step and a 4k-step compiled
+    ``decode_slots`` chain and divide the DIFFERENCE by 3k, so the
+    per-dispatch fixed cost (a ~100 ms round-trip on the tunneled chip)
+    cancels instead of diluting into every step. Each measurement ends
+    in a D2H token fetch BEFORE the clock read — the only trustworthy
+    barrier."""
+    import jax
+    from jax import lax
+
+    def chain(steps):
+        @jax.jit
+        def run(params, cache, tok):
+            def body(carry, _):
+                tok, cache = carry
+                logits, cache = model.decode_slots(
+                    params, tok, cache, engine=engine
+                )
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                return (nxt, cache), ()
+
+            (tok, cache), _ = lax.scan(
+                body, (tok, cache), None, length=steps
+            )
+            return tok
+
+        return run
+
+    run_k, run_4k = chain(k), chain(4 * k)
+    int(run_k(params, cache0, tok0)[0])  # compile + warm
+    int(run_4k(params, cache0, tok0)[0])
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        out = fn(params, cache0, tok0)
+        _ = int(out[0])  # the fetch happens BEFORE perf_counter below
+        return time.perf_counter() - t0
+
+    vals = []
+    for _ in range(reps):
+        tk = timed(run_k)
+        t4k = timed(run_4k)
+        vals.append((t4k - tk) / (3 * k))
+    return float(np.median(vals))
+
+
+def bench_decode_engine(
+    *,
+    cache_lens: tuple[int, ...] = (256, 1024),
+    kv_dtypes: tuple[str, ...] = ("bf16", "int8"),
+    two_point_k: int = 16,
+    model_kw=None,
+) -> dict:
+    """Fused-Pallas vs unrolled-XLA decode engine A/B (round 18): per
+    (engine, kv_dtype, cache_len) config, µs/token over a slots=1
+    ``decode_slots`` chain measured with the two-point method, the cache
+    prefilled to half its length so attention spans a real resident
+    cache. The PALLAS rows are measured ONLY on a real TPU backend —
+    off-chip the kernel runs the Pallas *interpreter*, whose wall time
+    is a correctness artifact, not a latency record (worse than
+    meaningless: it would seed the gate band with garbage); skipped
+    engines land in ``pending`` with that provenance, and the chip
+    session's rerun (``--decode-engine``) fills them as a fresh
+    device-keyed series."""
+    import jax
+
+    rows, pending = [], []
+    device = jax.devices()[0].device_kind
+    on_tpu = jax.default_backend() == "tpu"
+    engines = ("xla", "pallas") if on_tpu else ("xla",)
+    if not on_tpu:
+        pending.append(
+            {
+                "engine": "pallas",
+                "note": "interpreter-only off-TPU; rerun "
+                "serve_bench --decode-engine on the chip",
+            }
+        )
+    for c in cache_lens:
+        mk = dict(
+            vocab_size=512, max_len=c, model_dim=128, num_heads=4,
+            num_layers=2,
+        )
+        mk.update(model_kw or {})
+        model, params = _build(mk)
+        rng = np.random.default_rng(5)
+        prompt = rng.integers(0, model.vocab_size, (c // 2,)).astype(
+            np.int32
+        )
+        for kv in kv_dtypes:
+            cache = model.empty_slot_cache(1, kv)
+            _, cache = model.prefill_slots(
+                params,
+                cache,
+                jnp.asarray(prompt[None, :]),
+                jnp.asarray([prompt.size], jnp.int32),
+                jnp.ones((1,), bool),
+            )
+            tok0 = jnp.zeros((1,), jnp.int32)
+            for engine in engines:
+                per_step = _decode_two_point(
+                    model, params, cache, tok0, engine, k=two_point_k
+                )
+                rows.append(
+                    {
+                        "engine": engine,
+                        "kv_dtype": kv,
+                        "cache_len": int(c),
+                        "us_per_token": round(per_step * 1e6, 2),
+                        "tokens_per_s": round(1.0 / per_step, 1),
+                    }
+                )
+    # Fused speedup per (kv, cache) pair when both engines measured.
+    speedups = []
+    for c in cache_lens:
+        for kv in kv_dtypes:
+            pair = {
+                r["engine"]: r
+                for r in rows
+                if r["kv_dtype"] == kv and r["cache_len"] == c
+            }
+            if "xla" in pair and "pallas" in pair:
+                speedups.append(
+                    {
+                        "kv_dtype": kv,
+                        "cache_len": int(c),
+                        "fused_speedup": round(
+                            pair["xla"]["us_per_token"]
+                            / pair["pallas"]["us_per_token"],
+                            2,
+                        ),
+                    }
+                )
+    return {
+        "device": device,
+        "slots": 1,
+        "two_point_steps": [two_point_k, 4 * two_point_k],
+        "model": {"model_dim": 128, "num_layers": 2, "num_heads": 4},
+        "rows": rows,
+        "speedups": speedups,
+        "pending": pending,
+    }
+
+
 def bench_fleet(
     *,
     replicas: int = 3,
@@ -818,6 +963,41 @@ def emit_bench_events(payload: dict, events_path: str) -> list[dict]:
         j.close()
 
 
+def emit_decode_events(payload: dict, events_path: str) -> list[dict]:
+    """The decode-engine A/B's gate-covered series: one
+    ``decode_us_per_token`` bench_point per measured (engine, kv_dtype,
+    cache_len) config, unit ``us/token`` — lower-is-better after the
+    round-18 unit-direction fix, so the gate fails HIGH on a latency
+    regression. Config is encoded in the series NAME (the gate bands by
+    (tool, name, device) — attrs alone would collapse every config into
+    one band); pending (unmeasured) engines emit nothing, so the chip
+    rerun starts those series fresh under its own device key."""
+    from distributed_tensorflow_tpu.observability.journal import EventJournal
+
+    de = payload["decode_engine"]
+    j = EventJournal(events_path, run_id="serve_bench")
+    try:
+        common = dict(tool="serve_bench", device=de["device"])
+        return [
+            j.emit(
+                "bench_point",
+                name=(
+                    f"decode_us_per_token_{r['engine']}_{r['kv_dtype']}"
+                    f"_c{r['cache_len']}"
+                ),
+                value=r["us_per_token"],
+                unit="us/token",
+                engine=r["engine"],
+                kv_dtype=r["kv_dtype"],
+                cache_len=r["cache_len"],
+                **common,
+            )
+            for r in de["rows"]
+        ]
+    finally:
+        j.close()
+
+
 def emit_fleet_events(payload: dict, events_path: str) -> list[dict]:
     """The fleet row's gate-covered bench_point series (round-12 gate:
     tokens/s fails LOW, the ttft ``s`` unit fails HIGH). The
@@ -967,6 +1147,45 @@ def render(payload: dict) -> str:
             "treat the speedup as TUNNEL-TPU until the v5e rerun, like "
             "the round-13 int8 training row.",
         ]
+    de = payload.get("decode_engine")
+    if de:
+        dev = de.get("device", "?")
+        lines += [
+            "",
+            "## Fused decode-step engine A/B (`decode_engine`, "
+            "ops/pallas_decode.py)",
+            "",
+            "| engine | KV dtype | cache len | µs/token | tokens/s |",
+            "|---|---|---|---|---|",
+        ]
+        for r in de["rows"]:
+            lines.append(
+                f"| {r['engine']} | {r['kv_dtype']} | {r['cache_len']} "
+                f"| {r['us_per_token']} ({dev}) | {r['tokens_per_s']} |"
+            )
+        for s in de.get("speedups", []):
+            lines += [
+                "",
+                f"**Fused speedup ({s['kv_dtype']}, C={s['cache_len']}): "
+                f"{s['fused_speedup']}x** µs/token vs the unrolled XLA "
+                "engine.",
+            ]
+        lines += [
+            "",
+            f"Two-point method (k = {de['two_point_steps'][0]} vs "
+            f"{de['two_point_steps'][1]} warm compiled decode steps, "
+            "slots=1, cache prefilled to half its length; Δ/(3k) with a "
+            "D2H token fetch before every clock read), so the "
+            "per-dispatch fixed cost cancels out of the per-token "
+            "number.",
+        ]
+        for p in de.get("pending", []):
+            lines.append(
+                f"PENDING `{p['engine']}` rows: {p['note']} — the fused "
+                "kernel's latency claim (one launch per block at L=1, "
+                "int8/fp8 KV dequantized in-kernel) is measurable only "
+                "where Mosaic compiles it."
+            )
     sp = payload.get("speculation")
     if sp:
         lines += [
@@ -1138,10 +1357,33 @@ def main(argv=None) -> int:
         "— the other rows are untouched, so a fleet refresh needs no "
         "chip and no full rerun",
     )
+    ap.add_argument(
+        "--decode-engine",
+        action="store_true",
+        help="run ONLY the fused-vs-XLA decode engine A/B and merge its "
+        "section into the committed serving.json (the --fleet merge "
+        "pattern); on the chip this fills the pallas rows, off-chip it "
+        "measures the xla rows and records the pallas ones as pending",
+    )
     args = ap.parse_args(argv)
     events_path = args.events
     if events_path is None and args.write_docs:
         events_path = os.path.join(_docs_root(), "events.jsonl")
+    if args.decode_engine:
+        de = bench_decode_engine()
+        with open(os.path.join(_docs_root(), "serving.json")) as f:
+            payload = json.load(f)
+        payload["decode_engine"] = de
+        print(json.dumps(de))
+        if args.write_docs:
+            write_docs(payload)
+            print(f"wrote {_docs_root()}/serving.md and serving.json")
+        else:
+            print(render(payload))
+        if events_path:
+            n = len(emit_decode_events(payload, events_path))
+            print(f"appended {n} bench_point events to {events_path}")
+        return 0
     if args.fleet:
         fleet = bench_fleet()
         with open(os.path.join(_docs_root(), "serving.json")) as f:
@@ -1164,13 +1406,15 @@ def main(argv=None) -> int:
         chunk=args.chunk,
     )
     # A full rerun re-measures every engine row but not the fleet row
-    # (subprocess bench, its own --fleet entry point): carry the
-    # committed fleet section forward instead of silently dropping it.
+    # (subprocess bench, its own --fleet entry point) or the decode
+    # engine A/B (its own --decode-engine entry point): carry the
+    # committed sections forward instead of silently dropping them.
     try:
         with open(os.path.join(_docs_root(), "serving.json")) as f:
             old = json.load(f)
-        if "fleet" in old:
-            payload.setdefault("fleet", old["fleet"])
+        for key in ("fleet", "decode_engine"):
+            if key in old:
+                payload.setdefault(key, old[key])
     except (OSError, ValueError):
         pass
     print(json.dumps(payload))

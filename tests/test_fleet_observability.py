@@ -762,6 +762,35 @@ def test_gate_bytes_units_fail_high():
     assert res["failures"][0]["direction"] == "above"
 
 
+def test_gate_microsecond_units_fail_high():
+    # Round 18 unit-direction fix: before "us"/"µs" entered
+    # LOWER_IS_BETTER_UNITS, a microsecond latency series (serve_bench's
+    # decode_us_per_token) gated FAIL-LOW — it would have flagged an
+    # improvement and waved a latency regression straight through.
+    mk = lambda vals, unit: [  # noqa: E731
+        (i, v, unit) for i, v in enumerate(vals)
+    ]
+    for unit in ("us", "µs", "us/token", "µs/token"):
+        assert unit in regression_gate.LOWER_IS_BETTER_UNITS
+        # Latency going UP past the band fails...
+        res = regression_gate.check_series(
+            {("serve_bench", "decode_us_per_token"): mk(
+                [300.0, 310.0, 900.0], unit
+            )},
+            tolerance=0.5,
+        )
+        [f] = res["failures"]
+        assert f["direction"] == "above" and f["unit"] == unit
+        # ...and a large improvement (the old silent-fail-LOW case)
+        # never does.
+        assert not regression_gate.check_series(
+            {("serve_bench", "decode_us_per_token"): mk(
+                [300.0, 310.0, 40.0], unit
+            )},
+            tolerance=0.5,
+        )["failures"]
+
+
 def test_obs_report_comm_payload_rendering():
     # Round 17: bytes/round + effective compression beside the
     # steps-per-round line; full-precision segments render exactly the
