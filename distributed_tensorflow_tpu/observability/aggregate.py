@@ -213,6 +213,12 @@ def gang_chrome_trace(merged: dict) -> dict:
         "request_submit",
         "admission",
         "completion",
+        # Round 19: injected-fault + corrupt-mailbox-recovery events stay
+        # PER-RANK instants (never GANG_KINDS — multiple ranks can record
+        # the same kind with colliding anchor keys, which would poison
+        # estimate_skew's shared-lifecycle-anchor matching).
+        "failpoint",
+        "mailbox_corrupt",
     )
     for ev in stamped:
         kind = ev.get("kind")
@@ -289,6 +295,7 @@ def fleet_summary(merged: dict) -> dict:
         kind = ev.get("kind")
         if kind in GANG_KINDS or kind in (
             "preemption", "rollback", "restore", "weight_swap", "serve_drain",
+            "failpoint", "mailbox_corrupt",
         ):
             try:
                 line = obs_format.render(kind, ev)[0]

@@ -169,6 +169,28 @@ def _weight_swap(ev: dict) -> str:
     )
 
 
+def _mailbox_corrupt(ev: dict) -> str:
+    # Round 19 (CRC-hardened mailboxes): a committed-but-corrupt post.
+    # "skipped" = delta mailbox (watermark advanced past it, never
+    # consumed); "quarantined" = fleet mailbox (removed, never delivered).
+    line = (
+        f"Mailbox: corrupt mailbox={ev['mailbox']} file={ev['file']} "
+        f"reason={ev['reason']} action={ev['action']}"
+    )
+    if "peer" in ev:
+        line += f" peer={ev['peer']} round={ev['round']}"
+    if "box" in ev:
+        line += f" box={ev['box']}"
+    return line
+
+
+def _failpoint(ev: dict) -> str:
+    # Round 19 (train/failpoints.py): an injected fault fired.
+    return (
+        f"Failpoint: name={ev['name']} fault={ev['fault']} hit={ev['hit']}"
+    )
+
+
 RENDERERS = {
     "step": _step,
     "epoch": _epoch,
@@ -187,6 +209,8 @@ RENDERERS = {
     "fleet_below_floor": _fleet_below_floor,
     "serve_drain": _serve_drain,
     "weight_swap": _weight_swap,
+    "mailbox_corrupt": _mailbox_corrupt,
+    "failpoint": _failpoint,
 }
 
 

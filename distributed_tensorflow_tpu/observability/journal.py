@@ -49,6 +49,7 @@ import re
 import time
 
 from distributed_tensorflow_tpu.observability import tracing
+from distributed_tensorflow_tpu.train import failpoints
 
 _SEGMENT = re.compile(r"\.(\d+)$")
 
@@ -147,6 +148,7 @@ class EventJournal(NullJournal):
     def _rotate(self) -> None:
         """Retire the active file as the next ``.k`` segment (``.1`` is
         the oldest). Single-writer only — see the module docstring."""
+        failpoints.fire("journal.rotate")
         os.close(self._fd)
         self._fd = None
         taken = [
@@ -157,6 +159,9 @@ class EventJournal(NullJournal):
         os.replace(self.path, f"{self.path}.{max(taken, default=0) + 1}")
 
     def emit(self, kind: str, **fields) -> dict:
+        # Failpoint before any I/O; fire() guards its own reentrancy, so
+        # the `failpoint` event it journals cannot recurse through here.
+        failpoints.fire("journal.append")
         ev: dict = {"ts": self._clock(), "kind": kind}
         if self.rank is not None:
             ev["rank"] = int(self.rank)

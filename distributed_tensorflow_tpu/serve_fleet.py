@@ -100,7 +100,7 @@ from distributed_tensorflow_tpu.observability import journal as obs_journal
 from distributed_tensorflow_tpu.observability import tracing
 from distributed_tensorflow_tpu.observability.metrics import MetricsRegistry
 from distributed_tensorflow_tpu.serve_pool import RequestCancelled
-from distributed_tensorflow_tpu.train import resilience
+from distributed_tensorflow_tpu.train import failpoints, resilience
 from distributed_tensorflow_tpu.train.elastic import (
     ElasticAgent,
     HttpHealth,
@@ -134,10 +134,30 @@ class FleetBelowFloor(WorkerFailure):
 write_json_atomic = resilience.write_json_atomic
 
 
-def _read_dir(dirpath: str) -> list[dict]:
+def _payload_crc(obj: dict) -> int:
+    """CRC32C envelope over the canonical JSON bytes of a mailbox
+    payload (sort_keys — writer and reader must agree byte-for-byte).
+    Round-6 kernel: native fast path, table fallback, bit-identical."""
+    return resilience._crc32c_bytes(
+        json.dumps(obj, sort_keys=True).encode("utf-8")
+    )
+
+
+def _read_dir(dirpath: str, on_corrupt=None) -> list[dict]:
     """Read-and-remove every committed JSON file in ``dirpath``, oldest
-    first (filenames carry a zero-padded sequence)."""
+    first (filenames carry a zero-padded sequence).
+
+    Integrity (round 19): payloads carry a ``_crc`` envelope
+    (:func:`_payload_crc`, popped before delivery); a committed file
+    that fails the CRC or will not parse is QUARANTINED — removed,
+    never delivered, surfaced via ``on_corrupt(name, reason)`` — so
+    corrupt bytes cannot poison the router/replica AND cannot be
+    re-read forever (the pre-round-19 behavior left unparseable files
+    in place for every subsequent poll). Payloads without ``_crc``
+    (older writers) deliver unchecked. Transient OSError on open skips
+    WITHOUT removing — a racing writer's commit lands by next poll."""
     out = []
+    failpoints.fire("fleet.read")
     try:
         names = sorted(os.listdir(dirpath))
     except OSError:
@@ -148,11 +168,31 @@ def _read_dir(dirpath: str) -> list[dict]:
         path = os.path.join(dirpath, name)
         try:
             with open(path, encoding="utf-8") as f:
-                out.append(json.load(f))
-            os.remove(path)
-        except (OSError, ValueError):  # pragma: no cover — racing writer
+                obj = json.load(f)
+        except OSError:  # pragma: no cover — racing writer
             continue
+        except ValueError:
+            _quarantine(path, name, "json", on_corrupt)
+            continue
+        crc = obj.pop("_crc", None) if isinstance(obj, dict) else None
+        if crc is not None and crc != _payload_crc(obj):
+            _quarantine(path, name, "crc", on_corrupt)
+            continue
+        try:
+            os.remove(path)
+        except OSError:  # pragma: no cover — racing reader took it
+            continue
+        out.append(obj)
     return out
+
+
+def _quarantine(path: str, name: str, reason: str, on_corrupt) -> None:
+    try:
+        os.remove(path)
+    except OSError:  # pragma: no cover
+        pass
+    if on_corrupt is not None:
+        on_corrupt(name, reason)
 
 
 class MailboxClient:
@@ -161,36 +201,77 @@ class MailboxClient:
     (replica → router: results). Both sides write atomically; the
     directories outlive the replica process — that persistence is the
     storage half of the zero-loss contract (committed results survive a
-    crash; everything else visibly lacks a result and re-admits)."""
+    crash; everything else visibly lacks a result and re-admits).
 
-    def __init__(self, root: str):
+    Round 19: every write carries a ``_crc`` envelope verified (and
+    popped) on read; corrupt committed files are quarantined — removed,
+    never delivered, counted in ``corrupt_files`` and journaled as
+    ``mailbox_corrupt`` (the router wires its journal in; standalone
+    clients ride the process default). Stale ``.tmp`` orphans from
+    writers killed mid-write are age-guard swept at construction and on
+    ``clear_inbox`` (:func:`resilience.sweep_tmp_orphans` — the age
+    guard keeps a live writer's in-flight tmp safe). Failpoints:
+    ``fleet.submit``/``fleet.result`` (entry + tear of the committed
+    file), ``fleet.read`` at every poll."""
+
+    def __init__(self, root: str, *, journal=None, orphan_age_s: float = 60.0):
         self.root = root
         self.inbox = os.path.join(root, "inbox")
         self.outbox = os.path.join(root, "outbox")
         os.makedirs(self.inbox, exist_ok=True)
         os.makedirs(self.outbox, exist_ok=True)
         self._seq = 0
+        self.journal = journal
+        self.orphan_age_s = float(orphan_age_s)
+        self.corrupt_files = 0  # quarantined corrupt mailbox files
+        for d in (self.inbox, self.outbox):
+            resilience.sweep_tmp_orphans(d, age_s=self.orphan_age_s)
 
     def _next(self, dirpath: str, tag: str) -> str:
         self._seq += 1
         return os.path.join(dirpath, f"{self._seq:08d}-{tag}.json")
 
+    def _write(self, path: str, payload: dict) -> str:
+        body = dict(payload)
+        body["_crc"] = _payload_crc(payload)
+        write_json_atomic(path, body)
+        return path
+
+    def _on_corrupt(self, box: str):
+        def cb(name: str, reason: str) -> None:
+            self.corrupt_files += 1
+            j = self.journal
+            if j is None:
+                j = obs_journal.get_journal()
+            j.emit(
+                "mailbox_corrupt",
+                mailbox="fleet",
+                box=box,
+                file=name,
+                reason=reason,
+                action="quarantined",
+            )
+
+        return cb
+
     # -- router side -------------------------------------------------------
 
     def submit(self, payload: dict) -> None:
-        write_json_atomic(
+        failpoints.fire("fleet.submit")
+        path = self._write(
             self._next(self.inbox, payload.get("trace", "req")), payload
         )
+        failpoints.tear("fleet.submit", path)
 
     def control(self, payload: dict) -> None:
         """Control messages ride the same FIFO stream as requests, so a
         swap lands AFTER everything routed before it."""
-        write_json_atomic(
+        self._write(
             self._next(self.inbox, f"ctl-{payload.get('control')}"), payload
         )
 
     def poll_results(self) -> list[dict]:
-        return _read_dir(self.outbox)
+        return _read_dir(self.outbox, self._on_corrupt("outbox"))
 
     def clear_inbox(self) -> None:
         """Drop undelivered requests (before relaunching a replica: the
@@ -201,16 +282,19 @@ class MailboxClient:
                 os.remove(os.path.join(self.inbox, name))
             except OSError:  # pragma: no cover
                 pass
+        resilience.sweep_tmp_orphans(self.inbox, age_s=self.orphan_age_s)
 
     # -- replica side ------------------------------------------------------
 
     def take_inbox(self) -> list[dict]:
-        return _read_dir(self.inbox)
+        return _read_dir(self.inbox, self._on_corrupt("inbox"))
 
     def put_result(self, payload: dict) -> None:
-        write_json_atomic(
+        failpoints.fire("fleet.result")
+        path = self._write(
             self._next(self.outbox, payload.get("trace", "res")), payload
         )
+        failpoints.tear("fleet.result", path)
 
 
 # ---------------------------------------------------------------------------
@@ -315,6 +399,17 @@ class ReplicaRouter:
         self.replicas = {h.name: h for h in replicas}
         if len(self.replicas) != len(replicas):
             raise ValueError("replica names must be unique")
+        # Mailbox corruption events (round 19) ride the router's journal
+        # unless a client already has its own (fakes lack the attr).
+        for h in replicas:
+            client = getattr(h, "client", None)
+            if (
+                client is not None
+                and hasattr(client, "journal")
+                and client.journal is None
+                and journal is not None
+            ):
+                client.journal = journal
         self.min_replicas = int(min_replicas)
         if not 1 <= self.min_replicas <= len(replicas):
             raise ValueError(

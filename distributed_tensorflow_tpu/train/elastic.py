@@ -115,7 +115,7 @@ from typing import Callable, Sequence
 
 from distributed_tensorflow_tpu.observability import journal as obs_journal
 from distributed_tensorflow_tpu.observability.metrics import MetricsRegistry
-from distributed_tensorflow_tpu.train import resilience
+from distributed_tensorflow_tpu.train import failpoints, resilience
 from distributed_tensorflow_tpu.utils.summary import lifecycle_event
 
 
@@ -264,6 +264,10 @@ class HttpHealth:
         if not url:
             return None
         try:
+            # Failpoint inside the try: an injected raise IS a probe
+            # failure — the classify() verdicts see exactly what a real
+            # unreachable/hung endpoint produces.
+            failpoints.fire("elastic.health")
             doc = self._fetch(url)
         except Exception:  # noqa: BLE001 — any probe failure is "no answer"
             return None
@@ -335,6 +339,7 @@ class ElasticAgent:
 
     def start(self, rank: int | None = None, world: int | None = None,
               ranks: tuple | None = None):
+        failpoints.fire("elastic.relaunch")
         if rank is None:
             self.handle = self._spawn_fn()
         else:

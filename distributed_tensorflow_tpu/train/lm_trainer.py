@@ -202,6 +202,10 @@ class LMTrainer:
                     f"{delta_exchange.stale_limit} disagrees with "
                     f"config.stale_limit={self.config.stale_limit}"
                 )
+            # The exchange's mailbox_corrupt events (round 19) ride this
+            # trainer's journal unless the caller wired its own.
+            if getattr(delta_exchange, "journal", None) is None:
+                delta_exchange.journal = self.journal
         self.mode = self._resolve_mode()
 
         self.state = self._init_state(model.init(seed=self.config.seed))

@@ -114,6 +114,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from distributed_tensorflow_tpu.train import failpoints, resilience
+
 
 class DiLoCoState(NamedTuple):
     """The ``opt_state`` slot of a diloco-mode ``TrainState``.
@@ -851,7 +853,25 @@ class DeltaExchange:
     consumed watermark is in-memory: a member restarted from a
     checkpoint may re-consume posts still inside the window (bounded by
     ``stale_limit`` rounds of peer movement — the same replay bound any
-    restore has)."""
+    restore has).
+
+    Integrity (round 19): every post carries a CRC32C envelope (the
+    round-6 checkpoint-manifest kernel) over the stored array bytes,
+    verified on read. A committed-but-corrupt post (CRC mismatch, bad
+    zip — the storage layer corrupting committed bytes; atomic replace
+    already keeps torn *tmp* files invisible) is SKIPPED, never
+    consumed into the mean: the watermark advances past it (a
+    permanently bad file must not block that peer's later posts
+    forever), a structured ``mailbox_corrupt`` journal event fires, and
+    ``corrupt_posts`` counts it. Transient unreadability (OSError — a
+    shared-fs hiccup, a racing GC) keeps the old contract: break
+    without advancing, retry next boundary. Pre-round-19 posts without
+    a ``crc`` entry verify as legacy (accepted unchecked). Stale
+    ``.tmp`` orphans from writers killed mid-post are age-guard swept
+    on construction and at each post's GC pass
+    (:func:`resilience.sweep_tmp_orphans`)."""
+
+    _CORRUPT = object()  # _load sentinel: committed-but-bad, skip + advance
 
     def __init__(
         self,
@@ -861,6 +881,8 @@ class DeltaExchange:
         *,
         stale_limit: int = 0,
         delta_dtype: str | None = None,
+        journal=None,
+        orphan_age_s: float = 60.0,
     ):
         import os
 
@@ -882,10 +904,33 @@ class DeltaExchange:
         self.world = int(world)
         self.stale_limit = int(stale_limit)
         self.delta_dtype = delta_dtype
+        self.journal = journal  # LMTrainer wires its own; None → process
+        self.orphan_age_s = float(orphan_age_s)
+        self.corrupt_posts = 0  # committed-but-corrupt peer posts skipped
         # Per-peer consumed-round watermark: each posted delta is
         # applied at most once (class docstring).
         self._consumed: dict[int, int] = {}
         os.makedirs(self.dirpath, exist_ok=True)
+        resilience.sweep_tmp_orphans(self.dirpath, age_s=self.orphan_age_s)
+
+    def _emit_corrupt(self, *, file: str, reason: str, peer: int, round_idx: int):
+        self.corrupt_posts += 1
+        j = self.journal
+        if j is None:
+            from distributed_tensorflow_tpu.observability import (
+                journal as obs_journal,
+            )
+
+            j = obs_journal.get_journal()
+        j.emit(
+            "mailbox_corrupt",
+            mailbox="delta",
+            file=file,
+            reason=reason,
+            action="skipped",
+            peer=int(peer),
+            round=int(round_idx),
+        )
 
     def _fname(self, rank: int, round_idx: int) -> str:
         return f"w{rank:04d}_r{round_idx:010d}.npz"
@@ -930,26 +975,48 @@ class DeltaExchange:
         except OSError:
             return None
 
+    @staticmethod
+    def _payload_crc(stored, scales) -> int:
+        """CRC32C envelope over the wire bytes: every stored array's
+        buffer in index order, then the scales. Round-6 kernel
+        (native fast path, table fallback — bit-identical)."""
+        import numpy as np
+
+        blob = b"".join(
+            np.ascontiguousarray(x).tobytes() for x in stored
+        )
+        if scales is not None:
+            blob += np.ascontiguousarray(scales).tobytes()
+        return resilience._crc32c_bytes(blob)
+
     def post(self, round_idx: int, leaves) -> list:
         """Publish round ``round_idx``'s delta (numpy leaves, dense
         parameter order); returns the dequantized leaves exactly as
-        peers will read them."""
+        peers will read them. Failpoints: ``delta.post`` at entry (+
+        tear of the committed npz), ``delta.post.commit`` between the
+        tmp write and the atomic replace."""
         import os
 
         import numpy as np
 
+        failpoints.fire("delta.post")
         stored, scales, deq = _np_encode_delta(leaves, self.delta_dtype)
         payload = {f"a{i}": x for i, x in enumerate(stored)}
         payload["n"] = np.asarray(len(stored), np.int64)
         if scales is not None:
             payload["scales"] = scales
+        payload["crc"] = np.asarray(
+            self._payload_crc(stored, scales), np.int64
+        )
         path = os.path.join(
             self.dirpath, self._fname(self.rank, round_idx)
         )
         tmp = path + f".tmp{os.getpid()}"
         with open(tmp, "wb") as f:
             np.savez(f, **payload)
+        failpoints.fire("delta.post.commit")
         os.replace(tmp, path)  # commit is atomic: readers see all or nothing
+        failpoints.tear("delta.post", path)
         # GC own history past the window (+1 so a peer mid-read of the
         # oldest admissible round never races its unlink).
         floor = round_idx - self.stale_limit - 1
@@ -961,21 +1028,35 @@ class DeltaExchange:
                     )
                 except OSError:
                     pass
+        resilience.sweep_tmp_orphans(self.dirpath, age_s=self.orphan_age_s)
         return deq
 
     def _load(self, rank: int, round_idx: int):
+        """Read + verify a peer post. Returns the decoded leaves, None
+        for TRANSIENT unreadability (vanished to owner GC, an fs
+        hiccup — retried next boundary, watermark unmoved), or
+        ``_CORRUPT`` for a committed-but-bad file (CRC mismatch, torn
+        zip structure, missing keys — skipped forever, watermark
+        advances; class docstring)."""
         import os
+        import zipfile
 
         import numpy as np
 
         path = os.path.join(self.dirpath, self._fname(rank, round_idx))
         try:
+            failpoints.fire("delta.load")
             with np.load(path) as z:
                 n = int(z["n"])
                 stored = [z[f"a{i}"] for i in range(n)]
                 scales = z["scales"] if "scales" in z.files else None
-        except (OSError, KeyError, ValueError):
-            return None  # vanished (owner GC) or torn tmp never commits
+                crc = int(z["crc"]) if "crc" in z.files else None
+        except OSError:
+            return None  # vanished (owner GC) or transient fs hiccup
+        except (KeyError, ValueError, zipfile.BadZipFile, EOFError):
+            return self._CORRUPT  # committed file, broken structure
+        if crc is not None and crc != self._payload_crc(stored, scales):
+            return self._CORRUPT  # committed bytes flipped under the CRC
         return _np_decode_delta(stored, scales, self.delta_dtype)
 
     def gather(self, round_idx: int) -> list[tuple[int, int, float, list]]:
@@ -1012,6 +1093,19 @@ class DeltaExchange:
                     # the watermark past the unread round forever), a
                     # GC'd file simply stops appearing in _scan.
                     break
+                if leaves is self._CORRUPT:
+                    # Committed-but-corrupt: skipped, NEVER consumed
+                    # into the mean — but the watermark must advance
+                    # past it, or a permanently bad file would block
+                    # this peer's later posts forever.
+                    consumed = max(consumed, r)
+                    self._emit_corrupt(
+                        file=self._fname(rank, r),
+                        reason="crc",
+                        peer=rank,
+                        round_idx=r,
+                    )
+                    continue
                 consumed = max(consumed, r)
                 age = max(0, round_idx - r)  # ahead-of-round → fresh
                 out.append(

@@ -55,6 +55,8 @@ import time
 
 import numpy as np
 
+from distributed_tensorflow_tpu.train import failpoints
+
 MANIFEST_FORMAT = "dtf-checkpoint-manifest-v1"
 
 # ---------------------------------------------------------------------------
@@ -122,11 +124,48 @@ def write_json_atomic(path: str, obj: dict) -> None:
     THE crash-consistency primitive — the checkpoint manifests, the
     layout sidecars (train/supervisor.py), and the serving fleet's
     mailbox (serve_fleet.py) all write through here; a future hardening
-    (fsync-before-replace, tmp collision handling) lands once."""
+    (fsync-before-replace, tmp collision handling) lands once.
+
+    Failpoints (round 19): ``atomic.write`` at entry (+ tear of the
+    committed file), ``atomic.write.commit`` between the tmp write and
+    the replace — a kill there is the writer-crash case, leaving only a
+    ``.tmp`` orphan for :func:`sweep_tmp_orphans`."""
+    failpoints.fire("atomic.write")
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(obj, f)
+    failpoints.fire("atomic.write.commit")
     os.replace(tmp, path)
+    failpoints.tear("atomic.write", path)
+
+
+def sweep_tmp_orphans(
+    dirpath: str, *, age_s: float = 60.0, now=None
+) -> list[str]:
+    """Remove stale ``.tmp`` orphans left by writers killed mid-write
+    (the atomic-write protocol's one litter mode: the tmp file of a
+    crashed process is never replaced away). Age-guarded — only files
+    whose mtime is older than ``age_s`` go, so an in-flight write from a
+    live process is never swept. Returns the removed paths. Both
+    filesystem mailboxes (``DeltaExchange``, ``MailboxClient``) call this
+    on construction and from their GC passes (round-19 satellite)."""
+    removed: list[str] = []
+    cutoff = (time.time() if now is None else now) - age_s
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return removed
+    for name in names:
+        if ".tmp" not in name:
+            continue
+        p = os.path.join(dirpath, name)
+        try:
+            if os.path.getmtime(p) <= cutoff and os.path.isfile(p):
+                os.remove(p)
+                removed.append(p)
+        except OSError:
+            continue  # racing writer committed/removed it — fine
+    return removed
 
 
 def manifest_path(checkpoint_dir: str, step: int) -> str:
@@ -174,7 +213,12 @@ def write_manifest(checkpoint_dir: str, step: int, state=None) -> dict:
     given), and the layout sidecar's CRC when present. Written to a tmp
     name then ``os.replace``d — the manifest's presence marks a fully
     committed checkpoint, so a crash mid-save leaves a step that restore
-    classifies as unverified rather than silently trusting it."""
+    classifies as unverified rather than silently trusting it.
+
+    Failpoint ``ckpt.manifest``: fire at entry, tear of the committed
+    manifest after — the torn-manifest schedule is the corruption-cascade
+    scenario (restore must fall back to the newest verifying step)."""
+    failpoints.fire("ckpt.manifest")
     step_dir = os.path.join(checkpoint_dir, f"step_{step}")
     manifest: dict = {
         "format": MANIFEST_FORMAT,
@@ -191,6 +235,7 @@ def write_manifest(checkpoint_dir: str, step: int, state=None) -> dict:
     if state is not None:
         manifest["leaves"], manifest["leaves_complete"] = leaf_checksums(state)
     write_json_atomic(manifest_path(checkpoint_dir, step), manifest)
+    failpoints.tear("ckpt.manifest", manifest_path(checkpoint_dir, step))
     return manifest
 
 
@@ -346,16 +391,24 @@ def retry_io(
     backoff: float = 0.25,
     retry_on: tuple = (OSError,),
     describe: str = "checkpoint I/O",
+    jitter: float = 0.0,
+    rng=None,
+    sleep=time.sleep,
 ):
     """Checkpoint-I/O flavor of :func:`retry` (kept as the narrow public
-    surface Supervisor uses; no jitter — a single process retrying its own
-    disk has nothing to de-synchronize from)."""
+    surface Supervisor uses; jitter defaults OFF — a single process
+    retrying its own disk has nothing to de-synchronize from — but when
+    enabled it takes the same seeded ``rng`` and injectable ``sleep`` as
+    :func:`retry`, so chaos-sweep retry timing is reproducible)."""
     return retry(
         fn,
         attempts=attempts,
         backoff=backoff,
         retry_on=retry_on,
         describe=describe,
+        jitter=jitter,
+        rng=rng,
+        sleep=sleep,
     )
 
 

@@ -226,6 +226,7 @@ class Supervisor:
         policy GCs steps beyond ``keep_last_n`` — never the last valid."""
         if not (self.is_chief and self._ckptr):
             return
+        resilience.failpoints.fire("ckpt.save")
         import time as _time
 
         path = os.path.join(self.checkpoint_dir, f"step_{step}")
@@ -381,6 +382,7 @@ class Supervisor:
             path = os.path.join(self.checkpoint_dir, f"step_{step}")
             abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state)
             try:
+                resilience.failpoints.fire("ckpt.restore")
                 restored = self._retry(
                     lambda: self._ckptr.restore(path, abstract),
                     f"restore step_{step}",

@@ -1,0 +1,441 @@
+"""Failpoint registry (train/failpoints.py) + the round-19 hardening
+satellites — fast tier, jax-light (the registry, the orphan sweep, the
+seeded-backoff knobs, and the renderer/aggregate wiring are all jax-free;
+only the seam smoke tests touch numpy mailboxes).
+
+The load-bearing pins:
+
+- default-off contract: with nothing armed, fire/tear are one-falsy-check
+  no-ops and never count — every hardened path is round-18 behavior;
+- determinism: hit counters, no clock/RNG — the same spec faults the
+  same operation every run, and seeded retry jitter reproduces exactly;
+- registry ↔ docs cross-check: every REGISTERED name is documented in
+  docs/resilience.md §failpoints (the round-12 "widen knowingly"
+  discipline applied to fault names);
+- the journal seam cannot recurse (the failpoint event's own append).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.observability import format as obs_format
+from distributed_tensorflow_tpu.observability import journal as obs_journal
+from distributed_tensorflow_tpu.train import failpoints, resilience
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    failpoints.configure(None)
+    yield
+    failpoints.configure(None)
+
+
+# ---------------------------------------------------------------------------
+# Spec grammar.
+# ---------------------------------------------------------------------------
+
+
+def test_parse_grammar_roundtrips():
+    failpoints.configure(
+        "ckpt.manifest:torn@2, delta.load:raise ,"
+        "journal.append:delay=0.05@3+,atomic.write.commit:kill"
+    )
+    assert failpoints.active() == {
+        "ckpt.manifest": ["ckpt.manifest:torn@2"],
+        "delta.load": ["delta.load:raise@1"],
+        "journal.append": ["journal.append:delay=0.05@3+"],
+        "atomic.write.commit": ["atomic.write.commit:kill@1"],
+    }
+
+
+def test_parse_multiple_specs_per_name():
+    # The corruption-cascade schedule: two torn hits of one seam.
+    failpoints.configure("ckpt.manifest:torn@3,ckpt.manifest:torn@4")
+    assert failpoints.active()["ckpt.manifest"] == [
+        "ckpt.manifest:torn@3",
+        "ckpt.manifest:torn@4",
+    ]
+
+
+def test_parse_rejects_bad_entries():
+    with pytest.raises(ValueError, match="unknown failpoint name"):
+        failpoints.configure("no.such.seam:raise")
+    with pytest.raises(ValueError, match="kind must be one of"):
+        failpoints.configure("delta.load:explode")
+    with pytest.raises(ValueError, match="@N must be >= 1"):
+        failpoints.configure("delta.load:raise@0")
+    with pytest.raises(ValueError, match="only 'delay' takes"):
+        failpoints.configure("delta.load:raise=1.0")
+    with pytest.raises(ValueError, match="expected"):
+        failpoints.configure("delta.load")
+    with pytest.raises(ValueError):
+        failpoints.hit_count("no.such.seam")
+
+
+def test_reset_rearms_from_env(monkeypatch):
+    monkeypatch.setenv(failpoints.ENV_VAR, "delta.load:raise@2")
+    failpoints.reset()
+    assert failpoints.active() == {"delta.load": ["delta.load:raise@2"]}
+    monkeypatch.delenv(failpoints.ENV_VAR)
+    failpoints.reset()
+    assert failpoints.active() == {}
+
+
+def test_arm_stacks_and_resets_that_names_counter():
+    failpoints.configure("delta.load:raise@5")
+    failpoints.fire("delta.load")
+    assert failpoints.hit_count("delta.load") == 1
+    failpoints.arm("delta.load:delay=0@9")
+    assert failpoints.hit_count("delta.load") == 0  # counter reset
+    assert len(failpoints.active()["delta.load"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# Fault kinds + hit semantics.
+# ---------------------------------------------------------------------------
+
+
+def test_default_off_is_a_noop_and_never_counts():
+    for _ in range(3):
+        failpoints.fire("delta.load")
+    assert failpoints.hit_count("delta.load") == 0
+    assert failpoints.tear("delta.post", "/nonexistent") is False
+
+
+def test_raise_on_nth_hit_only():
+    failpoints.configure("delta.load:raise@3")
+    failpoints.fire("delta.load")
+    failpoints.fire("delta.load")
+    with pytest.raises(failpoints.FailpointError):
+        failpoints.fire("delta.load")
+    failpoints.fire("delta.load")  # hit 4: non-persistent, disarmed
+    assert failpoints.hit_count("delta.load") == 4
+
+
+def test_persistent_raise_every_hit_from_n():
+    failpoints.configure("delta.load:raise@2+")
+    failpoints.fire("delta.load")
+    for _ in range(3):
+        with pytest.raises(failpoints.FailpointError):
+            failpoints.fire("delta.load")
+
+
+def test_failpoint_error_is_oserror():
+    # The retry/skip seams under test catch OSError — an injected
+    # transient must ride the same recovery path as a real fs hiccup.
+    assert issubclass(failpoints.FailpointError, OSError)
+    failpoints.configure("ckpt.save:raise")
+    with pytest.raises(OSError):
+        failpoints.fire("ckpt.save")
+
+
+def test_delay_sleeps_arg_seconds():
+    failpoints.configure("journal.rotate:delay=0.05")
+    t0 = time.perf_counter()
+    failpoints.fire("journal.rotate")
+    assert time.perf_counter() - t0 >= 0.05
+
+
+def test_tear_truncates_committed_file_on_matching_hit(tmp_path):
+    p = str(tmp_path / "post.npz")
+    failpoints.configure("delta.post:torn@2")
+    with open(p, "wb") as f:
+        f.write(b"x" * 100)
+    failpoints.fire("delta.post")
+    assert failpoints.tear("delta.post", p) is False  # hit 1: no match
+    assert os.path.getsize(p) == 100
+    failpoints.fire("delta.post")
+    assert failpoints.tear("delta.post", p) is True  # hit 2: torn
+    assert os.path.getsize(p) == 50
+    # tear never counts a hit of its own.
+    assert failpoints.hit_count("delta.post") == 2
+
+
+def test_kill_sigkills_the_process():
+    # Subprocess (jax-free import): the kill kind must take the process
+    # down with SIGKILL, not an exception.
+    code = (
+        "from distributed_tensorflow_tpu.train import failpoints\n"
+        "failpoints.configure('elastic.relaunch:kill')\n"
+        "failpoints.fire('elastic.relaunch')\n"
+        "print('UNREACHED')\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=120,
+    )
+    assert proc.returncode == -9
+    assert "UNREACHED" not in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Journal seam: events land, recursion cannot.
+# ---------------------------------------------------------------------------
+
+
+def test_fired_failpoint_journals_event_without_recursion(tmp_path):
+    # delay on journal.append: EVERY emit hits the seam — including the
+    # `failpoint` event fire() itself emits. The reentrancy guard must
+    # keep that inner append from counting/recursing.
+    old = obs_journal.get_journal()
+    j = obs_journal.configure(str(tmp_path))
+    try:
+        failpoints.configure("journal.append:delay=0@1")
+        j.emit("gang_sync", sync=1)
+        j.emit("gang_sync", sync=2)
+        j.close()
+    finally:
+        obs_journal._default = old
+    events = obs_journal.read_events(str(tmp_path))
+    kinds = [e["kind"] for e in events]
+    assert kinds == ["failpoint", "gang_sync", "gang_sync"]
+    fp = events[0]
+    assert fp["name"] == "journal.append" and fp["fault"] == "delay"
+    assert fp["hit"] == 1
+    # Outer hits only: the failpoint event's own append never counted.
+    assert failpoints.hit_count("journal.append") == 2
+
+
+def test_write_json_atomic_seam_raise_and_tear(tmp_path):
+    p = str(tmp_path / "m.json")
+    failpoints.configure("atomic.write:raise@1")
+    with pytest.raises(failpoints.FailpointError):
+        resilience.write_json_atomic(p, {"a": 1})
+    assert not os.path.exists(p)  # failed before the tmp write
+    resilience.write_json_atomic(p, {"a": 1})  # hit 2: clean
+    assert json.load(open(p)) == {"a": 1}
+    failpoints.configure("atomic.write:torn@1")
+    resilience.write_json_atomic(p, {"a": 2, "pad": "x" * 64})
+    with pytest.raises(ValueError):
+        json.load(open(p))  # committed bytes torn — the CRC-model fault
+
+
+# ---------------------------------------------------------------------------
+# Satellite: registry ↔ docs cross-check.
+# ---------------------------------------------------------------------------
+
+
+def test_every_registered_failpoint_is_documented():
+    doc = open(os.path.join(REPO, "docs", "resilience.md")).read()
+    missing = [n for n in failpoints.REGISTERED if f"`{n}`" not in doc]
+    assert not missing, (
+        f"failpoint names missing from docs/resilience.md §failpoints: "
+        f"{missing} — document the seam (the 'widen knowingly' rule)"
+    )
+
+
+def test_docs_list_no_stale_failpoint_names():
+    # The reverse direction: a name documented but no longer registered
+    # is a stale doc.
+    import re
+
+    doc = open(os.path.join(REPO, "docs", "resilience.md")).read()
+    sect = doc.split("## Failpoints")[1]
+    documented = set(re.findall(r"`((?:atomic|ckpt|delta|fleet|journal|"
+                                r"elastic)\.[a-z._]+)`", sect))
+    stale = documented - set(failpoints.REGISTERED)
+    assert not stale, f"documented but unregistered failpoints: {stale}"
+
+
+# ---------------------------------------------------------------------------
+# Satellite: seeded retry jitter is deterministic.
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_delay_seeded_rng_is_reproducible():
+    def seq(seed):
+        return [
+            resilience.backoff_delay(
+                a, backoff=0.25, jitter=0.5, rng=random.Random(seed)
+            )
+            for a in range(5)
+        ]
+
+    assert seq(7) == seq(7)
+    assert seq(7) != seq(8)  # the jitter is real, just seeded
+    # Default (rng=None) unchanged: jitter=0 stays exact.
+    assert resilience.backoff_delay(2, backoff=0.5) == 2.0
+
+
+def test_retry_and_retry_io_accept_seeded_rng():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    slept = []
+    out = resilience.retry_io(
+        flaky, attempts=5, backoff=0.25, jitter=0.5,
+        rng=random.Random(3), sleep=slept.append,
+    )
+    assert out == "ok" and len(calls) == 3 and len(slept) == 2
+    # Same seed → identical jittered schedule.
+    calls2, slept2 = [], []
+
+    def flaky2():
+        calls2.append(1)
+        if len(calls2) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    resilience.retry_io(
+        flaky2, attempts=5, backoff=0.25, jitter=0.5,
+        rng=random.Random(3), sleep=slept2.append,
+    )
+    assert slept2 == slept
+
+
+# ---------------------------------------------------------------------------
+# Satellite: .tmp orphan sweep (age-guarded).
+# ---------------------------------------------------------------------------
+
+
+def test_sweep_tmp_orphans_age_guard(tmp_path):
+    d = str(tmp_path)
+    old = os.path.join(d, "w0_r3.npz.tmp123")
+    fresh = os.path.join(d, "m.json.tmp.999")
+    committed = os.path.join(d, "w0_r3.npz")
+    for p in (old, fresh, committed):
+        open(p, "wb").close()
+    os.utime(old, (0, 0))
+    os.makedirs(os.path.join(d, "step_3.tmpdir"))  # dirs never swept
+    removed = resilience.sweep_tmp_orphans(d, age_s=60.0)
+    assert removed == [old]
+    assert os.path.exists(fresh), "in-flight write must survive the sweep"
+    assert os.path.exists(committed)
+    assert os.path.isdir(os.path.join(d, "step_3.tmpdir"))
+    # age_s=0 with an explicit future `now` takes the fresh one too.
+    removed2 = resilience.sweep_tmp_orphans(
+        d, age_s=0.0, now=time.time() + 10
+    )
+    assert removed2 == [fresh]
+
+
+def test_mailboxes_sweep_orphans_on_construction(tmp_path):
+    from distributed_tensorflow_tpu.serve_fleet import MailboxClient
+    from distributed_tensorflow_tpu.train.local_sgd import DeltaExchange
+
+    md = tmp_path / "mail"
+    md.mkdir()
+    orphan = md / "w0_r1.npz.tmp42"
+    orphan.write_bytes(b"x")
+    os.utime(orphan, (0, 0))
+    DeltaExchange(str(md), 0, 2)
+    assert not orphan.exists()
+
+    fr = tmp_path / "replica"
+    inbox = fr / "inbox"
+    inbox.mkdir(parents=True)
+    orphan2 = inbox / "00000001-req.json.tmp.7"
+    orphan2.write_bytes(b"x")
+    os.utime(orphan2, (0, 0))
+    MailboxClient(str(fr))
+    assert not orphan2.exists()
+
+
+# ---------------------------------------------------------------------------
+# Observability wiring: renderers + gang timeline.
+# ---------------------------------------------------------------------------
+
+
+def test_mailbox_corrupt_and_failpoint_render_lines():
+    ev = {"mailbox": "delta", "file": "w0_r3.npz", "reason": "crc",
+          "action": "skipped", "peer": 0, "round": 3}
+    assert obs_format.render("mailbox_corrupt", ev) == [
+        "Mailbox: corrupt mailbox=delta file=w0_r3.npz reason=crc "
+        "action=skipped peer=0 round=3"
+    ]
+    ev2 = {"mailbox": "fleet", "box": "outbox", "file": "00000002-t1.json",
+           "reason": "json", "action": "quarantined"}
+    assert obs_format.render("mailbox_corrupt", ev2) == [
+        "Mailbox: corrupt mailbox=fleet file=00000002-t1.json reason=json "
+        "action=quarantined box=outbox"
+    ]
+    assert obs_format.render(
+        "failpoint", {"name": "delta.post", "fault": "torn", "hit": 2}
+    ) == ["Failpoint: name=delta.post fault=torn hit=2"]
+
+
+def test_gang_timeline_renders_fault_and_corruption_events():
+    from distributed_tensorflow_tpu.observability import aggregate
+
+    t0 = 1000.0
+    events = [
+        {"ts": t0, "kind": "worker_start", "pid": 1},
+        {"ts": t0 + 1, "kind": "failpoint", "name": "delta.post",
+         "fault": "torn", "hit": 2},
+        {"ts": t0 + 2, "kind": "mailbox_corrupt", "mailbox": "delta",
+         "file": "w0_r1.npz", "reason": "crc", "action": "skipped",
+         "peer": 0, "round": 1},
+    ]
+    merged = aggregate.merge({"rank0": events})
+    trace = aggregate.gang_chrome_trace(merged)
+    names = [e["name"] for e in trace["traceEvents"] if e.get("ph") == "i"]
+    assert "failpoint" in names and "mailbox_corrupt" in names
+    # NOT gang anchors: injected faults are per-rank instants — they must
+    # never enter estimate_skew's shared-lifecycle matching.
+    assert "failpoint" not in aggregate.GANG_KINDS
+    assert "mailbox_corrupt" not in aggregate.GANG_KINDS
+    summary = aggregate.fleet_summary(merged)
+    kinds = [entry["kind"] for entry in summary["lifecycle"]]
+    assert kinds == ["failpoint", "mailbox_corrupt"]
+    assert summary["lifecycle"][0]["line"].startswith("Failpoint: ")
+    assert summary["lifecycle"][1]["line"].startswith("Mailbox: corrupt ")
+
+
+# ---------------------------------------------------------------------------
+# Chaos sweep driver (in-process scenarios only — the subprocess kill
+# schedule is the RUN_SLOW integration test).
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_sweep_inprocess_schedules_pass():
+    from distributed_tensorflow_tpu.tools import chaos_sweep
+
+    rc = chaos_sweep.main(
+        ["--schedules", "delta-torn,delta-transient,fleet-torn-result,"
+         "fleet-garbage-json", "--seeds", "0,1"]
+    )
+    assert rc == 0
+
+
+def test_chaos_sweep_rejects_unknown_schedule():
+    from distributed_tensorflow_tpu.tools import chaos_sweep
+
+    with pytest.raises(SystemExit):
+        chaos_sweep.main(["--schedules", "no-such-schedule"])
+
+
+# ---------------------------------------------------------------------------
+# Seam smoke: delta mailbox corrupt-vs-transient split (numpy-only; the
+# full matrix lives in test_local_sgd.py).
+# ---------------------------------------------------------------------------
+
+
+def test_delta_post_crc_envelope_on_wire(tmp_path):
+    from distributed_tensorflow_tpu.train.local_sgd import DeltaExchange
+
+    a = DeltaExchange(str(tmp_path), 0, 2, stale_limit=2)
+    a.post(0, [np.ones((2, 3), np.float32)])
+    with np.load(os.path.join(a.dirpath, a._fname(0, 0))) as z:
+        assert "crc" in z.files
+        crc = int(z["crc"])
+    assert crc == a._payload_crc(
+        [np.ones((2, 3), np.float32)], None
+    )

@@ -1107,7 +1107,9 @@ def test_delta_exchange_quantized_wire_and_gc(tmp_path):
 
     a = _exchange(tmp_path, 0, delta_dtype="int8")
     b = _exchange(tmp_path, 1, delta_dtype="int8")
-    x = np.random.default_rng(7).standard_normal((32, 16)).astype(np.float32)
+    # Big enough to amortize npz member overhead incl. the round-19 CRC
+    # envelope (a fixed extra entry on BOTH payloads).
+    x = np.random.default_rng(7).standard_normal((64, 32)).astype(np.float32)
     deq = a.post(0, [x])
     # The poster's returned values ARE what the peer decodes (the EF
     # residual must see the wire, not the intent).
@@ -1129,6 +1131,77 @@ def test_delta_exchange_quantized_wire_and_gc(tmp_path):
     # Torn tmp files are invisible to readers.
     open(os.path.join(str(tmp_path), a._fname(0, 9) + ".tmp123"), "wb").close()
     assert a._rounds_of(0) == rounds
+
+
+# -- round 19: CRC-hardened mailbox — skipped, never consumed ---------------
+
+
+def test_delta_exchange_truncated_post_skipped_never_consumed(tmp_path):
+    # Satellite: a committed-but-truncated npz must not crash the gang
+    # NOR block the peer — the stale-weighted round proceeds without it,
+    # the watermark advances past it (later posts still arrive), and the
+    # skip is observable (counter + structured mailbox_corrupt event).
+    import os
+
+    events = []
+
+    class _Journal:
+        def emit(self, kind, **fields):
+            events.append({"kind": kind, **fields})
+            return fields
+
+    a = _exchange(tmp_path, 0, stale_limit=4)
+    b = _exchange(tmp_path, 1, stale_limit=4, journal=_Journal())
+    la = [np.full((4, 3), 2.0, np.float32)]
+    for r in range(3):
+        a.post(r, la)
+    torn = os.path.join(str(tmp_path), a._fname(0, 1))
+    with open(torn, "r+b") as f:
+        f.truncate(os.path.getsize(torn) // 2)
+    got = b.gather(2)
+    assert [(r, age) for r, age, _, _ in got] == [(0, 2), (0, 0)]
+    assert b.corrupt_posts == 1
+    assert events == [{
+        "kind": "mailbox_corrupt", "mailbox": "delta",
+        "file": a._fname(0, 1), "reason": "crc", "action": "skipped",
+        "peer": 0, "round": 1,
+    }]
+    # Watermark advanced PAST the corrupt round: nothing re-reads it.
+    assert b.gather(2) == [] and b._consumed == {0: 2}
+
+
+def test_delta_exchange_crc_mismatch_is_corrupt(tmp_path):
+    # A structurally valid npz whose payload bytes no longer match the
+    # CRC envelope (bit rot the zip layer happens to miss) is corrupt.
+    import os
+
+    a = _exchange(tmp_path, 0, stale_limit=2)
+    b = _exchange(tmp_path, 1, stale_limit=2)
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    path = os.path.join(str(tmp_path), a._fname(0, 0))
+    np.savez(
+        path,
+        a0=x, n=np.asarray(1, np.int64),
+        crc=np.asarray(a._payload_crc([x], None) ^ 1, np.int64),
+    )
+    assert b.gather(0) == []
+    assert b.corrupt_posts == 1 and b._consumed == {0: 0}
+
+
+def test_delta_exchange_legacy_post_without_crc_accepted(tmp_path):
+    # Round-17 writers carry no crc entry; their posts stay readable.
+    import os
+
+    a = _exchange(tmp_path, 0, stale_limit=2)
+    b = _exchange(tmp_path, 1, stale_limit=2)
+    x = np.full((4, 3), 5.0, np.float32)
+    np.savez(
+        os.path.join(str(tmp_path), a._fname(0, 0)),
+        a0=x, n=np.asarray(1, np.int64),
+    )
+    got = b.gather(0)
+    assert len(got) == 1
+    np.testing.assert_array_equal(got[0][3][0], x)
 
 
 def test_trainer_mailbox_gang_members_share_rounds(tmp_path):

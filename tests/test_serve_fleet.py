@@ -966,6 +966,78 @@ def test_mailbox_round_trip_order_and_crash_persistence(tmp_path):
     assert box.take_inbox() == []
 
 
+# -- round 19: CRC envelopes + quarantine (satellite) -----------------------
+
+
+def test_mailbox_torn_result_during_failover_quarantined_once(tmp_path):
+    # The failover seam: a replica commits results, the storage layer
+    # tears one (failpoint `fleet.result:torn@2`). The router's poll
+    # must deliver the survivors, quarantine the torn file (never
+    # delivered, never re-read — pre-round-19 an unparseable file was
+    # re-read forever), journal it, and the re-served result for the
+    # torn trace arrives on a later poll: every trace exactly once.
+    import os
+
+    from distributed_tensorflow_tpu.train import failpoints
+
+    j = _RecordingJournal()
+    box = MailboxClient(str(tmp_path), journal=j)
+    failpoints.configure("fleet.result:torn@2")
+    try:
+        box.put_result({"trace": "a", "tokens": [1]})
+        box.put_result({"trace": "b", "tokens": [2]})
+        box.put_result({"trace": "c", "tokens": [3]})
+    finally:
+        failpoints.configure(None)
+    got = box.poll_results()
+    assert [r["trace"] for r in got] == ["a", "c"]
+    assert box.corrupt_files == 1
+    (ev,) = j.kinds("mailbox_corrupt")
+    assert ev["mailbox"] == "fleet" and ev["box"] == "outbox"
+    assert ev["action"] == "quarantined"
+    assert box.poll_results() == [] and len(os.listdir(box.outbox)) == 0
+    # Failover re-serve (the router re-admits anything without a
+    # result): the re-posted result delivers — exactly once overall.
+    box.put_result({"trace": "b", "tokens": [2]})
+    assert box.poll_results() == [{"trace": "b", "tokens": [2]}]
+
+
+def test_mailbox_crc_mismatch_quarantined(tmp_path):
+    # A parseable JSON whose _crc doesn't match its payload (bit rot the
+    # JSON layer happens to miss) is quarantined, not delivered.
+    import json
+    import os
+
+    from distributed_tensorflow_tpu.serve_fleet import _payload_crc
+
+    j = _RecordingJournal()
+    box = MailboxClient(str(tmp_path), journal=j)
+    payload = {"trace": "x", "tokens": [7]}
+    bad = dict(payload, _crc=_payload_crc(payload) ^ 1)
+    with open(os.path.join(box.outbox, "00000000-x.json"), "w") as f:
+        json.dump(bad, f)
+    assert box.poll_results() == []
+    assert box.corrupt_files == 1
+    (ev,) = j.kinds("mailbox_corrupt")
+    assert ev["reason"] == "crc"
+    # And the round-trip _crc never leaks into delivered payloads.
+    box.put_result(payload)
+    assert box.poll_results() == [payload]
+
+
+def test_mailbox_inbox_garbage_quarantined_with_valid_delivery(tmp_path):
+    import os
+
+    box = MailboxClient(str(tmp_path))
+    box.submit({"trace": "ok", "tokens": [1]})
+    with open(os.path.join(box.inbox, "00000000-junk.json"), "wb") as f:
+        f.write(b"\x00\xffnot json")
+    taken = box.take_inbox()
+    assert [t["trace"] for t in taken] == ["ok"]
+    assert box.corrupt_files == 1
+    assert box.take_inbox() == []  # garbage gone, nothing re-reads it
+
+
 # ---------------------------------------------------------------------------
 # obs_report --fleet: the per-request join across journals (satellite).
 # ---------------------------------------------------------------------------
