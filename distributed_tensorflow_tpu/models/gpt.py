@@ -45,15 +45,19 @@ from distributed_tensorflow_tpu.ops.quantized import (
 from distributed_tensorflow_tpu.ops.ring_attention import dense_attention
 
 
-# Decode-path implementations (round 18): see GPTLM.__init__'s
+# Decode-path implementations (rounds 18+20): see GPTLM.__init__'s
 # decode_engine comment and ops/pallas_decode.py.
-DECODE_ENGINES = ("auto", "pallas", "xla")
+DECODE_ENGINES = ("auto", "pallas", "pallas-layer", "xla")
 
-# VMEM budget for one block's weights under the fused decode kernel
-# (~10·d² + 2·d·Hkv·Dh elements at compute dtype, all resident across
-# the launch). 8 MiB keeps serving widths (d ≤ ~512 bf16) fused and
-# refuses widths whose FFN pair alone would blow the ~16 MiB VMEM —
-# "auto" silently falls back to XLA there, an explicit "pallas" raises.
+# Per-LAYER VMEM budget for the decode kernels' weights (~10·d² +
+# 2·d·Hkv·Dh elements at compute dtype). Under the round-20 megakernel
+# weights are STREAMED layer by layer, so this caps the one layer
+# resident at a time — the same per-layer arithmetic also bounds the
+# "pallas-layer" kernel, whose single launch holds exactly one block.
+# 8 MiB keeps serving widths (d ≤ ~512 bf16) fused and refuses widths
+# whose FFN pair alone would blow the ~16 MiB VMEM — "auto" silently
+# falls back to XLA there, an explicit pallas variant raises (the
+# message states this cap AND the config's actual per-layer bytes).
 # PROVISIONAL until the chip session measures where the fused win stops
 # (the _FUSED_DQ_CAP_BYTES convention, ops/pallas_attention.py).
 _DECODE_VMEM_WEIGHT_CAP = 8 << 20
@@ -355,20 +359,32 @@ class GPTLM:
                     f"of {MATMUL_DTYPES}"
                 )
         self.matmul_dtype = matmul_dtype
-        # Round 18: which implementation serves the single-token decode
-        # paths (decode_step / decode_slots / decode_paged).
+        # Rounds 18-19: which implementation serves the single-token
+        # decode paths (decode_step / decode_slots / decode_paged) and,
+        # with spec_draft, the verify extend (verify_paged).
         #   "xla"    — the unrolled per-op path (rounds 5-15, bitwise
         #              unchanged; the default everywhere off-TPU).
-        #   "pallas" — the fused decode-step kernel
-        #              (ops/pallas_decode.py): one Pallas launch per
-        #              block per token, weights VMEM-resident, int8/fp8
-        #              KV dequantized in-kernel. Refused LOUDLY at
-        #              construction/call time for unsupported configs
-        #              (MoE FFNs, quantized projection weights, blocks
-        #              too wide for VMEM) instead of silently degrading.
-        #   "auto"   — pallas on TPU when the config is supported, else
-        #              xla (off-TPU auto is ALWAYS xla: the interpreter
-        #              kernel is a correctness tool, not a serving path).
+        #   "pallas" — the round-20 megakernel tier
+        #              (ops/pallas_decode.py decode_token_* /
+        #              verify_tokens_paged): ONE Pallas launch per
+        #              token across ALL layers, per-layer weights
+        #              streamed through index maps, the KV commit done
+        #              in-kernel via aliased cache operands, and the
+        #              speculation verify fused for paged decode.
+        #   "pallas-layer" — the round-18 per-layer kernel: one launch
+        #              per block per token, weights VMEM-resident,
+        #              commit via the external XLA scatter. The escape
+        #              hatch + parity oracle for "pallas" (the
+        #              round-13 fused-vs-split pattern); verify stays
+        #              on XLA.
+        #   Both pallas variants are refused LOUDLY at construction/
+        #   call time for unsupported configs (MoE FFNs, quantized
+        #   projection weights, layers too wide for VMEM) instead of
+        #   silently degrading.
+        #   "auto"   — the megakernel on TPU when the config is
+        #              supported, else xla (off-TPU auto is ALWAYS xla:
+        #              the interpreter kernels are correctness tools,
+        #              not serving paths).
         # Per-call override: decode_*(..., engine=) — TextServer threads
         # its own knob through the chunk scan this way.
         if decode_engine not in DECODE_ENGINES:
@@ -377,11 +393,12 @@ class GPTLM:
                 f"{DECODE_ENGINES}"
             )
         self.decode_engine = decode_engine
-        if decode_engine == "pallas":
+        if decode_engine in ("pallas", "pallas-layer"):
             reason = self._decode_unsupported_reason()
             if reason is not None:
                 raise ValueError(
-                    f"decode_engine='pallas' unsupported: {reason}"
+                    f"decode_engine={decode_engine!r} unsupported: "
+                    f"{reason}"
                 )
 
     # -- init --------------------------------------------------------------
@@ -1131,26 +1148,33 @@ class GPTLM:
             )
         d = self.model_dim
         elem = jnp.dtype(self.compute_dtype).itemsize
-        weight_bytes = (
-            10 * d * d + 2 * d * self.num_kv_heads * self.head_dim
+        attn_bytes = (
+            d * d + 2 * d * self.num_kv_heads * self.head_dim + d * d
         ) * elem
+        ffn_bytes = 8 * d * d * elem
+        weight_bytes = attn_bytes + ffn_bytes
         if weight_bytes > _DECODE_VMEM_WEIGHT_CAP:
             return (
-                f"block weights ({weight_bytes} B at compute dtype) exceed "
-                f"the fused kernel's VMEM-residency cap "
-                f"({_DECODE_VMEM_WEIGHT_CAP} B); the XLA engine streams "
-                "them instead"
+                f"one layer's weights ({weight_bytes} B at compute dtype: "
+                f"attention {attn_bytes} B + FFN {ffn_bytes} B) exceed the "
+                f"fused kernels' per-layer VMEM cap "
+                f"({_DECODE_VMEM_WEIGHT_CAP} B = "
+                f"{_DECODE_VMEM_WEIGHT_CAP >> 20} MiB) — the megakernel "
+                "streams one layer at a time and the per-layer kernel "
+                "holds one block, so the bound is per LAYER either way; "
+                "the XLA engine streams weights from HBM instead"
             )
         return None
 
     def _resolve_decode_engine(self, engine: str | None, params) -> str:
         """Resolve the per-call ``engine`` override (None → the model's
-        ``decode_engine`` knob) to "pallas" or "xla". "pallas" with an
-        unsupported config/params RAISES (a serving deployment must not
-        silently run a different engine than it asked for); "auto" is
-        pallas only on a real TPU backend with a supported config —
-        off-TPU auto always resolves to xla (pinned in
-        tests/test_pallas_decode.py)."""
+        ``decode_engine`` knob) to one of the three CONCRETE engines
+        "pallas" (megakernel tier) / "pallas-layer" (per-layer kernel)
+        / "xla". Either pallas variant with an unsupported config/params
+        RAISES (a serving deployment must not silently run a different
+        engine than it asked for); "auto" is the megakernel only on a
+        real TPU backend with a supported config — off-TPU auto always
+        resolves to xla (pinned in tests/test_pallas_decode.py)."""
         e = self.decode_engine if engine is None else engine
         if e not in DECODE_ENGINES:
             raise ValueError(
@@ -1166,12 +1190,14 @@ class GPTLM:
             reason = (
                 "weight-only quantized decode params (QuantizedLinear "
                 "leaves from decode_weights) route through wo_dot; the "
-                "fused kernel runs compute-dtype weights only"
+                "fused kernels run compute-dtype weights only"
             )
-        if e == "pallas":
+        if e in ("pallas", "pallas-layer"):
             if reason is not None:
-                raise ValueError(f"decode_engine='pallas' unsupported: {reason}")
-            return "pallas"
+                raise ValueError(
+                    f"decode_engine={e!r} unsupported: {reason}"
+                )
+            return e
         # auto
         if reason is not None or jax.default_backend() != "tpu":
             return "xla"
@@ -1364,9 +1390,11 @@ class GPTLM:
         (~20 ops/layer, forward-only), so unrolling costs no meaningful
         compile time; :meth:`prefill` and training keep their scans.
 
-        ``engine`` (round 18, default: the model's ``decode_engine``
-        knob): "pallas" runs each block as ONE fused kernel launch
-        (ops/pallas_decode.py) — same math, one dispatch per layer."""
+        ``engine`` (rounds 18+20, default: the model's ``decode_engine``
+        knob): "pallas" runs the WHOLE step as ONE megakernel launch
+        (weights streamed per layer, KV commit in-kernel);
+        "pallas-layer" runs each block as one fused launch with the
+        external scatter commit — same math either way."""
         if not isinstance(cache.length, jax.core.Tracer):
             if int(cache.length) >= self.max_len:
                 raise ValueError(
@@ -1376,7 +1404,27 @@ class GPTLM:
         h = self._embed_tokens(
             params, token[:, None], jnp.reshape(cache.length, (1,))
         )
-        if self._resolve_decode_engine(engine, params) == "pallas":
+        eng = self._resolve_decode_engine(engine, params)
+        if eng == "pallas":
+            from distributed_tensorflow_tpu.ops.pallas_decode import (
+                decode_token_slab,
+            )
+
+            b = token.shape[0]
+            lengths = jnp.broadcast_to(
+                jnp.asarray(cache.length, jnp.int32), (b,)
+            )
+            hr, nk, nv, _, _ = decode_token_slab(
+                h[:, 0], self._decode_kernel_weights(params.blocks),
+                cache.k, cache.v, None, None, lengths,
+                jnp.ones((b,), jnp.int32),
+                num_heads=self.num_heads, window=self.window,
+                kv_dtype="bf16", compute_dtype=self.compute_dtype,
+                rope=self.pos_embedding == "rope",
+            )
+            new_cache = KVCache(k=nk, v=nv, length=cache.length + 1)
+            return self._logits(params, hr[:, None])[:, 0], new_cache
+        if eng == "pallas-layer":
             from distributed_tensorflow_tpu.ops.pallas_decode import (
                 decode_block_slab,
             )
@@ -1721,7 +1769,10 @@ class GPTLM:
             params, token[:, None], cache.lengths[:, None]
         )
         qd = self._kv_quant_dtype(cache)
-        if self._resolve_decode_engine(engine, params) == "pallas":
+        eng = self._resolve_decode_engine(engine, params)
+        if eng == "pallas":
+            return self._decode_slots_mega(params, h, cache, act, qd)
+        if eng == "pallas-layer":
             return self._decode_slots_pallas(params, h, cache, act, qd)
         nks, nvs, nksc, nvsc = [], [], [], []
         for i in range(self.num_layers):
@@ -1784,6 +1835,34 @@ class GPTLM:
             lengths=lengths + act.astype(jnp.int32),
             k_scale=None if qd is None else jnp.stack(nksc),
             v_scale=None if qd is None else jnp.stack(nvsc),
+        )
+        return self._logits(params, hr[:, None])[:, 0], new_cache
+
+    def _decode_slots_mega(self, params, h, cache, act, qd):
+        """Megakernel half of :meth:`decode_slots` (round 20): ONE
+        ``ops/pallas_decode.decode_token_slab`` launch covers every
+        layer AND the fresh-row commit — the cache arrays come back
+        written at the same indices :meth:`_commit_slot_rows` scatters
+        to (inactive rows skip in-kernel, the scatter's no-op,
+        bit-for-bit); only the logits head stays XLA (round-13 rule)."""
+        from distributed_tensorflow_tpu.ops.pallas_decode import (
+            decode_token_slab,
+        )
+
+        hr, nk, nv, nks, nvs = decode_token_slab(
+            h[:, 0], self._decode_kernel_weights(params.blocks),
+            cache.k, cache.v,
+            None if qd is None else cache.k_scale,
+            None if qd is None else cache.v_scale,
+            cache.lengths, act.astype(jnp.int32),
+            num_heads=self.num_heads, window=self.window,
+            kv_dtype=qd or "bf16", compute_dtype=self.compute_dtype,
+            rope=self.pos_embedding == "rope",
+        )
+        new_cache = SlotKVCache(
+            k=nk, v=nv,
+            lengths=cache.lengths + act.astype(jnp.int32),
+            k_scale=nks, v_scale=nvs,
         )
         return self._logits(params, hr[:, None])[:, 0], new_cache
 
@@ -1954,6 +2033,58 @@ class GPTLM:
             k=nk, v=nv, k_scale=nksc, v_scale=nvsc
         )
 
+    def verify_paged(
+        self,
+        params: GPTLMParams,
+        cache: PagedKVCache,
+        tokens: jax.Array,
+        suffix_lens: jax.Array,
+        prefix_lens: jax.Array,
+        admit: jax.Array,
+        *,
+        engine: str | None = None,
+    ):
+        """The speculation-verify EXTEND (round 20): exactly
+        :meth:`extend_paged`'s contract — (per-position logits
+        [S, L, vocab], cache with K/V written, lengths/tables
+        caller-owned) — but engine-dispatched the way the decode paths
+        are. "pallas" runs ``ops/pallas_decode.verify_tokens_paged``:
+        ONE launch across all layers with the suffix causal block
+        folded into the online softmax and the valid rows committed
+        in-kernel (logits head stays XLA, round-13 rule). "xla" and
+        "pallas-layer" delegate to :meth:`extend_paged` verbatim (the
+        per-layer kernel has no multi-row step — XLA verify is its
+        pairing, and the parity oracle for the fused one). Greedy-exact
+        acceptance rides on the shared round-15 round-trip rule: both
+        engines attend exactly the values the cache stores."""
+        eng = self._resolve_decode_engine(engine, params)
+        if eng != "pallas":
+            return self.extend_paged(
+                params, cache, tokens, suffix_lens, prefix_lens, admit
+            )
+        from distributed_tensorflow_tpu.ops.pallas_decode import (
+            verify_tokens_paged,
+        )
+
+        s, l = tokens.shape
+        positions = prefix_lens[:, None] + jnp.arange(l)[None, :]
+        h = self._embed_tokens(params, tokens, positions)
+        qd = self._kv_quant_dtype(cache)
+        hr, nk, nv, nks, nvs = verify_tokens_paged(
+            h, self._decode_kernel_weights(params.blocks),
+            cache.k, cache.v,
+            None if qd is None else cache.k_scale,
+            None if qd is None else cache.v_scale,
+            cache.block_tables, prefix_lens, suffix_lens,
+            admit.astype(jnp.int32),
+            num_heads=self.num_heads, window=self.window,
+            kv_dtype=qd or "bf16", compute_dtype=self.compute_dtype,
+            rope=self.pos_embedding == "rope",
+        )
+        return self._logits(params, hr), cache._replace(
+            k=nk, v=nv, k_scale=nks, v_scale=nvs
+        )
+
     def _decode_block_paged(self, blk, h, pk, pv, block_tables, lengths,
                             act, pks=None, pvs=None, qd=None):
         """Per-slot single-token block step against the BLOCK POOL —
@@ -2040,7 +2171,10 @@ class GPTLM:
             params, token[:, None], cache.lengths[:, None]
         )
         qd = self._kv_quant_dtype(cache)
-        if self._resolve_decode_engine(engine, params) == "pallas":
+        eng = self._resolve_decode_engine(engine, params)
+        if eng == "pallas":
+            return self._decode_paged_mega(params, h, cache, act, qd)
+        if eng == "pallas-layer":
             return self._decode_paged_pallas(params, h, cache, act, qd)
         nks, nvs, nksc, nvsc = [], [], [], []
         for i in range(self.num_layers):
@@ -2107,6 +2241,34 @@ class GPTLM:
             lengths=lengths + act.astype(jnp.int32),
             k_scale=None if qd is None else jnp.stack(nksc),
             v_scale=None if qd is None else jnp.stack(nvsc),
+        )
+        return self._logits(params, hr[:, None])[:, 0], new_cache
+
+    def _decode_paged_mega(self, params, h, cache, act, qd):
+        """Megakernel half of :meth:`decode_paged` (round 20): ONE
+        ``ops/pallas_decode.decode_token_paged`` launch covers every
+        layer and commits the fresh rows through the block tables
+        in-kernel (inactive rows issue no DMA — the
+        ``scatter_token_kv`` sentinel-drop, bit-for-bit; the sentinel
+        itself never materializes)."""
+        from distributed_tensorflow_tpu.ops.pallas_decode import (
+            decode_token_paged,
+        )
+
+        hr, nk, nv, nks, nvs = decode_token_paged(
+            h[:, 0], self._decode_kernel_weights(params.blocks),
+            cache.k, cache.v,
+            None if qd is None else cache.k_scale,
+            None if qd is None else cache.v_scale,
+            cache.block_tables, cache.lengths, act.astype(jnp.int32),
+            num_heads=self.num_heads, window=self.window,
+            kv_dtype=qd or "bf16", compute_dtype=self.compute_dtype,
+            rope=self.pos_embedding == "rope",
+        )
+        new_cache = cache._replace(
+            k=nk, v=nv,
+            lengths=cache.lengths + act.astype(jnp.int32),
+            k_scale=nks, v_scale=nvs,
         )
         return self._logits(params, hr[:, None])[:, 0], new_cache
 

@@ -44,21 +44,58 @@ themselves are attended with the fresh position masked OUT
 (``idx != slot`` / ``idx < length``): the kernel reads the PRE-write
 cache, so the write's slot must come from registers, not memory.
 
-The one-row cache COMMIT stays outside the launch (models/gpt.py applies
-the same ``.at[rows, slot].set`` / ``scatter_token_kv`` index math as
-the XLA engine): TPU output blocks may only be revisited on consecutive
-grid steps, so an in-kernel scatter would either copy the whole cache
-through an aliased output (doubling the HBM traffic this kernel exists
-to remove) or need a manual-DMA HBM path; the row is S·Hkv·Dh elements
-— negligible next to the cache read — and XLA fuses the scatter with
-the launch's epilogue. Same division of labor as the fused flash
+In the PER-LAYER kernel the one-row cache COMMIT stays outside the
+launch (models/gpt.py applies the same ``.at[rows, slot].set`` /
+``scatter_token_kv`` index math as the XLA engine): TPU output blocks
+may only be revisited on consecutive grid steps, so an in-kernel
+scatter would either copy the whole cache through an aliased output
+(doubling the HBM traffic this kernel exists to remove) or need a
+manual-DMA HBM path. Same division of labor as the fused flash
 backward's dq-partial sum (ops/pallas_attention.py).
+
+Round 20 grows the per-layer kernel into a per-TOKEN tier
+(:func:`decode_token_slab` / :func:`decode_token_paged` /
+:func:`verify_tokens_paged` — ``decode_engine="pallas"``; the per-layer
+kernel stays as ``"pallas-layer"``, the escape hatch + parity oracle,
+the round-13 fused-vs-split pattern):
+
+- **Multi-layer megakernel**: the layer loop joins the grid as the
+  OUTERMOST dimension ``(n_layers, S, Hkv·nc + 1)`` and per-layer
+  weights are STREAMED through layer-indexed block maps instead of
+  held constant-index-map resident — one launch per token amortizes
+  the per-layer launch overhead, and the VMEM weight budget becomes a
+  per-LAYER cap (only the current layer's blocks are resident). The
+  residual rows live in an [S, d] f32 VMEM scratch across the whole
+  sequential grid.
+- **In-kernel cache commit**: the cache arrays ride the launch TWICE —
+  once as BlockSpec-pipelined read operands (unchanged structure) and
+  once as ``memory_space=ANY`` operands aliased input→output
+  (``input_output_aliases``), written by small manual DMAs at each
+  layer's finalize step. That sidesteps the output-revisit rule (the
+  commit is a DMA, not a pipelined output block) without copying the
+  cache. Inactive rows SKIP the DMA — exactly the XLA scatter's
+  drop-at-sentinel / write-old-value-back no-op, so the committed
+  bytes match the XLA index math bit-for-bit on the storage dtype
+  (scale side tensors included). Writes are disjoint from every read
+  by construction: the kernel attends the PRE-write cache (write slot
+  masked out / ``idx < length`` strict), and active slots never share
+  writable blocks (the serve_pool allocator invariant — COW prefixes
+  are read-only).
+- **Fused speculation-verify**: a small-L (L ≤ spec_draft+1) paged
+  verify kernel — the ragged ``extend_paged`` math with the suffix
+  causal block folded into the online-softmax init, fresh rows
+  round-tripped through the storage dtype (round-15 uniform rule),
+  strict ``idx < prefix_len`` cache validity, and per-position commit
+  DMAs gated on ``li < suffix_len`` — the greedy-exact acceptance
+  contract ("a bad draft never changes a token") rides on the same
+  quantize-on-write parity as the decode kernels.
 
 ``interpret=None`` auto-selects the Pallas interpreter off-TPU and the
 Mosaic compiler on TPU (the ops/pallas_attention.py convention); parity
 vs the XLA engine is pinned in tests/test_pallas_decode.py (interpreter)
 and recorded on-chip by ``tools/attention_parity.py --write-docs``
-(``decode-fused-vs-xla:*`` rows).
+(``decode-fused-vs-xla:*`` per-layer rows; round 20 adds
+``decode-mega-vs-xla:*`` and ``verify-fused-vs-xla:*``).
 """
 
 from __future__ import annotations
@@ -108,9 +145,11 @@ def _ln_row(x, scale_ref, bias_ref):
 
 
 def _rope_rows(x, pos_f, dh: int, base: float):
-    """Rotary embedding on [rows, Dh] at one shared position (all rows
-    of a decode step sit at the slot's own position) — the
-    models/gpt._rope pair rotation in f32."""
+    """Rotary embedding on [rows, Dh] — the models/gpt._rope pair
+    rotation in f32. ``pos_f`` is a scalar (all rows at the slot's own
+    position — the decode step) or a [rows, 1] f32 column (per-row
+    positions — the verify kernel's suffix rows); both broadcast
+    against the [1, half] frequency row identically."""
     half = dh // 2
     io = lax.broadcasted_iota(jnp.float32, (1, half), 1)
     # base ** (-i/half) in the models/gpt._rope evaluation order (the
@@ -561,3 +600,864 @@ def decode_block_paged(
         rope_base=rope_base, block_c=None, cache_len=pool_k.shape[1],
         interpret=interpret,
     )
+
+
+# -- round 20: the per-token megakernel tier -------------------------------
+
+
+def _dma(src, dst, sem):
+    """One synchronous manual copy (start + wait) — the in-kernel cache
+    commit's write primitive. Serialized on one DMA semaphore: commits
+    are a few rows per layer, latency-insignificant next to the cache
+    read stream."""
+    cp = pltpu.make_async_copy(src, dst, sem)
+    cp.start()
+    cp.wait()
+
+
+def _mega_decode_kernel(
+    *refs,
+    n_layers: int, nc: int, hkv_n: int, g: int, dh: int, bc: int,
+    cache_len: int, window: int | None, rolling: bool, paged: bool,
+    bs: int, kv_q: str | None, cd, rope: bool, rope_base: float,
+    n_prefetch: int,
+):
+    lens_ref, act_ref = refs[0], refs[1]
+    tab_ref = refs[2] if paged else None
+    i = n_prefetch
+    (h_ref, wq_ref, wk_ref, wv_ref, wo_ref, ln1s_ref, ln1b_ref,
+     ln2s_ref, ln2b_ref, wup_ref, bup_ref, wdn_ref, bdn_ref,
+     ck_ref, cv_ref) = refs[i:i + 15]
+    i += 15
+    if kv_q is not None:
+        ks_ref, vs_ref = refs[i:i + 2]
+        i += 2
+    # ANY-space alias sources: unused in the body (their whole purpose
+    # is donating the cache buffers into the outputs).
+    i += 2 if kv_q is None else 4
+    if kv_q is not None:
+        ho_any, cko, cvo, kso, vso = refs[i:i + 5]
+        i += 5
+    else:
+        ho_any, cko, cvo = refs[i:i + 3]
+        kso = vso = None
+        i += 3
+    (h_scr, hn_scr, q_scr, m_scr, l_scr, acc_scr, attn_scr,
+     kf_scr, vf_scr) = refs[i:i + 9]
+    i += 9
+    if kv_q is not None:
+        ksc_scr, vsc_scr = refs[i:i + 2]
+        i += 2
+    else:
+        ksc_scr = vsc_scr = None
+    out_scr, sem = refs[i], refs[i + 1]
+
+    l_i = pl.program_id(0)
+    s_i = pl.program_id(1)
+    j = pl.program_id(2)
+    t_att = hkv_n * nc
+    jc = jnp.minimum(j, t_att - 1)
+    hkv = jc // nc
+    ic = jc % nc
+    length = lens_ref[s_i]
+    is_act = act_ref[s_i] != 0
+    scale = 1.0 / math.sqrt(dh)
+
+    @pl.when((l_i == 0) & (j == 0))
+    def _seed_residual():
+        pl.store(h_scr, (pl.ds(s_i, 1), slice(None)), h_ref[:])
+
+    h_row = pl.load(h_scr, (pl.ds(s_i, 1), slice(None)))  # [1, d] f32
+
+    @pl.when(j == 0)
+    def _ln1():
+        hn_scr[:] = _ln_row(h_row, ln1s_ref[0], ln1b_ref[0])
+
+    @pl.when((j < t_att) & (ic == 0))
+    def _head_start():
+        # Identical math to _fused_decode_kernel's head start, with the
+        # weight blocks carrying a leading streamed-layer axis and the
+        # fresh quantized rows landing in scratch for the commit DMA.
+        hn = hn_scr[:].astype(cd)
+        wq = wq_ref[0]
+        for gi in range(g):
+            q_scr[gi:gi + 1, :] = jnp.dot(
+                hn, wq[:, gi * dh:(gi + 1) * dh],
+                preferred_element_type=jnp.float32,
+            )
+        kf = jnp.dot(hn, wk_ref[0], preferred_element_type=jnp.float32)
+        vf = jnp.dot(hn, wv_ref[0], preferred_element_type=jnp.float32)
+        if rope:
+            pos_f = length.astype(jnp.float32)
+            q_scr[:] = _rope_rows(q_scr[:], pos_f, dh, rope_base)
+            kf = _rope_rows(kf, pos_f, dh, rope_base)
+        if kv_q is None:
+            kq_row = kf.astype(kf_scr.dtype)
+            vq_row = vf.astype(vf_scr.dtype)
+            kf_att = kq_row.astype(jnp.float32)
+            vf_att = vq_row.astype(jnp.float32)
+        else:
+            kq_row, k_sc = _quant_row(kf, kv_q)
+            vq_row, v_sc = _quant_row(vf, kv_q)
+            kf_att = (kq_row.astype(jnp.float32) * k_sc).astype(cd).astype(
+                jnp.float32
+            )
+            vf_att = (vq_row.astype(jnp.float32) * v_sc).astype(cd).astype(
+                jnp.float32
+            )
+            # Head column selected by iota mask — scale scratch is a
+            # [1, Hkv] row, no lane-dynamic store.
+            col = lax.broadcasted_iota(jnp.int32, (1, hkv_n), 1) == hkv
+            ksc_scr[:] = jnp.where(col, k_sc[0, 0], ksc_scr[:])
+            vsc_scr[:] = jnp.where(col, v_sc[0, 0], vsc_scr[:])
+        pl.store(kf_scr, (pl.ds(hkv, 1), slice(None)), kq_row)
+        pl.store(vf_scr, (pl.ds(hkv, 1), slice(None)), vq_row)
+        sf = jnp.sum(q_scr[:] * kf_att, axis=-1, keepdims=True) * scale
+        m_scr[:] = sf
+        l_scr[:] = jnp.ones_like(l_scr)
+        acc_scr[:] = jnp.broadcast_to(vf_att, acc_scr.shape)
+
+    def _attend():
+        kblk = ck_ref[0, 0, :, 0, :]  # [bc, Dh]
+        vblk = cv_ref[0, 0, :, 0, :]
+        if kv_q is None:
+            kb = kblk.astype(jnp.float32)
+            vb = vblk.astype(jnp.float32)
+        else:
+            hsel = (
+                lax.broadcasted_iota(jnp.int32, (1, hkv_n), 1) == hkv
+            ).astype(jnp.float32)
+            ksc = jnp.sum(ks_ref[0, 0] * hsel, axis=-1, keepdims=True)
+            vsc = jnp.sum(vs_ref[0, 0] * hsel, axis=-1, keepdims=True)
+            kb = (kblk.astype(jnp.float32) * ksc).astype(cd).astype(
+                jnp.float32
+            )
+            vb = (vblk.astype(jnp.float32) * vsc).astype(cd).astype(
+                jnp.float32
+            )
+        sblk = jnp.dot(
+            q_scr[:], kb.T, preferred_element_type=jnp.float32
+        ) * scale  # [g, bc]
+        idx = ic * bc + lax.broadcasted_iota(jnp.int32, (g, bc), 1)
+        if rolling:
+            slot = length % cache_len
+            slot_pos = length - jnp.mod(slot - idx, cache_len)
+            valid = (slot_pos >= 0) & (idx != slot)
+        else:
+            valid = idx < length
+            if window is not None:
+                valid &= idx > length - window
+        sblk = jnp.where(valid, sblk, _NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(sblk, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.where(valid, jnp.exp(sblk - m_new), 0.0)
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jnp.dot(
+            p, vb, preferred_element_type=jnp.float32
+        )
+        m_scr[:] = m_new
+
+    if rolling:
+        live = j < t_att
+    else:
+        live = (j < t_att) & (ic * bc < length)
+        if window is not None:
+            live &= (ic + 1) * bc - 1 > length - window
+    pl.when(live)(_attend)
+
+    @pl.when((j < t_att) & (ic == nc - 1))
+    def _head_end():
+        out_h = acc_scr[:] / l_scr[:]  # l >= exp(m_f - m) > 0 always
+        pl.store(attn_scr, (pl.ds(hkv * g, g), slice(None)), out_h)
+
+    @pl.when(j == t_att)
+    def _final():
+        attn = attn_scr[:].astype(cd)  # [Hq, Dh]
+        wo = wo_ref[0]
+        d = wo.shape[1]
+        out = jnp.zeros((1, d), jnp.float32)
+        for h in range(hkv_n * g):
+            out = out + jnp.dot(
+                attn[h:h + 1, :], wo[h * dh:(h + 1) * dh, :],
+                preferred_element_type=jnp.float32,
+            )
+        h1 = h_row + out
+        hn2 = _ln_row(h1, ln2s_ref[0], ln2b_ref[0])
+        up = jnp.dot(
+            hn2.astype(cd), wup_ref[0], preferred_element_type=jnp.float32
+        ) + bup_ref[0]
+        dn = jnp.dot(
+            jax.nn.gelu(up).astype(cd), wdn_ref[0],
+            preferred_element_type=jnp.float32,
+        ) + bdn_ref[0]
+        h_new = h1 + dn
+        pl.store(h_scr, (pl.ds(s_i, 1), slice(None)), h_new)
+
+        # In-kernel commit: the XLA engines' exact scatter index math
+        # (slot = length % C rolling / length absolute; paged through
+        # the block table at position length), as manual DMAs into the
+        # aliased cache outputs. Inactive rows SKIP — the scatter's
+        # drop / write-old-back no-op, bit-for-bit.
+        @pl.when(is_act)
+        def _commit():
+            if paged:
+                blk_i = tab_ref[s_i, length // bs]
+                off = length % bs
+                _dma(kf_scr, cko.at[l_i, blk_i, off], sem)
+                _dma(vf_scr, cvo.at[l_i, blk_i, off], sem)
+                if kv_q is not None:
+                    _dma(ksc_scr, kso.at[l_i, blk_i, pl.ds(off, 1)], sem)
+                    _dma(vsc_scr, vso.at[l_i, blk_i, pl.ds(off, 1)], sem)
+            else:
+                slot = length % cache_len if rolling else length
+                _dma(kf_scr, cko.at[l_i, s_i, slot], sem)
+                _dma(vf_scr, cvo.at[l_i, s_i, slot], sem)
+                if kv_q is not None:
+                    _dma(ksc_scr, kso.at[l_i, s_i, pl.ds(slot, 1)], sem)
+                    _dma(vsc_scr, vso.at[l_i, s_i, pl.ds(slot, 1)], sem)
+
+        @pl.when(l_i == n_layers - 1)
+        def _emit():
+            out_scr[:] = h_new
+            _dma(out_scr, ho_any.at[pl.ds(s_i, 1)], sem)
+
+
+def _stacked_weight_inputs(w: dict, cd):
+    """Layer-stacked counterpart of :func:`_weight_inputs`: every leaf
+    keeps its leading [n_layers] axis (the streamed dimension);
+    projections cast to the compute dtype, layernorm/bias rows f32 as
+    [n_layers, 1, n]."""
+    n = w["wq"].shape[0]
+    row = lambda a: a.astype(jnp.float32).reshape(n, 1, -1)  # noqa: E731
+    return [
+        w["wq"].astype(cd), w["wk"].astype(cd), w["wv"].astype(cd),
+        w["wo"].astype(cd),
+        row(w["ln1_scale"]), row(w["ln1_bias"]),
+        row(w["ln2_scale"]), row(w["ln2_bias"]),
+        w["w_up"].astype(cd), row(w["b_up"]),
+        w["w_down"].astype(cd), row(w["b_down"]),
+    ]
+
+
+def _stacked_weight_specs(w, d, g, dh, headmap, lconst):
+    """BlockSpecs streaming ONE layer's weights per grid step: every
+    map leads with the layer coordinate, so Mosaic double-buffers the
+    next layer's blocks while the current one computes — the VMEM
+    budget is per-layer, not per-model."""
+    return [
+        pl.BlockSpec((1, d, g * dh), headmap),  # wq columns of the head group
+        pl.BlockSpec((1, d, dh), headmap),      # wk column
+        pl.BlockSpec((1, d, dh), headmap),      # wv column
+        pl.BlockSpec((1, d, d), lconst),        # wo
+        pl.BlockSpec((1, 1, d), lconst),        # ln1 scale
+        pl.BlockSpec((1, 1, d), lconst),        # ln1 bias
+        pl.BlockSpec((1, 1, d), lconst),        # ln2 scale
+        pl.BlockSpec((1, 1, d), lconst),        # ln2 bias
+        pl.BlockSpec((1, d, w["w_up"].shape[-1]), lconst),
+        pl.BlockSpec((1, 1, w["w_up"].shape[-1]), lconst),
+        pl.BlockSpec((1, w["w_down"].shape[-2], d), lconst),
+        pl.BlockSpec((1, 1, d), lconst),        # b_down
+    ]
+
+
+def _mega_call(
+    h, w, ck, cv, k_scale, v_scale, lengths, active, tables,
+    *, num_heads, window, rolling, kv_dtype, compute_dtype,
+    rope, rope_base, block_c, cache_len, interpret,
+):
+    """Launch builder for the multi-layer megakernel: ONE launch per
+    token over grid ``(n_layers, S, Hkv·nc + 1)``. ``ck``/``cv`` (and
+    scales) are the FULL layer-stacked cache arrays; they enter the
+    call twice — blocked read operands plus ANY-space operands aliased
+    onto the outputs (``input_output_aliases``; alias indices count the
+    scalar-prefetch operands) — and come back committed."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    s, d = h.shape
+    n_layers = ck.shape[0]
+    hkv_n, dh = ck.shape[-2], ck.shape[-1]
+    g = num_heads // hkv_n
+    kv_q = None if kv_dtype == "bf16" else kv_dtype
+    paged = tables is not None
+    if paged:
+        bc = ck.shape[2]  # pool block size
+        nc = tables.shape[1]
+    else:
+        bc = _pick_cache_block(ck.shape[2], block_c)
+        nc = ck.shape[2] // bc
+    t_total = hkv_n * nc + 1
+    t_att = hkv_n * nc
+
+    def _hkv_ic(j):
+        jc = jnp.minimum(j, t_att - 1)
+        return jc // nc, jc % nc
+
+    n_prefetch = 3 if paged else 2
+
+    if paged:
+        def cmap(l_i, s_i, j, lens, act, tab):
+            hkv, ic = _hkv_ic(j)
+            return (l_i, tab[s_i, ic], 0, hkv, 0)
+
+        def smap(l_i, s_i, j, lens, act, tab):
+            _, ic = _hkv_ic(j)
+            return (l_i, tab[s_i, ic], 0, 0)
+    else:
+        def cmap(l_i, s_i, j, lens, act):
+            hkv, ic = _hkv_ic(j)
+            return (l_i, s_i, ic, hkv, 0)
+
+        def smap(l_i, s_i, j, lens, act):
+            _, ic = _hkv_ic(j)
+            return (l_i, s_i, ic, 0)
+
+    def hmap(l_i, s_i, j, *pref):
+        return (s_i, 0)
+
+    def headmap(l_i, s_i, j, *pref):
+        return (l_i, 0, _hkv_ic(j)[0])
+
+    def lconst(l_i, s_i, j, *pref):
+        return (l_i, 0, 0)
+
+    in_specs = [pl.BlockSpec((1, d), hmap)]
+    in_specs += _stacked_weight_specs(w, d, g, dh, headmap, lconst)
+    in_specs += [
+        pl.BlockSpec((1, 1, bc, 1, dh), cmap),  # cache K block
+        pl.BlockSpec((1, 1, bc, 1, dh), cmap),  # cache V block
+    ]
+    inputs = [h.astype(jnp.float32)]
+    inputs += _stacked_weight_inputs(w, compute_dtype)
+    inputs += [ck, cv]
+    if kv_q is not None:
+        in_specs += [
+            pl.BlockSpec((1, 1, bc, hkv_n), smap),
+            pl.BlockSpec((1, 1, bc, hkv_n), smap),
+        ]
+        inputs += [k_scale, v_scale]
+    # The alias sources: same arrays again, whole-buffer ANY operands.
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    n_alias = 2 if kv_q is None else 4
+    alias_base = n_prefetch + len(inputs)
+    in_specs += [any_spec] * n_alias
+    inputs += [ck, cv] if kv_q is None else [ck, cv, k_scale, v_scale]
+
+    out_specs = [any_spec] * (1 + n_alias)
+    out_shape = [jax.ShapeDtypeStruct((s, d), jnp.float32)]
+    out_shape += [
+        jax.ShapeDtypeStruct(a.shape, a.dtype)
+        for a in ([ck, cv] if kv_q is None else [ck, cv, k_scale, v_scale])
+    ]
+    aliases = {alias_base + i: 1 + i for i in range(n_alias)}
+
+    storage = ck.dtype
+    scratch = [
+        pltpu.VMEM((s, d), jnp.float32),           # residual rows
+        pltpu.VMEM((1, d), jnp.float32),           # hn (post-LN1 row)
+        pltpu.VMEM((g, dh), jnp.float32),          # q of the current head
+        pltpu.VMEM((g, 1), jnp.float32),           # m
+        pltpu.VMEM((g, 1), jnp.float32),           # l
+        pltpu.VMEM((g, dh), jnp.float32),          # acc
+        pltpu.VMEM((num_heads, dh), jnp.float32),  # per-head attn out
+        pltpu.VMEM((hkv_n, dh), storage),          # fresh K rows (commit src)
+        pltpu.VMEM((hkv_n, dh), storage),          # fresh V rows
+    ]
+    if kv_q is not None:
+        scratch += [
+            pltpu.VMEM((1, hkv_n), jnp.float32),   # fresh K scales
+            pltpu.VMEM((1, hkv_n), jnp.float32),   # fresh V scales
+        ]
+    scratch += [
+        pltpu.VMEM((1, d), jnp.float32),           # h_out DMA staging
+        pltpu.SemaphoreType.DMA,
+    ]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=n_prefetch,
+        grid=(n_layers, s, t_total),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+    )
+    kern = partial(
+        _mega_decode_kernel,
+        n_layers=n_layers, nc=nc, hkv_n=hkv_n, g=g, dh=dh, bc=bc,
+        cache_len=cache_len, window=window, rolling=rolling, paged=paged,
+        bs=bc if paged else 0, kv_q=kv_q, cd=compute_dtype,
+        rope=rope, rope_base=rope_base, n_prefetch=n_prefetch,
+    )
+    prefetch = (lengths.astype(jnp.int32), active.astype(jnp.int32))
+    if paged:
+        prefetch += (tables.astype(jnp.int32),)
+    outs = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=tuple(out_shape),
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(*prefetch, *inputs)
+    if kv_q is not None:
+        ho, nk, nv, nks, nvs = outs
+        return ho, nk, nv, nks, nvs
+    ho, nk, nv = outs
+    return ho, nk, nv, None, None
+
+
+def decode_token_slab(
+    h: jax.Array,
+    weights: dict,
+    ck: jax.Array,
+    cv: jax.Array,
+    k_scale: jax.Array | None,
+    v_scale: jax.Array | None,
+    lengths: jax.Array,
+    active: jax.Array,
+    *,
+    num_heads: int,
+    window: int | None = None,
+    kv_dtype: str = "bf16",
+    compute_dtype=jnp.bfloat16,
+    rope: bool = False,
+    rope_base: float = 10000.0,
+    block_c: int | None = None,
+    interpret: bool | None = None,
+):
+    """The WHOLE model's fused single-token step over a SLAB cache —
+    one launch per token (``decode_engine="pallas"``).
+
+    ``h`` [S, d] f32 embedded token rows, ``weights`` the layer-STACKED
+    parameter dict (every leaf leading [n_layers] — the streamed axis),
+    ``ck``/``cv`` [n_layers, S, C, Hkv, Dh], scales
+    [n_layers, S, C, Hkv] f32 or None, ``lengths`` [S] int32 write
+    positions, ``active`` [S] bool (inactive rows compute but never
+    commit — the scatter no-op, in-kernel). Returns
+    ``(h_out [S, d] f32, ck', cv', k_scale', v_scale')`` with the fresh
+    rows ALREADY committed at the XLA engine's exact indices."""
+    return _mega_call(
+        h, weights, ck, cv, k_scale, v_scale, lengths, active, None,
+        num_heads=num_heads, window=window, rolling=window is not None,
+        kv_dtype=kv_dtype, compute_dtype=compute_dtype, rope=rope,
+        rope_base=rope_base, block_c=block_c, cache_len=ck.shape[2],
+        interpret=interpret,
+    )
+
+
+def decode_token_paged(
+    h: jax.Array,
+    weights: dict,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    k_scale: jax.Array | None,
+    v_scale: jax.Array | None,
+    tables: jax.Array,
+    lengths: jax.Array,
+    active: jax.Array,
+    *,
+    num_heads: int,
+    window: int | None = None,
+    kv_dtype: str = "bf16",
+    compute_dtype=jnp.bfloat16,
+    rope: bool = False,
+    rope_base: float = 10000.0,
+    interpret: bool | None = None,
+):
+    """Paged counterpart of :func:`decode_token_slab`:
+    ``pool_k``/``pool_v`` [n_layers, NB, bs, Hkv, Dh] (scales one axis
+    fewer), ``tables`` [S, max_blocks] int32 riding as scalar prefetch.
+    The commit lands at ``(table[s, len // bs], len % bs)`` — inactive
+    rows skip, the ``scatter_token_kv`` sentinel-drop semantics (the
+    sentinel itself never materializes: no DMA is issued at all).
+    Active slots never share writable blocks (the serve_pool allocator
+    invariant), so in-kernel writes stay disjoint from every read."""
+    return _mega_call(
+        h, weights, pool_k, pool_v, k_scale, v_scale, lengths, active,
+        tables,
+        num_heads=num_heads, window=window, rolling=False,
+        kv_dtype=kv_dtype, compute_dtype=compute_dtype, rope=rope,
+        rope_base=rope_base, block_c=None, cache_len=pool_k.shape[2],
+        interpret=interpret,
+    )
+
+
+def _verify_kernel(
+    *refs,
+    n_layers: int, nc: int, hkv_n: int, g: int, dh: int, L: int,
+    window: int | None, bs: int, kv_q: str | None, cd, rope: bool,
+    rope_base: float,
+):
+    plen_ref, slen_ref, act_ref, tab_ref = refs[:4]
+    i = 4
+    (h_ref, wq_ref, wk_ref, wv_ref, wo_ref, ln1s_ref, ln1b_ref,
+     ln2s_ref, ln2b_ref, wup_ref, bup_ref, wdn_ref, bdn_ref,
+     ck_ref, cv_ref) = refs[i:i + 15]
+    i += 15
+    if kv_q is not None:
+        ks_ref, vs_ref = refs[i:i + 2]
+        i += 2
+    i += 2 if kv_q is None else 4  # ANY-space alias sources, unread
+    if kv_q is not None:
+        ho_any, cko, cvo, kso, vso = refs[i:i + 5]
+        i += 5
+    else:
+        ho_any, cko, cvo = refs[i:i + 3]
+        kso = vso = None
+        i += 3
+    (h_scr, hn_scr, q_scr, m_scr, l_scr, acc_scr, attn_scr,
+     kf_scr, vf_scr) = refs[i:i + 9]
+    i += 9
+    if kv_q is not None:
+        ksc_scr, vsc_scr = refs[i:i + 2]
+        i += 2
+    else:
+        ksc_scr = vsc_scr = None
+    out_scr, sem = refs[i], refs[i + 1]
+
+    l_i = pl.program_id(0)
+    s_i = pl.program_id(1)
+    j = pl.program_id(2)
+    t_att = hkv_n * nc
+    jc = jnp.minimum(j, t_att - 1)
+    hkv = jc // nc
+    ic = jc % nc
+    plen = plen_ref[s_i]
+    slen = slen_ref[s_i]
+    is_act = act_ref[s_i] != 0
+    scale = 1.0 / math.sqrt(dh)
+    # Row r of the [g·L, …] q tiles is suffix position r % L of head
+    # hkv·g + r // L.
+    li_col = lax.broadcasted_iota(jnp.int32, (g * L, 1), 0) % L
+
+    @pl.when((l_i == 0) & (j == 0))
+    def _seed_residual():
+        pl.store(h_scr, (pl.ds(s_i * L, L), slice(None)), h_ref[0])
+
+    h_rows = pl.load(h_scr, (pl.ds(s_i * L, L), slice(None)))  # [L, d] f32
+
+    @pl.when(j == 0)
+    def _ln1():
+        hn_scr[:] = _ln_row(h_rows, ln1s_ref[0], ln1b_ref[0])
+
+    @pl.when((j < t_att) & (ic == 0))
+    def _head_start():
+        hn = hn_scr[:].astype(cd)
+        wq = wq_ref[0]
+        for gi in range(g):
+            q_scr[gi * L:(gi + 1) * L, :] = jnp.dot(
+                hn, wq[:, gi * dh:(gi + 1) * dh],
+                preferred_element_type=jnp.float32,
+            )
+        kf = jnp.dot(hn, wk_ref[0], preferred_element_type=jnp.float32)
+        vf = jnp.dot(hn, wv_ref[0], preferred_element_type=jnp.float32)
+        if rope:
+            plen_f = plen.astype(jnp.float32)
+            q_scr[:] = _rope_rows(
+                q_scr[:], plen_f + li_col.astype(jnp.float32), dh, rope_base
+            )
+            pos_k = plen_f + lax.broadcasted_iota(
+                jnp.float32, (L, 1), 0
+            )
+            kf = _rope_rows(kf, pos_k, dh, rope_base)
+        if kv_q is None:
+            kq_rows = kf.astype(kf_scr.dtype)
+            vq_rows = vf.astype(vf_scr.dtype)
+            kf_att = kq_rows.astype(jnp.float32)
+            vf_att = vq_rows.astype(jnp.float32)
+        else:
+            kq_rows, k_sc = _quant_row(kf, kv_q)  # [L, dh], [L, 1]
+            vq_rows, v_sc = _quant_row(vf, kv_q)
+            kf_att = (kq_rows.astype(jnp.float32) * k_sc).astype(cd).astype(
+                jnp.float32
+            )
+            vf_att = (vq_rows.astype(jnp.float32) * v_sc).astype(cd).astype(
+                jnp.float32
+            )
+            col = lax.broadcasted_iota(jnp.int32, (1, hkv_n), 1) == hkv
+            ksc_scr[:] = jnp.where(col, k_sc, ksc_scr[:])
+            vsc_scr[:] = jnp.where(col, v_sc, vsc_scr[:])
+        pl.store(kf_scr, (pl.ds(hkv * L, L), slice(None)), kq_rows)
+        pl.store(vf_scr, (pl.ds(hkv * L, L), slice(None)), vq_rows)
+        # Softmax INIT from the fresh causal block: query row li attends
+        # suffix keys lj ≤ li (within the real suffix; windowed models
+        # also bound the band). Dead rows (no valid key) are guarded —
+        # their m is _NEG_INF and their l stays 0.
+        sf = jnp.dot(
+            q_scr[:], kf_att.T, preferred_element_type=jnp.float32
+        ) * scale  # [g·L, L]
+        lj = lax.broadcasted_iota(jnp.int32, (g * L, L), 1)
+        valid = (lj <= li_col) & (lj < slen)
+        if window is not None:
+            valid &= lj > li_col - window
+        sf = jnp.where(valid, sf, _NEG_INF)
+        m0 = jnp.max(sf, axis=-1, keepdims=True)
+        m_safe = jnp.where(m0 > _NEG_INF * 0.5, m0, 0.0)
+        p = jnp.where(valid, jnp.exp(sf - m_safe), 0.0)
+        m_scr[:] = m0
+        l_scr[:] = jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = jnp.dot(p, vf_att, preferred_element_type=jnp.float32)
+
+    def _attend():
+        kblk = ck_ref[0, 0, :, 0, :]  # [bs, Dh]
+        vblk = cv_ref[0, 0, :, 0, :]
+        if kv_q is None:
+            kb = kblk.astype(jnp.float32)
+            vb = vblk.astype(jnp.float32)
+        else:
+            hsel = (
+                lax.broadcasted_iota(jnp.int32, (1, hkv_n), 1) == hkv
+            ).astype(jnp.float32)
+            ksc = jnp.sum(ks_ref[0, 0] * hsel, axis=-1, keepdims=True)
+            vsc = jnp.sum(vs_ref[0, 0] * hsel, axis=-1, keepdims=True)
+            kb = (kblk.astype(jnp.float32) * ksc).astype(cd).astype(
+                jnp.float32
+            )
+            vb = (vblk.astype(jnp.float32) * vsc).astype(cd).astype(
+                jnp.float32
+            )
+        sblk = jnp.dot(
+            q_scr[:], kb.T, preferred_element_type=jnp.float32
+        ) * scale  # [g·L, bs]
+        idx = ic * bs + lax.broadcasted_iota(jnp.int32, (g * L, bs), 1)
+        valid = idx < plen  # STRICT: the kernel reads the PRE-write pool
+        if window is not None:
+            valid &= idx > plen + li_col - window
+        sblk = jnp.where(valid, sblk, _NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(sblk, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.where(valid, jnp.exp(sblk - m_new), 0.0)
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jnp.dot(
+            p, vb, preferred_element_type=jnp.float32
+        )
+        m_scr[:] = m_new
+
+    live = (j < t_att) & (ic * bs < plen)
+    if window is not None:
+        # A row's band low edge is plen + li − W; the lowest (most
+        # permissive) belongs to li = 0 — skip only blocks that sit
+        # below EVERY row's band.
+        live &= (ic + 1) * bs - 1 > plen - window
+    pl.when(live)(_attend)
+
+    @pl.when((j < t_att) & (ic == nc - 1))
+    def _head_end():
+        out_h = jnp.where(l_scr[:] > 0, acc_scr[:] / l_scr[:], 0.0)
+        pl.store(attn_scr, (pl.ds(hkv * g * L, g * L), slice(None)), out_h)
+
+    @pl.when(j == t_att)
+    def _final():
+        attn = attn_scr[:].astype(cd)  # [Hq·L, Dh]
+        wo = wo_ref[0]
+        d = wo.shape[1]
+        out = jnp.zeros((L, d), jnp.float32)
+        for h in range(hkv_n * g):
+            out = out + jnp.dot(
+                attn[h * L:(h + 1) * L, :], wo[h * dh:(h + 1) * dh, :],
+                preferred_element_type=jnp.float32,
+            )
+        h1 = h_rows + out
+        hn2 = _ln_row(h1, ln2s_ref[0], ln2b_ref[0])
+        up = jnp.dot(
+            hn2.astype(cd), wup_ref[0], preferred_element_type=jnp.float32
+        ) + bup_ref[0]
+        dn = jnp.dot(
+            jax.nn.gelu(up).astype(cd), wdn_ref[0],
+            preferred_element_type=jnp.float32,
+        ) + bdn_ref[0]
+        h_new = h1 + dn
+        pl.store(h_scr, (pl.ds(s_i * L, L), slice(None)), h_new)
+
+        # Per-position commit: extend_paged's scatter validity is
+        # token_mask & admit = (li < slen) & active — invalid positions
+        # issue NO DMA (the sentinel-drop no-op, bit-for-bit).
+        for li in range(L):
+            @pl.when(is_act & (li < slen))
+            def _commit(li=li):
+                pos = plen + li
+                blk_i = tab_ref[s_i, pos // bs]
+                off = pos % bs
+                for hk in range(hkv_n):
+                    _dma(
+                        kf_scr.at[pl.ds(hk * L + li, 1)],
+                        cko.at[l_i, blk_i, off, pl.ds(hk, 1)], sem,
+                    )
+                    _dma(
+                        vf_scr.at[pl.ds(hk * L + li, 1)],
+                        cvo.at[l_i, blk_i, off, pl.ds(hk, 1)], sem,
+                    )
+                if kv_q is not None:
+                    _dma(
+                        ksc_scr.at[pl.ds(li, 1)],
+                        kso.at[l_i, blk_i, pl.ds(off, 1)], sem,
+                    )
+                    _dma(
+                        vsc_scr.at[pl.ds(li, 1)],
+                        vso.at[l_i, blk_i, pl.ds(off, 1)], sem,
+                    )
+
+        @pl.when(l_i == n_layers - 1)
+        def _emit():
+            out_scr[:] = h_new
+            _dma(out_scr, ho_any.at[s_i], sem)
+
+
+def verify_tokens_paged(
+    h: jax.Array,
+    weights: dict,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    k_scale: jax.Array | None,
+    v_scale: jax.Array | None,
+    tables: jax.Array,
+    prefix_lens: jax.Array,
+    suffix_lens: jax.Array,
+    active: jax.Array,
+    *,
+    num_heads: int,
+    window: int | None = None,
+    kv_dtype: str = "bf16",
+    compute_dtype=jnp.bfloat16,
+    rope: bool = False,
+    rope_base: float = 10000.0,
+    interpret: bool | None = None,
+):
+    """Fused small-L speculation-verify over the paged pool — the whole
+    model's ``extend_paged`` math in ONE launch (``decode_engine=
+    "pallas"`` with ``spec_draft > 0``).
+
+    ``h`` [S, L, d] f32 embedded draft rows (L ≤ spec_draft + 1),
+    ``prefix_lens`` [S] committed lengths (positions for rows li are
+    ``prefix + li``), ``suffix_lens`` [S] real suffix sizes (rows past
+    them neither attend as keys nor commit), ``active`` [S] bool.
+    Attention is causal WITHIN the suffix (folded into the softmax init)
+    and STRICT ``idx < prefix_len`` over the pool; fresh K/V round-trips
+    through the storage dtype before both attention and commit (the
+    round-15 uniform rule — greedy-exact acceptance needs the verify
+    pass to attend exactly what the decode pass will). Returns
+    ``(h_out [S, L, d] f32, pool_k', pool_v', k_scale', v_scale')`` with
+    valid rows committed at extend_paged's exact indices; lengths and
+    tables stay caller-owned (the round-11 commit contract)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    s, L, d = h.shape
+    n_layers = pool_k.shape[0]
+    hkv_n, dh = pool_k.shape[-2], pool_k.shape[-1]
+    g = num_heads // hkv_n
+    kv_q = None if kv_dtype == "bf16" else kv_dtype
+    bs = pool_k.shape[2]
+    nc = tables.shape[1]
+    t_total = hkv_n * nc + 1
+    t_att = hkv_n * nc
+
+    def _hkv_ic(j):
+        jc = jnp.minimum(j, t_att - 1)
+        return jc // nc, jc % nc
+
+    def cmap(l_i, s_i, j, plens, slens, act, tab):
+        hkv, ic = _hkv_ic(j)
+        return (l_i, tab[s_i, ic], 0, hkv, 0)
+
+    def smap(l_i, s_i, j, plens, slens, act, tab):
+        _, ic = _hkv_ic(j)
+        return (l_i, tab[s_i, ic], 0, 0)
+
+    def hmap(l_i, s_i, j, *pref):
+        return (s_i, 0, 0)
+
+    def headmap(l_i, s_i, j, *pref):
+        return (l_i, 0, _hkv_ic(j)[0])
+
+    def lconst(l_i, s_i, j, *pref):
+        return (l_i, 0, 0)
+
+    in_specs = [pl.BlockSpec((1, L, d), hmap)]
+    in_specs += _stacked_weight_specs(weights, d, g, dh, headmap, lconst)
+    in_specs += [
+        pl.BlockSpec((1, 1, bs, 1, dh), cmap),
+        pl.BlockSpec((1, 1, bs, 1, dh), cmap),
+    ]
+    inputs = [h.astype(jnp.float32)]
+    inputs += _stacked_weight_inputs(weights, compute_dtype)
+    inputs += [pool_k, pool_v]
+    if kv_q is not None:
+        in_specs += [
+            pl.BlockSpec((1, 1, bs, hkv_n), smap),
+            pl.BlockSpec((1, 1, bs, hkv_n), smap),
+        ]
+        inputs += [k_scale, v_scale]
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    n_alias = 2 if kv_q is None else 4
+    n_prefetch = 4
+    alias_base = n_prefetch + len(inputs)
+    in_specs += [any_spec] * n_alias
+    inputs += (
+        [pool_k, pool_v]
+        if kv_q is None
+        else [pool_k, pool_v, k_scale, v_scale]
+    )
+
+    out_specs = [any_spec] * (1 + n_alias)
+    out_shape = [jax.ShapeDtypeStruct((s, L, d), jnp.float32)]
+    out_shape += [
+        jax.ShapeDtypeStruct(a.shape, a.dtype)
+        for a in (
+            [pool_k, pool_v]
+            if kv_q is None
+            else [pool_k, pool_v, k_scale, v_scale]
+        )
+    ]
+    aliases = {alias_base + i: 1 + i for i in range(n_alias)}
+
+    storage = pool_k.dtype
+    scratch = [
+        pltpu.VMEM((s * L, d), jnp.float32),
+        pltpu.VMEM((L, d), jnp.float32),
+        pltpu.VMEM((g * L, dh), jnp.float32),
+        pltpu.VMEM((g * L, 1), jnp.float32),
+        pltpu.VMEM((g * L, 1), jnp.float32),
+        pltpu.VMEM((g * L, dh), jnp.float32),
+        pltpu.VMEM((num_heads * L, dh), jnp.float32),
+        pltpu.VMEM((hkv_n * L, dh), storage),
+        pltpu.VMEM((hkv_n * L, dh), storage),
+    ]
+    if kv_q is not None:
+        scratch += [
+            pltpu.VMEM((L, hkv_n), jnp.float32),
+            pltpu.VMEM((L, hkv_n), jnp.float32),
+        ]
+    scratch += [
+        pltpu.VMEM((L, d), jnp.float32),
+        pltpu.SemaphoreType.DMA,
+    ]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=n_prefetch,
+        grid=(n_layers, s, t_total),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+    )
+    kern = partial(
+        _verify_kernel,
+        n_layers=n_layers, nc=nc, hkv_n=hkv_n, g=g, dh=dh, L=L,
+        window=window, bs=bs, kv_q=kv_q, cd=compute_dtype,
+        rope=rope, rope_base=rope_base,
+    )
+    outs = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=tuple(out_shape),
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(
+        prefix_lens.astype(jnp.int32),
+        suffix_lens.astype(jnp.int32),
+        active.astype(jnp.int32),
+        tables.astype(jnp.int32),
+        *inputs,
+    )
+    if kv_q is not None:
+        return outs
+    ho, nk, nv = outs
+    return ho, nk, nv, None, None

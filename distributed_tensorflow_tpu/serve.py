@@ -392,17 +392,22 @@ class TextServer:
         if decode_matmul_dtype is not None and params is not None:
             params = model.decode_weights(params, decode_matmul_dtype)
         self.params = params
-        # Decode-engine knob (round 18, docs/serving.md §decode-kernel):
-        # None defers to the model's own ``decode_engine``; "pallas"
-        # runs the k-token chunk scan's per-layer step as ONE fused
-        # kernel launch (ops/pallas_decode.py). The EFFECTIVE engine
-        # (explicit knob OR the model's) is resolved ONCE here so an
-        # unsupported pairing (e.g. decode_matmul_dtype's
-        # QuantizedLinear tree + a pallas model knob) refuses at
-        # construction, not first dispatch. Prefill/extend/spec-verify
-        # stay on XLA — they are batched-L graphs the flash/dense
-        # attention already serves; the kernel's domain is the L=1
-        # chunk scan.
+        # Decode-engine knob (rounds 18+20, docs/serving.md
+        # §decode-kernel): None defers to the model's own
+        # ``decode_engine``; "pallas" runs the k-token chunk scan's
+        # step as ONE megakernel launch per token AND — with
+        # spec_draft — the verify extend as the fused small-L kernel
+        # (ops/pallas_decode.py verify_tokens_paged, threaded through
+        # GPTLM.verify_paged); "pallas-layer" is the round-18
+        # per-layer kernel (verify falls back to XLA there). The
+        # EFFECTIVE engine (explicit knob OR the model's) is resolved
+        # ONCE here so an unsupported pairing (e.g.
+        # decode_matmul_dtype's QuantizedLinear tree + a pallas model
+        # knob) refuses at construction, not first dispatch. Prefill
+        # and the non-spec extend stay on XLA — they are batched-L
+        # graphs the flash/dense attention already serves; the
+        # kernels' domain is the L=1 chunk scan plus the
+        # L ≤ spec_draft+1 verify.
         self.decode_engine = decode_engine
         if params is not None:
             model._resolve_decode_engine(decode_engine, params)
@@ -817,8 +822,9 @@ class TextServer:
         graph's host contract, so the scheduler loop is shared."""
         max_len = self.model.max_len
         act = ~st.finished & (st.lengths < max_len)
-        logits, cache = self.model.extend_paged(
-            params, self._cache(st), suffix, suffix_lens, st.lengths, act
+        logits, cache = self.model.verify_paged(
+            params, self._cache(st), suffix, suffix_lens, st.lengths, act,
+            engine=self.decode_engine,
         )
         s, d1 = suffix.shape
         amax = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, D+1]

@@ -13,13 +13,26 @@ Round 13 adds a ``fused-vs-split:*`` row per case: the one-pass fused
 dq+dk+dv backward (the new default) against the two-kernel split on the
 same forward, so the on-chip record covers the fused kernel explicitly.
 Round 18 adds ``decode-fused-vs-xla:*`` rows: the fused Pallas
-decode-step kernel (ops/pallas_decode.py, ``decode_engine="pallas"``)
-against the unrolled XLA decode engine over a short greedy decode —
-max logit error across steps plus the greedy-token agreement fraction,
-per serving-config feature (dense / GQA / rolling window / paged /
-int8 / fp8 KV). The round-3 lesson applies to these too: the CPU
-interpreter tolerates Mosaic-only bugs, so the rows only count as a
-kernel proof when the header says Mosaic.
+decode-step kernel (ops/pallas_decode.py) against the unrolled XLA
+decode engine over a short greedy decode — max logit error across
+steps plus the greedy-token agreement fraction, per serving-config
+feature (dense / GQA / rolling window / paged / int8 / fp8 KV). The
+round-3 lesson applies to these too: the CPU interpreter tolerates
+Mosaic-only bugs, so the rows only count as a kernel proof when the
+row says Mosaic.
+Round 20: the round-18 engine is now ``decode_engine="pallas-layer"``
+(the case names keep their committed round-18 ids); the new
+``decode-mega-vs-xla:*`` rows run the multi-layer megakernel
+(``decode_engine="pallas"``, one launch per token, in-kernel aliased
+cache commit) over the same matrix, and ``verify-fused-vs-xla:*`` rows
+prove the fused speculation-verify kernel (``GPTLM.verify_paged``)
+against the XLA extend path — logit error + argmax agreement on the
+valid suffix rows AND a bitwise cache/pool check (the commit contract).
+Rows now carry per-row ``device``/``mode`` provenance and
+``--write-docs`` MERGES into the committed record: a Mosaic row is
+never overwritten by an interpreter rerun, so the round-2 on-chip
+record survives off-chip regenerations while new cases land beside it
+tagged with the device that actually ran them.
 
 Usage (on the TPU)::
 
@@ -66,39 +79,66 @@ CASES = [
 ]
 
 
-def _decode_case(name, *, kv_dtype="bf16", heads=4, kv_heads=None,
-                 window=None, paged=False):
+def _decode_case(name, *, engine="pallas", kv_dtype="bf16", heads=4,
+                 kv_heads=None, window=None, paged=False):
     return dict(
-        name=name, kv_dtype=kv_dtype, heads=heads,
+        name=name, engine=engine, kv_dtype=kv_dtype, heads=heads,
         kv_heads=kv_heads or heads, window=window, paged=paged,
     )
 
 
 DECODE_CASES = [
-    _decode_case("decode-fused-vs-xla:dense-bf16"),
-    _decode_case("decode-fused-vs-xla:dense-int8", kv_dtype="int8"),
-    _decode_case("decode-fused-vs-xla:dense-fp8", kv_dtype="fp8"),
-    _decode_case("decode-fused-vs-xla:gqa", heads=8, kv_heads=2),
-    _decode_case("decode-fused-vs-xla:window-rolling", window=16),
+    # Round-18 rows: the per-layer kernel (its engine id became
+    # "pallas-layer" in round 20; the committed case names stay).
+    _decode_case("decode-fused-vs-xla:dense-bf16", engine="pallas-layer"),
     _decode_case(
-        "decode-fused-vs-xla:paged-int8", kv_dtype="int8", paged=True
+        "decode-fused-vs-xla:dense-int8", engine="pallas-layer",
+        kv_dtype="int8",
+    ),
+    _decode_case(
+        "decode-fused-vs-xla:dense-fp8", engine="pallas-layer",
+        kv_dtype="fp8",
+    ),
+    _decode_case(
+        "decode-fused-vs-xla:gqa", engine="pallas-layer", heads=8,
+        kv_heads=2,
+    ),
+    _decode_case(
+        "decode-fused-vs-xla:window-rolling", engine="pallas-layer",
+        window=16,
+    ),
+    _decode_case(
+        "decode-fused-vs-xla:paged-int8", engine="pallas-layer",
+        kv_dtype="int8", paged=True,
+    ),
+    # Round-20 rows: the multi-layer megakernel over the same matrix.
+    _decode_case("decode-mega-vs-xla:dense-bf16"),
+    _decode_case("decode-mega-vs-xla:dense-int8", kv_dtype="int8"),
+    _decode_case("decode-mega-vs-xla:dense-fp8", kv_dtype="fp8"),
+    _decode_case("decode-mega-vs-xla:gqa", heads=8, kv_heads=2),
+    _decode_case("decode-mega-vs-xla:window-rolling", window=16),
+    _decode_case(
+        "decode-mega-vs-xla:paged-int8", kv_dtype="int8", paged=True
     ),
 ]
 
 
-def run_decode_case(c: dict) -> dict:
-    """One serving config's fused-vs-XLA decode parity: prefill three
-    ragged prompts into slots, then 8 greedy decode steps with BOTH
-    engines fed the XLA engine's token stream (teacher-forced) — so
-    every step scores the same prefix and the max logit error stays a
-    kernel-parity measurement even after a budgeted argmax flip (self-
-    fed streams would diverge at the first flip and the error metric
-    would measure different prefixes, not the kernel). Token agreement
-    is the per-step argmax match under those identical prefixes; ``ok``
-    needs logit error under the shared tolerance bar and ≥ 90% token
-    agreement (bf16 compute — flips at near-ties are the budgeted
-    residual; tests/test_pallas_decode.py pins the tight f32
-    contract)."""
+VERIFY_CASES = [
+    _decode_case("verify-fused-vs-xla:bf16", paged=True),
+    _decode_case("verify-fused-vs-xla:int8", kv_dtype="int8", paged=True),
+    _decode_case("verify-fused-vs-xla:fp8", kv_dtype="fp8", paged=True),
+    _decode_case(
+        "verify-fused-vs-xla:gqa-int8", kv_dtype="int8", heads=8,
+        kv_heads=2, paged=True,
+    ),
+    _decode_case(
+        "verify-fused-vs-xla:window-int8", kv_dtype="int8", window=16,
+        paged=True,
+    ),
+]
+
+
+def _decode_model_and_cache(c: dict):
     import numpy as np
 
     from distributed_tensorflow_tpu.models.gpt import GPTLM
@@ -124,18 +164,38 @@ def run_decode_case(c: dict) -> dict:
             params, cache, toks, lens, jnp.zeros((3,), jnp.int32), admit
         )
         cache = cache._replace(lengths=lens)
-        decode = m.decode_paged
     else:
         cache = m.empty_slot_cache(3, c["kv_dtype"])
         _, cache = m.prefill_slots(params, cache, toks, lens, admit)
-        decode = m.decode_slots
+    return m, params, cache
+
+
+def run_decode_case(c: dict) -> dict:
+    """One serving config's Pallas-vs-XLA decode parity: prefill three
+    ragged prompts into slots, then 8 greedy decode steps with BOTH
+    engines fed the XLA engine's token stream (teacher-forced) — so
+    every step scores the same prefix and the max logit error stays a
+    kernel-parity measurement even after a budgeted argmax flip (self-
+    fed streams would diverge at the first flip and the error metric
+    would measure different prefixes, not the kernel). Token agreement
+    is the per-step argmax match under those identical prefixes; ``ok``
+    needs logit error under the shared tolerance bar and ≥ 90% token
+    agreement (bf16 compute — flips at near-ties are the budgeted
+    residual; tests/test_pallas_decode.py pins the tight f32
+    contract). ``c["engine"]`` selects the kernel tier: "pallas-layer"
+    (round 18, one launch per block) or "pallas" (round 20 megakernel,
+    one launch per token)."""
+    import numpy as np
+
+    m, params, cache = _decode_model_and_cache(c)
+    decode = m.decode_paged if c["paged"] else m.decode_slots
     tok = jnp.asarray([1, 2, 3], jnp.int32)
     cx = cp = cache
     tx = tok
     steps, agree, err = 8, 0, 0.0
     for _ in range(steps):
         lx, cx = decode(params, tx, cx, engine="xla")
-        lp, cp = decode(params, tx, cp, engine="pallas")
+        lp, cp = decode(params, tx, cp, engine=c["engine"])
         err = max(err, float(jnp.max(jnp.abs(
             lx.astype(jnp.float32) - lp.astype(jnp.float32)
         ))))
@@ -150,6 +210,60 @@ def run_decode_case(c: dict) -> dict:
         "fwd_max_err": round(err, 5),
         "tok_match": round(tok_match, 4),
         "ok": bool(err < tol and tok_match >= 0.9),
+    }
+
+
+def run_verify_case(c: dict) -> dict:
+    """Fused speculation-verify parity (round 20): score a 4-token
+    draft suffix per slot with ``GPTLM.verify_paged`` under both
+    engines ("xla" delegates to the extend path; "pallas" launches the
+    fused verify kernel). Logit error and argmax agreement are measured
+    on the VALID suffix rows of admitted slots only; the committed
+    cache — payload AND quantization scales — must match the XLA
+    extend's scatter bit-for-bit on the payload (scales compare at f32
+    reassociation tolerance), because greedy-exact acceptance rides on
+    the verified suffix being the one the cache remembers."""
+    import numpy as np
+
+    m, params, cache = _decode_model_and_cache(c)
+    rng = np.random.default_rng(3)
+    suffix = jnp.asarray(rng.integers(0, 97, (3, 4)), jnp.int32)
+    slens = jnp.asarray([4, 3, 4], jnp.int32)
+    admit = jnp.asarray([True, True, False])
+    lx, cvx = m.verify_paged(
+        params, cache, suffix, slens, cache.lengths, admit, engine="xla"
+    )
+    lp, cvp = m.verify_paged(
+        params, cache, suffix, slens, cache.lengths, admit,
+        engine="pallas",
+    )
+    valid = (
+        (jnp.arange(suffix.shape[1])[None, :] < slens[:, None])
+        & admit[:, None]
+    )
+    err = float(jnp.max(jnp.where(
+        valid[..., None],
+        jnp.abs(lx.astype(jnp.float32) - lp.astype(jnp.float32)),
+        0.0,
+    )))
+    nx = np.asarray(jnp.argmax(lx, -1))
+    npal = np.asarray(jnp.argmax(lp, -1))
+    vmask = np.asarray(valid)
+    tok_match = float((nx == npal)[vmask].mean())
+    cache_ok = bool(jnp.all(cvx.k == cvp.k)) and bool(
+        jnp.all(cvx.v == cvp.v)
+    )
+    if cvx.k_scale is not None:
+        cache_ok = cache_ok and bool(
+            jnp.allclose(cvx.k_scale, cvp.k_scale, atol=1e-6)
+        ) and bool(jnp.allclose(cvx.v_scale, cvp.v_scale, atol=1e-6))
+    tol = ATOL + RTOL
+    return {
+        "case": c["name"],
+        "fwd_max_err": round(err, 5),
+        "tok_match": round(tok_match, 4),
+        "cache_bitwise": cache_ok,
+        "ok": bool(err < tol and tok_match >= 0.9 and cache_ok),
     }
 
 
@@ -296,12 +410,60 @@ def run_fused_split_case(c: dict) -> dict:
     }
 
 
+def _case_order() -> list[str]:
+    order = []
+    for c in CASES:
+        order += [c["name"], f"fused-vs-split:{c['name']}"]
+    order += [c["name"] for c in DECODE_CASES]
+    order += [c["name"] for c in VERIFY_CASES]
+    return order
+
+
+def merge_rows(new_rows: list[dict], old_payload: dict | None) -> list[dict]:
+    """Per-row provenance merge (round 20): committed rows without a
+    ``device``/``mode`` tag inherit the committed payload's header (the
+    round-2 record predates per-row tags); a new row replaces the
+    committed one UNLESS that would downgrade a Mosaic row to an
+    interpreter rerun — the on-chip proof is the scarce artifact, an
+    off-chip regeneration must never erase it. Rows are ordered by the
+    current case list, unknown (retired) committed cases trail."""
+    merged: dict[str, dict] = {}
+    if old_payload:
+        old_mode = (
+            "Mosaic" if old_payload.get("backend") == "tpu"
+            else "interpreter"
+        )
+        for r in old_payload.get("rows", []):
+            r = dict(r)
+            r.setdefault("device", old_payload.get("device", "?"))
+            r.setdefault("mode", old_mode)
+            merged[r["case"]] = r
+    for r in new_rows:
+        prev = merged.get(r["case"])
+        if (
+            prev is not None
+            and prev.get("mode") == "Mosaic"
+            and r.get("mode") != "Mosaic"
+        ):
+            continue
+        merged[r["case"]] = r
+    order = {name: i for i, name in enumerate(_case_order())}
+    return sorted(
+        merged.values(),
+        key=lambda r: (order.get(r["case"], len(order)), r["case"]),
+    )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--write-docs", action="store_true")
     ap.add_argument("--cases", nargs="+", default=None)
     args = ap.parse_args(argv)
-    known = {c["name"] for c in CASES} | {c["name"] for c in DECODE_CASES}
+    known = (
+        {c["name"] for c in CASES}
+        | {c["name"] for c in DECODE_CASES}
+        | {c["name"] for c in VERIFY_CASES}
+    )
     if args.cases:
         unknown = set(args.cases) - known
         if unknown:
@@ -309,6 +471,9 @@ def main(argv=None) -> int:
             ap.error(
                 f"unknown case(s) {sorted(unknown)}; known: {sorted(known)}"
             )
+    device = jax.devices()[0].device_kind
+    backend = jax.default_backend()
+    mode = "Mosaic" if backend == "tpu" else "interpreter"
     rows = []
     for c in CASES:
         if args.cases and c["name"] not in args.cases:
@@ -322,38 +487,46 @@ def main(argv=None) -> int:
                     {"case": label, "ok": False,
                      "error": f"{type(exc).__name__}: {exc}"[:200]}
                 )
-    for c in DECODE_CASES:
-        if args.cases and c["name"] not in args.cases:
-            continue
-        try:
-            rows.append(run_decode_case(c))
-        except Exception as exc:  # noqa: BLE001
-            rows.append(
-                {"case": c["name"], "ok": False,
-                 "error": f"{type(exc).__name__}: {exc}"[:200]}
-            )
-    device = jax.devices()[0].device_kind
-    backend = jax.default_backend()
-    all_ok = bool(rows) and all(r["ok"] for r in rows)
-    header = (
-        f"device: {device}  backend: {backend}  "
-        f"mode: {'Mosaic' if backend == 'tpu' else 'interpreter'}"
-    )
-    print(header)
-    cols = ["case", "fwd", "dq", "dk", "dv", "tok", "ok"]
-    lines = ["| " + " | ".join(cols) + " |", "|" + "---|" * len(cols)]
+    for cases, runner in ((DECODE_CASES, run_decode_case),
+                          (VERIFY_CASES, run_verify_case)):
+        for c in cases:
+            if args.cases and c["name"] not in args.cases:
+                continue
+            try:
+                rows.append(runner(c))
+            except Exception as exc:  # noqa: BLE001
+                rows.append(
+                    {"case": c["name"], "ok": False,
+                     "error": f"{type(exc).__name__}: {exc}"[:200]}
+                )
     for r in rows:
-        if "error" in r:
-            lines.append(f"| {r['case']} | error: {r['error']} |" + " |" * 5)
-            continue
-        lines.append(
-            f"| {r['case']} | {r['fwd_max_err']} "
-            f"| {r.get('dq_max_err', '-')} | {r.get('dk_max_err', '-')} "
-            f"| {r.get('dv_max_err', '-')} | {r.get('tok_match', '-')} "
-            f"| {'PASS' if r['ok'] else 'FAIL'} |"
-        )
-    table = "\n".join(lines)
-    print(table)
+        r["device"] = device
+        r["mode"] = mode
+    header = f"device: {device}  backend: {backend}  mode: {mode}"
+    print(header)
+
+    def _table(rs):
+        cols = ["case", "fwd", "dq", "dk", "dv", "tok", "device", "ok"]
+        lines = ["| " + " | ".join(cols) + " |", "|" + "---|" * len(cols)]
+        for r in rs:
+            dev = f"{r.get('device', '?')} ({r.get('mode', '?')})"
+            if "error" in r:
+                lines.append(
+                    f"| {r['case']} | error: {r['error']} |" + " |" * 4
+                    + f" {dev} | FAIL |"
+                )
+                continue
+            lines.append(
+                f"| {r['case']} | {r['fwd_max_err']} "
+                f"| {r.get('dq_max_err', '-')} | {r.get('dk_max_err', '-')} "
+                f"| {r.get('dv_max_err', '-')} | {r.get('tok_match', '-')} "
+                f"| {dev} "
+                f"| {'PASS' if r['ok'] else 'FAIL'} |"
+            )
+        return "\n".join(lines)
+
+    print(_table(rows))
+    all_ok = bool(rows) and all(r["ok"] for r in rows)
     payload = {
         "rows": rows, "device": device, "backend": backend, "all_ok": all_ok,
     }
@@ -362,20 +535,51 @@ def main(argv=None) -> int:
         root = os.path.abspath(
             os.path.join(os.path.dirname(__file__), "..", "..", "docs", "benchmarks")
         )
-        with open(os.path.join(root, "attention_parity.json"), "w") as f:
+        json_path = os.path.join(root, "attention_parity.json")
+        old = None
+        try:
+            with open(json_path) as f:
+                old = json.load(f)
+        except (OSError, ValueError):
+            pass
+        rows = merge_rows(rows, old)
+        # The RECORD's verdict (merged rows) is the exit code under
+        # --write-docs: an interpreter rerun whose cpu rows lose to a
+        # committed Mosaic row must not fail a healthy record.
+        all_ok = bool(rows) and all(r["ok"] for r in rows)
+        payload = {
+            "rows": rows,
+            "device": device,
+            "backend": backend,
+            "all_ok": all_ok,
+        }
+        with open(json_path, "w") as f:
             json.dump(payload, f, indent=1)
         with open(os.path.join(root, "attention_parity.md"), "w") as f:
             f.write(
                 "# Flash-attention parity record (Mosaic vs dense XLA)\n\n"
                 "Generated by `python -m distributed_tensorflow_tpu.tools."
-                f"attention_parity --write-docs` — {header}. Forward and\n"
-                "q/k/v gradient max-abs errors vs the dense oracle, bf16\n"
-                "inputs, per feature (causal/window/banding/GQA/kv_lens/"
-                "offset).\n`decode-fused-vs-xla:*` rows (round 18): the "
-                "fused Pallas decode-step\nkernel vs the unrolled XLA "
-                "decode engine — max logit error over an\n8-step greedy "
-                "decode plus the token-agreement fraction (`tok`).\n\n"
-                + table + "\n"
+                f"attention_parity --write-docs` — last run {header}.\n"
+                "Per-row `device` is the backend that actually ran the "
+                "row (merge rule: an\ninterpreter rerun never overwrites "
+                "a Mosaic row — kernel PROOFS are the\nMosaic-tagged rows "
+                "only; interpreter rows are correctness previews awaiting"
+                "\nthe chip rerun). Forward and q/k/v gradient max-abs "
+                "errors vs the dense\noracle, bf16 inputs, per feature "
+                "(causal/window/banding/GQA/kv_lens/offset).\n"
+                "`decode-fused-vs-xla:*` rows (round 18): the per-layer "
+                "Pallas decode-step\nkernel (`decode_engine="
+                '"pallas-layer"`) vs the unrolled XLA decode engine —\n'
+                "max logit error over an 8-step greedy decode plus the "
+                "token-agreement\nfraction (`tok`). "
+                "`decode-mega-vs-xla:*` rows (round 20): the multi-layer"
+                "\nmegakernel (`decode_engine=\"pallas\"`, one launch per "
+                "token, in-kernel\naliased cache commit) over the same "
+                "matrix. `verify-fused-vs-xla:*` rows\n(round 20): the "
+                "fused speculation-verify kernel vs the XLA extend path "
+                "—\nlogit/argmax parity on valid suffix rows plus the "
+                "bitwise cache-commit\ncheck (`ok` includes it).\n\n"
+                + _table(rows) + "\n"
             )
         print(f"wrote {root}/attention_parity.md")
     return 0 if all_ok else 1

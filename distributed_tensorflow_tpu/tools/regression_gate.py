@@ -59,9 +59,14 @@ import sys
 # before this entry a us-unit latency series silently gated FAIL-LOW,
 # i.e. it would have flagged an IMPROVEMENT and waved regressions
 # through (direction pinned in tests/test_fleet_observability.py).
+# ``dispatches/token`` (round 20): the decode megakernel's structural
+# launch count — more launches per token is the regression (the whole
+# point of the tier is O(1)); fails HIGH, direction pinned alongside
+# the us variants.
 LOWER_IS_BETTER_UNITS = (
     "ms", "s", "ms/token", "ms/dispatch", "requests", "bytes",
     "bytes/token", "us", "µs", "us/token", "µs/token",
+    "dispatches/token",
 )
 
 DEFAULT_TOLERANCE = 0.5

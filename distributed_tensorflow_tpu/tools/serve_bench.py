@@ -533,6 +533,91 @@ def bench_decode_engine(
     }
 
 
+def _count_dispatch_eqns(jaxpr) -> tuple[int, int]:
+    """(kernel launches, cache-commit ops) in a traced jaxpr: Pallas
+    launches are ``pallas_call`` eqns (counted whole — their interior
+    kernel jaxpr is one launch, never recursed into); commit ops are
+    the scatter family plus ``dynamic_update_slice``, the shapes XLA
+    emits for the per-layer cache/scale writes the fused kernels fold
+    into their aliased in-kernel DMA. Recurses through sub-jaxprs
+    (pjit/scan/cond bodies) so engine-internal structure can't hide
+    eqns from the count."""
+    kernels = commits = 0
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "pallas_call":
+            kernels += 1
+            continue
+        if name.startswith("scatter") or name == "dynamic_update_slice":
+            commits += 1
+        for v in eqn.params.values():
+            for x in v if isinstance(v, (tuple, list)) else (v,):
+                sub = getattr(x, "jaxpr", x)
+                if hasattr(sub, "eqns"):
+                    k, s = _count_dispatch_eqns(sub)
+                    kernels += k
+                    commits += s
+    return kernels, commits
+
+
+def bench_decode_dispatches(
+    *, cache_len: int = 256, kv_dtype: str = "int8", model_kw=None
+) -> dict:
+    """The CPU-deterministic half of the decode A/B (round 20):
+    dispatches per decoded token, counted on the TRACED ``decode_slots``
+    jaxpr rather than timed — launch counts are structural, identical on
+    every device, so this half commits a gate-stable series off-chip
+    while the µs/token rows stay pending for the v5e (the round-15
+    slot-density precedent). Convention: dispatches/token = pallas_call
+    eqns + cache-commit eqns (scatter family + dynamic_update_slice)
+    + 1 for the sampling tail (same one XLA dispatch for every engine).
+    The count is a structural proxy — XLA may fuse neighbouring commit
+    ops — but the ordering it certifies is the tentpole claim: the
+    unrolled XLA engine and the per-layer kernel both scale with
+    num_layers (~S kernel/commit pairs), the megakernel is O(1) (ONE
+    launch; the commit rides the kernel's input/output aliasing)."""
+    import jax
+
+    mk = dict(
+        vocab_size=512, max_len=cache_len, model_dim=128, num_heads=4,
+        num_layers=2,
+    )
+    mk.update(model_kw or {})
+    model, params = _build(mk)
+    cache = model.empty_slot_cache(1, kv_dtype)
+    tok0 = jnp.zeros((1,), jnp.int32)
+    act = jnp.ones((1,), bool)
+    rows = []
+    for engine in ("xla", "pallas-layer", "pallas"):
+
+        def step(p, t, c, a, engine=engine):
+            return model.decode_slots(p, t, c, a, engine=engine)
+
+        jaxpr = jax.make_jaxpr(step)(params, tok0, cache, act)
+        kernels, commits = _count_dispatch_eqns(jaxpr.jaxpr)
+        rows.append(
+            {
+                "engine": engine,
+                "kernel_launches": kernels,
+                "commit_ops": commits,
+                "dispatches_per_token": kernels + commits + 1,
+            }
+        )
+    return {
+        "device": "trace",
+        "cache_len": int(cache_len),
+        "kv_dtype": kv_dtype,
+        "model": {
+            "model_dim": mk["model_dim"],
+            "num_layers": mk["num_layers"],
+            "num_heads": mk["num_heads"],
+        },
+        "convention": "pallas_call + scatter-family/dynamic_update_slice "
+        "eqns in one traced decode_slots step, +1 sampling tail",
+        "rows": rows,
+    }
+
+
 def bench_fleet(
     *,
     replicas: int = 3,
@@ -998,6 +1083,39 @@ def emit_decode_events(payload: dict, events_path: str) -> list[dict]:
         j.close()
 
 
+def emit_dispatch_events(payload: dict, events_path: str) -> list[dict]:
+    """The dispatch-count half's gate series: one
+    ``decode_dispatches_per_token_{engine}`` bench_point per engine,
+    unit ``dispatches/token`` (LOWER_IS_BETTER — the gate fails HIGH if
+    an engine ever regresses to more launches per token). Device key is
+    the section's literal ``trace``: the count is structural, so its
+    band must never collide with a cpu- or chip-keyed timing series.
+    Emitted ONLY by ``--decode-dispatches`` — the µs/token series each
+    carry exactly one committed point and a dispatch refresh must not
+    append to them."""
+    from distributed_tensorflow_tpu.observability.journal import EventJournal
+
+    disp = payload["decode_engine"]["dispatches"]
+    j = EventJournal(events_path, run_id="serve_bench")
+    try:
+        common = dict(tool="serve_bench", device=disp["device"])
+        return [
+            j.emit(
+                "bench_point",
+                name=f"decode_dispatches_per_token_{r['engine']}",
+                value=r["dispatches_per_token"],
+                unit="dispatches/token",
+                engine=r["engine"],
+                kv_dtype=disp["kv_dtype"],
+                cache_len=disp["cache_len"],
+                **common,
+            )
+            for r in disp["rows"]
+        ]
+    finally:
+        j.close()
+
+
 def emit_fleet_events(payload: dict, events_path: str) -> list[dict]:
     """The fleet row's gate-covered bench_point series (round-12 gate:
     tokens/s fails LOW, the ttft ``s`` unit fails HIGH). The
@@ -1186,6 +1304,36 @@ def render(payload: dict) -> str:
                 "int8/fp8 KV dequantized in-kernel) is measurable only "
                 "where Mosaic compiles it."
             )
+        disp = de.get("dispatches")
+        if disp:
+            m = disp["model"]
+            lines += [
+                "",
+                "### Dispatches per token (traced — device-independent)",
+                "",
+                "| engine | kernel launches | commit ops "
+                "| dispatches/token |",
+                "|---|---|---|---|",
+            ]
+            for r in disp["rows"]:
+                lines.append(
+                    f"| {r['engine']} | {r['kernel_launches']} "
+                    f"| {r['commit_ops']} "
+                    f"| {r['dispatches_per_token']} |"
+                )
+            lines += [
+                "",
+                f"Counted on the traced `decode_slots` jaxpr "
+                f"({m['num_layers']} layers, d={m['model_dim']}, "
+                f"{disp['kv_dtype']} KV, C={disp['cache_len']}): "
+                f"{disp['convention']}. The XLA engine and the "
+                "per-layer kernel both scale with the layer count "
+                "(a kernel/commit pair per layer); the megakernel is "
+                "O(1) — one launch per token, the cache commit rides "
+                "its input/output aliasing. Structural counts, not "
+                "wall time: the gate series is committable off-chip "
+                "(round-15 slot-density precedent).",
+            ]
     sp = payload.get("speculation")
     if sp:
         lines += [
@@ -1365,14 +1513,43 @@ def main(argv=None) -> int:
         "pattern); on the chip this fills the pallas rows, off-chip it "
         "measures the xla rows and records the pallas ones as pending",
     )
+    ap.add_argument(
+        "--decode-dispatches",
+        action="store_true",
+        help="re-count ONLY the dispatches-per-token half of the decode "
+        "A/B (traced jaxpr, device-independent) and merge it under the "
+        "committed decode_engine section — the timing rows (each a "
+        "single committed point per series) are untouched",
+    )
     args = ap.parse_args(argv)
     events_path = args.events
     if events_path is None and args.write_docs:
         events_path = os.path.join(_docs_root(), "events.jsonl")
+    if args.decode_dispatches:
+        disp = bench_decode_dispatches()
+        with open(os.path.join(_docs_root(), "serving.json")) as f:
+            payload = json.load(f)
+        payload.setdefault("decode_engine", {})["dispatches"] = disp
+        print(json.dumps(disp))
+        if args.write_docs:
+            write_docs(payload)
+            print(f"wrote {_docs_root()}/serving.md and serving.json")
+        else:
+            print(render(payload))
+        if events_path:
+            n = len(emit_dispatch_events(payload, events_path))
+            print(f"appended {n} bench_point events to {events_path}")
+        return 0
     if args.decode_engine:
         de = bench_decode_engine()
         with open(os.path.join(_docs_root(), "serving.json")) as f:
             payload = json.load(f)
+        # A timing rerun (chip or cpu) never re-traces the dispatch
+        # half — carry the committed counts forward (the --fleet merge
+        # pattern, one level down).
+        prev = payload.get("decode_engine") or {}
+        if "dispatches" in prev:
+            de.setdefault("dispatches", prev["dispatches"])
         payload["decode_engine"] = de
         print(json.dumps(de))
         if args.write_docs:

@@ -791,6 +791,32 @@ def test_gate_microsecond_units_fail_high():
         )["failures"]
 
 
+def test_gate_dispatch_unit_fails_high():
+    # Round 20: the megakernel's structural launch count
+    # ("dispatches/token", serve_bench's decode_dispatches_per_token
+    # series) is lower-is-better — the tier's whole claim is O(1)
+    # launches per token, so MORE launches is the regression and a
+    # fusion improvement must never trip the gate.
+    mk = lambda vals, unit: [  # noqa: E731
+        (i, v, unit) for i, v in enumerate(vals)
+    ]
+    assert "dispatches/token" in regression_gate.LOWER_IS_BETTER_UNITS
+    res = regression_gate.check_series(
+        {("serve_bench", "decode_dispatches_per_token_pallas"): mk(
+            [2.0, 2.0, 11.0], "dispatches/token"
+        )},
+        tolerance=0.5,
+    )
+    [f] = res["failures"]
+    assert f["direction"] == "above" and f["unit"] == "dispatches/token"
+    assert not regression_gate.check_series(
+        {("serve_bench", "decode_dispatches_per_token_xla"): mk(
+            [9.0, 9.0, 2.0], "dispatches/token"
+        )},
+        tolerance=0.5,
+    )["failures"]
+
+
 def test_obs_report_comm_payload_rendering():
     # Round 17: bytes/round + effective compression beside the
     # steps-per-round line; full-precision segments render exactly the

@@ -1,17 +1,23 @@
-"""Fused Pallas decode-step kernel (round 18, ops/pallas_decode.py).
+"""Fused Pallas decode kernels (rounds 18+20, ops/pallas_decode.py).
 
-Parity contract: ``decode_engine="pallas"`` runs the same math as the
-unrolled XLA decode engine — layernorm/QKV/rope/quantize-on-write/
-attention/out-projection/FFN fused into one launch per block, with the
-fresh-row commit using the XLA engine's exact scatter index math. At
-f32 compute (these tests) the two engines agree to fp-reassociation
-tolerance and greedy token streams are identical; the on-chip Mosaic
-record is ``tools/attention_parity.py --write-docs``
-(``decode-fused-vs-xla:*`` rows) and the relaxed bf16 budget lives
-there. The engine knob contract: "pallas" REFUSES unsupported configs
-loudly (MoE, quantized projection weights, VMEM-oversized blocks) and
-"auto" resolves to XLA off-TPU — the interpreter kernel is a
-correctness tool, not a serving path.
+Parity contract: both Pallas engines run the same math as the unrolled
+XLA decode engine — ``"pallas-layer"`` (round 18) fuses one block per
+launch with the external scatter commit; ``"pallas"`` (round 20) is the
+megakernel tier: ONE launch per token across all layers with streamed
+weights and the KV commit done in-kernel through aliased cache
+operands, plus the fused small-L speculation verify
+(``GPTLM.verify_paged``). At f32 compute (these tests) the engines
+agree to fp-reassociation tolerance, greedy token streams are
+identical, and the two Pallas engines write BITWISE-identical caches
+(same kernel math + index-exact commit — the aliased in-kernel write
+must reproduce the XLA scatter exactly on the storage dtype, scales
+included). The on-chip Mosaic record is ``tools/attention_parity.py
+--write-docs`` (``decode-fused-vs-xla:*`` / ``decode-mega-vs-xla:*`` /
+``verify-fused-vs-xla:*`` rows) and the relaxed bf16 budget lives
+there. The engine knob contract: both pallas variants REFUSE
+unsupported configs loudly (MoE, quantized projection weights,
+VMEM-oversized layers) and "auto" resolves to XLA off-TPU — the
+interpreter kernels are correctness tools, not serving paths.
 
 Round-14 audit rule: dense + int8-KV are the fast-tier representatives;
 the GQA/window/fp8 matrix rows are heavy-marked.
@@ -71,45 +77,65 @@ def _prefilled_paged(m, params, kv_dtype, block_size=8, num_blocks=24):
     return cache._replace(lengths=lens)
 
 
+_PALLAS_ENGINES = ("pallas-layer", "pallas")
+
+
 def _assert_engines_agree(m, params, cache, decode, steps=6,
                           active_pattern=None):
     """Run ``steps`` greedy decode steps under each engine, each fed its
     OWN argmax stream; assert token equality, tight logit closeness on
-    ACTIVE rows, and cache agreement (allclose: the engines differ by
-    fp reassociation only at f32 compute)."""
+    ACTIVE rows, and cache agreement vs XLA (allclose: the engines
+    differ by fp reassociation only at f32 compute). The two PALLAS
+    engines' caches must additionally be BITWISE equal — identical
+    kernel math plus the aliased in-kernel commit reproducing the
+    external scatter's bytes exactly."""
     tok = jnp.asarray([1, 2, 3], jnp.int32)
-    cx = cp = cache
-    tx = tp = tok
+    engines = ("xla",) + _PALLAS_ENGINES
+    caches = {e: cache for e in engines}
+    toks = {e: tok for e in engines}
     for i in range(steps):
         act = None
         if active_pattern is not None:
             act = jnp.asarray(active_pattern[i % len(active_pattern)])
-        lx, cx = m.__getattribute__(decode)(
-            params, tx, cx, active=act, engine="xla"
-        )
-        lp, cp = m.__getattribute__(decode)(
-            params, tp, cp, active=act, engine="pallas"
-        )
         rows = np.ones(3, bool) if act is None else np.asarray(act)
+        logits = {}
+        for e in engines:
+            logits[e], caches[e] = m.__getattribute__(decode)(
+                params, toks[e], caches[e], active=act, engine=e
+            )
+        nxt = {
+            e: jnp.argmax(logits[e], -1).astype(jnp.int32) for e in engines
+        }
+        for e in _PALLAS_ENGINES:
+            np.testing.assert_allclose(
+                np.asarray(logits["xla"], np.float32)[rows],
+                np.asarray(logits[e], np.float32)[rows],
+                atol=1e-4, rtol=1e-4,
+            )
+            assert bool(
+                (np.asarray(nxt["xla"])[rows] == np.asarray(nxt[e])[rows])
+                .all()
+            ), e
+        for e in engines:
+            toks[e] = jnp.where(jnp.asarray(rows), nxt[e], toks[e])
+    cx = caches["xla"]
+    for e in _PALLAS_ENGINES:
+        cp = caches[e]
         np.testing.assert_allclose(
-            np.asarray(lx, np.float32)[rows],
-            np.asarray(lp, np.float32)[rows],
-            atol=1e-4, rtol=1e-4,
+            np.asarray(cx.k, np.float32), np.asarray(cp.k, np.float32),
+            atol=1e-5,
         )
-        nx = jnp.argmax(lx, -1).astype(jnp.int32)
-        npal = jnp.argmax(lp, -1).astype(jnp.int32)
-        assert bool((np.asarray(nx)[rows] == np.asarray(npal)[rows]).all())
-        tx = jnp.where(jnp.asarray(rows), nx, tx)
-        tp = jnp.where(jnp.asarray(rows), npal, tp)
-    np.testing.assert_allclose(
-        np.asarray(cx.k, np.float32), np.asarray(cp.k, np.float32),
-        atol=1e-5,
-    )
-    assert bool(jnp.array_equal(cx.lengths, cp.lengths))
-    if cx.k_scale is not None:
-        np.testing.assert_allclose(
-            np.asarray(cx.k_scale), np.asarray(cp.k_scale), atol=1e-7
-        )
+        assert bool(jnp.array_equal(cx.lengths, cp.lengths)), e
+        if cx.k_scale is not None:
+            np.testing.assert_allclose(
+                np.asarray(cx.k_scale), np.asarray(cp.k_scale), atol=1e-7
+            )
+    cl, cm = caches["pallas-layer"], caches["pallas"]
+    assert bool(jnp.array_equal(cl.k, cm.k))
+    assert bool(jnp.array_equal(cl.v, cm.v))
+    if cl.k_scale is not None:
+        assert bool(jnp.array_equal(cl.k_scale, cm.k_scale))
+        assert bool(jnp.array_equal(cl.v_scale, cm.v_scale))
 
 
 # -- parity matrix (fast: dense + int8; heavy: gqa / window / fp8) ---------
@@ -189,23 +215,92 @@ def test_decode_step_fused_matches_xla():
     )
     logits, cache = m.prefill(params, prompt)
     tok = jnp.argmax(logits, -1).astype(prompt.dtype)
-    cx = cp = cache
-    tx = tp = tok
+    engines = ("xla",) + _PALLAS_ENGINES
+    caches = {e: cache for e in engines}
+    toks = {e: tok for e in engines}
     for _ in range(5):
-        lx, cx = m.decode_step(params, tx, cx, engine="xla")
-        lp, cp = m.decode_step(params, tp, cp, engine="pallas")
+        lg = {}
+        for e in engines:
+            lg[e], caches[e] = m.decode_step(
+                params, toks[e], caches[e], engine=e
+            )
+            toks[e] = jnp.argmax(lg[e], -1).astype(prompt.dtype)
+        for e in _PALLAS_ENGINES:
+            np.testing.assert_allclose(
+                np.asarray(lg["xla"], np.float32),
+                np.asarray(lg[e], np.float32),
+                atol=1e-4, rtol=1e-4,
+            )
+            assert bool((toks["xla"] == toks[e]).all())
+    for e in _PALLAS_ENGINES:
+        assert int(caches["xla"].length) == int(caches[e].length)
         np.testing.assert_allclose(
-            np.asarray(lx, np.float32), np.asarray(lp, np.float32),
-            atol=1e-4, rtol=1e-4,
+            np.asarray(caches["xla"].k, np.float32),
+            np.asarray(caches[e].k, np.float32),
+            atol=1e-5,
         )
-        tx = jnp.argmax(lx, -1).astype(prompt.dtype)
-        tp = jnp.argmax(lp, -1).astype(prompt.dtype)
-        assert bool((tx == tp).all())
-    assert int(cx.length) == int(cp.length)
-    np.testing.assert_allclose(
-        np.asarray(cx.k, np.float32), np.asarray(cp.k, np.float32),
-        atol=1e-5,
+    assert bool(
+        jnp.array_equal(caches["pallas-layer"].k, caches["pallas"].k)
     )
+
+
+# -- fused speculation-verify (round 20) -----------------------------------
+
+
+def _verify_case(kv_dtype):
+    m = tiny()
+    params = m.init(seed=1)
+    cache = _prefilled_paged(m, params, kv_dtype)
+    rng = np.random.default_rng(7)
+    suffix = jnp.asarray(rng.integers(0, 97, (3, 3)), jnp.int32)
+    slens = jnp.asarray([3, 2, 3], jnp.int32)
+    admit = jnp.asarray([True, True, False])
+    outs = {}
+    for e in ("xla", "pallas-layer", "pallas"):
+        outs[e] = m.verify_paged(
+            params, cache, suffix, slens, cache.lengths, admit, engine=e
+        )
+    lx, cx = outs["xla"]
+    # xla and pallas-layer DELEGATE to extend_paged — identical objects'
+    # worth of math, bitwise.
+    ll, cl = outs["pallas-layer"]
+    assert bool(jnp.array_equal(lx, ll))
+    assert bool(jnp.array_equal(cx.k, cl.k))
+    lp, cp = outs["pallas"]
+    row_valid = (
+        (np.arange(3)[None] < np.asarray(slens)[:, None])
+        & np.asarray(admit)[:, None]
+    )
+    np.testing.assert_allclose(
+        np.asarray(lx, np.float32)[row_valid],
+        np.asarray(lp, np.float32)[row_valid],
+        atol=1e-3, rtol=1e-4,
+    )
+    # Greedy-exact acceptance rides on argmax equality per position.
+    assert bool(
+        (
+            np.asarray(jnp.argmax(lx, -1))[row_valid]
+            == np.asarray(jnp.argmax(lp, -1))[row_valid]
+        ).all()
+    )
+    # The in-kernel commit must land the XLA scatter's exact bytes:
+    # valid rows written, invalid rows (admit=False, li >= suffix_len)
+    # untouched — pool arrays bitwise.
+    assert bool(jnp.array_equal(cx.k, cp.k))
+    assert bool(jnp.array_equal(cx.v, cp.v))
+    if cx.k_scale is not None:
+        np.testing.assert_allclose(
+            np.asarray(cx.k_scale), np.asarray(cp.k_scale), atol=1e-7
+        )
+
+
+def test_verify_paged_fused_matches_xla_int8():
+    _verify_case("int8")
+
+
+@pytest.mark.heavy
+def test_verify_paged_fused_matches_xla_bf16():
+    _verify_case("bf16")
 
 
 # -- engine knob: refusals + auto resolution -------------------------------
@@ -224,6 +319,36 @@ def test_pallas_engine_refuses_matmul_dtype():
 def test_pallas_engine_refuses_oversized_block_weights():
     with pytest.raises(ValueError, match="VMEM"):
         tiny(model_dim=4096, num_heads=8, decode_engine="pallas")
+
+
+def test_vmem_refusal_names_cap_and_actual_bytes():
+    # Round-20 satellite: the refusal must state the measured cap AND
+    # the config's actual per-layer weight bytes (attention + FFN
+    # breakdown), not be a bare "too big".
+    with pytest.raises(ValueError, match="VMEM") as ei:
+        tiny(model_dim=4096, num_heads=8, decode_engine="pallas")
+    msg = str(ei.value)
+    d = 4096
+    dh = d // 8
+    expected = (10 * d * d + 2 * d * 8 * dh) * 4  # f32 compute dtype
+    assert str(expected) in msg
+    assert str(8 << 20) in msg
+    assert "per-layer" in msg or "per LAYER" in msg
+    assert "FFN" in msg
+
+
+def test_pallas_layer_engine_refusals_match():
+    # The escape-hatch engine shares the refusal matrix (the
+    # construction-time and call-time paths route through the same
+    # helper, so they cannot drift).
+    with pytest.raises(ValueError, match="MoE"):
+        tiny(moe_experts=4, decode_engine="pallas-layer")
+    with pytest.raises(ValueError, match="VMEM"):
+        tiny(model_dim=4096, num_heads=8, decode_engine="pallas-layer")
+    m = tiny()
+    qparams = m.decode_weights(m.init(seed=1), "int8")
+    with pytest.raises(ValueError, match="QuantizedLinear"):
+        m._resolve_decode_engine("pallas-layer", qparams)
 
 
 def test_pallas_engine_refuses_weight_only_quantized_params():
@@ -264,7 +389,13 @@ def test_auto_resolves_to_xla_off_tpu():
     # auto + unsupported config resolves to xla instead of raising
     mq = tiny(matmul_dtype="int8")
     assert mq._resolve_decode_engine("auto", mq.init(seed=1)) == "xla"
-    assert DECODE_ENGINES == ("auto", "pallas", "xla")
+    assert DECODE_ENGINES == ("auto", "pallas", "pallas-layer", "xla")
+    # Explicit concrete engines resolve to themselves on a supported
+    # config (no silent cross-tier substitution).
+    assert m._resolve_decode_engine("pallas", params) == "pallas"
+    assert (
+        m._resolve_decode_engine("pallas-layer", params) == "pallas-layer"
+    )
 
 
 # -- TextServer threading --------------------------------------------------
@@ -301,3 +432,43 @@ def test_textserver_pallas_refuses_weight_only_decode():
             m, params, decode_matmul_dtype="int8",
             decode_engine="pallas", slots=1, buckets=(16,),
         )
+
+
+def _spec_streams(kv_dtype):
+    """Round-20 satellite: spec_draft > 0 with every engine tier —
+    megakernel decode + fused Pallas verify ("pallas"), per-layer
+    decode + XLA-fallback verify ("pallas-layer"), and the pure XLA
+    server — must produce identical greedy streams AND identical
+    acceptance counts (greedy-exact: a bad draft never changes a
+    token, on any engine)."""
+    m = tiny()
+    params = m.init(seed=1)
+    rng = np.random.default_rng(11)
+    prompts = [
+        np.asarray(rng.integers(1, 97, n), np.int32) for n in (5, 9, 3)
+    ]
+    cfg = GenerationConfig(max_new=8, greedy=True)
+    kw = dict(
+        slots=2, chunk=4, buckets=(16,), paged=True, block_size=4,
+        spec_draft=3, kv_dtype=kv_dtype,
+    )
+    outs, accepted = {}, {}
+    for eng in (None, "pallas-layer", "pallas"):
+        srv = TextServer(m, params, decode_engine=eng, **kw)
+        outs[eng] = srv.generate(prompts, [cfg] * len(prompts))
+        accepted[eng] = srv.metrics.counter("spec_tokens_accepted").value
+    for eng in ("pallas-layer", "pallas"):
+        for a, b in zip(outs[None], outs[eng], strict=True):
+            assert np.array_equal(a, b), eng
+        assert accepted[eng] == accepted[None], eng
+    # Speculation actually engaged (greedy slots propose drafts).
+    assert accepted[None] > 0
+
+
+def test_textserver_spec_pallas_streams_match_int8():
+    _spec_streams("int8")
+
+
+@pytest.mark.heavy
+def test_textserver_spec_pallas_streams_match_bf16():
+    _spec_streams("bf16")

@@ -134,6 +134,25 @@ def test_serving_decode_engine_record():
     # kernel's latency claim is chip-only until the Mosaic rerun.
     if not any(r["engine"] == "pallas" for r in de["rows"]):
         assert any(p["engine"] == "pallas" for p in de.get("pending", []))
+    # Round 20: the dispatch-count half (traced, device-independent) is
+    # committed beside the timing rows — every engine tier present, the
+    # megakernel at its O(1) count (one launch + the sampling tail),
+    # and the layer-scaling engines strictly above it.
+    disp = de.get("dispatches")
+    assert disp, (
+        "decode_engine section lost its dispatches half; run python -m "
+        "distributed_tensorflow_tpu.tools.serve_bench "
+        "--decode-dispatches --write-docs"
+    )
+    assert disp["device"] == "trace"
+    counts = {
+        r["engine"]: r["dispatches_per_token"] for r in disp["rows"]
+    }
+    assert set(counts) == {"xla", "pallas-layer", "pallas"}
+    assert counts["pallas"] == 2
+    assert counts["xla"] > counts["pallas"]
+    assert counts["pallas-layer"] > counts["pallas"]
     with open(os.path.join(root, "serving.md")) as f:
         committed = f.read()
     assert "Fused decode-step engine A/B" in committed
+    assert "Dispatches per token" in committed
