@@ -54,6 +54,9 @@ GANG_KINDS = (
     "replica_relaunch",
     "replica_benched",
     "fleet_below_floor",
+    "breaker_open",
+    "breaker_half_open",
+    "breaker_close",
 )
 
 _RANK_FILE = re.compile(r"^events-rank(\d+)\.jsonl$")

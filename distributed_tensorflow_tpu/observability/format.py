@@ -184,6 +184,24 @@ def _mailbox_corrupt(ev: dict) -> str:
     return line
 
 
+def _breaker_open(ev: dict) -> str:
+    # Round 21 (router circuit breaker): routes divert immediately,
+    # before the slower HttpHealth verdict; nothing charged to the
+    # restart budget.
+    return (
+        f"Breaker: open replica={ev['replica']} failures={ev['failures']} "
+        f"reason[{ev['reason']}] reset_s={ev['reset_s']:.1f}"
+    )
+
+
+def _breaker_half_open(ev: dict) -> str:
+    return f"Breaker: half-open replica={ev['replica']} — probing one request"
+
+
+def _breaker_close(ev: dict) -> str:
+    return f"Breaker: close replica={ev['replica']}"
+
+
 def _failpoint(ev: dict) -> str:
     # Round 19 (train/failpoints.py): an injected fault fired.
     return (
@@ -211,6 +229,9 @@ RENDERERS = {
     "weight_swap": _weight_swap,
     "mailbox_corrupt": _mailbox_corrupt,
     "failpoint": _failpoint,
+    "breaker_open": _breaker_open,
+    "breaker_half_open": _breaker_half_open,
+    "breaker_close": _breaker_close,
 }
 
 

@@ -100,12 +100,18 @@ class EventJournal(NullJournal):
         world: int | None = None,
         run_id: str | None = None,
         rotate_bytes: int = 0,
+        fsync: bool = False,
         clock=time.time,
     ):
         self.path = path
         self.rank = rank
         self.world = world
         self.run_id = run_id
+        # Round 21 (opt-in; DTF_JOURNAL_FSYNC=1): fsync after EVERY
+        # append, so a kill inside emit() can no longer lose the final
+        # line (docs/known_issues.md). Default off — the write path and
+        # bytes are identical, only durability timing changes.
+        self.fsync = bool(fsync)
         self.rotate_bytes = int(rotate_bytes)
         if self.rotate_bytes < 0:
             raise ValueError(
@@ -184,6 +190,8 @@ class EventJournal(NullJournal):
             fd = self._file()
         os.write(fd, data)  # ONE write = one line: the atomicity contract
         self._size += len(data)
+        if self.fsync:
+            self.flush()
         return ev
 
     def flush(self) -> None:
@@ -280,6 +288,7 @@ def configure(
     world: int | None = None,
     run_id: str | None = None,
     rotate_bytes: int = 0,
+    fsync: bool = False,
 ) -> NullJournal:
     """Install the process-default journal (``<logdir>/events.jsonl``, or
     an explicit ``path``). Components that were not handed a journal
@@ -295,7 +304,7 @@ def configure(
             path = os.path.join(logdir, "events.jsonl")
         _default = EventJournal(
             path, rank=rank, world=world, run_id=run_id,
-            rotate_bytes=rotate_bytes,
+            rotate_bytes=rotate_bytes, fsync=fsync,
         )
     return _default
 
@@ -322,7 +331,9 @@ def configure_from_env(
       argument, else ``DTF_RANK``), else ``events.jsonl``.
 
     ``DTF_WORLD_SIZE``/``DTF_RUN_ID`` tag events;
-    ``DTF_JOURNAL_ROTATE_BYTES`` arms rotation. With neither path knob
+    ``DTF_JOURNAL_ROTATE_BYTES`` arms rotation;
+    ``DTF_JOURNAL_FSYNC=1`` arms fsync-per-append (round 21 — the
+    kill-in-append durability opt-in). With neither path knob
     set this is a no-op returning the current default — safe to call
     unconditionally. ``announce=True`` emits a ``worker_start`` event
     (pid + rank), which is how a per-rank journal shows its own restarts:
@@ -348,6 +359,7 @@ def configure_from_env(
         world=world,
         run_id=env.get("DTF_RUN_ID"),
         rotate_bytes=int(env.get("DTF_JOURNAL_ROTATE_BYTES", "0") or 0),
+        fsync=env.get("DTF_JOURNAL_FSYNC", "") in ("1", "true"),
     )
     if announce:
         j.emit("worker_start", pid=os.getpid())

@@ -38,6 +38,7 @@ output does not depend on what shared the batch with it.
 from __future__ import annotations
 
 import dataclasses
+import math
 import os
 import time
 from collections import deque
@@ -60,13 +61,14 @@ from distributed_tensorflow_tpu.serve_pool import (
     PrefixCache,
     QueueFull,
     RequestCancelled,
+    RequestShed,
     blocks_for,
     lookup_draft,
 )
 
-__all__ = [  # noqa: F822 — QueueFull/RequestCancelled re-exported above
-    "GenerationConfig", "QueueFull", "RequestCancelled", "TextServer",
-    "canonical_lm_params", "load_tokenizer",
+__all__ = [  # noqa: F822 — QueueFull/RequestCancelled/RequestShed re-exported
+    "GenerationConfig", "QueueFull", "RequestCancelled", "RequestShed",
+    "TextServer", "canonical_lm_params", "load_tokenizer",
 ]
 
 
@@ -253,16 +255,22 @@ class _PagedState(NamedTuple):
 class _Request:
     __slots__ = (
         "rid", "tokens", "config", "out", "done", "trace", "cancelled",
-        "deadline", "t_submit", "t_admit", "t_first",
+        "shed", "priority", "deadline", "t_submit", "t_admit", "t_first",
     )
 
-    def __init__(self, rid, tokens, config, *, trace=None, deadline_s=None):
+    def __init__(
+        self, rid, tokens, config, *, trace=None, deadline_s=None, priority=0
+    ):
         self.rid = rid
         self.tokens = tokens
         self.config = config
         self.out: list[int] = []
         self.done = False
         self.cancelled = False
+        # Shed (round 21): dropped by the scheduler WITHOUT spending a
+        # dispatch — terminal like cancelled, but typed RequestShed.
+        self.shed = False
+        self.priority = priority  # int >= 0; higher = more important
         # Trace id (round 12, observability/tracing.py): joins every
         # journal event of this request's life — request_submit →
         # admission → prefill/decode spans (by rid) → completion — so
@@ -548,6 +556,17 @@ class TextServer:
         self._slot_req: list[_Request | None] = [None] * slots
         self._next_rid = 0
         self._results: dict[int, _Request] = {}
+        # Measured per-token decode seconds (EWMA over chunk dispatches,
+        # round 21): the "provably cannot finish" shed predicate's only
+        # evidence. None until the first measured chunk — the scheduler
+        # never sheds on a guess, only on expiry, before then.
+        self._tok_ewma: float | None = None
+        # The first decode dispatch carries the chunk-scan COMPILE —
+        # seconds/token of one-time cost. Feeding it to the EWMA made a
+        # freshly-warmed replica shed its first deadline-bearing traffic
+        # as "hopeless" within microseconds (the round-21 chaos schedule
+        # caught this live); that measurement is discarded instead.
+        self._tok_first_dispatch = True
         self._state = self._init_state()
         self._prefill_jit = jax.jit(
             self._paged_prefill_graph if paged else self._prefill_graph
@@ -926,6 +945,7 @@ class TextServer:
         config: GenerationConfig | None = None,
         *,
         deadline_s: float | None = None,
+        priority: int = 0,
         trace: str | None = None,
     ) -> int:
         """Queue one request (prompt as a 1-D int token array). Returns a
@@ -934,15 +954,32 @@ class TextServer:
         ``len + max_new`` must fit ``max_len`` (the KV cache is the slot's
         whole memory — vLLM's fixed-slot discipline).
 
-        ``deadline_s`` (round 16): wall-clock budget from NOW; an overdue
-        request — queued or resident — is cancelled at the next chunk
-        boundary (slot/blocks freed, ``request_cancelled`` journal event,
-        :meth:`result` raises :class:`RequestCancelled`). ``trace``
-        overrides the generated trace id so a fleet router's retries keep
-        one id across replicas. Raises :class:`QueueFull` when the queue
-        is at ``queue_limit`` and RuntimeError once :meth:`drain` closed
-        admission."""
+        ``deadline_s`` (round 16, shed semantics round 21): wall-clock
+        budget from NOW. A RESIDENT request past its deadline is
+        cancelled at the next chunk boundary (slot/blocks freed,
+        ``request_cancelled`` event, :meth:`result` raises
+        :class:`RequestCancelled`). A QUEUED request past its deadline —
+        or whose remaining budget provably cannot finish inside it at
+        the measured per-token rate — is SHED before any prefill
+        dispatch (``request_shed`` event, :class:`RequestShed`); one
+        that arrives already dead (``deadline_s <= 0``) is shed AT
+        SUBMIT and never occupies queue_limit budget.
+
+        ``priority`` (round 21): int >= 0, higher = more important.
+        Admission picks by (priority class, earliest deadline first);
+        with every queued request at priority 0 and no deadline the
+        order is EXACTLY the round-16 FIFO. Under saturation a
+        higher-priority submit sheds the lowest class's most deferrable
+        request instead of bouncing QueueFull.
+
+        ``trace`` overrides the generated trace id so a fleet router's
+        retries keep one id across replicas. Raises :class:`QueueFull`
+        when the queue is at ``queue_limit`` with no lower class to
+        shed, and RuntimeError once :meth:`drain` closed admission."""
         config = config or GenerationConfig()
+        priority = int(priority)
+        if priority < 0:
+            raise ValueError(f"priority must be >= 0, got {priority}")
         config.validate(self.model.vocab_size)
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         if tokens.size < 1:
@@ -972,40 +1009,110 @@ class TextServer:
                 "server is draining: admission is closed (residents are "
                 "being finished; route new requests to another replica)"
             )
+        if deadline_s is not None and float(deadline_s) <= 0.0:
+            # Arrived dead: terminal RequestShed at submit — it must
+            # never occupy queue_limit budget or displace live work
+            # (satellite, round 21). The birth event still fires so the
+            # per-request timeline reconstruction sees one lifecycle.
+            rid = self._next_rid
+            self._next_rid += 1
+            req = _Request(
+                rid, tokens, config,
+                trace=trace, deadline_s=deadline_s, priority=priority,
+            )
+            self._results[rid] = req
+            self.metrics.counter("requests_submitted_total").inc()
+            self._emit_submit(req)
+            self._shed(req, reason="expired_at_submit")
+            return rid
         if (
             self.queue_limit is not None
             and len(self._queue) >= self.queue_limit
         ):
-            self.metrics.counter("queue_rejections_total").inc()
-            self.journal.emit(
-                "queue_reject",
-                prompt_len=int(tokens.size),
-                queue_depth=len(self._queue),
-                queue_limit=int(self.queue_limit),
-                **({"trace": trace} if trace else {}),
-            )
-            raise QueueFull(
-                f"admission queue is at queue_limit={self.queue_limit}; "
-                "retry later or route to another replica"
-            )
+            victim = self._shed_victim(priority)
+            if victim is None:
+                self.metrics.counter("queue_rejections_total").inc()
+                self.journal.emit(
+                    "queue_reject",
+                    prompt_len=int(tokens.size),
+                    queue_depth=len(self._queue),
+                    queue_limit=int(self.queue_limit),
+                    **({"trace": trace} if trace else {}),
+                )
+                raise QueueFull(
+                    f"admission queue is at queue_limit={self.queue_limit}; "
+                    "retry later or route to another replica"
+                )
+            # Saturation shed (round 21): the newcomer outranks the
+            # lowest queued class — shed that class's most deferrable
+            # member (no deadline first, then latest deadline; never out
+            # of deadline order within the class) instead of bouncing
+            # the higher-priority request.
+            self._queue.remove(victim)
+            self._shed(victim, reason="preempted")
         rid = self._next_rid
         self._next_rid += 1
-        req = _Request(rid, tokens, config, trace=trace, deadline_s=deadline_s)
+        req = _Request(
+            rid, tokens, config,
+            trace=trace, deadline_s=deadline_s, priority=priority,
+        )
         self._queue.append(req)
         self._results[rid] = req
         self.metrics.counter("requests_submitted_total").inc()
         self.metrics.gauge("queue_depth").set(len(self._queue))
+        self._emit_submit(req)
+        return rid
+
+    def _emit_submit(self, req: _Request) -> None:
         # The trace's birth event: everything downstream (admission,
-        # spans, completion) joins to it by trace/rid.
+        # spans, completion/shed) joins to it by trace/rid. ``priority``
+        # rides only when non-default — the round-16 event bytes are
+        # preserved on the default path.
         self.journal.emit(
             "request_submit",
-            rid=rid,
+            rid=req.rid,
             trace=req.trace,
-            prompt_len=int(tokens.size),
-            max_new=int(config.max_new),
-            greedy=bool(config.greedy),
+            prompt_len=int(req.tokens.size),
+            max_new=int(req.config.max_new),
+            greedy=bool(req.config.greedy),
+            **({"priority": req.priority} if req.priority else {}),
         )
-        return rid
+
+    def _shed_victim(self, priority: int) -> _Request | None:
+        """Under a full queue: the request a ``priority``-class submit may
+        displace — a member of the strictly LOWEST queued class when that
+        class ranks below the newcomer; within the class the most
+        deferrable one (no deadline, then latest deadline, then newest).
+        All-default traffic (priority 0 everywhere) finds no victim and
+        keeps the round-16 QueueFull contract."""
+        if priority <= 0 or not self._queue:
+            return None
+        low = min(r.priority for r in self._queue)
+        if low >= priority:
+            return None
+        return max(
+            (r for r in self._queue if r.priority == low),
+            key=lambda r: (
+                math.inf if r.deadline is None else r.deadline, r.rid,
+            ),
+        )
+
+    def _shed(self, req: _Request, *, reason: str) -> None:
+        """Terminal drop WITHOUT spending a dispatch: the loud record
+        (``request_shed`` event + ``sheds_total``) a router or load
+        generator keys on. Distinct from :meth:`_cancel` — no slot or
+        blocks exist to free, and :meth:`result` raises
+        :class:`RequestShed`."""
+        req.shed = True
+        self.metrics.counter("sheds_total").inc()
+        self.journal.emit(
+            "request_shed",
+            rid=req.rid,
+            trace=req.trace,
+            priority=req.priority,
+            reason=reason,
+            age_s=round(time.perf_counter() - req.t_submit, 6),
+        )
 
     def bucket_for(self, length: int) -> int:
         """Smallest bucket holding a ``length``-token prompt."""
@@ -1334,22 +1441,63 @@ class TextServer:
             age_s=round(time.perf_counter() - req.t_submit, 6),
         )
 
-    def _cancel_overdue(self) -> None:
-        """Deadline enforcement at the chunk boundary: cancel queued and
-        resident requests whose ``deadline_s`` budget has elapsed."""
+    def _hopeless(self, req: _Request, now: float) -> bool:
+        """True when the request provably cannot finish: full remaining
+        budget × the measured per-token EWMA exceeds the deadline slack.
+        Conservative by construction — no measurement yet (or no
+        deadline) never sheds, and the estimate ignores queue wait ahead
+        of the request, so only truly unreachable deadlines trip it."""
+        if req.deadline is None or self._tok_ewma is None:
+            return False
+        return req.config.max_new * self._tok_ewma > req.deadline - now
+
+    def _shed_overdue(self) -> None:
+        """Queued-side deadline enforcement at the chunk boundary (round
+        21): a queued request past its deadline — or provably unable to
+        finish inside it — is SHED before any prefill dispatch is spent
+        on it. Residents are the :meth:`_cancel_overdue` half."""
         now = time.perf_counter()
-        if any(r.deadline is not None and now > r.deadline for r in self._queue):
-            keep: deque[_Request] = deque()
-            for req in self._queue:
-                if req.deadline is not None and now > req.deadline:
-                    self._cancel(req)
-                else:
-                    keep.append(req)
-            self._queue = keep
-            self.metrics.gauge("queue_depth").set(len(self._queue))
+        if not any(
+            r.deadline is not None
+            and (now > r.deadline or self._hopeless(r, now))
+            for r in self._queue
+        ):
+            return
+        keep: deque[_Request] = deque()
+        for req in self._queue:
+            if req.deadline is not None and now > req.deadline:
+                self._shed(req, reason="expired")
+            elif self._hopeless(req, now):
+                self._shed(req, reason="hopeless")
+            else:
+                keep.append(req)
+        self._queue = keep
+        self.metrics.gauge("queue_depth").set(len(self._queue))
+
+    def _cancel_overdue(self) -> None:
+        """Deadline enforcement at the chunk boundary: cancel RESIDENT
+        requests whose ``deadline_s`` budget elapsed mid-generation
+        (queued ones are shed instead — :meth:`_shed_overdue`)."""
+        now = time.perf_counter()
         for slot, req in enumerate(self._slot_req):
             if req is not None and req.deadline is not None and now > req.deadline:
                 self._cancel(req, slot=slot)
+
+    def _schedule(self) -> None:
+        """Admission order (round 21): (priority class desc, earliest
+        deadline first, submission order). When every queued request is
+        priority 0 with no deadline the sort is skipped entirely — the
+        queue stays the round-16 FIFO deque, untouched."""
+        if all(r.priority == 0 and r.deadline is None for r in self._queue):
+            return
+        self._queue = deque(sorted(
+            self._queue,
+            key=lambda r: (
+                -r.priority,
+                math.inf if r.deadline is None else r.deadline,
+                r.rid,
+            ),
+        ))
 
     def _finish(self, slot: int) -> None:
         req = self._slot_req[slot]
@@ -1463,9 +1611,11 @@ class TextServer:
         swap is pending — so residents ALWAYS complete under the weights
         they were admitted with (the parity contract is per-admission)."""
         self._last_tick = time.time()  # /healthz heartbeat: engine ticking
+        self._shed_overdue()
         self._cancel_overdue()
         self._maybe_apply_swap()
         if not self._draining and self._pending_swap is None:
+            self._schedule()
             self._admit()
         occupied = sum(r is not None for r in self._slot_req)
         self.metrics.gauge("slots_busy").set(occupied)
@@ -1478,6 +1628,7 @@ class TextServer:
             spec = self.spec_draft and any(
                 r is not None and r.config.greedy for r in self._slot_req
             )
+            t_dispatch = time.perf_counter()
             if spec:
                 toks, valid = self._spec_dispatch(occupied)
             else:
@@ -1494,12 +1645,29 @@ class TextServer:
                     toks = sp.fetch(toks)
                 valid = np.asarray(valid)
             fin = np.asarray(self._state.finished)
+            emitted = 0
             for slot, req in enumerate(self._slot_req):
                 if req is None:
                     continue
-                req.out.extend(int(t) for t in toks[valid[:, slot], slot])
+                picked = [int(t) for t in toks[valid[:, slot], slot]]
+                req.out.extend(picked)
+                emitted += len(picked)
                 if fin[slot]:
                     self._finish(slot)
+            # Per-token EWMA (round 21): one decode dispatch's wall time
+            # over the tokens it emitted — the evidence the hopeless-shed
+            # predicate runs on. EWMA (not last-sample) so one slow tick
+            # (GC pause, cold path) cannot trigger a shed storm.
+            if emitted:
+                if self._tok_first_dispatch:
+                    # Compile-bearing measurement: discard (see __init__).
+                    self._tok_first_dispatch = False
+                else:
+                    inst = (time.perf_counter() - t_dispatch) / emitted
+                    self._tok_ewma = (
+                        inst if self._tok_ewma is None
+                        else 0.8 * self._tok_ewma + 0.2 * inst
+                    )
             # Re-read after _finish frees slots: the tick that completes
             # the last request must leave the gauge at 0 (an idle server
             # must not scrape as busy forever).
@@ -1646,18 +1814,25 @@ class TextServer:
             self.exporter = None
 
     def done(self, rid: int) -> bool:
-        """True once the request reached a terminal state (finished or
-        cancelled) — the poll half of the submit/step/result cycle a
-        replica worker loop drives."""
-        return self._results[rid].done or self._results[rid].cancelled
+        """True once the request reached a terminal state (finished,
+        cancelled, or shed) — the poll half of the submit/step/result
+        cycle a replica worker loop drives."""
+        req = self._results[rid]
+        return req.done or req.cancelled or req.shed
 
     def result(self, rid: int) -> np.ndarray:
         """Generated tokens of a finished request (prompt excluded).
         Consumes the record — a second read raises — so a long-lived
         server does not accumulate every request it ever served. A
-        deadline-cancelled request raises :class:`RequestCancelled`
-        (record consumed too)."""
+        deadline-cancelled request raises :class:`RequestCancelled`, a
+        shed one :class:`RequestShed` (record consumed either way)."""
         req = self._results[rid]
+        if req.shed:
+            del self._results[rid]
+            raise RequestShed(
+                f"request {rid} was shed before prefill (deadline "
+                "unreachable or displaced under saturation)"
+            )
         if req.cancelled:
             del self._results[rid]
             raise RequestCancelled(

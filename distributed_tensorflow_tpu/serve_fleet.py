@@ -72,6 +72,27 @@ closing the DiLoCo train→publish→serve loop. Residents admitted before
 the swap complete under the old weights' parity contract; new admissions
 serve the new weights; no request is dropped.
 
+Overload robustness (round 21)
+------------------------------
+Under load the router degrades gracefully instead of rejecting blindly
+(docs/serving.md §overload). Requests carry ``priority`` + ``deadline_s``
+fleet-wide: queued requests live in PER-CLASS queues served weighted-fair
+(deficit round robin, weight ``priority+1`` — low classes still progress,
+high classes get the larger share), earliest-deadline-first within a
+class; a queued request past its deadline — or provably unable to finish
+inside it (remaining budget x the fleet's measured per-token EWMA) — is
+SHED before a route is spent on it (:class:`~serve_pool.RequestShed`
+terminal result, ``request_shed`` journal event; distinct from a
+``RequestCancelled`` resident). A per-replica CIRCUIT BREAKER watches
+route timeouts (``route_timeout_s``; default None = off): consecutive
+failures open it and divert routes immediately — BEFORE the slower
+HttpHealth verdict lands — half-open admits one probe after
+``breaker_reset_s``, any collected result closes it. Breaker transitions
+are routing decisions: they emit ``breaker_*`` journal events and charge
+NOTHING to the restart budget (supervision still owns kill/relaunch).
+Default path (no priority/deadline, no route timeout) is byte-identical
+to round 16.
+
 Out of scope (deliberately): sharded (tensor-parallel) serving and the
 HTTP/SSE streaming frontend — both gate on the partition-rule engine
 (ROADMAP item 2) and deserve their own PR.
@@ -89,6 +110,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import os
 import subprocess
 import sys
@@ -99,7 +121,7 @@ from typing import Sequence
 from distributed_tensorflow_tpu.observability import journal as obs_journal
 from distributed_tensorflow_tpu.observability import tracing
 from distributed_tensorflow_tpu.observability.metrics import MetricsRegistry
-from distributed_tensorflow_tpu.serve_pool import RequestCancelled
+from distributed_tensorflow_tpu.serve_pool import RequestCancelled, RequestShed
 from distributed_tensorflow_tpu.train import failpoints, resilience
 from distributed_tensorflow_tpu.train.elastic import (
     ElasticAgent,
@@ -214,7 +236,14 @@ class MailboxClient:
     ``fleet.submit``/``fleet.result`` (entry + tear of the committed
     file), ``fleet.read`` at every poll."""
 
-    def __init__(self, root: str, *, journal=None, orphan_age_s: float = 60.0):
+    def __init__(
+        self,
+        root: str,
+        *,
+        journal=None,
+        metrics=None,
+        orphan_age_s: float = 60.0,
+    ):
         self.root = root
         self.inbox = os.path.join(root, "inbox")
         self.outbox = os.path.join(root, "outbox")
@@ -222,6 +251,7 @@ class MailboxClient:
         os.makedirs(self.outbox, exist_ok=True)
         self._seq = 0
         self.journal = journal
+        self.metrics = metrics  # round 21: counters beside the journal
         self.orphan_age_s = float(orphan_age_s)
         self.corrupt_files = 0  # quarantined corrupt mailbox files
         for d in (self.inbox, self.outbox):
@@ -240,6 +270,8 @@ class MailboxClient:
     def _on_corrupt(self, box: str):
         def cb(name: str, reason: str) -> None:
             self.corrupt_files += 1
+            if self.metrics is not None:
+                self.metrics.counter("mailbox_corrupt_files_total").inc()
             j = self.journal
             if j is None:
                 j = obs_journal.get_journal()
@@ -306,10 +338,11 @@ class _FleetRequest:
     __slots__ = (
         "rid", "trace", "tokens", "config", "deadline", "deadline_s",
         "t_submit", "replica", "attempts", "done", "cancelled", "failed",
-        "out", "t_done",
+        "shed", "priority", "out", "t_done", "t_routed",
     )
 
-    def __init__(self, rid, trace, tokens, config, deadline, deadline_s, now):
+    def __init__(self, rid, trace, tokens, config, deadline, deadline_s,
+                 now, priority=0):
         self.rid = rid
         self.trace = trace
         self.tokens = tokens
@@ -322,12 +355,18 @@ class _FleetRequest:
         self.done = False
         self.cancelled = False
         self.failed: str | None = None  # terminal rejection (error text)
+        self.shed = False  # dropped before any route/prefill (round 21)
+        self.priority = priority  # int >= 0; higher = more important
         self.out: list[int] | None = None
         self.t_done: float | None = None
+        self.t_routed: float | None = None  # last route, breaker timeout
 
     @property
     def terminal(self) -> bool:
-        return self.done or self.cancelled or self.failed is not None
+        return (
+            self.done or self.cancelled or self.shed
+            or self.failed is not None
+        )
 
 
 class ReplicaHandle:
@@ -358,11 +397,27 @@ class ReplicaHandle:
         self.inflight: dict[str, _FleetRequest] = {}
         self.cooldown_until = 0.0  # QueueFull backpressure hold-off
         self._next_probe = 0.0
+        # Round-21 circuit breaker (routing layer, independent of the
+        # supervision states above): closed / open / half_open.
+        self.breaker = "closed"
+        self.breaker_failures = 0  # consecutive route failures
+        self.breaker_until = 0.0  # open -> half_open at this clock
+        self.breaker_probe: str | None = None  # the half-open probe trace
+
+    def breaker_reset(self) -> None:
+        self.breaker = "closed"
+        self.breaker_failures = 0
+        self.breaker_until = 0.0
+        self.breaker_probe = None
 
     @property
     def routable(self) -> bool:
         if self.state != "up":
             return False
+        if self.breaker == "open":
+            return False
+        if self.breaker == "half_open" and self.breaker_probe is not None:
+            return False  # one probe at a time
         doc = self.health.last if self.health is not None else None
         return not (doc and doc.get("draining"))
 
@@ -387,6 +442,9 @@ class ReplicaRouter:
         affinity_cap: int = 4096,
         spill_threshold: float = 0.75,
         max_reroutes: int = 8,
+        breaker_failures: int = 3,
+        breaker_reset_s: float = 5.0,
+        route_timeout_s: float | None = None,
         probe_interval_s: float = 0.5,
         poll_interval: float = 0.05,
         journal=None,
@@ -424,17 +482,46 @@ class ReplicaRouter:
         self.affinity_cap = int(affinity_cap)
         self.spill_threshold = float(spill_threshold)
         self.max_reroutes = int(max_reroutes)
+        self.breaker_failures = int(breaker_failures)
+        self.breaker_reset_s = float(breaker_reset_s)
+        # None (the default) disarms route-timeout detection entirely —
+        # the round-16 path, byte-identical.
+        self.route_timeout_s = (
+            None if route_timeout_s is None else float(route_timeout_s)
+        )
         self.probe_interval_s = float(probe_interval_s)
         self.poll_interval = float(poll_interval)
         self.journal = (
             journal if journal is not None else obs_journal.get_journal()
         )
         self.metrics = metrics if metrics is not None else MetricsRegistry()
+        # Round-21 satellite: mailbox corruption counters ride the
+        # router's registry (mailbox_corrupt_files_total) so dashboards
+        # see rot, not a "silent replica" (docs/known_issues.md).
+        for h in replicas:
+            client = getattr(h, "client", None)
+            if (
+                client is not None
+                and hasattr(client, "metrics")
+                and client.metrics is None
+            ):
+                client.metrics = self.metrics
         self.print_fn = print_fn
         self.clock = clock
         self.sleep = sleep
         self.rng = rng
-        self._queue: deque[_FleetRequest] = deque()
+        # Per-priority-class queues (round 21). All-default traffic lives
+        # in class 0 and dequeues exactly like the old single FIFO deque:
+        # rids are monotone and every requeue is an appendleft of rids
+        # lower than anything behind them, so FIFO order IS rid order and
+        # the EDF key (deadline-or-inf, rid) degenerates to the head.
+        self._queues: dict[int, deque[_FleetRequest]] = {}
+        self._drr: dict[int, float] = {}  # deficit round-robin credits
+        # Fleet per-token seconds (EWMA over completed requests): the
+        # router-side "provably cannot finish" shed predicate's only
+        # evidence. None until the first completion — the router never
+        # sheds on a guess, only on expiry, before then.
+        self._tok_ewma: float | None = None
         self._by_rid: dict[int, _FleetRequest] = {}
         self._by_trace: dict[str, _FleetRequest] = {}
         self._affinity: dict[tuple, str] = {}
@@ -463,12 +550,19 @@ class ReplicaRouter:
                 h.state = "up"  # nothing to confirm: trust the spawn
         self.metrics.gauge("replicas_total").set(len(self.replicas))
 
-    def submit(self, tokens, config=None, *, deadline_s=None) -> int:
+    def submit(
+        self, tokens, config=None, *, deadline_s=None, priority: int = 0
+    ) -> int:
         """Queue one request fleet-wide. ``config`` is a GenerationConfig
         dataclass or a plain dict of its fields (the router is jax-free
         and never imports the engine); the FULL config travels with the
         request so a failover re-serves the identical stream. Returns a
-        router-scope request id for :meth:`result`."""
+        router-scope request id for :meth:`result`.
+
+        Round 21: ``priority`` picks the request's class queue (higher =
+        more important, weighted-fair dequeue); a request that arrives
+        with its deadline already spent is shed HERE — terminal
+        :class:`~serve_pool.RequestShed`, never queued, never routed."""
         if self._draining:
             raise RuntimeError("router is draining: admission closed")
         if dataclasses.is_dataclass(config) and not isinstance(config, type):
@@ -480,6 +574,9 @@ class ReplicaRouter:
                 f"unknown generation config keys {unknown}; valid: "
                 f"{list(CONFIG_KEYS)}"
             )
+        priority = int(priority)
+        if priority < 0:
+            raise ValueError(f"priority must be >= 0, got {priority}")
         tokens = [int(t) for t in tokens]
         if not tokens:
             raise ValueError("empty prompt")
@@ -490,35 +587,64 @@ class ReplicaRouter:
         req = _FleetRequest(
             rid, trace, tokens, config,
             None if deadline_s is None else now + float(deadline_s),
-            deadline_s, now,
+            deadline_s, now, priority,
         )
-        self._queue.append(req)
         self._by_rid[rid] = req
         self._by_trace[trace] = req
+        if deadline_s is not None and float(deadline_s) <= 0.0:
+            # Arrived dead: shed at submit — it must never occupy queue
+            # space or cost a route (round-21 satellite).
+            self.metrics.counter("fleet_submitted_total").inc()
+            self._emit_submit(req)
+            self._shed(req, now, reason="expired_at_submit")
+            return rid
+        self._enqueue(req)
         self.metrics.counter("fleet_submitted_total").inc()
+        self._emit_submit(req)
+        return rid
+
+    def _emit_submit(self, req: _FleetRequest) -> None:
+        # The priority field appears ONLY when non-zero: default-path
+        # journals stay byte-identical to round 16.
         self.journal.emit(
             "request_submit",
-            rid=rid,
-            trace=trace,
-            prompt_len=len(tokens),
-            max_new=int(config.get("max_new", 64)),
-            greedy=bool(config.get("greedy", True)),
+            rid=req.rid,
+            trace=req.trace,
+            prompt_len=len(req.tokens),
+            max_new=int(req.config.get("max_new", 64)),
+            greedy=bool(req.config.get("greedy", True)),
+            **({"priority": req.priority} if req.priority else {}),
         )
-        return rid
+
+    # -- per-class queues (round 21) ---------------------------------------
+
+    def _enqueue(self, req: _FleetRequest) -> None:
+        self._queues.setdefault(req.priority, deque()).append(req)
+
+    def _requeue_front(self, req: _FleetRequest) -> None:
+        self._queues.setdefault(req.priority, deque()).appendleft(req)
+
+    def _queue_len(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def _queued(self):
+        for p in sorted(self._queues, reverse=True):
+            yield from self._queues[p]
 
     def step(self) -> bool:
         """One router tick: collect results (every mailbox, dead
         replicas included — committed results survive their writer),
         supervise (verdicts → failover + relaunch scheduling), relaunch
-        due members, cancel overdue queued requests, route. Returns True
+        due members, shed overdue queued requests, route. Returns True
         while requests are outstanding."""
         if not self._started:
             self.start()
         now = self.clock()
         self._collect()
+        self._breaker_scan(now)
         self._supervise(now)
         self._relaunch_due(now)
-        self._cancel_overdue(now)
+        self._shed_overdue(now)
         self._route(now)
         return not self.done_all()
 
@@ -544,7 +670,7 @@ class ReplicaRouter:
             self.sleep(self.poll_interval)
 
     def done_all(self) -> bool:
-        return not self._queue and all(
+        return self._queue_len() == 0 and all(
             r.terminal for r in self._by_rid.values()
         )
 
@@ -564,10 +690,18 @@ class ReplicaRouter:
     def result(self, rid: int) -> list[int]:
         """The served stream (router copy; consumes the record). Raises
         the same typed :class:`~serve_pool.RequestCancelled` as
-        ``TextServer.result`` for a deadline-cancelled request, and a
-        RuntimeError naming the replica's error for a terminally
-        rejected one."""
+        ``TextServer.result`` for a deadline-cancelled request,
+        :class:`~serve_pool.RequestShed` for one the scheduler dropped
+        before routing/prefill, and a RuntimeError naming the replica's
+        error for a terminally rejected one."""
         req = self._by_rid[rid]
+        if req.shed:
+            del self._by_rid[rid]
+            self._by_trace.pop(req.trace, None)
+            raise RequestShed(
+                f"request {rid} was shed before serving (deadline "
+                "unreachable or displaced under overload)"
+            )
         if req.cancelled:
             del self._by_rid[rid]
             self._by_trace.pop(req.trace, None)
@@ -645,8 +779,9 @@ class ReplicaRouter:
             "submitted": self._next_rid,
             "done": sum(r.done for r in reqs),
             "cancelled": sum(r.cancelled for r in reqs),
+            "shed": sum(r.shed for r in reqs),
             "failed": sum(r.failed is not None for r in reqs),
-            "queued": len(self._queue),
+            "queued": self._queue_len(),
             "inflight": sum(
                 len(h.inflight) for h in self.replicas.values()
             ),
@@ -664,6 +799,10 @@ class ReplicaRouter:
     def _collect(self) -> None:
         for h in self.replicas.values():
             for payload in h.client.poll_results():
+                # Any collected payload proves the mailbox round-trip is
+                # alive: reset the breaker's consecutive-failure count
+                # (and close it, if a half-open probe just came back).
+                self._breaker_success(h)
                 trace = payload.get("trace")
                 # Pop BEFORE the dedupe check: a duplicate result (the
                 # request already completed elsewhere) must still clear
@@ -693,10 +832,36 @@ class ReplicaRouter:
                         replica=h.name,
                         status="cancelled",
                     )
+                elif payload.get("shed"):
+                    # The replica's own scheduler shed it (queued there
+                    # past its deadline / displaced under saturation).
+                    req.shed = True
+                    req.t_done = self.clock()
+                    self.metrics.counter("fleet_shed_total").inc()
+                    self.journal.emit(
+                        "fleet_result",
+                        trace=trace,
+                        rid=req.rid,
+                        replica=h.name,
+                        status="shed",
+                    )
                 else:
                     req.out = [int(t) for t in payload.get("tokens", [])]
                     req.done = True
                     req.t_done = self.clock()
+                    if req.out and req.t_routed is not None:
+                        # Route-to-result seconds per emitted token: the
+                        # hopeless-shed predicate's evidence. Includes
+                        # replica-side queueing by design — that IS the
+                        # completion-time a queued request faces.
+                        inst = max(req.t_done - req.t_routed, 0.0) / len(
+                            req.out
+                        )
+                        self._tok_ewma = (
+                            inst
+                            if self._tok_ewma is None
+                            else 0.8 * self._tok_ewma + 0.2 * inst
+                        )
                     self.metrics.counter("fleet_completions_total").inc()
                     self.journal.emit(
                         "fleet_result",
@@ -732,7 +897,7 @@ class ReplicaRouter:
                 reason="backpressure",
             )
             req.replica = None
-            self._queue.appendleft(req)
+            self._requeue_front(req)
             return
         permanent = kind in ("ValueError", "TypeError")
         if permanent or req.attempts > self.max_reroutes:
@@ -760,7 +925,7 @@ class ReplicaRouter:
             reason="rejected",
         )
         req.replica = None
-        self._queue.appendleft(req)  # older than anything queued behind it
+        self._requeue_front(req)  # older than anything queued behind it
 
     def _supervise(self, now: float) -> None:
         for h in self.replicas.values():
@@ -820,8 +985,9 @@ class ReplicaRouter:
                 attempt=req.attempts,
                 reason="replica_dead",
             )
-            self._queue.appendleft(req)
+            self._requeue_front(req)
         h.inflight.clear()
+        h.breaker_reset()  # supervision owns the replica now
         h.attempts += 1
         self.metrics.counter("failovers_total").inc()
         lifecycle_event(
@@ -891,31 +1057,168 @@ class ReplicaRouter:
                 backoff_s=h.backoff_s,
             )
 
-    def _cancel_overdue(self, now: float) -> None:
+    def _hopeless(self, req: _FleetRequest, now: float) -> bool:
+        """Provably cannot finish: full remaining budget at the fleet's
+        measured per-token pace overruns the slack. Conservative by
+        construction — no EWMA yet means no verdict."""
+        if req.deadline is None or self._tok_ewma is None:
+            return False
+        max_new = int(req.config.get("max_new", 64))
+        return max_new * self._tok_ewma > req.deadline - now
+
+    def _shed(self, req: _FleetRequest, now: float, *, reason: str) -> None:
+        req.shed = True
+        req.t_done = now
+        self.metrics.counter("fleet_shed_total").inc()
+        self.journal.emit(
+            "request_shed",
+            rid=req.rid,
+            trace=req.trace,
+            priority=req.priority,
+            reason=reason,
+            age_s=round(now - req.t_submit, 6),
+        )
+
+    def _shed_overdue(self, now: float) -> None:
         """Router-side deadline enforcement for QUEUED requests (resident
         ones are cancelled replica-side and report back as cancelled).
-        A cancelled request is terminal: failover never resurrects it."""
-        if not any(
-            r.deadline is not None and now > r.deadline for r in self._queue
-        ):
-            return
-        keep: deque[_FleetRequest] = deque()
-        for req in self._queue:
-            if req.deadline is not None and now > req.deadline:
-                req.cancelled = True
-                req.t_done = now
-                self.metrics.counter("fleet_cancelled_total").inc()
-                self.journal.emit(
-                    "request_cancelled",
-                    rid=req.rid,
-                    trace=req.trace,
-                    resident=False,
-                    tokens=0,
-                    age_s=round(now - req.t_submit, 6),
-                )
+        Round 21: a queued request past its deadline — or hopeless
+        (:meth:`_hopeless`) — is SHED before a route is spent on it.
+        A shed request is terminal: failover never resurrects it."""
+        for prio in list(self._queues):
+            q = self._queues[prio]
+            if not any(
+                r.deadline is not None
+                and (now > r.deadline or self._hopeless(r, now))
+                for r in q
+            ):
+                continue
+            keep: deque[_FleetRequest] = deque()
+            for req in q:
+                if req.deadline is not None and now > req.deadline:
+                    self._shed(req, now, reason="expired")
+                elif self._hopeless(req, now):
+                    self._shed(req, now, reason="hopeless")
+                else:
+                    keep.append(req)
+            if keep:
+                self._queues[prio] = keep
             else:
-                keep.append(req)
-        self._queue = keep
+                del self._queues[prio]
+
+    # -- circuit breaker (round 21) ----------------------------------------
+
+    def _breaker_scan(self, now: float) -> None:
+        """Per-replica circuit breaker: consecutive route timeouts open
+        it, diverting routes IMMEDIATELY — before the slower HttpHealth
+        verdict lands; after ``breaker_reset_s`` it half-opens and admits
+        ONE probe; any collected result closes it (``_breaker_success``).
+        Pure routing layer: no kill, no relaunch, nothing charged to the
+        restart budget. ``route_timeout_s=None`` (default) disarms the
+        timeout detector — round-16 behavior, byte-identical."""
+        for h in self.replicas.values():
+            if h.breaker == "open" and now >= h.breaker_until:
+                h.breaker = "half_open"
+                h.breaker_probe = None
+                lifecycle_event(
+                    "breaker_half_open",
+                    print_fn=self.print_fn,
+                    journal=self.journal,
+                    replica=h.name,
+                )
+            if self.route_timeout_s is None or h.state != "up":
+                continue
+            timed_out = sorted(
+                (
+                    r
+                    for r in h.inflight.values()
+                    if not r.terminal
+                    and r.t_routed is not None
+                    and now - r.t_routed > self.route_timeout_s
+                ),
+                key=lambda r: r.rid,
+            )
+            for req in reversed(timed_out):
+                h.inflight.pop(req.trace, None)
+                req.replica = None
+                self.metrics.counter("reroutes_total").inc()
+                self.journal.emit(
+                    "request_reroute",
+                    trace=req.trace,
+                    rid=req.rid,
+                    from_replica=h.name,
+                    attempt=req.attempts,
+                    reason="route_timeout",
+                )
+                self._requeue_front(req)
+            if timed_out:
+                self._breaker_failure(
+                    h, now, reason=f"{len(timed_out)} route timeout(s)"
+                )
+
+    def _breaker_failure(
+        self, h: ReplicaHandle, now: float, *, reason: str
+    ) -> None:
+        h.breaker_failures += 1
+        if h.breaker == "half_open":
+            # The one probe failed: straight back to open.
+            self._breaker_trip(h, now, reason=f"probe failed: {reason}")
+        elif (
+            h.breaker == "closed"
+            and h.breaker_failures >= self.breaker_failures
+        ):
+            self._breaker_trip(h, now, reason=reason)
+
+    def _breaker_trip(
+        self, h: ReplicaHandle, now: float, *, reason: str
+    ) -> None:
+        h.breaker = "open"
+        h.breaker_until = now + self.breaker_reset_s
+        h.breaker_probe = None
+        self.metrics.counter("breaker_opens_total").inc()
+        lifecycle_event(
+            "breaker_open",
+            print_fn=self.print_fn,
+            journal=self.journal,
+            replica=h.name,
+            failures=h.breaker_failures,
+            reason=reason,
+            reset_s=self.breaker_reset_s,
+        )
+        # Divert everything still routed there: the breaker's whole
+        # point is not leaving work parked on a suspect replica until
+        # the health verdict. Dedupe-on-trace keeps a late committed
+        # result valid (first terminal wins), so diverting early is
+        # free of double-serve risk.
+        stuck = sorted(
+            (r for r in h.inflight.values() if not r.terminal),
+            key=lambda r: r.rid,
+        )
+        for req in reversed(stuck):
+            h.inflight.pop(req.trace, None)
+            req.replica = None
+            self.metrics.counter("reroutes_total").inc()
+            self.journal.emit(
+                "request_reroute",
+                trace=req.trace,
+                rid=req.rid,
+                from_replica=h.name,
+                attempt=req.attempts,
+                reason="breaker_open",
+            )
+            self._requeue_front(req)
+
+    def _breaker_success(self, h: ReplicaHandle) -> None:
+        h.breaker_failures = 0
+        h.breaker_probe = None
+        if h.breaker != "closed":
+            h.breaker = "closed"
+            lifecycle_event(
+                "breaker_close",
+                print_fn=self.print_fn,
+                journal=self.journal,
+                replica=h.name,
+            )
 
     def _saturated(self, h: ReplicaHandle) -> bool:
         if self.clock() < h.cooldown_until:
@@ -968,30 +1271,105 @@ class ReplicaRouter:
                 self._affinity.pop(next(iter(self._affinity)))
         return pick
 
+    def _next_queued(self) -> tuple[int, int] | None:
+        """(priority, index) of the next dequeue candidate: weighted-fair
+        ACROSS classes (deficit round robin, weight ``priority+1`` — low
+        classes always progress, high classes get the larger share;
+        replenished classes serve highest-first), earliest-deadline-first
+        WITHIN a class (key ``(deadline-or-inf, rid)``; all-default
+        traffic degenerates to the FIFO head — see the ``_queues``
+        comment in ``__init__``)."""
+        classes = sorted(
+            (p for p, q in self._queues.items() if q), reverse=True
+        )
+        if not classes:
+            return None
+        if len(classes) == 1:
+            prio = classes[0]
+        else:
+            funded = [p for p in classes if self._drr.get(p, 0.0) >= 1.0]
+            if not funded:
+                self._drr = {
+                    p: self._drr.get(p, 0.0) + (p + 1) for p in classes
+                }
+                funded = classes
+            prio = funded[0]
+        q = self._queues[prio]
+        idx = min(
+            range(len(q)),
+            key=lambda i: (
+                math.inf if q[i].deadline is None else q[i].deadline,
+                q[i].rid,
+            ),
+        )
+        return prio, idx
+
     def _route(self, now: float) -> None:
-        while self._queue:
-            req = self._queue[0]
+        while True:
+            nxt = self._next_queued()
+            if nxt is None:
+                return
+            prio, idx = nxt
+            q = self._queues[prio]
+            req = q[idx]
             if req.terminal:
                 # Became terminal while queued (a dead replica's
                 # committed result arrived after the failover re-queue):
                 # routing it again would re-serve a DONE request.
-                self._queue.popleft()
+                del q[idx]
+                if not q:
+                    del self._queues[prio]
                 continue
             h = self._pick(req)
             if h is None:
                 return
-            self._queue.popleft()
+            # Charge DRR credit only while classes actually compete — a
+            # lone class dequeues by the fast path above and must not
+            # accumulate debt against classes that appear later.
+            contested = sum(1 for qq in self._queues.values() if qq) > 1
+            del q[idx]
+            if not q:
+                del self._queues[prio]
+            if contested:
+                self._drr[prio] = self._drr.get(prio, 0.0) - 1.0
             req.replica = h.name
             req.attempts += 1
+            req.t_routed = now
             h.inflight[req.trace] = req
+            if h.breaker == "half_open":
+                h.breaker_probe = req.trace  # the one probe in flight
             payload = {
                 "trace": req.trace,
                 "tokens": req.tokens,
                 "config": req.config,
             }
+            if req.priority:
+                payload["priority"] = req.priority
             if req.deadline is not None:
                 payload["deadline_s"] = max(req.deadline - now, 0.0)
-            h.client.submit(payload)
+            try:
+                h.client.submit(payload)
+            except OSError as exc:
+                # Transport failure counts as a breaker failure; the
+                # request goes back to its queue front uncharged. Stop
+                # routing this tick — retrying the same pick in a tight
+                # loop would spin until the breaker trips.
+                h.inflight.pop(req.trace, None)
+                req.replica = None
+                self.metrics.counter("reroutes_total").inc()
+                self.journal.emit(
+                    "request_reroute",
+                    trace=req.trace,
+                    rid=req.rid,
+                    from_replica=h.name,
+                    attempt=req.attempts,
+                    reason="submit_error",
+                )
+                self._requeue_front(req)
+                self._breaker_failure(
+                    h, now, reason=f"submit {type(exc).__name__}"
+                )
+                return
             self.metrics.counter("routed_total").inc()
             self.journal.emit(
                 "request_route",
@@ -1174,6 +1552,7 @@ def run_replica(args) -> int:
         GenerationConfig,
         QueueFull,
         RequestCancelled,
+        RequestShed,
         TextServer,
     )
 
@@ -1192,7 +1571,7 @@ def run_replica(args) -> int:
         buckets=buckets,
         queue_limit=args.queue_limit or None,
     )
-    box = MailboxClient(args.dir)
+    box = MailboxClient(args.dir, metrics=srv.metrics)
     # A fresh incarnation serves only newly routed work: anything in the
     # inbox predates this process and already failed over elsewhere.
     box.clear_inbox()
@@ -1210,7 +1589,15 @@ def run_replica(args) -> int:
                 [_np.arange(1, b + 1, dtype=_np.int32)],
                 GenerationConfig(max_new=2),
             )
-    exporter = MetricsExporter(srv.metrics, port=args.port, health_fn=srv.health)
+    def _health():
+        # Round-21 satellite: mailbox corruption is a health-visible
+        # signal, not a "silent replica by design" (known_issues.md) —
+        # router verdicts and dashboards see the quarantine count.
+        doc = srv.health()
+        doc["mailbox_corrupt_files"] = box.corrupt_files
+        return doc
+
+    exporter = MetricsExporter(srv.metrics, port=args.port, health_fn=_health)
     write_json_atomic(port_file(args.dir), {"port": exporter.start()})
 
     stop: list[int] = []
@@ -1225,6 +1612,8 @@ def run_replica(args) -> int:
                     box.put_result(
                         {"trace": trace, "tokens": [int(t) for t in toks]}
                     )
+                except RequestShed:
+                    box.put_result({"trace": trace, "shed": True})
                 except RequestCancelled:
                     box.put_result({"trace": trace, "cancelled": True})
 
@@ -1264,6 +1653,7 @@ def run_replica(args) -> int:
                             payload["tokens"],
                             GenerationConfig(**(payload.get("config") or {})),
                             deadline_s=payload.get("deadline_s"),
+                            priority=int(payload.get("priority", 0)),
                             trace=payload.get("trace"),
                         )
                     except (

@@ -57,6 +57,17 @@ class RequestCancelled(RuntimeError):
     contract for both surfaces (re-exported from serve.py)."""
 
 
+class RequestShed(RuntimeError):
+    """``result()`` for a request the scheduler dropped WITHOUT spending a
+    dispatch on it: it arrived past its deadline, its deadline expired (or
+    became provably unreachable) while queued, or it was the
+    lowest-priority victim of saturation shedding. Distinct from
+    :class:`RequestCancelled` — a cancel interrupts work already started
+    (a resident past its deadline); a shed refuses work before any
+    prefill. Raised by TextServer.result AND ReplicaRouter.result
+    (re-exported from serve.py)."""
+
+
 def blocks_for(tokens: int, block_size: int) -> int:
     """Blocks needed to hold ``tokens`` positions."""
     if tokens < 0:

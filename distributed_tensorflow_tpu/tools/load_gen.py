@@ -1,0 +1,428 @@
+"""Scenario-diverse deterministic load generator for the serving stack.
+
+Round 21 (overload robustness): the deadline/priority scheduler in
+serve.py and the per-class weighted-fair router in serve_fleet.py make
+claims that only show under SHAPED load — a steady trickle never trips
+saturation shedding, a uniform workload never exercises weighted
+fairness, and an all-greedy mix never touches the sampled key chain
+under displacement. This module generates that load: six named
+scenarios, each a pure function of ``(seed, n, vocab, rate)`` (stdlib
+``random.Random`` only — bit-reproducible across hosts, no numpy global
+state), drivable against a live :class:`~..serve.TextServer`, a
+:class:`~..serve_fleet.ReplicaRouter`, or the FakeClock test harness,
+and summarized per priority class from round-12 journal events alone.
+
+Scenarios::
+
+    steady        Poisson arrivals at ``rate`` rps, mid prompts/decodes
+    bursty        ON/OFF square wave: 4x rate bursts, silent gaps
+    long_prefill  prompt-heavy (near-bucket prompts, short decodes)
+    chat          decode-heavy (short prompts, long generations)
+    mixed_sampling half greedy / half nucleus-sampled (per-request seed)
+    priority_mix  3 classes: interactive p2 + tight deadline, standard
+                  p1 + loose deadline, batch p0 + no deadline
+
+The summary's TTFT is **submit -> first service** (TextServer
+``admission`` / router ``request_route``) — the scheduler observable
+both targets share and the one the round-21 scheduler reorders; latency
+is submit -> terminal. Shed rate is per class, the round-21 loudness
+contract made measurable (``shed_rate_{class}`` fails HIGH under the
+regression gate).
+
+jax-free at import (the serve_fleet convention): scenario generation and
+journal summarization run anywhere; only :func:`drive` against a real
+TextServer touches jax, inside the call.
+
+Usage::
+
+    python -m distributed_tensorflow_tpu.tools.load_gen --scenario bursty
+    python -m distributed_tensorflow_tpu.tools.load_gen --list
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import time
+
+
+class LoadRequest:
+    """One generated request: arrival offset + everything submit needs."""
+
+    __slots__ = (
+        "at_s", "tokens", "max_new", "priority", "deadline_s", "greedy",
+        "temperature", "top_p", "seed",
+    )
+
+    def __init__(
+        self,
+        at_s: float,
+        tokens: list[int],
+        max_new: int,
+        *,
+        priority: int = 0,
+        deadline_s: float | None = None,
+        greedy: bool = True,
+        temperature: float = 1.0,
+        top_p: float = 1.0,
+        seed: int = 0,
+    ):
+        self.at_s = float(at_s)
+        self.tokens = list(tokens)
+        self.max_new = int(max_new)
+        self.priority = int(priority)
+        self.deadline_s = deadline_s
+        self.greedy = bool(greedy)
+        self.temperature = float(temperature)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
+
+    def to_dict(self) -> dict:
+        d = {
+            "at_s": round(self.at_s, 6),
+            "prompt_len": len(self.tokens),
+            "max_new": self.max_new,
+            "priority": self.priority,
+            "greedy": self.greedy,
+        }
+        if self.deadline_s is not None:
+            d["deadline_s"] = self.deadline_s
+        return d
+
+
+def _prompt(rng: random.Random, vocab: int, lo: int, hi: int) -> list[int]:
+    n = rng.randint(lo, hi)
+    return [rng.randrange(vocab) for _ in range(n)]
+
+
+def _poisson_arrivals(rng: random.Random, n: int, rate: float):
+    """Cumulative exponential gaps — the memoryless arrival process."""
+    t, out = 0.0, []
+    for _ in range(n):
+        t += rng.expovariate(rate)
+        out.append(t)
+    return out
+
+
+def _steady(rng, n, vocab, rate):
+    return [
+        LoadRequest(t, _prompt(rng, vocab, 8, 48), rng.randint(16, 48))
+        for t in _poisson_arrivals(rng, n, rate)
+    ]
+
+
+def _bursty(rng, n, vocab, rate):
+    """ON/OFF square wave: bursts at 4x the nominal rate separated by
+    silent gaps of equal expected mass — the overload-then-idle shape
+    that exercises saturation shedding and queue drain."""
+    out, t = [], 0.0
+    while len(out) < n:
+        burst = min(rng.randint(4, 8), n - len(out))
+        for _ in range(burst):
+            t += rng.expovariate(4.0 * rate)
+            out.append(
+                LoadRequest(
+                    t, _prompt(rng, vocab, 8, 48), rng.randint(16, 48)
+                )
+            )
+        t += burst / rate  # the OFF gap carries the deferred mass
+    return out
+
+
+def _long_prefill(rng, n, vocab, rate):
+    return [
+        LoadRequest(t, _prompt(rng, vocab, 40, 60), rng.randint(4, 12))
+        for t in _poisson_arrivals(rng, n, rate)
+    ]
+
+
+def _chat(rng, n, vocab, rate):
+    return [
+        LoadRequest(t, _prompt(rng, vocab, 4, 16), rng.randint(48, 96))
+        for t in _poisson_arrivals(rng, n, rate)
+    ]
+
+
+def _mixed_sampling(rng, n, vocab, rate):
+    out = []
+    for i, t in enumerate(_poisson_arrivals(rng, n, rate)):
+        sampled = rng.random() < 0.5
+        out.append(
+            LoadRequest(
+                t,
+                _prompt(rng, vocab, 8, 32),
+                rng.randint(16, 48),
+                greedy=not sampled,
+                temperature=0.8 if sampled else 1.0,
+                top_p=0.95 if sampled else 1.0,
+                seed=rng.randrange(1 << 30) if sampled else 0,
+            )
+        )
+    return out
+
+
+def _priority_mix(rng, n, vocab, rate):
+    """Three service classes: interactive (p2, tight deadline), standard
+    (p1, loose deadline), batch (p0, none). Under ≥2x-capacity overload
+    the round-21 contract is: every shed lands on the batch class, every
+    deadline-capable interactive request completes."""
+    out = []
+    for t in _poisson_arrivals(rng, n, rate):
+        u = rng.random()
+        if u < 0.3:
+            out.append(
+                LoadRequest(
+                    t, _prompt(rng, vocab, 4, 16), rng.randint(8, 16),
+                    priority=2, deadline_s=30.0,
+                )
+            )
+        elif u < 0.6:
+            out.append(
+                LoadRequest(
+                    t, _prompt(rng, vocab, 8, 32), rng.randint(16, 32),
+                    priority=1, deadline_s=120.0,
+                )
+            )
+        else:
+            out.append(
+                LoadRequest(
+                    t, _prompt(rng, vocab, 8, 48), rng.randint(24, 48),
+                )
+            )
+    return out
+
+
+SCENARIOS = {
+    "steady": _steady,
+    "bursty": _bursty,
+    "long_prefill": _long_prefill,
+    "chat": _chat,
+    "mixed_sampling": _mixed_sampling,
+    "priority_mix": _priority_mix,
+}
+
+
+def generate(
+    scenario: str,
+    *,
+    seed: int = 0,
+    n: int = 32,
+    vocab: int = 512,
+    rate: float = 50.0,
+) -> list[LoadRequest]:
+    """The scenario's request list — deterministic in every argument.
+    ``rate`` is nominal requests/second of SIMULATED arrival time; the
+    driver compresses or stretches it against the target's real clock."""
+    try:
+        fn = SCENARIOS[scenario]
+    except KeyError:
+        raise ValueError(
+            f"unknown scenario {scenario!r}; one of {sorted(SCENARIOS)}"
+        ) from None
+    # A str seed routes through random's deterministic sha512 path; a
+    # tuple would go through hash(), which PYTHONHASHSEED randomizes.
+    rng = random.Random(f"{seed}:{scenario}")
+    reqs = fn(rng, n, vocab, rate)
+    assert len(reqs) == n
+    return reqs
+
+
+# -- driving a live target -------------------------------------------------
+
+
+def _submit(target, req: LoadRequest):
+    """Adapter over the two servable targets. The router takes a plain
+    config dict (it travels the mailbox); TextServer takes the real
+    GenerationConfig. Both share the round-21 submit keywords."""
+    if hasattr(target, "replicas"):  # ReplicaRouter
+        cfg = {"max_new": req.max_new, "greedy": req.greedy}
+        if not req.greedy:
+            cfg.update(
+                temperature=req.temperature, top_p=req.top_p, seed=req.seed
+            )
+        return target.submit(
+            req.tokens, cfg, deadline_s=req.deadline_s,
+            priority=req.priority,
+        )
+    from distributed_tensorflow_tpu.serve import GenerationConfig
+
+    cfg = GenerationConfig(
+        max_new=req.max_new, greedy=req.greedy,
+        temperature=req.temperature, top_p=req.top_p, seed=req.seed,
+    )
+    return target.submit(
+        req.tokens, cfg, deadline_s=req.deadline_s, priority=req.priority
+    )
+
+
+def drive(
+    target,
+    requests: list[LoadRequest],
+    *,
+    clock=None,
+    sleep=None,
+    timeout_s: float = 300.0,
+) -> dict:
+    """Replay the scenario against a live TextServer or ReplicaRouter:
+    submit each request when its arrival offset elapses (by ``clock`` —
+    inject the FakeClock pair for simulated-time tests), stepping the
+    target in between, until every submitted request is terminal.
+    Returns ``{"rids": [...], "wall_s": ...}``; per-request outcomes are
+    read from the journal (:func:`summarize`), not collected here — the
+    journal is the operator's own path and the one the summary claims
+    hold on."""
+    clock = clock or time.perf_counter
+    sleep = sleep or time.sleep
+    pending = sorted(requests, key=lambda r: r.at_s)
+    rids: list = []
+    rejected = 0
+    t0 = clock()
+    deadline = t0 + timeout_s
+    i = 0
+    while True:
+        now = clock() - t0
+        while i < len(pending) and pending[i].at_s <= now:
+            try:
+                rids.append(_submit(target, pending[i]))
+            except Exception as exc:
+                # QueueFull is the server's loud backpressure — a load
+                # generator absorbs it (a real client would retry);
+                # matched by name so the module stays jax-free.
+                if type(exc).__name__ != "QueueFull":
+                    raise
+                rejected += 1
+            i += 1
+        busy = target.step()
+        done = i >= len(pending) and all(target.done(r) for r in rids)
+        if done:
+            break
+        if clock() > deadline:
+            break
+        if not busy:
+            if i < len(pending):
+                sleep(max(min(pending[i].at_s - (clock() - t0), 0.05), 0.0))
+            else:
+                sleep(0.001)
+    return {"rids": rids, "rejected": rejected, "wall_s": clock() - t0}
+
+
+# -- per-class summary from journal events ---------------------------------
+
+_FIRST_SERVICE = ("admission", "request_route")
+
+
+def summarize(events: list[dict]) -> dict:
+    """Per-priority-class outcome metrics from round-12 journal events —
+    works on a TextServer journal (``admission``/``completion``/
+    ``request_shed``) and a router journal (``request_route``/
+    ``fleet_result``/``request_shed``) alike. Returns::
+
+        {"classes": {prio: {requests, done, shed, cancelled, failed,
+                            shed_rate, ttft_s: {p50, p95},
+                            latency_s: {p50, p95}}},
+         "requests": N, "shed_rate": overall}
+    """
+    sub: dict = {}
+    first: dict = {}
+    term: dict = {}
+    for ev in events:
+        kind, rid = ev.get("kind"), ev.get("rid")
+        if rid is None:
+            continue
+        if kind == "request_submit":
+            sub[rid] = (ev.get("ts"), int(ev.get("priority", 0)))
+        elif kind in _FIRST_SERVICE:
+            first.setdefault(rid, ev.get("ts"))
+        elif kind == "completion":
+            term[rid] = ("done", ev.get("ts"))
+        elif kind == "fleet_result":
+            status = ev.get("status", "done")
+            term[rid] = (
+                "done" if status == "done" else status, ev.get("ts")
+            )
+        elif kind == "request_shed":
+            term[rid] = ("shed", ev.get("ts"))
+        elif kind == "request_cancelled":
+            term[rid] = ("cancelled", ev.get("ts"))
+    classes: dict = {}
+    for rid, (ts0, prio) in sub.items():
+        c = classes.setdefault(
+            prio,
+            {
+                "requests": 0, "done": 0, "shed": 0, "cancelled": 0,
+                "failed": 0, "_ttft": [], "_lat": [],
+            },
+        )
+        c["requests"] += 1
+        status, ts1 = term.get(rid, (None, None))
+        if status == "done":
+            c["done"] += 1
+            if ts1 is not None and ts0 is not None:
+                c["_lat"].append(ts1 - ts0)
+            if rid in first and first[rid] is not None and ts0 is not None:
+                c["_ttft"].append(first[rid] - ts0)
+        elif status == "shed":
+            c["shed"] += 1
+        elif status == "cancelled":
+            c["cancelled"] += 1
+        elif status in ("rejected", "failed"):
+            c["failed"] += 1
+
+    def pct(vals, q):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return round(vals[min(int(q * len(vals)), len(vals) - 1)], 6)
+
+    out: dict = {}
+    for prio, c in sorted(classes.items()):
+        out[prio] = {
+            "requests": c["requests"],
+            "done": c["done"],
+            "shed": c["shed"],
+            "cancelled": c["cancelled"],
+            "failed": c["failed"],
+            "shed_rate": round(c["shed"] / max(c["requests"], 1), 4),
+            "ttft_s": {"p50": pct(c["_ttft"], 0.5),
+                       "p95": pct(c["_ttft"], 0.95)},
+            "latency_s": {"p50": pct(c["_lat"], 0.5),
+                          "p95": pct(c["_lat"], 0.95)},
+        }
+    total = sum(c["requests"] for c in out.values())
+    shed = sum(c["shed"] for c in out.values())
+    return {
+        "classes": out,
+        "requests": total,
+        "shed_rate": round(shed / max(total, 1), 4),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", default="steady",
+                    choices=sorted(SCENARIOS))
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--n", type=int, default=32)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--rate", type=float, default=50.0)
+    ap.add_argument("--list", action="store_true",
+                    help="list scenario names and exit")
+    args = ap.parse_args(argv)
+    if args.list:
+        for name in sorted(SCENARIOS):
+            print(name)
+        return 0
+    reqs = generate(
+        args.scenario, seed=args.seed, n=args.n, vocab=args.vocab,
+        rate=args.rate,
+    )
+    for r in reqs:
+        print(json.dumps(r.to_dict()))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

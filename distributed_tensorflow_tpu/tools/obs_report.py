@@ -444,6 +444,8 @@ def reconstruct_requests(events: list[dict]) -> list[dict]:
                 "latency_s": None,
                 "tokens": None,
                 "done": False,
+                "priority": 0,
+                "shed": False,
             },
         )
 
@@ -454,6 +456,12 @@ def reconstruct_requests(events: list[dict]) -> list[dict]:
             r["trace"] = ev.get("trace")
             r["prompt_len"] = ev.get("prompt_len")
             r["max_new"] = ev.get("max_new")
+            # Round 21: absent on default-path journals (byte parity).
+            r["priority"] = int(ev.get("priority", 0))
+        elif kind == "request_shed":
+            r = rec(ev.get("rid"))
+            r["trace"] = r["trace"] or ev.get("trace")
+            r["shed"] = True
         elif kind == "admission":
             r = rec(ev.get("rid"))
             r["trace"] = r["trace"] or ev.get("trace")
@@ -516,8 +524,38 @@ def render_requests(records: list[dict]) -> str:
             f"{r['decode_ms']:>10.3f}/{r['decode_chunks']:<6} "
             f"{fmt(r['ttft_s'], '.4f'):>7}  {fmt(r['latency_s'], '.4f'):>10}  "
             f"{fmt(r['tokens'], 'd'):>6}"
-            + ("" if r["done"] else "  (in flight)")
+            + (
+                "  (shed)"
+                if r.get("shed")
+                else ("" if r["done"] else "  (in flight)")
+            )
         )
+    # Round 21 per-class rollup: rendered only when the workload used
+    # priority classes or shed anything — default journals keep the
+    # round-12 output byte-identical.
+    if any(r.get("priority") or r.get("shed") for r in records):
+        classes: dict = {}
+        for r in records:
+            c = classes.setdefault(
+                int(r.get("priority") or 0), {"n": 0, "done": 0, "shed": 0,
+                                              "ttft": []}
+            )
+            c["n"] += 1
+            c["done"] += bool(r["done"] and not r.get("shed"))
+            c["shed"] += bool(r.get("shed"))
+            if r.get("ttft_s") is not None:
+                c["ttft"].append(float(r["ttft_s"]))
+        for prio, c in sorted(classes.items(), reverse=True):
+            p95 = (
+                round(_percentile(sorted(c["ttft"]), 0.95), 4)
+                if c["ttft"]
+                else "-"
+            )
+            lines.append(
+                f"class p{prio}: {c['n']} requests, {c['done']} done, "
+                f"{c['shed']} shed (rate "
+                f"{round(c['shed'] / max(c['n'], 1), 4)}), TTFT p95 {p95}s"
+            )
     pct = request_percentiles(records)
     if pct:
         lines.append(

@@ -62,11 +62,13 @@ import sys
 # ``dispatches/token`` (round 20): the decode megakernel's structural
 # launch count — more launches per token is the regression (the whole
 # point of the tier is O(1)); fails HIGH, direction pinned alongside
-# the us variants.
+# the us variants. ``shed_rate`` (round 21): the per-class load-shed
+# fraction under the fixed overload scenario — MORE shedding at the
+# same offered load is a scheduling/capacity regression; fails HIGH.
 LOWER_IS_BETTER_UNITS = (
     "ms", "s", "ms/token", "ms/dispatch", "requests", "bytes",
     "bytes/token", "us", "µs", "us/token", "µs/token",
-    "dispatches/token",
+    "dispatches/token", "shed_rate",
 )
 
 DEFAULT_TOLERANCE = 0.5

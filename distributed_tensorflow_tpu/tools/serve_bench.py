@@ -765,6 +765,91 @@ def bench_fleet(
         shutil.rmtree(fleet_dir, ignore_errors=True)
 
 
+def bench_load_gen(
+    *,
+    n: int = 48,
+    rate: float = 150.0,
+    slots: int = 2,
+    chunk: int = 8,
+    queue_limit: int = 16,
+    seed: int = 21,
+    model_kw=None,
+) -> dict:
+    """Overload row (round 21): the ``priority_mix`` load-gen scenario
+    replayed at well over 2x capacity (``rate`` rps offered into
+    ``slots`` slots behind a ``queue_limit``-deep queue), plus a
+    ``steady`` baseline at the same shape. The measured contract —
+    acceptance criteria of the round-21 scheduler, not aspirations:
+
+    - every shed lands on the LOWEST class (batch p0), as a loud
+      terminal ``RequestShed`` (the ``request_shed`` journal event the
+      per-class summary is built from);
+    - the deadline-capable classes (interactive p2, standard p1) lose
+      NOTHING: ``hi_class_misses`` must be 0;
+    - excess p0 arrivals that find no lower class to displace get
+      round-16 ``QueueFull`` backpressure (the ``rejected`` column),
+      never a silent drop.
+
+    Per-class TTFT here is submit -> admission (the scheduler
+    observable; see load_gen.summarize). The shed-rate magnitude is
+    timing-dependent (how many arrivals catch a full queue), so the
+    gate series carries it with the default tolerance; the ZERO on the
+    hi classes is the hard claim and is also test-pinned."""
+    from distributed_tensorflow_tpu.observability.journal import (
+        EventJournal,
+        read_events,
+    )
+    from distributed_tensorflow_tpu.serve import GenerationConfig, TextServer
+    from distributed_tensorflow_tpu.tools import load_gen
+
+    import tempfile
+
+    model, params = _build(model_kw)
+    scenarios = {}
+    for scenario, q in (("priority_mix", queue_limit), ("steady", None)):
+        path = os.path.join(tempfile.mkdtemp(), "events.jsonl")
+        journal = EventJournal(path, run_id="load_gen")
+        srv = TextServer(
+            model, params, slots=slots, chunk=chunk, buckets=(64,),
+            queue_limit=q, journal=journal,
+        )
+        warm = [np.arange(1, 9, dtype=np.int32)] * min(2, slots)
+        srv.generate(warm, GenerationConfig(max_new=4))
+        reqs = load_gen.generate(
+            scenario, seed=seed, n=n, vocab=model.vocab_size, rate=rate
+        )
+        out = load_gen.drive(srv, reqs, timeout_s=600.0)
+        journal.close()
+        workload = [e for e in read_events(path) if e.get("rid", -1) >= 2]
+        summary = load_gen.summarize(workload)
+        hi_miss = sum(
+            c["requests"] - c["done"]
+            for p, c in summary["classes"].items()
+            if p > 0
+        )
+        lo_sheds = sum(
+            c["shed"] for p, c in summary["classes"].items() if p == 0
+        )
+        all_sheds = sum(c["shed"] for c in summary["classes"].values())
+        scenarios[scenario] = {
+            "n": n,
+            "rate_rps": rate,
+            "queue_limit": q,
+            "wall_s": round(out["wall_s"], 4),
+            "rejected": out["rejected"],
+            "hi_class_misses": int(hi_miss),
+            "sheds_on_lowest_class_only": bool(lo_sheds == all_sheds),
+            **summary,
+        }
+    return {
+        "device": jax.devices()[0].device_kind,
+        "slots": slots,
+        "chunk": chunk,
+        "seed": seed,
+        "scenarios": scenarios,
+    }
+
+
 def bench_request_percentiles(
     model,
     params,
@@ -1153,6 +1238,47 @@ def emit_fleet_events(payload: dict, events_path: str) -> list[dict]:
         j.close()
 
 
+def emit_load_gen_events(payload: dict, events_path: str) -> list[dict]:
+    """The overload row's gate-covered per-class series (round 21):
+    ``fleet_ttft_p95_p{k}_s`` (unit ``s``, fails HIGH — a scheduler
+    regression shows up as interactive-tail inflation under the same
+    load) and ``shed_rate_p{k}`` (unit ``shed_rate``, fails HIGH — more
+    shedding at the same offered load is a capacity or scheduling
+    regression; the regression_gate unit table lists it
+    lower-is-better). Only the overload (priority_mix) scenario feeds
+    the gate; the steady baseline is provenance in the md."""
+    from distributed_tensorflow_tpu.observability.journal import EventJournal
+
+    lg = payload["load_gen"]
+    sc = lg["scenarios"]["priority_mix"]
+    j = EventJournal(events_path, run_id="serve_bench")
+    try:
+        common = dict(
+            tool="serve_bench", device=lg["device"],
+            scenario="priority_mix", seed=lg["seed"],
+        )
+        out = []
+        for prio, c in sorted(sc["classes"].items()):
+            p95 = (c.get("ttft_s") or {}).get("p95")
+            if p95 is not None:
+                out.append(
+                    j.emit(
+                        "bench_point", name=f"fleet_ttft_p95_p{prio}_s",
+                        value=p95, unit="s", priority=int(prio), **common,
+                    )
+                )
+            out.append(
+                j.emit(
+                    "bench_point", name=f"shed_rate_p{prio}",
+                    value=c["shed_rate"], unit="shed_rate",
+                    priority=int(prio), **common,
+                )
+            )
+        return out
+    finally:
+        j.close()
+
+
 # -- rendering (offline: the staleness guard re-renders committed JSON) ----
 
 
@@ -1392,6 +1518,61 @@ def render(payload: dict) -> str:
             "of the bench host: this row is a routing/failover property, "
             "not a model-speed claim.",
         ]
+    lg = payload.get("load_gen")
+    if lg:
+        dev = lg.get("device", "?")
+        lines += [
+            "",
+            "## Overload robustness (load_gen scenarios, round 21)",
+            "",
+            f"slots={lg['slots']}, chunk={lg['chunk']}, seed={lg['seed']}"
+            f", measured on {dev}. TTFT = submit → admission (the "
+            "scheduler observable).",
+        ]
+        for scenario, sc in sorted(lg["scenarios"].items()):
+            lines += [
+                "",
+                f"### `{scenario}` — {sc['n']} requests at "
+                f"{sc['rate_rps']} rps offered"
+                + (
+                    f", queue_limit={sc['queue_limit']}"
+                    if sc.get("queue_limit")
+                    else ""
+                ),
+                "",
+                "| class | requests | done | shed | shed rate "
+                "| TTFT p50/p95 (s) | latency p50/p95 (s) |",
+                "|---|---|---|---|---|---|---|",
+            ]
+            for prio, c in sorted(
+                sc["classes"].items(), key=lambda kv: int(kv[0])
+            ):
+                t, l = c.get("ttft_s") or {}, c.get("latency_s") or {}
+                lines.append(
+                    f"| p{prio} | {c['requests']} | {c['done']} "
+                    f"| {c['shed']} | {c['shed_rate']} "
+                    f"| {t.get('p50')}/{t.get('p95')} "
+                    f"| {l.get('p50')}/{l.get('p95')} |"
+                )
+            lines += [
+                "",
+                f"wall {sc['wall_s']} s; {sc['rejected']} QueueFull "
+                "rejections (round-16 backpressure on same-or-lower-"
+                "class arrivals); **hi-class misses: "
+                f"{sc['hi_class_misses']}** (must be 0); sheds on "
+                "lowest class only: "
+                f"**{sc['sheds_on_lowest_class_only']}**.",
+            ]
+        lines += [
+            "",
+            "Under ≥2x-capacity overload the deadline/priority scheduler "
+            "(serve.py round 21) sheds ONLY the batch class — loudly, as "
+            "terminal `RequestShed` with a `request_shed` journal event — "
+            "while every deadline-capable interactive/standard request "
+            "completes. The per-class `fleet_ttft_p95_p{k}_s` and "
+            "`shed_rate_p{k}` series feed the regression gate (both fail "
+            "HIGH).",
+        ]
     pc = payload.get("request_percentiles")
     if pc:
         lines += [
@@ -1506,6 +1687,14 @@ def main(argv=None) -> int:
         "chip and no full rerun",
     )
     ap.add_argument(
+        "--load-gen",
+        action="store_true",
+        help="run ONLY the overload load-generator scenarios "
+        "(tools/load_gen.py against an in-process TextServer) and merge "
+        "the section into the committed serving.json (the --fleet merge "
+        "pattern) — per-class TTFT/shed-rate series feed the gate",
+    )
+    ap.add_argument(
         "--decode-engine",
         action="store_true",
         help="run ONLY the fused-vs-XLA decode engine A/B and merge its "
@@ -1561,6 +1750,21 @@ def main(argv=None) -> int:
             n = len(emit_decode_events(payload, events_path))
             print(f"appended {n} bench_point events to {events_path}")
         return 0
+    if args.load_gen:
+        lg = bench_load_gen()
+        with open(os.path.join(_docs_root(), "serving.json")) as f:
+            payload = json.load(f)
+        payload["load_gen"] = lg
+        print(json.dumps(lg))
+        if args.write_docs:
+            write_docs(payload)
+            print(f"wrote {_docs_root()}/serving.md and serving.json")
+        else:
+            print(render(payload))
+        if events_path:
+            n = len(emit_load_gen_events(payload, events_path))
+            print(f"appended {n} bench_point events to {events_path}")
+        return 0
     if args.fleet:
         fleet = bench_fleet()
         with open(os.path.join(_docs_root(), "serving.json")) as f:
@@ -1589,7 +1793,7 @@ def main(argv=None) -> int:
     try:
         with open(os.path.join(_docs_root(), "serving.json")) as f:
             old = json.load(f)
-        for key in ("fleet", "decode_engine"):
+        for key in ("fleet", "decode_engine", "load_gen"):
             if key in old:
                 payload.setdefault(key, old[key])
     except (OSError, ValueError):

@@ -203,9 +203,12 @@ class LMTrainer:
                     f"config.stale_limit={self.config.stale_limit}"
                 )
             # The exchange's mailbox_corrupt events (round 19) ride this
-            # trainer's journal unless the caller wired its own.
+            # trainer's journal unless the caller wired its own; same for
+            # the corruption counter (round 21 — exporter-visible).
             if getattr(delta_exchange, "journal", None) is None:
                 delta_exchange.journal = self.journal
+            if getattr(delta_exchange, "metrics", None) is None:
+                delta_exchange.metrics = self.metrics
         self.mode = self._resolve_mode()
 
         self.state = self._init_state(model.init(seed=self.config.seed))

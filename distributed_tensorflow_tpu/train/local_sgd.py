@@ -882,6 +882,7 @@ class DeltaExchange:
         stale_limit: int = 0,
         delta_dtype: str | None = None,
         journal=None,
+        metrics=None,
         orphan_age_s: float = 60.0,
     ):
         import os
@@ -905,6 +906,7 @@ class DeltaExchange:
         self.stale_limit = int(stale_limit)
         self.delta_dtype = delta_dtype
         self.journal = journal  # LMTrainer wires its own; None → process
+        self.metrics = metrics  # round 21: counters beside the journal
         self.orphan_age_s = float(orphan_age_s)
         self.corrupt_posts = 0  # committed-but-corrupt peer posts skipped
         # Per-peer consumed-round watermark: each posted delta is
@@ -915,6 +917,8 @@ class DeltaExchange:
 
     def _emit_corrupt(self, *, file: str, reason: str, peer: int, round_idx: int):
         self.corrupt_posts += 1
+        if self.metrics is not None:
+            self.metrics.counter("mailbox_corrupt_posts_total").inc()
         j = self.journal
         if j is None:
             from distributed_tensorflow_tpu.observability import (

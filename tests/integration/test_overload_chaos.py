@@ -1,0 +1,275 @@
+"""Overload chaos schedule (RUN_SLOW, round 21): every robustness seam
+this repo has, fired TOGETHER against one live fleet — a ≥2x-capacity
+priority_mix workload from the round-21 load generator, a replica
+SIGKILLed mid-decode, the storage layer tearing committed mailbox
+results (round-19 failpoint ``fleet.result:torn``), and a dead-on-arrival
+request — while the round-21 contracts hold simultaneously:
+
+- zero hi-class (p1/p2) deadline misses: every deadline-capable request
+  completes token-identically to in-process decode,
+- every miss is a LOUD terminal :class:`RequestShed` on the lowest
+  class (here: the dead-on-arrival request; batch p0 traffic completes),
+- the circuit breaker isolates a FROZEN (SIGSTOP — alive but silent)
+  replica at route-timeout speed while the health layer never reaches a
+  verdict at all, and charges the restart budget nothing (a SIGKILLed
+  process is the health layer's case: the ``rc=`` supervision verdict
+  catches it near-instantly by design),
+- torn committed results are quarantined + counted (``mailbox_corrupt``
+  events, ``mailbox_corrupt_files_total`` counter) and the affected
+  requests re-serve via route-timeout failover — zero lost requests.
+
+The chaos twin of test_serve_fleet_failover.py: that file proves each
+fault in isolation; this one proves the faults COMPOSE — the paper's
+async thesis (workers fail independently, service continues) at its
+round-21 strongest (reference tfdist_between.py:83 re-attach semantics).
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("RUN_SLOW"),
+    reason="overload chaos schedule (set RUN_SLOW=1)",
+)
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+_VOCAB = 97
+
+_MODEL_KW = dict(
+    vocab_size=_VOCAB,
+    max_len=128,
+    model_dim=32,
+    num_heads=4,
+    num_layers=2,
+    compute_dtype="float32",  # bitwise-stable across processes
+)
+
+
+def _fleet_env():
+    return {
+        "PALLAS_AXON_POOL_IPS": "",  # subprocesses skip the axon plugin
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PYTHONPATH": os.environ.get("PYTHONPATH", "")
+        + os.pathsep
+        + _REPO,
+        # Round-19 chaos arm: each replica's 5th committed result is torn
+        # by "the storage layer" AFTER the atomic replace — exactly the
+        # corruption the CRC quarantine + route-timeout failover must
+        # absorb. Per-process hit counters: every surviving replica that
+        # serves >= 5 requests fires it once.
+        "DTF_FAILPOINTS": "fleet.result:torn@5",
+    }
+
+
+def _model_and_params(seed):
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models.gpt import GPTLM
+
+    kw = dict(_MODEL_KW)
+    kw["compute_dtype"] = jnp.float32
+    model = GPTLM(**kw)
+    return model, model.init(seed)
+
+
+def _reference_stream(model, params, prompt, max_new):
+    import jax.numpy as jnp
+
+    prompt = np.asarray(prompt, np.int32)
+    ref = model.greedy_decode(params, jnp.asarray(prompt[None]), max_new)
+    return np.asarray(ref)[0, prompt.size:]
+
+
+def test_overload_chaos_schedule(tmp_path):
+    from distributed_tensorflow_tpu import serve_fleet
+    from distributed_tensorflow_tpu.observability import aggregate
+    from distributed_tensorflow_tpu.serve_pool import RequestShed
+    from distributed_tensorflow_tpu.tools import load_gen
+
+    model, params = _model_and_params(seed=6)
+    ckpt = str(tmp_path / "ckpt")
+    serve_fleet.publish_checkpoint(model, params, ckpt, step=1)
+
+    fleet_dir = str(tmp_path / "fleet")
+    router = serve_fleet.local_fleet(
+        _MODEL_KW,
+        ckpt,
+        fleet_dir,
+        replicas=3,
+        slots=2,
+        chunk=4,
+        queue_limit=64,
+        buckets=(64,),
+        env=_fleet_env(),
+        min_replicas=1,
+        max_restarts=2,
+        backoff=0.5,
+        jitter=0.25,
+        probe_interval_s=0.25,
+        poll_interval=0.02,
+        # Breaker-vs-health timing: a FROZEN replica (alive, silent) is
+        # the case the breaker exists for — route timeouts trip it at
+        # ~route_timeout_s while the health verdict needs dead_after_s
+        # of failed probes (a SIGKILLed process, by contrast, is caught
+        # by the rc= supervision verdict near-instantly BY DESIGN — the
+        # breaker cannot and need not beat that).
+        route_timeout_s=6.0,
+        breaker_failures=1,
+        breaker_reset_s=2.0,
+        dead_after_s=20.0,
+        print_fn=lambda *a: None,
+    )
+    # The round-21 generator IS the workload: burst-rate priority_mix
+    # (arrivals compress into ~a quarter second -> instant >=2x
+    # overload of the 6-slot fleet). Decode budgets are stretched so a
+    # request genuinely LIVES in a slot for a while — a SIGKILL must
+    # land mid-decode with uncommitted results (tiny-model requests
+    # otherwise finish in milliseconds and the kill catches only
+    # already-committed work, which the mailbox delivers posthumously).
+    reqs = load_gen.generate("priority_mix", seed=11, n=24, vocab=_VOCAB,
+                             rate=100.0)
+    for r in reqs:
+        r.max_new = min(64, _MODEL_KW["max_len"] - len(r.tokens) - 1)
+    try:
+        router.wait_until_up()
+        rids = [load_gen._submit(router, r) for r in reqs]
+        # Dead-on-arrival satellite: shed at submit, loudly, before any
+        # queue space or route is spent — the one legitimate "miss" in
+        # the schedule, and it lands on the lowest class.
+        doa = router.submit(
+            [1, 2, 3, 4], {"max_new": 8}, deadline_s=0.0
+        )
+        assert router.done(doa)
+
+        # Chaos choreography, all inside one drive loop:
+        #   1. freeze (SIGSTOP) the busiest replica — alive but silent;
+        #   2. wait for its breaker to OPEN (route-timeout detection,
+        #      long before any health verdict) — then SIGCONT it;
+        #   3. SIGKILL a different replica holding in-flight work.
+        frozen = killed = None
+        frozen_open_at = None
+        deadline = time.time() + 600
+        while router.step():
+            now = time.time()
+            if frozen is None and router.stats()["done"] >= 2:
+                victim = max(
+                    router.replicas.values(), key=lambda h: len(h.inflight)
+                )
+                if len(victim.inflight) >= 2 and victim.agent.handle is not None:
+                    os.kill(victim.agent.handle.pid, signal.SIGSTOP)
+                    frozen = victim.name
+            elif frozen is not None and frozen_open_at is None:
+                h = router.replicas[frozen]
+                if h.breaker == "open":
+                    frozen_open_at = now
+                    os.kill(h.agent.handle.pid, signal.SIGCONT)
+            elif frozen_open_at is not None and killed is None:
+                for h in router.replicas.values():
+                    if (
+                        h.name != frozen
+                        and len(h.inflight) >= 1
+                        and h.agent.handle is not None
+                    ):
+                        os.kill(h.agent.handle.pid, signal.SIGKILL)
+                        killed = h.name
+                        break
+            assert now < deadline, f"fleet stuck: {router.stats()}"
+            time.sleep(0.02)
+        assert frozen is not None, "fleet finished before the freeze staged"
+        assert frozen_open_at is not None, "breaker never opened on the frozen replica"
+        assert killed is not None, "fleet finished before the kill staged"
+
+        # The drain can finish inside the relaunch backoff window; keep
+        # supervising until the killed replica's replacement is spawned
+        # (step() supervises/relaunches even with no traffic left).
+        relaunch_deadline = time.time() + 120
+        while router.replicas[killed].state not in ("starting", "up"):
+            router.step()
+            assert time.time() < relaunch_deadline, router.stats()
+            time.sleep(0.05)
+
+        # -- zero loss, zero hi-class misses -----------------------------
+        stats = router.stats()
+        assert stats["done"] == len(reqs), stats
+        assert stats["cancelled"] == 0 and stats["failed"] == 0, stats
+        assert stats["shed"] == 1, stats  # the dead-on-arrival only
+        with pytest.raises(RequestShed):
+            router.result(doa)
+
+        # Parity through chaos: every stream — rerouted after the kill,
+        # re-served after a torn result — equals in-process decode.
+        for r, rid in zip(reqs, rids):
+            out = np.asarray(router.result(rid), np.int32)
+            ref = _reference_stream(model, params, r.tokens, r.max_new)
+            assert np.array_equal(out, ref), (r.priority, r.tokens)
+
+        # Torn committed results were quarantined and COUNTED (round-21
+        # satellite: corruption is dashboard-visible, never a silent
+        # replica — docs/known_issues.md entry closed).
+        corrupt = int(
+            router.metrics.counter("mailbox_corrupt_files_total").value
+        )
+        assert corrupt >= 1, "no torn result fired; chaos arm inert?"
+    finally:
+        router.shutdown()
+        router.journal.close()
+
+    # -- the merged journals tell the story ------------------------------
+    merged = aggregate.merge(fleet_dir)
+    events = merged["events"]
+    by_kind: dict = {}
+    for ev in events:
+        by_kind.setdefault(ev.get("kind"), []).append(ev)
+
+    # Breaker before health: the FROZEN replica's breaker_open diverted
+    # its traffic at route-timeout speed while the health layer never
+    # reached a verdict at all (no replica_dead for it, no relaunch, no
+    # restart-budget charge — after SIGCONT its own results closed the
+    # breaker and it kept serving as incarnation one). The SIGKILLed
+    # replica took the round-16 path: rc= supervision verdict, reroute,
+    # relaunch.
+    opens = [e for e in by_kind.get("breaker_open", ())
+             if e.get("replica") == frozen]
+    assert opens, (frozen, sorted(by_kind))
+    frozen_deads = [e for e in by_kind.get("replica_dead", ())
+                    if e.get("replica") == frozen]
+    assert not frozen_deads, frozen_deads
+    closes = [e for e in by_kind.get("breaker_close", ())
+              if e.get("replica") == frozen]
+    assert closes and min(e["ts"] for e in opens) < min(
+        e["ts"] for e in closes
+    )
+    deads = [e for e in by_kind.get("replica_dead", ())
+             if e.get("replica") == killed]
+    assert deads, (killed, sorted(by_kind))
+    assert by_kind.get("replica_relaunch"), "killed replica never relaunched"
+    assert by_kind.get("mailbox_corrupt"), "torn result not journaled"
+    summary = aggregate.fleet_summary(merged)
+    assert summary["worker_starts"][frozen] == 1, summary
+
+    # Per-class rollup from the ROUTER's own journal (replica journals
+    # carry replica-local rids that must not join into router traffic) —
+    # the operator's view the load generator's summarize() claims hold
+    # on: hi classes clean, the only shed is the dead-on-arrival p0.
+    from distributed_tensorflow_tpu.observability.journal import read_events
+
+    router_events = read_events(os.path.join(fleet_dir, "events.jsonl"))
+    summary = load_gen.summarize(router_events)
+    classes = summary["classes"]
+    for prio in (1, 2):
+        assert classes[prio]["shed"] == 0, classes
+        assert classes[prio]["done"] == classes[prio]["requests"], classes
+    assert classes[0]["shed"] == 1, classes
+    (shed_ev,) = [
+        e for e in router_events if e.get("kind") == "request_shed"
+    ]
+    assert shed_ev["priority"] == 0
+    assert shed_ev["reason"] == "expired_at_submit"

@@ -1033,3 +1033,157 @@ def test_lm_phase_bench_events_feed_the_gate(tmp_path):
         f["name"] == "x/backward_selective_ms" and f["direction"] == "above"
         for f in res["failures"]
     )
+
+
+# ---------------------------------------------------------------------------
+# Round 21: shed_rate gate direction, load_gen scenarios, per-class rollup.
+# ---------------------------------------------------------------------------
+
+
+def test_gate_shed_rate_unit_fails_high():
+    # Round 21: the per-class shed fraction under the fixed overload
+    # scenario is lower-is-better — MORE shedding at the same offered
+    # load is the regression; a scheduler improvement (less shedding)
+    # must never trip the gate.
+    mk = lambda vals, unit: [  # noqa: E731
+        (i, v, unit) for i, v in enumerate(vals)
+    ]
+    assert "shed_rate" in regression_gate.LOWER_IS_BETTER_UNITS
+    res = regression_gate.check_series(
+        {("serve_bench", "shed_rate_p0"): mk(
+            [0.5, 0.6, 0.99], "shed_rate"
+        )},
+        tolerance=0.5,
+    )
+    [f] = res["failures"]
+    assert f["direction"] == "above" and f["unit"] == "shed_rate"
+    assert not regression_gate.check_series(
+        {("serve_bench", "shed_rate_p0"): mk(
+            [0.9, 0.8, 0.1], "shed_rate"
+        )},
+        tolerance=0.5,
+    )["failures"]
+
+
+def test_load_gen_scenarios_deterministic_and_shaped():
+    from distributed_tensorflow_tpu.tools import load_gen
+
+    for name in sorted(load_gen.SCENARIOS):
+        a = load_gen.generate(name, seed=7, n=24)
+        b = load_gen.generate(name, seed=7, n=24)
+        assert [r.to_dict() for r in a] == [r.to_dict() for r in b], name
+        c = load_gen.generate(name, seed=8, n=24)
+        assert [r.to_dict() for r in a] != [r.to_dict() for r in c], name
+        assert all(r.at_s >= 0 and r.tokens for r in a)
+        assert [r.at_s for r in a] == sorted(r.at_s for r in a), name
+    # Scenario shapes: the properties each one exists to exercise.
+    mix = load_gen.generate("priority_mix", seed=7, n=64)
+    assert {r.priority for r in mix} == {0, 1, 2}
+    assert all(r.deadline_s is not None for r in mix if r.priority > 0)
+    assert all(r.deadline_s is None for r in mix if r.priority == 0)
+    samp = load_gen.generate("mixed_sampling", seed=7, n=64)
+    assert any(not r.greedy for r in samp) and any(r.greedy for r in samp)
+    assert len({r.seed for r in samp if not r.greedy}) > 1
+    pre = load_gen.generate("long_prefill", seed=7, n=24)
+    chat = load_gen.generate("chat", seed=7, n=24)
+    assert min(len(r.tokens) for r in pre) > max(len(r.tokens) for r in chat)
+    assert min(r.max_new for r in chat) > max(r.max_new for r in pre)
+
+
+def test_load_gen_summarize_both_event_vocabularies():
+    """One summarize over both journal dialects: TextServer
+    (admission/completion/request_shed) and router
+    (request_route/fleet_result)."""
+    from distributed_tensorflow_tpu.tools import load_gen
+
+    server_events = [
+        {"kind": "request_submit", "ts": 0.0, "rid": 0, "priority": 2},
+        {"kind": "request_submit", "ts": 0.0, "rid": 1},
+        {"kind": "admission", "ts": 0.5, "rid": 0},
+        {"kind": "completion", "ts": 1.0, "rid": 0},
+        {"kind": "request_shed", "ts": 0.2, "rid": 1, "priority": 0,
+         "reason": "preempted"},
+    ]
+    s = load_gen.summarize(server_events)
+    assert s["classes"][2]["done"] == 1
+    assert s["classes"][2]["ttft_s"]["p50"] == 0.5
+    assert s["classes"][2]["latency_s"]["p50"] == 1.0
+    assert s["classes"][0]["shed"] == 1
+    assert s["classes"][0]["shed_rate"] == 1.0
+    assert s["shed_rate"] == 0.5
+
+    router_events = [
+        {"kind": "request_submit", "ts": 0.0, "rid": 0, "priority": 1},
+        {"kind": "request_route", "ts": 0.25, "rid": 0},
+        {"kind": "fleet_result", "ts": 2.0, "rid": 0, "status": "done"},
+        {"kind": "request_submit", "ts": 0.0, "rid": 1},
+        {"kind": "fleet_result", "ts": 0.1, "rid": 1, "status": "shed"},
+    ]
+    s = load_gen.summarize(router_events)
+    assert s["classes"][1]["done"] == 1
+    assert s["classes"][1]["ttft_s"]["p50"] == 0.25
+    assert s["classes"][0]["shed"] == 1
+
+
+def test_serve_bench_load_gen_emits_per_class_series(tmp_path):
+    from distributed_tensorflow_tpu.tools import serve_bench
+
+    payload = {
+        "load_gen": {
+            "device": "cpu", "slots": 2, "chunk": 8, "seed": 21,
+            "scenarios": {
+                "priority_mix": {
+                    "classes": {
+                        0: {"shed_rate": 0.7,
+                            "ttft_s": {"p50": 0.3, "p95": 0.35}},
+                        2: {"shed_rate": 0.0,
+                            "ttft_s": {"p50": 0.01, "p95": 0.03}},
+                    }
+                }
+            },
+        }
+    }
+    path = str(tmp_path / "events.jsonl")
+    out = serve_bench.emit_load_gen_events(payload, path)
+    by_name = {e["name"]: e for e in out}
+    assert by_name["shed_rate_p0"]["unit"] == "shed_rate"
+    assert by_name["shed_rate_p0"]["value"] == 0.7
+    assert by_name["fleet_ttft_p95_p2_s"]["unit"] == "s"
+    assert by_name["fleet_ttft_p95_p2_s"]["value"] == 0.03
+    # The series feed the gate under the (tool, name, device) key.
+    evs = obs.read_events(path)
+    assert all(e["tool"] == "serve_bench" for e in evs)
+
+
+def test_obs_report_per_class_rollup():
+    """The --requests view rolls up priority classes and shed outcomes —
+    and keeps the round-12 output byte-identical for default journals
+    (no priority field anywhere, nothing shed => no class lines)."""
+    events = [
+        {"kind": "request_submit", "ts": 0.0, "rid": 0, "trace": "t0",
+         "priority": 2, "prompt_len": 4, "max_new": 8},
+        {"kind": "admission", "ts": 0.1, "rid": 0},
+        {"kind": "completion", "ts": 0.4, "rid": 0, "ttft_s": 0.1,
+         "latency_s": 0.4, "tokens": 8},
+        {"kind": "request_submit", "ts": 0.0, "rid": 1, "trace": "t1",
+         "prompt_len": 4, "max_new": 8},
+        {"kind": "request_shed", "ts": 0.2, "rid": 1, "priority": 0,
+         "reason": "preempted"},
+    ]
+    records = obs_report.reconstruct_requests(events)
+    assert records[0]["priority"] == 2 and records[1]["shed"] is True
+    txt = obs_report.render_requests(records)
+    assert "class p2: 1 requests, 1 done, 0 shed" in txt
+    assert "class p0: 1 requests, 0 done, 1 shed (rate 1.0)" in txt
+    assert "(shed)" in txt
+
+    plain = [
+        {"kind": "request_submit", "ts": 0.0, "rid": 0, "trace": "t0",
+         "prompt_len": 4, "max_new": 8},
+        {"kind": "admission", "ts": 0.1, "rid": 0},
+        {"kind": "completion", "ts": 0.4, "rid": 0, "ttft_s": 0.1,
+         "latency_s": 0.4, "tokens": 8},
+    ]
+    assert "class p" not in obs_report.render_requests(
+        obs_report.reconstruct_requests(plain)
+    )

@@ -919,3 +919,60 @@ def test_observability_imports_and_runs_without_jax(tmp_path):
     )
     assert out.returncode == 0, out.stderr
     assert "NOJAX-OK" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# Round 21: breaker lifecycle renderers + fsync-per-append opt-in.
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_lines_byte_identical():
+    cases = {
+        "breaker_open": (
+            {"replica": "r2", "failures": 3, "reason": "2 route timeout(s)",
+             "reset_s": 5.0},
+            "Breaker: open replica=r2 failures=3 "
+            "reason[2 route timeout(s)] reset_s=5.0",
+        ),
+        "breaker_half_open": (
+            {"replica": "r2"},
+            "Breaker: half-open replica=r2 — probing one request",
+        ),
+        "breaker_close": (
+            {"replica": "r2"},
+            "Breaker: close replica=r2",
+        ),
+    }
+    for kind, (fields, expected) in cases.items():
+        ev = obs.NullJournal().emit(kind, **fields)
+        assert obs_format.render(kind, ev) == [expected], kind
+
+
+def test_journal_fsync_opt_in(tmp_path):
+    """DTF_JOURNAL_FSYNC=1 arms fsync-per-append (round 21 — closes the
+    kill-inside-append durability window for operators who want it);
+    default stays OFF and byte-identical."""
+    from distributed_tensorflow_tpu.observability.journal import (
+        EventJournal,
+        configure_from_env,
+        read_events,
+    )
+
+    p = tmp_path / "events.jsonl"
+    j = EventJournal(str(p), fsync=True)
+    j.emit("step", value=1)
+    j.emit("step", value=2)
+    j.close()
+    assert [e["value"] for e in read_events(str(p))] == [1, 2]
+    assert EventJournal(str(tmp_path / "x.jsonl")).fsync is False
+
+    try:
+        env = {"DTF_EVENTS_PATH": str(tmp_path / "armed.jsonl"),
+               "DTF_JOURNAL_FSYNC": "1"}
+        j2 = configure_from_env(environ=env, announce=False)
+        assert j2.fsync is True
+        env2 = {"DTF_EVENTS_PATH": str(tmp_path / "plain.jsonl")}
+        j3 = configure_from_env(environ=env2, announce=False)
+        assert j3.fsync is False
+    finally:
+        obs.configure()  # back to the NullJournal

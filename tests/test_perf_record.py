@@ -156,3 +156,35 @@ def test_serving_decode_engine_record():
         committed = f.read()
     assert "Fused decode-step engine A/B" in committed
     assert "Dispatches per token" in committed
+
+
+def test_serving_load_gen_record():
+    """Round 21: the overload-robustness row is part of the committed
+    serving record — serving.json carries the ``load_gen`` section with
+    the priority_mix scenario (per-class stats) and the two acceptance
+    booleans the bench asserts: zero hi-class misses under ~2x offered
+    load, and every miss landing on the lowest class as a loud shed. A
+    full serve_bench rerun dropping the --load-gen merge key fails
+    here."""
+    from distributed_tensorflow_tpu.tools import serve_bench
+
+    root = serve_bench._docs_root()
+    with open(os.path.join(root, "serving.json")) as f:
+        payload = json.load(f)
+    lg = payload.get("load_gen")
+    assert lg, (
+        "serving.json lost its load_gen section; run python -m "
+        "distributed_tensorflow_tpu.tools.serve_bench --load-gen "
+        "--write-docs"
+    )
+    mix = lg["scenarios"]["priority_mix"]
+    assert mix["hi_class_misses"] == 0
+    assert mix["sheds_on_lowest_class_only"] is True
+    classes = mix["classes"]
+    assert {int(k) for k in classes} == {0, 1, 2}
+    for stats in classes.values():
+        for key in ("requests", "done", "shed", "shed_rate", "ttft_s"):
+            assert key in stats
+    # The steady baseline rides alongside: no shedding at sub-capacity.
+    steady = lg["scenarios"]["steady"]
+    assert all(s["shed"] == 0 for s in steady["classes"].values())

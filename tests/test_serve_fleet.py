@@ -25,6 +25,7 @@ from distributed_tensorflow_tpu.serve import (
     GenerationConfig,
     QueueFull,
     RequestCancelled,
+    RequestShed,
     TextServer,
 )
 from distributed_tensorflow_tpu.serve_fleet import (
@@ -87,23 +88,53 @@ def test_queue_limit_rejects_loudly_and_journals():
 # ---------------------------------------------------------------------------
 
 
-def test_deadline_cancels_queued_request_at_chunk_boundary():
+def test_deadline_sheds_queued_request_before_prefill():
+    """Round 21: a queued request whose deadline expires before admission
+    is SHED (terminal RequestShed, no prefill spent) — distinct from the
+    resident cancel below. An epsilon deadline expires while queued."""
     m = tiny_model()
     j = _RecordingJournal()
     srv = TextServer(m, params=None, slots=1, chunk=4, buckets=(8,), journal=j)
     _FakeEngine(srv, m.vocab_size)
     pr = _prompts(m.vocab_size, [4])[0]
-    rid = srv.submit(pr, GenerationConfig(max_new=8), deadline_s=0.0)
+    rid = srv.submit(pr, GenerationConfig(max_new=8), deadline_s=1e-4)
     ok = srv.submit(pr, GenerationConfig(max_new=3))
+    time.sleep(0.002)  # the queued deadline expires before any step
     while srv.step():
         pass
     assert srv.done(rid) and srv.done(ok)
-    with pytest.raises(RequestCancelled):
+    with pytest.raises(RequestShed):
         srv.result(rid)
     assert len(srv.result(ok)) == 3  # the deadline-free request is intact
-    evs = j.kinds("request_cancelled")
-    assert len(evs) == 1 and evs[0]["resident"] is False
-    assert srv.metrics.counter("cancellations_total").value == 1
+    evs = j.kinds("request_shed")
+    assert len(evs) == 1 and evs[0]["reason"] == "expired"
+    assert srv.metrics.counter("sheds_total").value == 1
+    assert srv.metrics.counter("cancellations_total").value == 0
+
+
+def test_dead_on_arrival_request_sheds_at_submit():
+    """Round-21 satellite: deadline_s <= 0 sheds AT SUBMIT — terminal
+    immediately, never queued, never occupying queue_limit budget."""
+    m = tiny_model()
+    j = _RecordingJournal()
+    srv = TextServer(
+        m, params=None, slots=1, chunk=4, buckets=(8,), journal=j,
+        queue_limit=1,
+    )
+    _FakeEngine(srv, m.vocab_size)
+    pr = _prompts(m.vocab_size, [4])[0]
+    rid = srv.submit(pr, GenerationConfig(max_new=8), deadline_s=0.0)
+    assert srv.done(rid)  # terminal without a single step()
+    assert len(srv._queue) == 0
+    # The queue_limit slot it never took is free for a live request.
+    ok = srv.submit(pr, GenerationConfig(max_new=3))
+    while srv.step():
+        pass
+    assert len(srv.result(ok)) == 3
+    with pytest.raises(RequestShed):
+        srv.result(rid)
+    evs = j.kinds("request_shed")
+    assert len(evs) == 1 and evs[0]["reason"] == "expired_at_submit"
 
 
 def test_deadline_cancels_resident_and_frees_slot():
@@ -518,9 +549,16 @@ def test_cancelled_request_is_never_resurrected_by_failover():
     router, clock, j = make_router(1, ticks=1, max_restarts=2)
     router.start()
     router.step()
-    rid = router.submit([2, 2], {"max_new": 4}, deadline_s=0.0)
+    rid = router.submit([2, 2], {"max_new": 4}, deadline_s=60.0)
     live = router.submit([8], {"max_new": 3})
-    router.step()  # routed with deadline_s=0 -> fake cancels it
+    router.step()  # routed
+    # The replica's own scheduler cancels it (resident past deadline)
+    # and reports back — round 21 sheds dead-on-arrival at submit, so a
+    # replica-side cancel needs the replica to say so itself.
+    r0c = router.replicas["r0"].client
+    trace = router._by_rid[rid].trace
+    r0c.active.pop(trace, None)
+    r0c.ready.append({"trace": trace, "cancelled": True})
     _drive(router, clock)
     assert router.done(rid) and router._by_rid[rid].cancelled
     # Now the replica dies: nothing to reroute for the cancelled trace.
@@ -536,9 +574,10 @@ def test_cancelled_request_is_never_resurrected_by_failover():
     assert router.result(live) == _expect([8], 3)
 
 
-def test_router_cancels_overdue_queued_requests():
+def test_router_sheds_overdue_queued_requests():
     """A request the router never managed to place (whole fleet
-    saturated) still honors its deadline at the router."""
+    saturated) still honors its deadline at the router — round 21: as a
+    loud SHED (no route was ever spent on it), not a cancel."""
     router, clock, j = make_router(1, docs={0: {"queue_saturation": 1.0}})
     router.start()
     router.step()
@@ -547,8 +586,26 @@ def test_router_cancels_overdue_queued_requests():
     assert router.stats()["queued"] == 1  # held: replica saturated
     clock.sleep(6.0)
     router.step()
-    assert router.done(rid) and router._by_rid[rid].cancelled
-    assert len(j.kinds("request_cancelled")) == 1
+    assert router.done(rid) and router._by_rid[rid].shed
+    evs = j.kinds("request_shed")
+    assert len(evs) == 1 and evs[0]["reason"] == "expired"
+    assert router.metrics.counter("fleet_shed_total").value == 1
+    with pytest.raises(RequestShed):
+        router.result(rid)
+
+
+def test_router_sheds_dead_on_arrival_at_submit():
+    router, clock, j = make_router(1)
+    router.start()
+    router.step()
+    rid = router.submit([1], {"max_new": 2}, deadline_s=0.0)
+    assert router.done(rid)  # terminal before any routing tick
+    assert router.stats()["queued"] == 0
+    router.step()
+    assert not router.replicas["r0"].client.submitted  # no route spent
+    assert j.kinds("request_shed")[0]["reason"] == "expired_at_submit"
+    with pytest.raises(RequestShed):
+        router.result(rid)
 
 
 def test_restart_budget_bench_and_below_floor():
@@ -1112,3 +1169,323 @@ def test_obs_report_fleet_cli_on_real_fleet_dir(tmp_path, capsys):
     assert obs_report.main([str(tmp_path), "--fleet"]) == 0
     out = capsys.readouterr().out
     assert "1 requests: 1 done" in out
+
+
+# ---------------------------------------------------------------------------
+# Round 21: TextServer priority/EDF scheduler + saturation shedding.
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_admits_by_priority_then_deadline():
+    """Admission at chunk boundaries picks (priority class desc, EDF,
+    rid) — not FIFO — once any queued request carries a class/deadline."""
+    m = tiny_model()
+    j = _RecordingJournal()
+    srv = TextServer(m, params=None, slots=1, chunk=2, buckets=(8,), journal=j)
+    _FakeEngine(srv, m.vocab_size)
+    pr = _prompts(m.vocab_size, [4])[0]
+    cfg = GenerationConfig(max_new=2)
+    lo_late = srv.submit(pr, cfg, priority=0, deadline_s=60.0)
+    lo_soon = srv.submit(pr, cfg, priority=0, deadline_s=30.0)
+    hi = srv.submit(pr, cfg, priority=2)
+    mid = srv.submit(pr, cfg, priority=1)
+    while srv.step():
+        pass
+    order = [e["rid"] for e in j.kinds("admission")]
+    assert order == [hi, mid, lo_soon, lo_late]
+    for rid in (lo_late, lo_soon, hi, mid):
+        assert len(srv.result(rid)) == 2  # all served, nothing shed
+
+
+def test_saturation_shed_never_displaces_higher_or_equal_class():
+    """The shed-ordering property: a full queue sheds the LOWEST class's
+    most-deferrable member for a strictly-higher-class arrival; equal or
+    lower arrivals get QueueFull (round-16 behavior), never a victim."""
+    m = tiny_model()
+    j = _RecordingJournal()
+    srv = TextServer(
+        m, params=None, slots=1, chunk=2, buckets=(8,), journal=j,
+        queue_limit=2,
+    )
+    _FakeEngine(srv, m.vocab_size)
+    pr = _prompts(m.vocab_size, [4])[0]
+    cfg = GenerationConfig(max_new=2)
+    lo_keep = srv.submit(pr, cfg, priority=0, deadline_s=10.0)
+    lo_victim = srv.submit(pr, cfg, priority=0, deadline_s=99.0)
+    # Equal class: no victim, loud QueueFull, queue untouched.
+    with pytest.raises(QueueFull):
+        srv.submit(pr, cfg, priority=0)
+    assert not j.kinds("request_shed")
+    # Strictly higher class: the most-deferrable class-0 member goes.
+    hi = srv.submit(pr, cfg, priority=1)
+    evs = j.kinds("request_shed")
+    assert len(evs) == 1 and evs[0]["rid"] == lo_victim
+    assert evs[0]["reason"] == "preempted" and evs[0]["priority"] == 0
+    while srv.step():
+        pass
+    assert len(srv.result(hi)) == 2
+    assert len(srv.result(lo_keep)) == 2
+    with pytest.raises(RequestShed):
+        srv.result(lo_victim)
+
+
+def test_hopeless_queued_request_sheds_on_measured_ewma():
+    """remaining budget x measured per-token EWMA > slack => shed before
+    prefill; without a measurement the scheduler never sheds early."""
+    m = tiny_model()
+    j = _RecordingJournal()
+    srv = TextServer(m, params=None, slots=1, chunk=2, buckets=(8,), journal=j)
+    _FakeEngine(srv, m.vocab_size)
+    pr = _prompts(m.vocab_size, [4])[0]
+    busy = srv.submit(pr, GenerationConfig(max_new=4))
+    srv.step()  # occupies the only slot; also seeds a (tiny) real EWMA
+    doomed = srv.submit(pr, GenerationConfig(max_new=50), deadline_s=5.0)
+    srv._tok_ewma = 10.0  # measured: 10 s/token -> 50 tokens >> 5 s
+    srv.step()
+    assert srv.done(doomed)
+    evs = j.kinds("request_shed")
+    assert len(evs) == 1 and evs[0]["reason"] == "hopeless"
+    with pytest.raises(RequestShed):
+        srv.result(doomed)
+    while srv.step():
+        pass
+    assert len(srv.result(busy)) == 4
+
+
+def test_default_path_keeps_exact_fifo_and_event_shape():
+    """No priority/deadline anywhere => the scheduler never reorders (the
+    deque object is untouched) and request_submit events carry NO
+    priority field — the round-16 byte-parity contract."""
+    m = tiny_model()
+    j = _RecordingJournal()
+    srv = TextServer(m, params=None, slots=1, chunk=2, buckets=(8,), journal=j)
+    _FakeEngine(srv, m.vocab_size)
+    pr = _prompts(m.vocab_size, [4])[0]
+    rids = [srv.submit(pr, GenerationConfig(max_new=2)) for _ in range(3)]
+    queue_obj = srv._queue
+    before = [r.rid for r in srv._queue]
+    srv._schedule()
+    assert srv._queue is queue_obj  # skip path: not even rebuilt
+    assert [r.rid for r in srv._queue] == before
+    for ev in j.kinds("request_submit"):
+        assert "priority" not in ev
+    while srv.step():
+        pass
+    order = [e["rid"] for e in j.kinds("admission")]
+    assert order == rids  # FIFO
+    ewma = srv._tok_ewma
+    assert ewma is not None and ewma > 0  # measured, ready for round 2
+
+
+# ---------------------------------------------------------------------------
+# Round 21: router per-class weighted-fair queues + fleet-side shed.
+# ---------------------------------------------------------------------------
+
+
+def test_router_weighted_fair_dequeue_and_edf_within_class():
+    """Weighted-fair across classes (DRR, weight=priority+1: high gets
+    the bigger share but low always progresses) and EDF within a class."""
+    router, clock, j = make_router(1, docs={0: {"queue_saturation": 1.0}})
+    router.start()
+    router.step()  # r0 reads saturated: everything holds at the router
+    his = [router.submit([10 + i], {"max_new": 2}, priority=2)
+           for i in range(4)]
+    lo_late = router.submit([30], {"max_new": 2}, deadline_s=500.0)
+    lo_soon = router.submit([31], {"max_new": 2}, deadline_s=100.0)
+    lo_none = router.submit([32], {"max_new": 2})
+    router.step()
+    assert router.stats()["queued"] == 7
+    r0 = router.replicas["r0"]
+    r0.health.doc["queue_saturation"] = 0.0
+    router.step()  # probe refresh + route everything in one pass
+    routed = [p["trace"] for p in r0.client.submitted]
+    by_trace = {router._by_rid[r].trace: r for r in his + [lo_late, lo_soon,
+                                                           lo_none]}
+    order = [by_trace[t] for t in routed]
+    # DRR w=3 vs w=1: three his, one lo (EDF: lo_soon first), repeat.
+    assert order[:4] == [his[0], his[1], his[2], lo_soon]
+    assert order[4] == his[3]
+    # Remaining lo class drains EDF: deadline-free (inf) after deadlines.
+    assert order[5:] == [lo_late, lo_none]
+    _drive(router, clock)
+    for rid in his + [lo_late, lo_soon, lo_none]:
+        assert router.result(rid) is not None
+
+
+def test_router_default_submit_payload_and_events_unchanged():
+    """Default-path parity: no priority key in payloads or submit events."""
+    router, clock, j = make_router(1)
+    router.start()
+    router.submit([5, 6], {"max_new": 2})
+    router.step()
+    [payload] = router.replicas["r0"].client.submitted
+    assert "priority" not in payload and "deadline_s" not in payload
+    assert all("priority" not in e for e in j.kinds("request_submit"))
+    _drive(router, clock)
+
+
+# ---------------------------------------------------------------------------
+# Round 21: circuit breaker state machine (FakeClock, no processes).
+# ---------------------------------------------------------------------------
+
+
+def _breaker_router(**kw):
+    kw.setdefault("route_timeout_s", 1.0)
+    kw.setdefault("breaker_failures", 2)
+    kw.setdefault("breaker_reset_s", 5.0)
+    return make_router(1, ticks=1000, **kw)  # replica never answers
+
+
+def test_breaker_opens_half_opens_probes_and_closes():
+    router, clock, j = _breaker_router()
+    router.start()
+    router.step()
+    r0 = router.replicas["r0"]
+    rids = [router.submit([7 + i], {"max_new": 2}) for i in range(2)]
+    router.step()  # routed
+    assert len(r0.client.submitted) == 2 and r0.breaker == "closed"
+    # Two consecutive timeout scans trip the breaker (threshold 2).
+    clock.sleep(1.1)
+    router.step()  # timeout -> failure 1, requests requeued + rerouted
+    assert r0.breaker == "closed" and r0.breaker_failures == 1
+    clock.sleep(1.1)
+    router.step()  # failure 2 -> OPEN; routes divert (nothing to divert)
+    assert r0.breaker == "open"
+    assert not r0.routable
+    assert j.kinds("breaker_open")
+    # Health never saw anything: no verdict, no restart charged.
+    assert r0.attempts == 0 and not j.kinds("replica_dead")
+    # Requests hold at the router while open (sole replica).
+    router.step()
+    assert router.stats()["queued"] == 2
+    # Half-open after reset_s: exactly ONE probe goes out.
+    n_before = len(r0.client.submitted)
+    clock.sleep(5.1)
+    router.step()
+    assert r0.breaker == "half_open" and j.kinds("breaker_half_open")
+    assert len(r0.client.submitted) == n_before + 1
+    assert r0.breaker_probe is not None and not r0.routable
+    # Probe times out -> straight back to open.
+    clock.sleep(1.1)
+    router.step()
+    assert r0.breaker == "open"
+    assert len(j.kinds("breaker_open")) == 2
+    # Replica comes back: next probe completes and CLOSES the breaker.
+    # (Drop the stale half-served work first — a stale completion is
+    # ALSO a liveness proof and would close the breaker straight from
+    # open; here we want the half-open probe path itself.)
+    clock.sleep(5.1)
+    r0.client.active.clear()
+    r0.client.ticks = 1
+    router.step()  # half-open + probe
+    assert r0.breaker == "half_open"
+    _drive(router, clock)
+    assert r0.breaker == "closed" and j.kinds("breaker_close")
+    for i, rid in enumerate(rids):
+        assert router.result(rid) == _expect([7 + i], 2)  # zero loss
+    assert r0.attempts == 0  # the whole episode cost zero restart budget
+
+
+def test_breaker_open_diverts_inflight_to_healthy_replica():
+    """Tripping the breaker re-admits everything parked on the suspect
+    replica immediately — before any health verdict — and the healthy
+    replica serves it (zero-loss, reason=breaker_open)."""
+    router, clock, j = make_router(
+        2, route_timeout_s=1.0, breaker_failures=1, breaker_reset_s=50.0,
+    )
+    router.start()
+    router.step()
+    r0, r1 = router.replicas["r0"], router.replicas["r1"]
+    r0.client.ticks = 1000  # r0 swallows work; r1 stays fast
+    prompts = [[41], [42], [43], [44]]
+    rids = [router.submit(p, {"max_new": 3}) for p in prompts]
+    router.step()
+    assert r0.client.submitted  # least-loaded alternation used r0
+    clock.sleep(1.1)
+    router.step()  # r0 times out -> breaker opens -> all diverted
+    assert r0.breaker == "open"
+    reasons = {e["reason"] for e in j.kinds("request_reroute")}
+    assert reasons <= {"route_timeout", "breaker_open"}
+    _drive(router, clock)
+    for p, rid in zip(prompts, rids):
+        assert router.result(rid) == _expect(p, 3)
+    assert r0.attempts == 0 and not j.kinds("replica_dead")
+
+
+def test_breaker_counts_submit_transport_errors():
+    """An OSError from client.submit counts toward the breaker threshold
+    and requeues the request uncharged."""
+    router, clock, j = make_router(2, breaker_failures=1)
+    router.start()
+    router.step()
+    r0 = router.replicas["r0"]
+    orig = r0.client.submit
+
+    def boom(payload):
+        raise OSError("mailbox gone")
+
+    r0.client.submit = boom
+    rid = router.submit([9, 9, 9], {"max_new": 2})
+    router.step()
+    if r0.breaker != "open":
+        # Routing may have picked r1 first; force a route at r0.
+        r1 = router.replicas["r1"]
+        r1.health.doc["queue_saturation"] = 1.0
+        rid2 = router.submit([8, 8], {"max_new": 2})
+        router.step()
+        router.step()
+    assert r0.breaker == "open"
+    assert any(
+        e["reason"] == "submit_error" for e in j.kinds("request_reroute")
+    )
+    r0.client.submit = orig
+    router.replicas["r1"].health.doc["queue_saturation"] = 0.0
+    _drive(router, clock)
+    assert router._by_rid == {} or all(
+        r.terminal for r in router._by_rid.values()
+    )
+
+
+# ---------------------------------------------------------------------------
+# Round 21 satellites: mailbox corruption counters, journal fsync.
+# ---------------------------------------------------------------------------
+
+
+def test_mailbox_corruption_increments_metrics_counter(tmp_path):
+    from distributed_tensorflow_tpu.observability.metrics import (
+        MetricsRegistry,
+    )
+
+    reg = MetricsRegistry()
+    box = MailboxClient(str(tmp_path), metrics=reg)
+    with open(box.outbox + "/00000001-x.json", "w") as f:
+        f.write("{ torn")
+    assert box.poll_results() == []
+    assert box.corrupt_files == 1
+    assert reg.counter("mailbox_corrupt_files_total").value == 1
+
+
+def test_router_attaches_metrics_to_clients(tmp_path):
+    h = ReplicaHandle("r0", client=MailboxClient(str(tmp_path)))
+    router, = [ReplicaRouter([h], journal=_RecordingJournal())]
+    assert h.client.metrics is router.metrics
+
+
+def test_ewma_discards_compile_bearing_first_dispatch():
+    """The first decode dispatch carries the chunk-scan compile; its
+    seconds/token must NOT seed the hopeless predicate's EWMA — a
+    freshly-warmed server would shed its first deadline-bearing traffic
+    on a number that is one-time cost, not serving rate (the round-21
+    chaos schedule caught this live)."""
+    m = tiny_model()
+    srv = TextServer(m, params=None, slots=1, chunk=2, buckets=(8,))
+    _FakeEngine(srv, m.vocab_size)
+    pr = _prompts(m.vocab_size, [4])[0]
+    srv.submit(pr, GenerationConfig(max_new=2))
+    while srv.step():
+        pass
+    assert srv._tok_ewma is None  # one dispatch = the compile: discarded
+    srv.submit(pr, GenerationConfig(max_new=2))
+    while srv.step():
+        pass
+    assert srv._tok_ewma is not None and srv._tok_ewma > 0
