@@ -171,6 +171,17 @@ class TrainConfig:
     # Bounded retry-with-backoff around checkpoint save/restore I/O.
     checkpoint_retries: int = 3
     checkpoint_retry_backoff: float = 0.25
+    # Async checkpoint pipeline (round 22, train/resilience.py
+    # AsyncCheckpointWriter): the save boundary pays only the device→host
+    # snapshot; serialize + CRC + manifest + retention GC run on a
+    # bounded background writer through the SAME write sequence, so the
+    # artifacts are byte-identical to the synchronous path (test-pinned)
+    # and a crash mid-async-write is indistinguishable from today's torn
+    # write (newest→oldest fallback covers both). At most one write in
+    # flight; a newer snapshot supersedes a queued one; trainers drain at
+    # run() exit and before every restore. False = the round-6
+    # synchronous path, kept as the escape hatch.
+    async_checkpoint: bool = True
     # Preemption contract: run() installs a SIGTERM/SIGINT handler that
     # flips Supervisor.request_stop, so the loop exits at the next epoch/
     # dispatch boundary with a final save (TPU-pod preemption semantics).

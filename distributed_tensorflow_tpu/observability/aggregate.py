@@ -57,7 +57,22 @@ GANG_KINDS = (
     "breaker_open",
     "breaker_half_open",
     "breaker_close",
+    # Round 22 (progress watchdog): "stall" is a true gang moment — the
+    # driver's verdict on a frozen member, recorded once, mirrored on
+    # every track. "heartbeat" rides in GANG_KINDS for the report's
+    # per-rank last-progress join, but it is a PER-RANK stream, not a
+    # shared instant — it is excluded from the skew anchors
+    # (_ANCHOR_KINDS) and rendered as a local instant in the chrome
+    # trace, per the round-19 rule that per-rank kinds must never anchor
+    # (colliding keys would poison estimate_skew).
+    "stall",
+    "heartbeat",
 )
+
+# The skew-anchor subset of GANG_KINDS: kinds where ONE physical instant
+# is recorded in MULTIPLE journals. Per-rank streams (heartbeat) never
+# qualify.
+_ANCHOR_KINDS = tuple(k for k in GANG_KINDS if k != "heartbeat")
 
 _RANK_FILE = re.compile(r"^events-rank(\d+)\.jsonl$")
 
@@ -86,7 +101,13 @@ def _anchor_key(ev: dict):
     """Identity of a gang-wide event across journals: the kind plus its
     stable ordinal fields (restart ordinal, world size, an explicit sync
     id) — wall time deliberately excluded (it is what we are solving
-    for)."""
+    for). Round 22 adds ``member``: two stall verdicts on different
+    members are different instants and must never alias. The per-rank
+    auto-tags (``rank``, ``step``) stay OUT of the key — rank journals
+    stamp ``rank=`` on every event they record, so keying on either
+    would split the driver's copy of a shared anchor from the ranks'
+    copies (heartbeats, the stream those tags would disambiguate, are
+    excluded from anchoring wholesale via ``_ANCHOR_KINDS``)."""
     return (
         ev.get("kind"),
         ev.get("restart"),
@@ -94,6 +115,7 @@ def _anchor_key(ev: dict):
         ev.get("world"),
         ev.get("from_world"),
         ev.get("sync"),
+        ev.get("member"),
     )
 
 
@@ -106,7 +128,8 @@ def estimate_skew(journals: dict) -> dict:
         label: {
             _anchor_key(e): e["ts"]
             for e in evs
-            if e.get("kind") in GANG_KINDS and isinstance(e.get("ts"), (int, float))
+            if e.get("kind") in _ANCHOR_KINDS
+            and isinstance(e.get("ts"), (int, float))
         }
         for label, evs in journals.items()
     }
@@ -222,6 +245,11 @@ def gang_chrome_trace(merged: dict) -> dict:
         # estimate_skew's shared-lifecycle-anchor matching).
         "failpoint",
         "mailbox_corrupt",
+        # Round 22: a heartbeat belongs to the rank that beat — checked
+        # BEFORE the GANG_KINDS mirror below (it is in GANG_KINDS only
+        # for the report's last-progress join, never a fleet-wide
+        # instant to stamp on every track).
+        "heartbeat",
     )
     for ev in stamped:
         kind = ev.get("kind")
@@ -229,7 +257,20 @@ def gang_chrome_trace(merged: dict) -> dict:
         args = {
             k: v for k, v in ev.items() if k not in ("_src", "kind", "ts")
         }
-        if kind == "span":
+        if kind in local_instants:
+            events.append(
+                {
+                    "name": kind,
+                    "cat": "lifecycle",
+                    "ph": "i",
+                    "s": "p",
+                    "ts": us(ev["ts"]),
+                    "pid": pid,
+                    "tid": 0,
+                    "args": args,
+                }
+            )
+        elif kind == "span":
             dur = float(ev.get("dur_us", 0.0))
             events.append(
                 {
@@ -257,19 +298,6 @@ def gang_chrome_trace(merged: dict) -> dict:
                         "args": args,
                     }
                 )
-        elif kind in local_instants:
-            events.append(
-                {
-                    "name": kind,
-                    "cat": "lifecycle",
-                    "ph": "i",
-                    "s": "p",
-                    "ts": us(ev["ts"]),
-                    "pid": pid,
-                    "tid": 0,
-                    "args": args,
-                }
-            )
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
@@ -279,6 +307,14 @@ def fleet_summary(merged: dict) -> dict:
     entry tagged with the journal that recorded it)."""
     from distributed_tensorflow_tpu.observability import format as obs_format
 
+    ts_newest = max(
+        (
+            e["ts"]
+            for e in merged["events"]
+            if isinstance(e.get("ts"), (int, float))
+        ),
+        default=None,
+    )
     per_rank: dict = {}
     for label in merged["ranks"]:
         evs = [e for e in merged["events"] if e["_src"] == label]
@@ -293,9 +329,29 @@ def fleet_summary(merged: dict) -> dict:
             "kinds": dict(sorted(kinds.items())),
             "wall_span_s": round(max(ts) - min(ts), 3) if ts else 0.0,
         }
+        # Round 22 (progress watchdog): last-progress age from the rank's
+        # newest heartbeat event, measured against the merged timeline's
+        # end — a member whose age keeps growing while the gang's clock
+        # advances is stalling, visible here BEFORE the verdict fires.
+        beats = [
+            e
+            for e in evs
+            if e.get("kind") == "heartbeat"
+            and isinstance(e.get("ts"), (int, float))
+        ]
+        if beats and ts_newest is not None:
+            last = max(beats, key=lambda e: e["ts"])
+            per_rank[label]["last_progress"] = {
+                "step": last.get("step"),
+                "age_s": round(ts_newest - last["ts"], 3),
+            }
     lifecycle = []
     for ev in merged["events"]:
         kind = ev.get("kind")
+        # heartbeat is a per-rank stream (summarized as last_progress
+        # above) — listing every beat would drown the lifecycle history.
+        if kind == "heartbeat":
+            continue
         if kind in GANG_KINDS or kind in (
             "preemption", "rollback", "restore", "weight_swap", "serve_drain",
             "failpoint", "mailbox_corrupt",

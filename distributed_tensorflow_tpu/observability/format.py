@@ -108,9 +108,37 @@ def _rollback_compiled(ev: dict) -> str:
 
 
 def _preemption(ev: dict) -> str:
-    return (
+    # Round 22: a guard asked for off the main thread never arms — say so
+    # once instead of being discovered at kill time.
+    if ev.get("disarmed"):
+        return f"Preemption: disarmed ({ev['disarmed']})"
+    line = (
         f"Preemption: signal={ev['signal']} stop_requested=1 — finishing "
         "the current epoch, saving, exiting (signal again to force)"
+    )
+    # Round 22 (emergency snapshot): the step the handler persisted
+    # immediately. Absent when nothing newer than disk existed (sync
+    # mode, or the boundary save already landed) — the round-6 line
+    # stays byte-identical.
+    if ev.get("saved_step") is not None:
+        line += f" saved_step={ev['saved_step']}"
+    return line
+
+
+def _heartbeat(ev: dict) -> str:
+    # Round 22 (progress watchdog): normally journal-only — trainers emit
+    # it without a print_fn; the renderer exists for obs_report replays.
+    return f"Heartbeat: rank={ev.get('rank')} step={ev.get('step')}"
+
+
+def _stall(ev: dict) -> str:
+    # Round 22: the watchdog's verdict line — alive but not advancing
+    # (the SIGSTOP / wedged-collective class rc= and health can't see).
+    return (
+        f"Stall: member={ev['member']} "
+        f"heartbeat_age_s={ev['age_s']:.1f} "
+        f"stall_after_s={ev['stall_after_s']:.1f} — killing and "
+        "recovering through the elastic path"
     )
 
 
@@ -220,6 +248,8 @@ RENDERERS = {
     "rollback": _rollback,
     "rollback_compiled": _rollback_compiled,
     "preemption": _preemption,
+    "heartbeat": _heartbeat,
+    "stall": _stall,
     "restore": _restore,
     "replica_dead": _replica_dead,
     "replica_relaunch": _replica_relaunch,

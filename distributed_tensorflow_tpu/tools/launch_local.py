@@ -101,6 +101,15 @@ def lost_marker(logdir: str, worker: int) -> str:
     return os.path.join(logdir, f"worker{worker}.lost")
 
 
+def heartbeat_file(logdir: str, worker: int) -> str:
+    """Path of worker ``i``'s progress-heartbeat file (round 22): the
+    trainer mtime-bumps it at every step/epoch boundary
+    (Supervisor.report_progress via ``DTF_HEARTBEAT_FILE``); the driver's
+    watchdog reads its age. File-based like the lost marker — any
+    external scheduler can watch it."""
+    return os.path.join(logdir, f"worker{worker}.heartbeat")
+
+
 def _launch_elastic(
     command: list[str],
     num_workers: int,
@@ -112,8 +121,9 @@ def _launch_elastic(
     heartbeat_timeout_ms: int,
     heartbeat_grace_ms: int | None,
     stall_timeout_ms: int,
-    backoff: float,
-    poll_interval: float,
+    stall_after_s: float = 0.0,
+    backoff: float = 1.0,
+    poll_interval: float = 0.5,
     min_workers: int | None = None,
     rejoin_timeout_s: float = 30.0,
     independent: bool = False,
@@ -190,12 +200,27 @@ def _launch_elastic(
     def _worker_env(i: int) -> dict:
         wenv = dict(env)
         wenv["DTF_RANK"] = str(i)  # the member's ORIGINAL id (log convention)
+        # Progress watchdog (round 22): the trainer mtime-bumps this file
+        # at step/epoch boundaries; SIGUSR1 makes the member dump all
+        # stacks to the .stalldump before the watchdog kills it.
+        wenv["DTF_HEARTBEAT_FILE"] = heartbeat_file(logdir, i)
+        wenv["DTF_STALL_DUMP"] = os.path.join(logdir, f"worker{i}.stalldump")
         return wenv
+
+    def _clear_heartbeat(i: int) -> None:
+        # A fresh incarnation must start never-beaten — a stale mtime from
+        # the previous life would age straight into a spurious stall
+        # verdict (or mask a hung restart with a recent-looking beat).
+        try:
+            os.remove(heartbeat_file(logdir, i))
+        except OSError:
+            pass
 
     def _make_spawn(i: int):
         def _spawn():
             mode = "ab" if i in launched else "wb"
             launched.add(i)
+            _clear_heartbeat(i)
             return _spawn_task(
                 command, "worker", i, logdir, _worker_env(i), mode=mode
             )
@@ -208,6 +233,7 @@ def _launch_elastic(
             # the env (launch.cluster_from_env → ClusterConfig.subset), the
             # log continuing under the member's ORIGINAL id.
             launched.add(i)
+            _clear_heartbeat(i)
             tenv = _worker_env(i)
             tenv["DTF_WORLD_SIZE"] = str(world)
             tenv["DTF_WORKER_RANKS"] = ",".join(str(r) for r in ranks)
@@ -216,6 +242,18 @@ def _launch_elastic(
             )
 
         return _spawn
+
+    def _make_heartbeat(i: int):
+        def _age() -> float | None:
+            # Wall-clock age of the member's last progress beat; None
+            # (never judged) while the file doesn't exist yet — startup
+            # and first-compile latency never read as a stall.
+            try:
+                return time.time() - os.path.getmtime(heartbeat_file(logdir, i))
+            except OSError:
+                return None
+
+        return _age
 
     def _make_available(i: int):
         def _available():
@@ -231,6 +269,7 @@ def _launch_elastic(
             worker_id=i,
             available_fn=_make_available(i) if elastic_resize else None,
             topo_spawn_fn=_make_topo_spawn(i) if elastic_resize else None,
+            heartbeat_fn=_make_heartbeat(i),
         )
         for i in range(num_workers)
     ]
@@ -243,6 +282,7 @@ def _launch_elastic(
         min_workers=min_workers if elastic_resize else None,
         rejoin_timeout_s=rejoin_timeout_s,
         independent=independent,
+        stall_after_s=stall_after_s,
         print_fn=print_fn,
         summary_writer=summary_writer,
         journal=journal,
@@ -319,6 +359,14 @@ def launch(
     # 30 s timeout for a 150 s grace.
     heartbeat_grace_ms: int | None = None,
     stall_timeout_ms: int = 0,
+    # Progress watchdog (round 22, train/elastic.py): no trainer heartbeat
+    # on <logdir>/worker<i>.heartbeat for this long → Stall: verdict,
+    # SIGKILL, recovery through the elastic path. Needs NO detector port —
+    # the file-mtime path catches the frozen/wedged class (SIGSTOP, hung
+    # collective) that exit codes and liveness probes can't see. Size it
+    # above the worst-case gap between beats (an epoch + a fresh compile).
+    # 0 disables (default).
+    stall_after_s: float = 0.0,
     backoff: float = 1.0,
     poll_interval: float = 0.5,
     # Shrink-to-fit resize (round 8; only with max_restarts > 0). None/0
@@ -382,6 +430,7 @@ def launch(
             heartbeat_timeout_ms=heartbeat_timeout_ms,
             heartbeat_grace_ms=heartbeat_grace_ms,
             stall_timeout_ms=stall_timeout_ms,
+            stall_after_s=stall_after_s,
             backoff=backoff,
             poll_interval=poll_interval,
             min_workers=min_workers,
@@ -457,6 +506,16 @@ def main(argv=None) -> int:
         "counter is frozen past this window (0 disables; default: "
         "$DTF_STALL_TIMEOUT_MS)",
     )
+    parser.add_argument(
+        "--stall-after-s",
+        type=float,
+        default=float(os.environ.get("DTF_STALL_AFTER_S", "0") or 0),
+        help="file-based progress watchdog (round 22): kill and recover a "
+        "worker whose <logdir>/worker<i>.heartbeat has not advanced for "
+        "this long — catches the frozen/wedged class without any detector "
+        "port; size above the worst epoch+compile gap (0 disables; "
+        "default: $DTF_STALL_AFTER_S)",
+    )
     parser.add_argument("--backoff", type=float, default=1.0)
     parser.add_argument(
         "--min-workers",
@@ -517,6 +576,7 @@ def main(argv=None) -> int:
         heartbeat_timeout_ms=args.heartbeat_timeout_ms,
         heartbeat_grace_ms=args.heartbeat_grace_ms,
         stall_timeout_ms=args.stall_timeout_ms,
+        stall_after_s=args.stall_after_s,
         backoff=args.backoff,
         min_workers=args.min_workers or None,
         rejoin_timeout_s=args.rejoin_timeout_s,

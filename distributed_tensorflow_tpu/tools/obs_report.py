@@ -728,10 +728,17 @@ def render_gang(summary: dict) -> str:
     for label, r in summary["ranks"].items():
         skew = summary["skew_s"].get(label, 0.0)
         starts = summary["worker_starts"].get(label, 0)
+        prog = r.get("last_progress")
         lines.append(
             f"  {label}: {r['events']} events over {r['wall_span_s']}s"
             + (f", skew {skew}s" if skew else "")
             + (f", {starts} incarnation(s)" if starts else "")
+            + (
+                f", last progress step {prog['step']} "
+                f"({prog['age_s']}s ago)"
+                if prog
+                else ""
+            )
         )
     if summary["lifecycle"]:
         lines.append("gang lifecycle:")

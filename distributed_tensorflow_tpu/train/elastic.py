@@ -110,6 +110,8 @@ silence is not immediately re-verdicted.
 
 from __future__ import annotations
 
+import os
+import signal as _signal
 import time
 from typing import Callable, Sequence
 
@@ -315,7 +317,14 @@ class ElasticAgent:
       driver exports it so workers can re-derive their cluster subset).
       Only consulted when the gang's current roster differs from the
       original; the original roster always spawns via ``spawn_fn()`` so a
-      fully regrown gang is byte-identical to a fresh launch."""
+      fully regrown gang is byte-identical to a fresh launch.
+
+    Progress watchdog (round 22): ``heartbeat_fn() -> float | None``
+    returns the seconds since this member's last progress beat (the
+    launcher wires an mtime probe of ``<logdir>/worker<i>.heartbeat``),
+    or None when the member has never beaten — startup/first-compile is
+    not judged. The gang's stall verdict reads it through
+    :meth:`heartbeat_age`."""
 
     def __init__(
         self,
@@ -325,12 +334,14 @@ class ElasticAgent:
         worker_id: int | None = None,
         available_fn: Callable[[], bool] | None = None,
         topo_spawn_fn: Callable | None = None,
+        heartbeat_fn: Callable[[], float | None] | None = None,
     ):
         self.name = name
         self.worker_id = worker_id
         self._spawn_fn = spawn_fn
         self.available_fn = available_fn
         self.topo_spawn_fn = topo_spawn_fn
+        self.heartbeat_fn = heartbeat_fn
         self.handle = None
 
     def available(self) -> bool:
@@ -355,6 +366,35 @@ class ElasticAgent:
     def poll(self):
         """Exit code, or None (running / not yet started)."""
         return None if self.handle is None else self.handle.poll()
+
+    def heartbeat_age(self) -> float | None:
+        """Seconds since the member's last progress beat, or None (no
+        ``heartbeat_fn`` wired, never beaten, or the probe failed —
+        none of which is judgeable evidence of a stall)."""
+        if self.heartbeat_fn is None:
+            return None
+        try:
+            age = self.heartbeat_fn()
+        except Exception:  # noqa: BLE001 — a broken probe is not a verdict
+            return None
+        return None if age is None else float(age)
+
+    def request_dump(self) -> bool:
+        """Best-effort SIGUSR1 to the member: its ``faulthandler`` dump
+        (armed via ``resilience.arm_stall_dump`` / ``$DTF_STALL_DUMP``)
+        lands all-thread stacks in the logdir. faulthandler's handler is
+        C-level, so a rank wedged inside a collective CAN still dump; a
+        SIGSTOPped one cannot (the signal queues until SIGCONT) — the
+        stall verdict never waits on the dump."""
+        pid = getattr(self.handle, "pid", None)
+        usr1 = getattr(_signal, "SIGUSR1", None)
+        if pid is None or usr1 is None:
+            return False
+        try:
+            os.kill(pid, usr1)
+            return True
+        except OSError:
+            return False
 
     def kill(self) -> None:
         """Hard-kill a live member (SIGKILL semantics — a rank hung in a
@@ -423,6 +463,7 @@ class ElasticGang:
         rejoin_timeout_s: float = 0.0,
         independent: bool = False,
         member_grace_s: float = 60.0,
+        stall_after_s: float = 0.0,
         print_fn=print,
         summary_writer=None,
         journal=None,
@@ -454,6 +495,19 @@ class ElasticGang:
             )
         self.independent = bool(independent)
         self.member_grace_s = float(member_grace_s)
+        # Progress watchdog (round 22): a member whose process is ALIVE
+        # but whose heartbeat file has not moved for stall_after_s gets a
+        # "stalled" verdict — the SIGSTOP / wedged-collective class that
+        # rc= polls and health probes can never see (mirror of the
+        # round-21 breaker's frozen-replica reasoning). 0 disables. Size
+        # it above the worst-case epoch + first-compile latency — the
+        # never-beaten startup phase is not judged, but a long compile
+        # BETWEEN beats is.
+        self.stall_after_s = float(stall_after_s)
+        if self.stall_after_s < 0:
+            raise ValueError(
+                f"stall_after_s must be >= 0, got {self.stall_after_s}"
+            )
         if self.independent and self._elastic:
             raise ValueError(
                 "independent=True does not compose with shrink-to-fit "
@@ -562,6 +616,32 @@ class ElasticGang:
                             v = health.classify(wid)
                             if v != "ok":
                                 verdicts[a.name] = v
+                # Stall verdict (round 22): alive, past any rc/health
+                # verdict, but the progress heartbeat is stale. Emit the
+                # Stall: line, ask the member for its faulthandler dump
+                # (best-effort), SIGKILL it, and hand the verdict to the
+                # EXISTING recovery machinery (gang restart, shrink/
+                # rejoin, or independent relaunch — nothing new below).
+                if self.stall_after_s > 0:
+                    for a in self.active:
+                        if rcs[a.name] is not None or a.name in verdicts:
+                            continue
+                        age = a.heartbeat_age()
+                        if age is not None and age > self.stall_after_s:
+                            lifecycle_event(
+                                "stall",
+                                print_fn=self.print_fn,
+                                journal=self.journal,
+                                writer=self.summary_writer,
+                                scalar=("stall", float(age), self.restarts),
+                                member=a.name,
+                                age_s=round(float(age), 3),
+                                stall_after_s=self.stall_after_s,
+                            )
+                            self.metrics.counter("stalls_total").inc()
+                            a.request_dump()
+                            a.kill()
+                            verdicts[a.name] = "stalled"
                 if verdicts and self.independent:
                     # Independent members (module docstring): relaunch
                     # ONLY the failed members; survivors keep running.

@@ -94,6 +94,13 @@ REGISTERED = {
         "resilience.write_manifest (+ tear of the committed manifest "
         "sidecar)"
     ),
+    "ckpt.async": (
+        "resilience.AsyncCheckpointWriter worker, before executing a "
+        "queued write — raise: the writer dies before serializing (the "
+        "queued step never lands, error deferred to wait_pending); "
+        "kill: crash mid-async-write; delay: makes supersession "
+        "deterministic"
+    ),
     "delta.post": (
         "local_sgd.DeltaExchange.post entry (+ tear of the committed "
         "npz post)"

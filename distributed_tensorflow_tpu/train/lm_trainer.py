@@ -225,6 +225,7 @@ class LMTrainer:
                 keep_last_n=self.config.keep_last_n,
                 io_retries=self.config.checkpoint_retries,
                 io_backoff=self.config.checkpoint_retry_backoff,
+                async_checkpoint=self.config.async_checkpoint,
             )
         self.tokenizer = tokenizer
         if (
@@ -1343,9 +1344,13 @@ class LMTrainer:
                 "epoch; use run()"
             )
         with tracing.trace(tracing.current_trace()):
-            return self._run_compiled(
-                epochs, epoch_offset=epoch_offset, finalize=finalize
-            )
+            try:
+                return self._run_compiled(
+                    epochs, epoch_offset=epoch_offset, finalize=finalize
+                )
+            finally:
+                if finalize and self.supervisor is not None:
+                    self.supervisor.wait_pending()
 
     def _run_compiled(
         self,
@@ -1912,13 +1917,22 @@ class LMTrainer:
 
         # Ambient trace (round 12): one id across every journal event of
         # this run — see Trainer.run. Reuses an enclosing trace.
+        from distributed_tensorflow_tpu.train.resilience import arm_stall_dump
+
+        arm_stall_dump()  # $DTF_STALL_DUMP (elastic launcher) or no-op
         with tracing.trace(tracing.current_trace()), preemption_guard(
             self.supervisor,
             enabled=self.config.handle_preemption,
             print_fn=self.print_fn,
             journal=self.journal,
         ):
-            return self._run(epochs)
+            try:
+                return self._run(epochs)
+            finally:
+                # Async-checkpoint drain (round 22): run() returns only
+                # once every submitted save is durable on disk.
+                if self.supervisor is not None:
+                    self.supervisor.wait_pending()
 
     def _run(self, epochs: int | None = None) -> dict:
         cfg = self.config

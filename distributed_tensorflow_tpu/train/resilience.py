@@ -304,6 +304,189 @@ def verify_leaves(state, manifest: dict) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# Async checkpoint writer (round 22): the bounded background half of the
+# zero-stall save pipeline.
+# ---------------------------------------------------------------------------
+
+
+class AsyncCheckpointWriter:
+    """Depth-1 background checkpoint writer: the training loop hands a
+    fully host-resident write closure to :meth:`submit` and dispatches
+    the next epoch immediately; a single daemon thread serializes, CRCs,
+    and commits exactly as the synchronous path would (the closure IS the
+    synchronous path — state parity is by construction, pinned in
+    tests/test_resilience.py).
+
+    Bounds: at most ONE write in flight plus ONE queued; submitting while
+    a write is queued-but-not-started REPLACES it (the superseded step
+    never lands — on a writer slower than the save cadence, disk always
+    receives the newest snapshot rather than an ever-growing backlog of
+    stale ones; ``superseded`` counts the drops). :meth:`wait_pending`
+    blocks until everything submitted has committed — the shutdown/final-
+    save drain, and the barrier every restore entry point takes (an
+    in-flight step directory has NO manifest yet, which reads as
+    "unverifiable, trusted" to pre-manifest fallback logic; draining
+    first keeps reads ordered after writes).
+
+    A write that raises does not kill the writer: the error is captured
+    and re-raised at the next :meth:`wait_pending` /
+    :meth:`raise_deferred` — losing one save costs one checkpoint
+    interval (the round-6 fallback contract), losing the ERROR would cost
+    the diagnosis. Failpoint ``ckpt.async`` fires on the worker thread
+    before each queued write executes (``raise`` = writer dies before
+    serializing, the queued step never lands; ``kill`` = the crash-mid-
+    async-write case, indistinguishable from a torn synchronous write by
+    design; ``delay`` makes supersession deterministic in tests)."""
+
+    def __init__(self, *, name: str = "ckpt-writer"):
+        self._cond = threading.Condition()
+        self._pending = None  # (tag, fn) queued, not yet started
+        self._in_flight = False
+        self._error: BaseException | None = None
+        self._closed = False
+        self.superseded = 0
+        self._thread = threading.Thread(
+            target=self._worker, name=name, daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, fn, *, tag=None) -> None:
+        """Queue ``fn`` for the worker. Replaces a queued-not-started
+        write (the newer snapshot supersedes); never blocks on I/O."""
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointWriter is closed")
+            if self._pending is not None:
+                self.superseded += 1
+            self._pending = (tag, fn)
+            self._cond.notify_all()
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while self._pending is None and not self._closed:
+                    self._cond.wait()
+                if self._pending is None:
+                    return
+                _tag, fn = self._pending
+                self._pending = None
+                self._in_flight = True
+            try:
+                failpoints.fire("ckpt.async")
+                fn()
+            except BaseException as exc:  # noqa: BLE001 — deferred re-raise
+                with self._cond:
+                    self._error = exc
+            finally:
+                with self._cond:
+                    self._in_flight = False
+                    self._cond.notify_all()
+
+    def raise_deferred(self) -> None:
+        """Re-raise (and clear) a captured writer error; non-blocking."""
+        with self._cond:
+            err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    def wait_pending(self) -> None:
+        """Block until every submitted write has committed, then surface
+        any deferred writer error."""
+        with self._cond:
+            while self._pending is not None or self._in_flight:
+                self._cond.wait()
+        self.raise_deferred()
+
+    @property
+    def busy(self) -> bool:
+        with self._cond:
+            return self._pending is not None or self._in_flight
+
+    def close(self) -> None:
+        """Drain and stop the worker thread."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self.wait_pending()
+        self._thread.join(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Progress heartbeat + stall dump (round 22): the watchdog's worker half.
+# ---------------------------------------------------------------------------
+
+
+def touch_heartbeat(path: str) -> bool:
+    """Atomic mtime-bump of a worker heartbeat file (creating it on the
+    first beat). The elastic watchdog reads the mtime age — an mtime
+    update is a single metadata write, so there is no torn-read mode and
+    nothing to fsync. Returns False (never raises) on I/O failure: a
+    heartbeat must not be able to kill the run it protects."""
+    if not path:
+        return False
+    try:
+        os.utime(path)
+        return True
+    except FileNotFoundError:
+        try:
+            with open(path, "a", encoding="utf-8"):
+                pass
+            return True
+        except OSError:
+            return False
+    except OSError:
+        return False
+
+
+_stall_dump_file = None  # keep the fd alive — faulthandler borrows it
+
+
+def arm_stall_dump(path: str | None = None) -> str | None:
+    """Register a ``faulthandler`` traceback dump on SIGUSR1, appended to
+    ``path`` (default: ``$DTF_STALL_DUMP``; unset/empty = disarmed).
+    The elastic watchdog sends SIGUSR1 right before SIGKILLing a stalled
+    member, so the member's own all-thread stacks land in the logdir for
+    diagnosis. faulthandler registers a C-level handler — a worker wedged
+    inside a collective CAN still dump; a SIGSTOPped one cannot (the
+    signal queues until SIGCONT), which is why the dump is best-effort
+    and the verdict never waits on it. Returns the armed path or None."""
+    global _stall_dump_file
+    if path is None:
+        path = os.environ.get("DTF_STALL_DUMP", "")
+    if not path:
+        return None
+    import faulthandler
+
+    try:
+        f = open(path, "a", encoding="utf-8")
+        faulthandler.register(
+            signal.SIGUSR1, file=f, all_threads=True, chain=False
+        )
+    except (OSError, ValueError, AttributeError):  # pragma: no cover
+        return None  # exotic host (no SIGUSR1 / no fd) — stay disarmed
+    _stall_dump_file = f
+    return path
+
+
+def disarm_stall_dump() -> None:
+    """Unregister the SIGUSR1 dump handler and close its file. Safe to
+    call when never armed (workers call it from teardown paths)."""
+    global _stall_dump_file
+    import faulthandler
+
+    try:
+        faulthandler.unregister(signal.SIGUSR1)
+    except (ValueError, AttributeError):  # pragma: no cover - no SIGUSR1
+        pass
+    if _stall_dump_file is not None:
+        try:
+            _stall_dump_file.close()
+        except OSError:  # pragma: no cover
+            pass
+        _stall_dump_file = None
+
+
+# ---------------------------------------------------------------------------
 # Bounded retry with exponential backoff — the ONE retry implementation.
 # Checkpoint I/O (retry_io), the elastic gang-restart cycle
 # (train/elastic.py), and the bounded jax.distributed bootstrap
@@ -431,12 +614,35 @@ def preemption_guard(
     process default) and rendered byte-identically to stdout.
 
     No-ops (yields None) when disabled, when there is no supervisor to
-    stop, or off the main thread (CPython only delivers signals there)."""
-    if (
-        not enabled
-        or supervisor is None
-        or threading.current_thread() is not threading.main_thread()
-    ):
+    stop, or off the main thread (CPython only delivers signals there) —
+    but the off-main-thread case is the one a caller did NOT choose, so
+    it emits one structured ``Preemption: disarmed (non-main thread)``
+    line (round 22): a guard that never armed is visible in the journal
+    instead of discovered at kill time.
+
+    Round 22: the first signal additionally triggers
+    ``supervisor.emergency_save()`` when the supervisor has one — the
+    last completed-epoch host snapshot (retained by the async checkpoint
+    pipeline) persists immediately, so a preemption landing mid-epoch
+    loses nothing; the ``Preemption:`` line grows ``saved_step=N`` when
+    a step was persisted (absent otherwise — the default line is
+    byte-identical to round 6)."""
+    if not enabled or supervisor is None:
+        yield None
+        return
+    if threading.current_thread() is not threading.main_thread():
+        from distributed_tensorflow_tpu.observability import format as obs_format
+        from distributed_tensorflow_tpu.observability import (
+            journal as obs_journal,
+        )
+
+        j = journal if journal is not None else obs_journal.get_journal()
+        obs_format.emit_line(
+            "preemption",
+            journal=j,
+            print_fn=print_fn,
+            disarmed="non-main thread",
+        )
         yield None
         return
     prev: dict = {}
@@ -453,6 +659,19 @@ def preemption_guard(
 
     def _handler(signum, frame):
         supervisor.request_stop()
+        # Emergency snapshot (round 22): persist the last completed-epoch
+        # host state NOW, not at the boundary the loop may never reach in
+        # the grace window. emergency_save is reentrancy-guarded (no-op
+        # when the signal interrupted a save already in progress) and
+        # quiet (zero journal/metrics I/O in this frame); it returns the
+        # persisted step, or None when there was nothing newer than disk.
+        saved_step = None
+        emergency = getattr(supervisor, "emergency_save", None)
+        if emergency is not None:
+            try:
+                saved_step = emergency()
+            except Exception:  # noqa: BLE001 — best-effort in a handler
+                saved_step = None
         # Structured one-liner (greppable key=value, like Step:/Cost:).
         # Journal file I/O is NOT reentrancy-safe: the signal can land
         # mid-write on the journal's own buffered file (StepLogger emits
@@ -464,11 +683,13 @@ def preemption_guard(
         from distributed_tensorflow_tpu.observability import format as obs_format
         from distributed_tensorflow_tpu.observability.journal import NullJournal
 
+        extra = {} if saved_step is None else {"saved_step": int(saved_step)}
         ev = obs_format.emit_line(
             "preemption",
             journal=NullJournal(),
             print_fn=print_fn,
             signal=int(signum),
+            **extra,
         )
         pending.append(
             {k: v for k, v in ev.items() if k not in ("ts", "kind")}

@@ -24,10 +24,12 @@ docs/resilience.md.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import re
 import shutil
+import threading
 import warnings
 
 from typing import TYPE_CHECKING
@@ -109,12 +111,25 @@ class Supervisor:
         keep_last_n: int | None = None,
         io_retries: int = 3,
         io_backoff: float = 0.25,
+        async_checkpoint: bool = False,
     ):
         self.is_chief = is_chief
         self.checkpoint_dir = os.path.abspath(checkpoint_dir) if checkpoint_dir else None
         self.keep_last_n = keep_last_n
         self.io_retries = max(1, int(io_retries))
         self.io_backoff = float(io_backoff)
+        # Async checkpoint pipeline (round 22): ``save`` snapshots device
+        # state to host and returns immediately; a depth-1 background
+        # writer commits the EXACT synchronous byte sequence. Default OFF
+        # here (bare Supervisors — inference, serving, launch probes —
+        # have no training loop to unblock); TrainConfig.async_checkpoint
+        # (default ON) flips it for the trainers.
+        self.async_checkpoint = bool(async_checkpoint)
+        self._writer = None
+        self._write_lock = threading.Lock()
+        self._saving = False  # main-thread sync save in progress
+        self._last_snapshot = None  # (host_state, step, layout) — newest
+        self._heartbeat_file = os.environ.get("DTF_HEARTBEAT_FILE") or None
         self._stop_requested = False
         self._heartbeat = None
         self._stall_timeout_ms = 0
@@ -175,13 +190,29 @@ class Supervisor:
 
     def report_progress(self, progress: int) -> None:
         """Advance the attached heartbeat progress counter; no-op when no
-        reporter is wired (single process, heartbeat unavailable)."""
+        reporter is wired (single process, heartbeat unavailable).
+
+        Round 22: when ``$DTF_HEARTBEAT_FILE`` names a path (the elastic
+        launcher exports one per worker), each report also mtime-bumps
+        that file and emits a ``heartbeat`` journal event — the progress
+        watchdog's evidence that this member is alive AND advancing, not
+        merely scheduled. Gated on the env var so default journal streams
+        are byte-identical to round 21."""
         if self._progress_fn is not None:
             self._progress_fn(int(progress))
+        if self._heartbeat_file:
+            resilience.touch_heartbeat(self._heartbeat_file)
+            if self._journal is not None:
+                self._journal.emit(
+                    "heartbeat",
+                    rank=int(os.environ.get("DTF_RANK", "0") or 0),
+                    step=int(progress),
+                )
 
     # -- checkpoint/restore (upgrade over the reference's nothing) --------
 
     def latest_step(self, *, verify: bool = False) -> int | None:
+        self.wait_pending()
         return latest_checkpoint_step(self.checkpoint_dir, verify=verify)
 
     def newest_restorable_step(self) -> int | None:
@@ -189,7 +220,13 @@ class Supervisor:
         manifest exists, trusted where none does (pre-round-6 checkpoints
         carry no manifest but must keep restoring). The restore entry
         points use this so a corrupt latest checkpoint points them at the
-        newest valid one instead."""
+        newest valid one instead.
+
+        Reads drain writes (round 22): an in-flight async step directory
+        has no manifest yet — ``verify_files`` would return None and this
+        probe would TRUST a half-written step — so every restore entry
+        point drains the writer first."""
+        self.wait_pending()
         for step in reversed(checkpoint_steps(self.checkpoint_dir)):
             if resilience.verify_files(self.checkpoint_dir, step) is False:
                 warnings.warn(
@@ -223,47 +260,146 @@ class Supervisor:
         Durability (round 6): the orbax write runs under bounded
         retry-with-backoff, then the manifest sidecar commits atomically
         (its presence marks a complete checkpoint), then the retention
-        policy GCs steps beyond ``keep_last_n`` — never the last valid."""
+        policy GCs steps beyond ``keep_last_n`` — never the last valid.
+
+        Async (round 22, ``async_checkpoint=True``): the save boundary
+        pays only the device→host snapshot; serialize+CRC+manifest+GC run
+        on the background writer through the SAME ``_write_step`` the
+        synchronous path uses, so artifacts are state-identical (test-
+        pinned: byte-equal manifest leaf CRCs, bitwise-equal restores —
+        orbax's own content-hashed filenames keep raw bytes
+        nondeterministic even sync-vs-sync). The snapshot is retained as
+        the emergency-save source; a
+        prior writer error surfaces here (and at ``wait_pending``) rather
+        than being swallowed."""
         if not (self.is_chief and self._ckptr):
             return
         resilience.failpoints.fire("ckpt.save")
+        if self.async_checkpoint:
+            import jax
+            import numpy as _np
+
+            # The snapshot must OWN its memory: on CPU backends
+            # jax.device_get returns zero-copy VIEWS of the device
+            # buffers, and a donated buffer is overwritten by the next
+            # dispatched step while the write is still in flight (the
+            # orbax bytes and the manifest CRCs would then disagree —
+            # caught live by the corrupt-latest fallback test).
+            host_state = jax.tree.map(
+                lambda x: x.copy() if isinstance(x, _np.ndarray) else x,
+                jax.device_get(state),
+            )
+            self._last_snapshot = (host_state, int(step), layout)
+            if self._writer is None:
+                self._writer = resilience.AsyncCheckpointWriter()
+            else:
+                self._writer.raise_deferred()
+            self._writer.submit(
+                lambda: self._write_step(host_state, int(step), layout),
+                tag=int(step),
+            )
+            return
+        self._saving = True
+        try:
+            self._write_step(state, int(step), layout)
+        finally:
+            self._saving = False
+
+    def _write_step(
+        self, state, step: int, layout: dict | None, *, quiet: bool = False
+    ) -> None:
+        """The one write sequence (round-6 order, both modes): orbax under
+        retry → layout sidecar → manifest commit → telemetry → retention
+        sweep. Runs on the main thread (sync) or the writer thread
+        (async); ``_write_lock`` serializes the two. The sweep running
+        HERE, after the manifest commit, is what keeps ``keep_last_n`` GC
+        ordered behind every in-flight write — a step whose manifest
+        isn't committed yet is never a sweep candidate's newest-valid
+        competitor mid-write. ``quiet=True`` (emergency save from the
+        signal-handler frame) skips span/journal/metrics — none of those
+        sinks are reentrancy-safe there."""
         import time as _time
 
         path = os.path.join(self.checkpoint_dir, f"step_{step}")
-        t0 = _time.perf_counter()
 
         def _write():
             self._ckptr.save(path, state, force=True)
             self._ckptr.wait_until_finished()
 
-        with self._span("checkpoint_save", step=int(step)):
-            self._retry(_write, f"save step_{step}")
-            if layout is not None:
-                resilience.write_json_atomic(f"{path}.layout.json", layout)
-            manifest = self._retry(
-                lambda: resilience.write_manifest(
-                    self.checkpoint_dir, step, state
-                ),
-                f"manifest step_{step}",
+        with self._write_lock:
+            t0 = _time.perf_counter()
+            span = (
+                contextlib.nullcontext()
+                if quiet
+                else self._span("checkpoint_save", step=int(step))
             )
-        duration_s = _time.perf_counter() - t0
-        # The manifest already walked the step dir with sizes — the byte
-        # count is free (no second disk pass).
-        nbytes = sum(
-            r["size"] for r in manifest.get("files", {}).values()
-        ) + sum(r["size"] for r in manifest.get("sidecars", {}).values())
-        if self._journal is not None:
-            self._journal.emit(
-                "checkpoint_save",
-                step=int(step),
-                bytes=int(nbytes),
-                duration_s=round(duration_s, 6),
-            )
-        if self._metrics is not None:
-            self._metrics.counter("checkpoint_saves_total").inc()
-            self._metrics.counter("checkpoint_bytes_total").inc(nbytes)
-            self._metrics.histogram("checkpoint_save_s").observe(duration_s)
-        self._retention_sweep()
+            with span:
+                self._retry(_write, f"save step_{step}")
+                if layout is not None:
+                    resilience.write_json_atomic(f"{path}.layout.json", layout)
+                manifest = self._retry(
+                    lambda: resilience.write_manifest(
+                        self.checkpoint_dir, step, state
+                    ),
+                    f"manifest step_{step}",
+                )
+            duration_s = _time.perf_counter() - t0
+            # The manifest already walked the step dir with sizes — the byte
+            # count is free (no second disk pass).
+            nbytes = sum(
+                r["size"] for r in manifest.get("files", {}).values()
+            ) + sum(r["size"] for r in manifest.get("sidecars", {}).values())
+            if not quiet and self._journal is not None:
+                self._journal.emit(
+                    "checkpoint_save",
+                    step=int(step),
+                    bytes=int(nbytes),
+                    duration_s=round(duration_s, 6),
+                )
+            if not quiet and self._metrics is not None:
+                self._metrics.counter("checkpoint_saves_total").inc()
+                self._metrics.counter("checkpoint_bytes_total").inc(nbytes)
+                self._metrics.histogram("checkpoint_save_s").observe(
+                    duration_s
+                )
+            self._retention_sweep()
+
+    def wait_pending(self) -> None:
+        """Drain the async writer: every submitted write committed (or
+        its deferred error re-raised). No-op in sync mode. The final-save
+        barrier — trainers call it on run() exit — and the read barrier
+        every restore entry point takes (an in-flight step directory has
+        no manifest yet and would read as 'unverifiable, trusted')."""
+        w = self._writer
+        if w is not None:
+            w.wait_pending()
+
+    def emergency_save(self) -> int | None:
+        """Persist the newest retained host snapshot NOW (the preemption
+        handler's hook). Drains the writer first — normally that alone
+        lands the newest step — then writes the snapshot synchronously
+        only if it is still not committed on disk (superseded queue slot,
+        or the writer died on it). Reentrancy-guarded: no-op (None) when
+        the signal interrupted a synchronous save in progress (a blocking
+        wait here would deadlock the main thread against itself).
+        Returns the snapshot's step when it is durable on disk after the
+        call, else None."""
+        if not (self.is_chief and self._ckptr) or self._saving:
+            return None
+        snap = self._last_snapshot
+        if snap is None:
+            return None
+        host_state, step, layout = snap
+        try:
+            self.wait_pending()
+        except Exception:  # noqa: BLE001 — writer died; write it ourselves
+            pass
+        if resilience.verify_files(self.checkpoint_dir, step) is not True:
+            try:
+                self._write_step(host_state, step, layout, quiet=True)
+            except Exception:  # noqa: BLE001 — best-effort in a handler
+                return None
+        return int(step)
 
     def _retention_sweep(self) -> None:
         """Delete steps beyond the ``keep_last_n`` newest. The newest
@@ -329,6 +465,7 @@ class Supervisor:
         state's shapes the way :meth:`prepare_or_restore` does."""
         if self._ckptr is None:
             raise RuntimeError("no checkpointer (orbax unavailable or no dir)")
+        self.wait_pending()
         import jax
 
         path = os.path.join(self.checkpoint_dir, f"step_{step}")
@@ -364,6 +501,7 @@ class Supervisor:
         re-read+CRC pass for it."""
         if self._ckptr is None:
             return state, 0
+        self.wait_pending()
         import jax
 
         candidates = list(reversed(checkpoint_steps(self.checkpoint_dir)))
@@ -453,6 +591,9 @@ class Supervisor:
         return self._stop_requested
 
     def stop(self) -> None:
-        if self._ckptr is not None:
-            self._ckptr.wait_until_finished()
-        self._stop_requested = True
+        try:
+            self.wait_pending()
+        finally:
+            if self._ckptr is not None:
+                self._ckptr.wait_until_finished()
+            self._stop_requested = True

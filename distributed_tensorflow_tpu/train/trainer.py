@@ -105,6 +105,7 @@ class Trainer:
                 keep_last_n=self.config.keep_last_n,
                 io_retries=self.config.checkpoint_retries,
                 io_backoff=self.config.checkpoint_retry_backoff,
+                async_checkpoint=self.config.async_checkpoint,
             )
         self.start_step = 0
         if self.supervisor is not None:
@@ -564,9 +565,13 @@ class Trainer:
         from distributed_tensorflow_tpu.observability import tracing
 
         with tracing.trace(tracing.current_trace()):
-            return self._run_compiled(
-                epochs, epoch_offset=epoch_offset, finalize=finalize
-            )
+            try:
+                return self._run_compiled(
+                    epochs, epoch_offset=epoch_offset, finalize=finalize
+                )
+            finally:
+                if finalize and self.supervisor is not None:
+                    self.supervisor.wait_pending()
 
     def _run_compiled(
         self,
@@ -1019,13 +1024,23 @@ class Trainer:
         # trace id, so obs_report can separate interleaved runs sharing a
         # journal. Reuses an enclosing trace (a resumed run staying in
         # its caller's scope) instead of splitting it.
+        from distributed_tensorflow_tpu.train.resilience import arm_stall_dump
+
+        arm_stall_dump()  # $DTF_STALL_DUMP (elastic launcher) or no-op
         with tracing.trace(tracing.current_trace()), preemption_guard(
             self.supervisor,
             enabled=self.config.handle_preemption,
             print_fn=self.print_fn,
             journal=self.journal,
         ):
-            return self._run(epochs)
+            try:
+                return self._run(epochs)
+            finally:
+                # Async-checkpoint drain (round 22): run() returns only
+                # once every submitted save is durable on disk — callers
+                # (and tests) probe checkpoints right after.
+                if self.supervisor is not None:
+                    self.supervisor.wait_pending()
 
     def _run(self, epochs: int | None = None) -> dict:
         cfg = self.config

@@ -199,6 +199,11 @@ _CACHE_OPT_OUT_FIRST = (
     # autouse fixture opts out of the persistent cache like the two
     # above — fresh compiles must not follow a warm-loaded preamble).
     "test_local_sgd.py",
+    # Round 22: warm cache loads corrupt the checkpoint restore round
+    # trips (~50% standalone flake on pre-round-22 HEAD: segfault in a
+    # later lowering, or a restored int32 step reading the f32 -inf bit
+    # pattern). Cache-off runs are deterministic — see known_issues.md.
+    "test_resilience.py",
 )
 
 

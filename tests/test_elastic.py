@@ -1184,3 +1184,100 @@ def test_launch_local_cli_resize_defaults_from_env(monkeypatch):
     assert seen["min_workers"] == 1
     assert seen["rejoin_timeout_s"] == 7.5
     assert seen["drive_mode"] == "none"
+
+
+# ---------------------------------------------------------------------------
+# Progress watchdog — the stall verdict (round 22).
+# ---------------------------------------------------------------------------
+
+
+def _stall_gang(table, heartbeats, **kw):
+    """FakeTable.gang, but with per-worker heartbeat_fn wired (the table
+    helper predates the watchdog and does not thread it)."""
+    kw.setdefault("sleep", lambda s: None)
+    kw.setdefault("jitter", 0.0)
+    agents = [
+        ElasticAgent(
+            f"worker{i}",
+            table.spawner(i),
+            worker_id=i,
+            heartbeat_fn=heartbeats.get(i),
+        )
+        for i in range(len(table.scripts))
+    ]
+    return ElasticGang(agents, **kw)
+
+
+def test_stall_verdict_kills_member_and_recovers():
+    """A member that is alive but whose heartbeat age exceeds
+    stall_after_s draws the stalled verdict: Stall: line + stall scalar,
+    SIGKILL, and recovery through the ORDINARY gang restart."""
+    # Incarnation 0: both alive forever (worker1 stalled); inc 1: exit 0.
+    t = FakeTable({0: [[None], [0]], 1: [[None], [0]]})
+    lines, writer = [], FakeWriter()
+    gang = _stall_gang(
+        t,
+        {0: lambda: 1.0, 1: lambda: 99.0},  # worker1's beat is stale
+        max_restarts=1, stall_after_s=5.0,
+        print_fn=lines.append, summary_writer=writer,
+    )
+    assert gang.run() == 0
+    assert gang.restarts == 1
+    assert t.procs[(1, 0)].killed  # the stalled member was SIGKILLed
+    (stall,) = [l for l in lines if l.startswith("Stall:")]
+    assert "member=worker1" in stall
+    assert "heartbeat_age_s=99.0" in stall and "stall_after_s=5.0" in stall
+    (restart,) = [l for l in lines if l.startswith("Restart: restart=")]
+    assert "worker1=stalled" in restart
+    assert ("stall", 99.0, 0) in writer.scalars
+
+
+def test_stall_never_beaten_or_fresh_age_not_judged():
+    """None age (no heartbeat_fn / never beaten / probe failed) and ages
+    below the threshold are NOT judgeable evidence; stall_after_s=0 (the
+    default) disables the verdict entirely even for huge ages."""
+    t = FakeTable({0: [[None, 0]], 1: [[None, None, 0]]})
+    gang = _stall_gang(
+        t, {0: None, 1: lambda: 0.5},  # worker0 unwired, worker1 fresh
+        max_restarts=1, stall_after_s=5.0, print_fn=lambda *a: None,
+    )
+    assert gang.run() == 0 and gang.restarts == 0
+    t2 = FakeTable({0: [[None, 0]]})
+    gang2 = _stall_gang(  # default stall_after_s=0.0: watchdog off
+        t2, {0: lambda: 1e9}, max_restarts=1, print_fn=lambda *a: None,
+    )
+    assert gang2.run() == 0 and gang2.restarts == 0
+
+
+def test_stall_rc_verdict_takes_precedence():
+    """A member that DIED is judged by its exit code, never double-
+    verdicted as stalled (its heartbeat is naturally stale too)."""
+    t = FakeTable({0: [[None], [0]], 1: [[9], [0]]})
+    lines = []
+    gang = _stall_gang(
+        t, {0: lambda: 1.0, 1: lambda: 99.0},
+        max_restarts=1, stall_after_s=5.0, print_fn=lines.append,
+    )
+    assert gang.run() == 0
+    assert not any(l.startswith("Stall:") for l in lines)
+    (restart,) = [l for l in lines if l.startswith("Restart: restart=")]
+    assert "worker1=rc=9" in restart
+
+
+def test_stall_broken_probe_is_not_a_verdict():
+    """heartbeat_fn raising is a broken probe, not a stall."""
+    def _boom():
+        raise OSError("probe host gone")
+
+    t = FakeTable({0: [[None, 0]]})
+    gang = _stall_gang(
+        t, {0: _boom}, max_restarts=1, stall_after_s=5.0,
+        print_fn=lambda *a: None,
+    )
+    assert gang.run() == 0 and gang.restarts == 0
+
+
+def test_stall_validation_rejects_negative():
+    t = FakeTable({0: [[0]]})
+    with pytest.raises(ValueError):
+        _stall_gang(t, {}, stall_after_s=-1.0)

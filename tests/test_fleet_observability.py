@@ -431,6 +431,51 @@ def test_launch_local_gang_journals_merge_with_restart(tmp_path):
         assert isinstance(e["pid"], int) and "ph" in e
 
 
+def test_gang_heartbeats_summarize_as_last_progress(tmp_path):
+    """Round 22 (progress watchdog): per-rank heartbeat events become a
+    last_progress {step, age_s} summary (age vs the merged timeline's
+    newest event), stay OUT of the lifecycle history and OUT of the skew
+    anchors, and render on the --gang report's per-rank lines."""
+    t0 = 1000.0
+    restart = dict(restart=1, max_restarts=2, cause="worker1=rc=1",
+                   backoff_s=0.5)
+    drv = obs.EventJournal.in_dir(str(tmp_path), run_id="drv")
+    drv._clock = lambda: t0 + 20.0
+    drv.emit("restart", **restart)
+    drv.close()
+    # Both ranks beat at step 5 at DIFFERENT wall times: if heartbeats
+    # were skew anchors, the 6 s delta would be misread as clock skew.
+    for rank, beats in ((0, ((t0 + 4.0, 3), (t0 + 10.0, 5))),
+                        (1, ((t0 + 16.0, 5),))):
+        j = obs.EventJournal(
+            obs.rank_journal_path(str(tmp_path), rank), rank=rank
+        )
+        for ts, step in beats:
+            j._clock = lambda ts=ts: ts
+            j.emit("heartbeat", rank=rank, step=step)
+        j._clock = lambda: t0 + 20.0
+        j.emit("restart", **restart)  # the real shared anchor
+        j.close()
+    merged = aggregate.merge(str(tmp_path))
+    assert merged["skew_s"]["rank0"] == 0.0
+    assert merged["skew_s"]["rank1"] == 0.0
+    summary = aggregate.fleet_summary(merged)
+    # Newest merged ts is the restart at t0+20.
+    assert summary["ranks"]["rank0"]["last_progress"] == {
+        "step": 5, "age_s": pytest.approx(10.0)
+    }
+    assert summary["ranks"]["rank1"]["last_progress"] == {
+        "step": 5, "age_s": pytest.approx(4.0)
+    }
+    assert "last_progress" not in summary["ranks"]["driver"]
+    # Beats never flood the lifecycle history.
+    assert all(h["kind"] != "heartbeat" for h in summary["lifecycle"])
+    rendered = obs_report.render_gang(summary)
+    assert "rank0: " in rendered
+    assert "last progress step 5 (10.0s ago)" in rendered
+    assert "last progress step 5 (4.0s ago)" in rendered
+
+
 def test_launch_local_metrics_port_scrapes_live_gang(tmp_path):
     """Acceptance: /metrics over HTTP DURING a live gang run returns
     Prometheus text (world_size gauge et al.)."""

@@ -403,13 +403,35 @@ def legacy_lifecycle_line(kind, **fields):
     return obs_format.render(kind, obs.NullJournal().emit(kind, **fields))
 
 
+def test_round22_lifecycle_renderers():
+    """Watchdog + preemption-variant lines (round 22). The default
+    preemption line stays byte-identical (LEGACY_LIFECYCLE above); the
+    disarmed and saved_step variants are additive."""
+    assert legacy_lifecycle_line(
+        "preemption", disarmed="non-main thread"
+    ) == ["Preemption: disarmed (non-main thread)"]
+    assert legacy_lifecycle_line("preemption", signal=15, saved_step=70) == [
+        "Preemption: signal=15 stop_requested=1 — finishing the current "
+        "epoch, saving, exiting (signal again to force) saved_step=70"
+    ]
+    assert legacy_lifecycle_line("heartbeat", rank=2, step=400) == [
+        "Heartbeat: rank=2 step=400"
+    ]
+    assert legacy_lifecycle_line(
+        "stall", member="worker1", age_s=42.125, stall_after_s=30.0
+    ) == [
+        "Stall: member=worker1 heartbeat_age_s=42.1 stall_after_s=30.0 "
+        "— killing and recovering through the elastic path"
+    ]
+
+
 # ---------------------------------------------------------------------------
 # Grep-lint: structured-line literals only inside observability/format.py.
 # ---------------------------------------------------------------------------
 
 _STRUCTURED_LITERAL = re.compile(
-    r"""["']f?(Restart|Resize|Rollback|Preemption|Restore):|"""
-    r"""f["'](Restart|Resize|Rollback|Preemption|Restore):"""
+    r"""["']f?(Restart|Resize|Rollback|Preemption|Restore|Stall|Heartbeat):|"""
+    r"""f["'](Restart|Resize|Rollback|Preemption|Restore|Stall|Heartbeat):"""
 )
 
 

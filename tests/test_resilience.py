@@ -32,6 +32,22 @@ from distributed_tensorflow_tpu.train.supervisor import (
     latest_checkpoint_step,
 )
 
+
+@pytest.fixture(scope="module", autouse=True)
+def _no_persistent_cache():
+    """XLA:CPU AOT cache-LOAD bug, round-22 manifestation (see
+    docs/known_issues.md): with a WARM persistent cache, the
+    checkpoint-restore round trips in this module flake ~50% standalone
+    (pre-round-22 HEAD: 4/8 runs) — either a segfault in a later
+    lowering or a restored state whose int32 step reads back the f32
+    -inf bit pattern (-8388608). Cache-off runs are deterministic
+    (0/6+), so this module opts out like test_lm_trainer.py; keep it in
+    conftest._CACHE_OPT_OUT_FIRST."""
+    old = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    yield
+    jax.config.update("jax_enable_compilation_cache", old)
+
 _QUIET = dict(print_fn=lambda *a, **k: None)
 
 
@@ -634,3 +650,286 @@ def test_lm_tokenizer_json_refuses_mismatch(tmp_path):
     assert BPETokenizer.load(
         os.path.join(ck, "tokenizer.json")
     ).merges == tok_a.merges
+
+
+# ---------------------------------------------------------------------------
+# Async checkpoint pipeline (round 22).
+# ---------------------------------------------------------------------------
+
+
+def test_async_writer_supersedes_queued():
+    """Depth-1 bound: while a write is in flight, a second submit queues
+    and a third REPLACES it — disk receives newest, never a backlog."""
+    gate, executed = threading.Event(), []
+
+    def slow(tag):
+        def _run():
+            gate.wait(10)
+            executed.append(tag)
+
+        return _run
+
+    w = R.AsyncCheckpointWriter()
+    try:
+        w.submit(slow(1), tag=1)
+        # Wait until 1 is IN FLIGHT (popped off pending) so 2 queues
+        # behind it rather than superseding nothing.
+        deadline = time.time() + 5
+        while w._pending is not None and time.time() < deadline:
+            time.sleep(0.001)
+        w.submit(lambda: executed.append(2), tag=2)
+        w.submit(lambda: executed.append(3), tag=3)  # supersedes 2
+        gate.set()
+        w.wait_pending()
+        assert executed == [1, 3]
+        assert w.superseded == 1
+    finally:
+        gate.set()
+        w.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        w.submit(lambda: None)
+
+
+def test_async_writer_defers_error_to_wait():
+    w = R.AsyncCheckpointWriter()
+    try:
+        w.submit(lambda: (_ for _ in ()).throw(OSError("disk gone")))
+        with pytest.raises(OSError, match="disk gone"):
+            w.wait_pending()
+        # The error was surfaced ONCE and the writer still works.
+        w.wait_pending()
+        done = []
+        w.submit(lambda: done.append(1))
+        w.wait_pending()
+        assert done == [1]
+    finally:
+        w.close()
+
+
+def test_async_save_state_identical_to_sync(tmp_path):
+    """The parity oracle: orbax itself embeds nondeterminism (content-
+    hashed data files, timestamps) so raw-byte equality does not hold
+    even sync-vs-sync; the strongest true claim — pinned here — is STATE
+    identity: byte-equal per-leaf CRC manifest sections, mutual
+    verification, and bitwise-identical restored states."""
+    d1, d2 = str(tmp_path / "sync"), str(tmp_path / "async")
+    s_sync = Supervisor(is_chief=True, checkpoint_dir=d1)
+    s_async = Supervisor(is_chief=True, checkpoint_dir=d2,
+                         async_checkpoint=True)
+    st = _state(5)
+    s_sync.save(st, 5, layout={"mode": "sync"})
+    s_async.save(st, 5, layout={"mode": "sync"})
+    s_async.wait_pending()
+    m1, m2 = R.load_manifest(d1, 5), R.load_manifest(d2, 5)
+    assert m1["leaves"] == m2["leaves"]
+    assert R.verify_files(d1, 5) is True and R.verify_files(d2, 5) is True
+    st1, r1 = Supervisor(checkpoint_dir=d1).prepare_or_restore(_state(0))
+    st2, r2 = Supervisor(
+        checkpoint_dir=d2, async_checkpoint=True
+    ).prepare_or_restore(_state(0))
+    assert (r1, r2) == (5, 5)
+    for a, b in zip(jax.tree.leaves(st1), jax.tree.leaves(st2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # Each path's restore even verifies against the OTHER's manifest.
+    assert R.verify_leaves(st1, m2) is True
+    assert R.verify_leaves(st2, m1) is True
+    # Layout sidecars agree too (cross-topology restore can't tell).
+    assert s_sync.saved_layout(5) == {"mode": "sync"}
+    assert Supervisor(checkpoint_dir=d2).saved_layout(5) == {"mode": "sync"}
+
+
+def test_async_reads_drain_writes(tmp_path):
+    """Restore entry points wait for the in-flight write: an undrained
+    read would see a manifest-less (→ 'trusted') half-written step."""
+    from distributed_tensorflow_tpu.train import failpoints
+
+    d = str(tmp_path / "ck")
+    sup = Supervisor(is_chief=True, checkpoint_dir=d, async_checkpoint=True)
+    try:
+        failpoints.configure("ckpt.async:delay=0.3")
+        sup.save(_state(7), 7)
+        # No sleep: the read itself must drain the 0.3 s-delayed write.
+        assert sup.newest_restorable_step() == 7
+        assert sup.latest_step(verify=True) == 7
+    finally:
+        failpoints.configure(None)
+        sup.stop()
+
+
+def test_async_gc_ordered_behind_writes_and_supersession(tmp_path):
+    """keep_last_n GC runs inside the writer's lock after each manifest
+    commit: whatever subset of steps actually lands (supersession may
+    drop intermediates), the newest landed step is committed + verified
+    and retention holds."""
+    from distributed_tensorflow_tpu.train import failpoints
+
+    d = str(tmp_path / "ck")
+    sup = Supervisor(
+        is_chief=True, checkpoint_dir=d, keep_last_n=1, async_checkpoint=True
+    )
+    try:
+        failpoints.configure("ckpt.async:delay=0.05@1+")
+        for s in (1, 2, 3, 4):
+            sup.save(_state(s), s)
+        sup.wait_pending()
+    finally:
+        failpoints.configure(None)
+        sup.stop()
+    steps = checkpoint_steps(d)
+    assert steps and steps[-1] == 4  # newest snapshot always lands
+    assert latest_checkpoint_step(d, verify=True) == 4
+    assert len(steps) <= 2  # keep_last_n=1 (+ at most the in-flight one)
+
+
+def test_ckpt_async_failpoint_raise_and_fallback_restore(tmp_path):
+    """Satellite (a): ckpt.async raise = the writer dies before
+    serializing — the queued step never lands, the error surfaces at the
+    drain, and restore falls back to the previous committed step."""
+    from distributed_tensorflow_tpu.train import failpoints
+
+    d = str(tmp_path / "ck")
+    sup = Supervisor(is_chief=True, checkpoint_dir=d, async_checkpoint=True)
+    sup.save(_state(1), 1)
+    sup.wait_pending()
+    try:
+        failpoints.configure("ckpt.async:raise")
+        sup.save(_state(2), 2)
+        with pytest.raises(failpoints.FailpointError):
+            sup.wait_pending()
+    finally:
+        failpoints.configure(None)
+        sup.stop()
+    assert checkpoint_steps(d) == [1]  # step 2 never landed
+    st, step = Supervisor(checkpoint_dir=d).prepare_or_restore(_state(0))
+    assert step == 1
+
+
+def test_ckpt_manifest_torn_falls_back_with_warning(tmp_path):
+    """Satellite (a): ckpt.manifest:torn@N — the storage layer corrupts a
+    COMMITTED manifest; restore skips the torn step newest→oldest with
+    the existing RuntimeWarning naming it."""
+    from distributed_tensorflow_tpu.train import failpoints
+
+    d = str(tmp_path / "ck")
+    sup = Supervisor(is_chief=True, checkpoint_dir=d)
+    try:
+        # Hit counting starts at arming: arm BEFORE both saves so the
+        # second save's manifest write is hit 2 (fire() does not count
+        # hits while no spec is armed).
+        failpoints.configure("ckpt.manifest:torn@2")  # tear save #2's
+        sup.save(_state(1), 1)
+        sup.save(_state(2), 2)
+    finally:
+        failpoints.configure(None)
+    assert R.verify_files(d, 2) is False
+    with pytest.warns(RuntimeWarning, match="step_2"):
+        st, step = Supervisor(checkpoint_dir=d).prepare_or_restore(_state(0))
+    assert step == 1
+
+
+# ---------------------------------------------------------------------------
+# Emergency preemption snapshot + watchdog primitives (round 22).
+# ---------------------------------------------------------------------------
+
+
+def test_emergency_save_persists_uncommitted_snapshot(tmp_path):
+    d = str(tmp_path / "ck")
+    sup = Supervisor(is_chief=True, checkpoint_dir=d, async_checkpoint=True)
+    sup.save(_state(1), 1)
+    sup.wait_pending()
+    # Already-committed snapshot: emergency save reports it, writes nothing.
+    assert sup.emergency_save() == 1
+    # Simulate a snapshot whose write never landed (superseded / writer
+    # died): the handler-frame path writes it durably, quiet.
+    host = jax.device_get(_state(2))
+    sup._last_snapshot = (host, 2, None)
+    assert sup.emergency_save() == 2
+    assert R.verify_files(d, 2) is True
+    # Mid-save reentrancy guard: a signal interrupting a main-thread save
+    # must not deadlock on the write lock — it skips.
+    sup._saving = True
+    assert sup.emergency_save() is None
+    sup._saving = False
+    sup.stop()
+    # No snapshot at all (fresh supervisor): None.
+    s2 = Supervisor(is_chief=True, checkpoint_dir=str(tmp_path / "ck2"),
+                    async_checkpoint=True)
+    assert s2.emergency_save() is None
+
+
+def test_preemption_handler_reports_saved_step(tmp_path):
+    d = str(tmp_path / "ck")
+    sup = Supervisor(is_chief=True, checkpoint_dir=d, async_checkpoint=True)
+    sup._last_snapshot = (jax.device_get(_state(3)), 3, None)
+    lines = []
+    with R.preemption_guard(sup, print_fn=lines.append) as handler:
+        handler(signal.SIGTERM, None)
+    assert sup.should_stop
+    assert lines and lines[0].endswith(" saved_step=3")
+    assert R.verify_files(d, 3) is True
+    sup.stop()
+
+
+def test_preemption_guard_disarmed_off_main_thread():
+    """Satellite (b): the round-6 silent no-op off the main thread is now
+    one loud line."""
+    sup = Supervisor()
+    lines, holder = [], {}
+
+    def _run():
+        with R.preemption_guard(sup, print_fn=lines.append) as h:
+            holder["h"] = h
+
+    t = threading.Thread(target=_run)
+    t.start()
+    t.join()
+    assert holder["h"] is None
+    assert lines == ["Preemption: disarmed (non-main thread)"]
+
+
+def test_touch_heartbeat_creates_bumps_never_raises(tmp_path):
+    p = str(tmp_path / "w0.heartbeat")
+    assert R.touch_heartbeat(p) is True  # first beat creates
+    t0 = os.path.getmtime(p)
+    time.sleep(0.02)
+    assert R.touch_heartbeat(p) is True  # subsequent beats bump mtime
+    assert os.path.getmtime(p) >= t0
+    assert R.touch_heartbeat("") is False
+    assert R.touch_heartbeat(str(tmp_path / "no" / "dir" / "x")) is False
+
+
+def test_arm_stall_dump_dumps_all_threads_on_sigusr1(tmp_path):
+    p = str(tmp_path / "w0.stalldump")
+    try:
+        assert R.arm_stall_dump(p) == p
+        os.kill(os.getpid(), signal.SIGUSR1)
+        time.sleep(0.1)
+        with open(p) as f:
+            dump = f.read()
+        assert "Thread" in dump or "Stack" in dump
+    finally:
+        R.disarm_stall_dump()
+    # Unset env = disarmed.
+    old = os.environ.pop("DTF_STALL_DUMP", None)
+    try:
+        assert R.arm_stall_dump() is None
+    finally:
+        if old is not None:
+            os.environ["DTF_STALL_DUMP"] = old
+
+
+def test_report_progress_beats_heartbeat_file(tmp_path, monkeypatch):
+    p = str(tmp_path / "w0.heartbeat")
+    monkeypatch.setenv("DTF_HEARTBEAT_FILE", p)
+    sup = Supervisor()
+    sup.report_progress(3)
+    assert os.path.exists(p)
+    t0 = os.path.getmtime(p)
+    time.sleep(0.02)
+    sup.report_progress(4)
+    assert os.path.getmtime(p) >= t0
+    # Default-off: no env var, no file I/O.
+    monkeypatch.delenv("DTF_HEARTBEAT_FILE")
+    sup2 = Supervisor()
+    sup2.report_progress(1)
+    assert sup2._heartbeat_file is None
