@@ -2502,6 +2502,73 @@ class GPTLM:
         return jnp.concatenate([prompt, best_seq], axis=1)
 
 
+def export_kv_blocks(cache: PagedKVCache, block_ids) -> dict:
+    """Lift the named pool blocks out of a :class:`PagedKVCache` as host
+    arrays — the wire half of the round-23 prefill→decode handoff. The
+    payload carries the EXACT storage-dtype bytes (bf16, or the int8/fp8
+    1-byte elements plus their per-row f32 scale side tensors at the
+    same block coordinates), so an import followed by attention
+    reproduces the source replica's dequantized values bit-for-bit (the
+    round-15 uniform rule is what makes the migrated stream
+    token-identical). ``block_ids`` must be valid pool indices — export
+    has no sentinel (you cannot export a block you never wrote).
+
+    Returns ``{"k", "v"[, "k_scale", "v_scale"]}`` with payload shape
+    ``[num_layers, n, block_size, Hkv, Dh]`` (scales one axis fewer)."""
+    ids = jnp.asarray(block_ids, jnp.int32)
+    if ids.ndim != 1:
+        raise ValueError(f"block_ids must be 1-D, got shape {ids.shape}")
+    out = {"k": cache.k[:, ids], "v": cache.v[:, ids]}
+    if cache.k_scale is not None:
+        out["k_scale"] = cache.k_scale[:, ids]
+        out["v_scale"] = cache.v_scale[:, ids]
+    return out
+
+
+def import_kv_blocks(cache: PagedKVCache, block_ids, blocks: dict) -> PagedKVCache:
+    """Write exported block payloads into this pool at ``block_ids`` —
+    the receiving half of :func:`export_kv_blocks`. Values land verbatim
+    in storage dtype (scale side pools ride the same index math, one
+    fewer axis), so export→import round-trips bit-exactly.
+
+    Sentinel rule (round 11): an id equal to ``num_blocks`` DROPS that
+    payload row instead of writing it — never ``-1``, which JAX wraps to
+    the last real block and corrupts it silently. Implemented the way
+    the runtime scatters do: the pool is extended by one garbage block
+    at index ``num_blocks`` that the final slice discards."""
+    ids = jnp.asarray(block_ids, jnp.int32)
+    nb = cache.k.shape[1]
+    if bool(jnp.any((ids < 0) | (ids > nb))):
+        raise ValueError(
+            f"block id out of range [0, {nb}] (sentinel={nb} drops; -1 "
+            "would wrap and corrupt the last block)"
+        )
+
+    def put(pool, payload):
+        if payload.shape[1:] != (ids.shape[0],) + pool.shape[2:]:
+            raise ValueError(
+                f"payload shape {payload.shape} does not match pool "
+                f"{pool.shape} over {ids.shape[0]} blocks"
+            )
+        ext = jnp.concatenate([pool, jnp.zeros_like(pool[:, :1])], axis=1)
+        ext = ext.at[:, ids].set(jnp.asarray(payload).astype(pool.dtype))
+        return ext[:, :nb]
+
+    has_scale = cache.k_scale is not None
+    if has_scale != ("k_scale" in blocks):
+        raise ValueError(
+            "scale side tensors must travel with a quantized pool and "
+            "only with one (pool has scales: %s, payload has: %s)"
+            % (has_scale, "k_scale" in blocks)
+        )
+    return cache._replace(
+        k=put(cache.k, blocks["k"]),
+        v=put(cache.v, blocks["v"]),
+        k_scale=put(cache.k_scale, blocks["k_scale"]) if has_scale else None,
+        v_scale=put(cache.v_scale, blocks["v_scale"]) if has_scale else None,
+    )
+
+
 def _picked_nll(logits32, targets):
     """Per-position negative log-likelihood ``logsumexp(x) − x[target]``
     with the pick as a fused compare-and-reduce over the vocab axis, NOT

@@ -57,6 +57,11 @@ GANG_KINDS = (
     "breaker_open",
     "breaker_half_open",
     "breaker_close",
+    # Round 23 (disaggregated fleet): the role map is a one-shot
+    # router-journal lifecycle moment, mirrored like the other fleet
+    # kinds. Per-request migration kinds (request_migrated,
+    # kv_migration) stay OUT, like request_route.
+    "fleet_roles",
     # Round 22 (progress watchdog): "stall" is a true gang moment — the
     # driver's verdict on a frozen member, recorded once, mirrored on
     # every track. "heartbeat" rides in GANG_KINDS for the report's
@@ -345,6 +350,14 @@ def fleet_summary(merged: dict) -> dict:
                 "step": last.get("step"),
                 "age_s": round(ts_newest - last["ts"], 3),
             }
+    # Round 23 (disaggregated fleet): tag each replica's rank row with
+    # its role from the router's one-shot fleet_roles event, so the
+    # report reads "replica0 [prefill]: ..." without a separate join.
+    for ev in merged["events"]:
+        if ev.get("kind") == "fleet_roles":
+            for name, role in (ev.get("roles") or {}).items():
+                if name in per_rank:
+                    per_rank[name]["role"] = role
     lifecycle = []
     for ev in merged["events"]:
         kind = ev.get("kind")

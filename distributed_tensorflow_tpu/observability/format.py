@@ -230,6 +230,30 @@ def _breaker_close(ev: dict) -> str:
     return f"Breaker: close replica={ev['replica']}"
 
 
+def _fleet_roles(ev: dict) -> str:
+    # Round 23 (disaggregated fleet): the role map, recorded once at
+    # router construction.
+    roles = ev.get("roles") or {}
+    body = " ".join(f"{k}={v}" for k, v in sorted(roles.items()))
+    return f"Fleet: roles {body} migrate_dir={ev.get('migrate_dir')}"
+
+
+def _request_migrated(ev: dict) -> str:
+    return (
+        f"Migrate: trace={ev.get('trace')} from={ev.get('from_replica')} "
+        f"post={ev.get('post')} blocks={ev.get('blocks')} "
+        f"nbytes={ev.get('nbytes')}"
+    )
+
+
+def _kv_migration(ev: dict) -> str:
+    line = f"KV-migration: phase={ev.get('phase')} trace={ev.get('trace')}"
+    for k in ("slot", "blocks", "nbytes", "wall_ms", "file", "reason"):
+        if k in ev:
+            line += f" {k}={ev[k]}"
+    return line
+
+
 def _failpoint(ev: dict) -> str:
     # Round 19 (train/failpoints.py): an injected fault fired.
     return (
@@ -262,6 +286,9 @@ RENDERERS = {
     "breaker_open": _breaker_open,
     "breaker_half_open": _breaker_half_open,
     "breaker_close": _breaker_close,
+    "fleet_roles": _fleet_roles,
+    "request_migrated": _request_migrated,
+    "kv_migration": _kv_migration,
 }
 
 

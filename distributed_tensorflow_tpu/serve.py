@@ -256,6 +256,7 @@ class _Request:
     __slots__ = (
         "rid", "tokens", "config", "out", "done", "trace", "cancelled",
         "shed", "priority", "deadline", "t_submit", "t_admit", "t_first",
+        "prefill_only", "resume", "export", "migrated",
     )
 
     def __init__(
@@ -267,6 +268,16 @@ class _Request:
         self.out: list[int] = []
         self.done = False
         self.cancelled = False
+        # Disaggregated-fleet handoff state (round 23, docs/serving.md
+        # §disaggregation): prefill_only requests stop after the
+        # prefill's first token and EXPORT their paged KV + sampling
+        # state (``export`` holds the payload until take_export);
+        # ``resume`` carries an imported payload — admission skips
+        # prefill and continues the chunk scan from it.
+        self.prefill_only = False
+        self.resume = None
+        self.export = None
+        self.migrated = False
         # Shed (round 21): dropped by the scheduler WITHOUT spending a
         # dispatch — terminal like cancelled, but typed RequestShed.
         self.shed = False
@@ -947,6 +958,9 @@ class TextServer:
         deadline_s: float | None = None,
         priority: int = 0,
         trace: str | None = None,
+        prefill_only: bool = False,
+        resume: dict | None = None,
+        emitted_tokens=None,
     ) -> int:
         """Queue one request (prompt as a 1-D int token array). Returns a
         request id for :meth:`result`. Validates against the bucket/cache
@@ -975,7 +989,23 @@ class TextServer:
         ``trace`` overrides the generated trace id so a fleet router's
         retries keep one id across replicas. Raises :class:`QueueFull`
         when the queue is at ``queue_limit`` with no lower class to
-        shed, and RuntimeError once :meth:`drain` closed admission."""
+        shed, and RuntimeError once :meth:`drain` closed admission.
+
+        Disaggregated handoff (round 23, docs/serving.md
+        §disaggregation; both knobs require ``paged=True`` — block
+        tables are what make the cache relocatable):
+
+        - ``prefill_only=True``: run prefill + the first token, then
+          EXPORT the request's written KV blocks + sampling state
+          (:meth:`take_export`) and free the slot — the prefill leg of
+          a two-leg fleet request. A request that FINISHES at prefill
+          (budget 1 / immediate EOS) completes normally instead.
+        - ``resume=payload``: admit a mid-flight request — the decode
+          leg. The payload (an export from a prefill replica, same
+          model geometry) is imported into freshly reserved blocks and
+          the chunk scan continues token-identically.
+          ``emitted_tokens`` seeds the output with leg 1's tokens so
+          :meth:`result` returns the complete stream."""
         config = config or GenerationConfig()
         priority = int(priority)
         if priority < 0:
@@ -1004,6 +1034,18 @@ class TextServer:
                     f"{self.kv_blocks}; raise kv_blocks or shrink the "
                     "request"
                 )
+        if (prefill_only or resume is not None) and not self.paged:
+            raise ValueError(
+                "KV migration requires paged=True (block tables are what "
+                "make the cache relocatable across replicas)"
+            )
+        if prefill_only and resume is not None:
+            raise ValueError(
+                "prefill_only and resume are the two LEGS of one request "
+                "— a submit is at most one of them"
+            )
+        if resume is not None:
+            self._validate_resume(resume, tokens, config)
         if self._draining:
             raise RuntimeError(
                 "server is draining: admission is closed (residents are "
@@ -1056,6 +1098,17 @@ class TextServer:
             rid, tokens, config,
             trace=trace, deadline_s=deadline_s, priority=priority,
         )
+        req.prefill_only = bool(prefill_only)
+        if resume is not None:
+            req.resume = resume
+            if emitted_tokens is not None:
+                req.out = [int(t) for t in np.asarray(emitted_tokens)]
+            if len(req.out) != int(resume["meta"]["emitted"]):
+                raise ValueError(
+                    f"resume payload says {resume['meta']['emitted']} "
+                    f"tokens were emitted on leg 1 but emitted_tokens "
+                    f"carries {len(req.out)}"
+                )
         self._queue.append(req)
         self._results[rid] = req
         self.metrics.counter("requests_submitted_total").inc()
@@ -1077,6 +1130,50 @@ class TextServer:
             greedy=bool(req.config.greedy),
             **({"priority": req.priority} if req.priority else {}),
         )
+
+    def _validate_resume(self, resume: dict, tokens, config) -> None:
+        """Refuse a migration payload that cannot continue here — wrong
+        model geometry, wrong cache dtype, or inconsistent with the
+        request it claims to resume. Raises ValueError (a PERMANENT
+        rejection in the fleet protocol: the router falls back to
+        re-prefill, it does not retry the import)."""
+        meta = resume.get("meta") or {}
+        arrays = resume.get("arrays") or {}
+        want = {
+            "kv_dtype": self.kv_dtype,
+            "block_size": self.block_size,
+            "num_layers": self.model.num_layers,
+            "num_kv_heads": self.model.num_kv_heads,
+            "head_dim": self.model.head_dim,
+        }
+        for k, w in want.items():
+            if meta.get(k) != w:
+                raise ValueError(
+                    f"resume payload geometry mismatch: {k}="
+                    f"{meta.get(k)!r} but this replica serves {w!r}"
+                )
+        if int(meta.get("length", -1)) != int(tokens.size):
+            raise ValueError(
+                f"resume payload covers {meta.get('length')} positions "
+                f"but the prompt has {tokens.size}"
+            )
+        if int(meta.get("emitted", 0)) < 1:
+            raise ValueError("resume payload emitted no leg-1 token")
+        need = {"k", "v", "key"}
+        if self.kv_dtype != "bf16":
+            need |= {"k_scale", "v_scale"}
+        missing = need - set(arrays)
+        if missing:
+            raise ValueError(
+                f"resume payload missing arrays: {sorted(missing)}"
+            )
+        n_src = int(meta.get("blocks", 0))
+        if n_src != blocks_for(int(tokens.size), self.block_size):
+            raise ValueError(
+                f"resume payload carries {n_src} blocks; "
+                f"{blocks_for(int(tokens.size), self.block_size)} cover "
+                "the prompt"
+            )
 
     def _shed_victim(self, priority: int) -> _Request | None:
         """Under a full queue: the request a ``priority``-class submit may
@@ -1170,6 +1267,132 @@ class TextServer:
             "new": n_new,
         }
 
+    def _plan_import(self, req: _Request):
+        """Block reservation for a migration import: ``prompt + max_new``
+        FRESH blocks, no prefix-cache match — the payload's blocks are
+        the authoritative prompt KV (round-15 storage-dtype values), and
+        splicing locally cached prefix blocks under an imported stream
+        would trade a bitwise guarantee for a recomputed one. Same
+        eviction-under-pressure rule as :meth:`_plan_admission`."""
+        total = blocks_for(
+            int(req.tokens.size) + req.config.max_new, self.block_size
+        )
+        if not self._alloc.can_alloc(total) and self._prefix is not None:
+            deficit = total - self._alloc.free_blocks
+            if self._prefix.evictable_blocks() >= deficit:
+                self._prefix.evict(deficit)
+        if not self._alloc.can_alloc(total):
+            return None
+        return {"table": self._alloc.alloc(total), "matched": 0,
+                "new": total}
+
+    def _import_resume(self, slot: int, req: _Request, plan: dict) -> None:
+        """Admit a mid-flight request from a migration payload: write the
+        exported blocks into this pool (:func:`import_kv_blocks` — the
+        sentinel=``num_blocks`` scatter rule), restore the per-slot
+        sampling/progress rows EXACTLY as the prefill dispatch left them
+        on the source replica, and let the ordinary chunk scan continue.
+        Token parity is by construction: the blocks carry the exact
+        storage-dtype values (round-15 uniform rule) and the PRNG row is
+        the carried key after leg 1's single split."""
+        from distributed_tensorflow_tpu.models.gpt import import_kv_blocks
+
+        t0 = time.perf_counter()
+        payload = req.resume
+        meta = payload["meta"]
+        arrays = payload["arrays"]
+        table = plan["table"]
+        row = self._host_tables[slot]
+        row[:] = 0
+        row[: len(table)] = table
+        self._slot_blocks[slot] = list(table)
+        n_src = int(meta["blocks"])
+        blocks = {
+            k: arrays[k]
+            for k in ("k", "v", "k_scale", "v_scale")
+            if k in arrays
+        }
+        # Pad every import to ONE canonical block count (sentinel ids
+        # drop their zero rows): the eager scatter otherwise compiles a
+        # fresh executable per distinct payload size — a ~1 s XLA
+        # compile per prompt-length class, which the disagg bench
+        # measured as the dominant cost of the whole migration path.
+        pool = self._cache(self._state)
+        cap = blocks_for(self.model.max_len, self.block_size)
+        ids = list(int(b) for b in table[:n_src])
+        ids += [int(pool.k.shape[1])] * (cap - n_src)
+        if cap > n_src:
+            blocks = {
+                k: np.concatenate(
+                    [
+                        np.asarray(a),
+                        np.zeros(
+                            (a.shape[0], cap - n_src) + a.shape[2:],
+                            np.asarray(a).dtype,
+                        ),
+                    ],
+                    axis=1,
+                )
+                for k, a in (
+                    (k, np.asarray(a)) for k, a in blocks.items()
+                )
+            }
+        cache = import_kv_blocks(pool, ids, blocks)
+        st = self._state
+
+        def put_row(field, value):
+            a = np.asarray(getattr(st, field)).copy()
+            a[slot] = value
+            return self._commit_row(a)
+
+        c = req.config
+        self._state = st._replace(
+            k=cache.k,
+            v=cache.v,
+            k_scale=cache.k_scale,
+            v_scale=cache.v_scale,
+            block_tables=put_row("block_tables", row),
+            lengths=put_row("lengths", int(meta["length"])),
+            last_tok=put_row("last_tok", int(meta["last_tok"])),
+            key=put_row("key", np.asarray(arrays["key"])),
+            emitted=put_row("emitted", int(meta["emitted"])),
+            budget=put_row("budget", c.max_new),
+            finished=put_row("finished", False),
+            greedy=put_row("greedy", c.greedy),
+            temp=put_row("temp", c.temperature),
+            top_p=put_row("top_p", c.top_p),
+            eos=put_row("eos", -1 if c.eos_id is None else c.eos_id),
+        )
+        self._slot_req[slot] = req
+        req.t_admit = time.perf_counter()
+        nbytes = sum(
+            np.asarray(a).nbytes for a in arrays.values()
+        )
+        self.metrics.counter("admissions_total").inc()
+        self.metrics.counter("migrations_imported_total").inc()
+        self.journal.emit(
+            "admission",
+            rid=req.rid,
+            trace=req.trace,
+            slot=int(slot),
+            bucket=0,
+            prompt_len=int(req.tokens.size),
+            imported_blocks=n_src,
+            new_blocks=int(plan["new"]),
+            migrated=True,
+            queue_wait_s=round(req.t_admit - req.t_submit, 6),
+        )
+        self.journal.emit(
+            "kv_migration",
+            phase="import",
+            rid=req.rid,
+            trace=req.trace,
+            slot=int(slot),
+            blocks=n_src,
+            nbytes=int(nbytes),
+            wall_ms=round((time.perf_counter() - t0) * 1e3, 3),
+        )
+
     def _admit_member_row(
         self, slot, req, lb, key, budget, greedy, temp, top_p, eos,
         journal_extra=None,
@@ -1208,7 +1431,137 @@ class TextServer:
         self.metrics.histogram("ttft_s").observe(t_first - req.t_submit)
         req.out.append(int(first[slot]))
         if fin[slot]:
+            # A prefill_only request that FINISHES at prefill (budget 1,
+            # immediate EOS) completes normally — nothing to migrate.
             self._finish(slot)
+        elif req.prefill_only:
+            self._export_request(slot, req)
+
+    def _commit_row(self, a):
+        """Host-edited state rows must re-enter the jit as arrays
+        COMMITTED to the same device as the graph outputs they replace:
+        a raw numpy leaf keys the executable cache under unspecified
+        sharding, and the NEXT prefill/chunk dispatch silently
+        recompiles its multi-second program (same trace, different
+        executable — the round-23 disagg A/B surfaced this as a full
+        recompile after every export/import/cancel)."""
+        sharding = getattr(self._state.k, "sharding", None)
+        return jax.device_put(a, sharding)
+
+    def _export_request(self, slot: int, req: _Request) -> None:
+        """The prefill leg's terminal act: fetch the request's WRITTEN
+        KV blocks (``ceil(prompt/block_size)`` — the first generated
+        token's KV is written by the first decode step, which runs on
+        the importing replica) + the per-slot sampling/progress rows,
+        stash them as the migration payload (:meth:`take_export`), and
+        free the slot. The request is terminal HERE; the radix keeps the
+        prompt's prefix blocks warm for future prefills."""
+        from distributed_tensorflow_tpu.models.gpt import export_kv_blocks
+
+        t0 = time.perf_counter()
+        st = self._state
+        length = int(np.asarray(st.lengths[slot]))
+        n_src = blocks_for(length, self.block_size)
+        ids = self._slot_blocks[slot][:n_src]
+        # Gather at the ONE canonical block count every export shares
+        # (pad with repeats of a real id — export has no sentinel), then
+        # trim on the host: the eager gather's executable is keyed on
+        # len(ids), so per-prompt-length shapes would compile a fresh
+        # XLA program per length class at serving time. Wire bytes stay
+        # the trimmed n_src blocks.
+        cap = blocks_for(self.model.max_len, self.block_size)
+        padded = list(ids) + [int(ids[0])] * (cap - n_src)
+        arrays = {
+            k: np.asarray(v)[:, :n_src]
+            for k, v in export_kv_blocks(self._cache(st), padded).items()
+        }
+        arrays["key"] = np.asarray(st.key[slot])
+        meta = {
+            "kv_dtype": self.kv_dtype,
+            "block_size": self.block_size,
+            "num_layers": self.model.num_layers,
+            "num_kv_heads": self.model.num_kv_heads,
+            "head_dim": self.model.head_dim,
+            "length": length,
+            "blocks": n_src,
+            "last_tok": int(np.asarray(st.last_tok[slot])),
+            "emitted": int(np.asarray(st.emitted[slot])),
+            "max_new": int(req.config.max_new),
+        }
+        req.export = {"arrays": arrays, "meta": meta}
+        req.migrated = True
+        req.done = True
+        fin = np.asarray(st.finished).copy()
+        fin[slot] = True
+        self._state = self._state._replace(finished=self._commit_row(fin))
+        self._release_slot(slot)
+        nbytes = sum(a.nbytes for a in arrays.values())
+        self.metrics.counter("migrations_exported_total").inc()
+        self.journal.emit(
+            "kv_migration",
+            phase="export",
+            rid=req.rid,
+            trace=req.trace,
+            slot=int(slot),
+            blocks=n_src,
+            nbytes=int(nbytes),
+            wall_ms=round((time.perf_counter() - t0) * 1e3, 3),
+            ttft_s=round(
+                (req.t_first if req.t_first is not None else t0)
+                - req.t_submit,
+                6,
+            ),
+        )
+
+    def warm_import(self) -> None:
+        """Compile BOTH migration executables ahead of traffic: one
+        all-sentinel import against the live pool (every row drops, so
+        the pool values are untouched) plus one canonical-shape export
+        gather. `_import_resume` pads every real payload and
+        `_export_request` pads every gather to this single shape, so
+        these two programs are the only ones migration ever dispatches —
+        first-request TTFT on either leg's replica must not be an XLA
+        compile measurement (the ``--warm`` contract)."""
+        if not self.paged:
+            return
+        from distributed_tensorflow_tpu.models.gpt import (
+            export_kv_blocks,
+            import_kv_blocks,
+        )
+
+        pool = self._cache(self._state)
+        cap = blocks_for(self.model.max_len, self.block_size)
+
+        def zeros(p):
+            return np.zeros((p.shape[0], cap) + tuple(p.shape[2:]), p.dtype)
+
+        blocks = {"k": zeros(pool.k), "v": zeros(pool.v)}
+        if pool.k_scale is not None:
+            blocks["k_scale"] = zeros(pool.k_scale)
+            blocks["v_scale"] = zeros(pool.v_scale)
+        import_kv_blocks(pool, [int(pool.k.shape[1])] * cap, blocks)
+        jax.block_until_ready(
+            list(export_kv_blocks(pool, [0] * cap).values())
+        )
+
+    def take_export(self, rid: int) -> dict | None:
+        """Consume a migrated request's payload: the KV-block arrays +
+        state meta :meth:`_export_request` stashed, plus leg 1's emitted
+        tokens. Returns None when the request completed without
+        migrating (finished at prefill) — the caller then treats
+        :meth:`result` as the terminal read. A consumed or unknown rid
+        also returns None (idempotent, like a second ``result`` read is
+        not): the worker loop probes every done rid through here."""
+        req = self._results.get(rid)
+        if req is None or not req.migrated:
+            return None
+        del self._results[rid]
+        return {
+            "arrays": req.export["arrays"],
+            "meta": req.export["meta"],
+            "tokens": list(req.out),
+            "trace": req.trace,
+        }
 
     def _admit_paged(self) -> None:
         free = self._free_slots()
@@ -1220,15 +1573,32 @@ class TextServer:
         # the admission WAVE whose prefill writes its K/V this round.
         pending: dict[int, int] = {}
         bs = self.block_size
+        imports: list[tuple[int, _Request, dict]] = []
         while free and self._queue:
             req = self._queue.popleft()
-            plan = self._plan_admission(req)
+            plan = (
+                self._plan_import(req) if req.resume is not None
+                else self._plan_admission(req)
+            )
             if plan is None:
                 # No head-of-line blocking: a request the pool cannot
                 # hold yet waits WITHOUT starving shorter requests
                 # behind it (relative FIFO order is preserved both among
                 # the admitted and among the skipped).
                 skipped.append(req)
+                continue
+            if req.resume is not None:
+                # Migration import (round 23): the payload's device
+                # writes land synchronously below, BEFORE any of this
+                # round's prefill waves dispatch — so the radix entries
+                # registered here are valid for every same-round reader
+                # without joining the wave dependency graph.
+                if self._prefix is not None:
+                    self._prefix.insert(
+                        req.tokens, plan["table"],
+                        int(req.tokens.size) // bs,
+                    )
+                imports.append((free.pop(0), req, plan))
                 continue
             # Register the planned full PROMPT blocks in the radix NOW —
             # round 11 registered post-prefill, so N cold requests
@@ -1257,7 +1627,12 @@ class TextServer:
         skipped.extend(self._queue)
         self._queue = skipped
         self.metrics.gauge("queue_depth").set(len(self._queue))
+        for slot, req, plan in imports:
+            self._import_resume(slot, req, plan)
         if not batch:
+            self.metrics.gauge("kv_blocks_used").set(
+                self._alloc.used_blocks
+            )
             return
         for slot, req, plan, wave in batch:
             row = self._host_tables[slot]
@@ -1428,7 +1803,9 @@ class TextServer:
         if slot is not None:
             fin = np.asarray(self._state.finished).copy()
             fin[slot] = True
-            self._state = self._state._replace(finished=fin)
+            self._state = self._state._replace(
+                finished=self._commit_row(fin)
+            )
             self._release_slot(slot)
         self.metrics.counter("cancellations_total").inc()
         self.journal.emit(
@@ -1449,7 +1826,11 @@ class TextServer:
         of the request, so only truly unreachable deadlines trip it."""
         if req.deadline is None or self._tok_ewma is None:
             return False
-        return req.config.max_new * self._tok_ewma > req.deadline - now
+        # Remaining budget, not max_new: a resumed decode leg already
+        # carries leg 1's tokens (round 23) — its remaining work is
+        # what the deadline must cover.
+        remaining = req.config.max_new - len(req.out)
+        return remaining * self._tok_ewma > req.deadline - now
 
     def _shed_overdue(self) -> None:
         """Queued-side deadline enforcement at the chunk boundary (round
@@ -1838,6 +2219,13 @@ class TextServer:
             raise RequestCancelled(
                 f"request {rid} was cancelled at a chunk boundary "
                 "(deadline exceeded)"
+            )
+        if req.migrated:
+            # NOT consumed: take_export() owns this record — result()
+            # must not destroy the payload a confused caller probed.
+            raise RuntimeError(
+                f"request {rid} migrated — take_export() owns its "
+                "payload; the decode leg's result is the stream"
             )
         if not req.done:
             raise RuntimeError(f"request {rid} is not finished")

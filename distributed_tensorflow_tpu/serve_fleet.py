@@ -329,6 +329,160 @@ class MailboxClient:
         failpoints.tear("fleet.result", path)
 
 
+def _np_dtype(name: str):
+    """Resolve a dtype name, reaching into ml_dtypes for the storage
+    dtypes numpy alone does not know (fp8 variants, bfloat16)."""
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+class MigrationStore:
+    """Shared directory of KV-migration posts (round 23,
+    docs/serving.md §disaggregation): one CRC-enveloped npz file per
+    prefill→decode handoff. File layout: a canonical-JSON header line
+    ``{"meta":…, "tokens":…, "trace":…, "crc":…, "nbytes":…}`` followed
+    by the raw npz bytes of the KV-block arrays — the CRC covers the npz
+    body, so a torn write (truncated past the atomic commit by the
+    ``fleet.migrate`` failpoint, or real storage rot) is detected at
+    LOAD and quarantined once: removed, counted in ``corrupt_files``,
+    journaled as ``mailbox_corrupt`` with ``mailbox="migrate"`` —
+    never delivered and never re-read forever (the round-19 discipline).
+    The importer does NOT delete a loaded post: the ROUTER owns the
+    file's lifetime (removed when the request is terminal), so a decode
+    replica dying mid-stream re-imports the same post on failover.
+
+    jax-free; numpy is imported lazily (the router constructs the store
+    but only replica workers move arrays through it)."""
+
+    def __init__(self, root: str, *, journal=None, metrics=None):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self.journal = journal
+        self.metrics = metrics
+        self.corrupt_files = 0
+        resilience.sweep_tmp_orphans(root, age_s=60.0)
+
+    def path(self, name: str) -> str:
+        return os.path.join(self.root, name)
+
+    def post(self, name: str, payload: dict) -> str:
+        """Commit one migration post atomically (tmp + ``os.replace``).
+        ``payload`` is a ``TextServer.take_export`` dict: ``arrays``
+        (name → ndarray), ``meta``, ``tokens``, ``trace``. Raises
+        OSError (incl. FailpointError) on failure — the caller falls
+        back to migration-less handoff, never loses the request."""
+        import io
+
+        import numpy as np
+
+        failpoints.fire("fleet.migrate")
+        arrays: dict = {}
+        exotic: dict = {}
+        for k, v in payload["arrays"].items():
+            a = np.asarray(v)
+            if a.dtype.kind == "V":
+                # ml_dtypes storage dtypes (fp8/bf16) do not survive
+                # np.savez (they load back as opaque void) — ship the
+                # raw bytes as uint8 and rebuild from the header's
+                # dtype+shape at load (the round-17 mailbox discipline).
+                exotic[k] = {"dtype": a.dtype.name, "shape": list(a.shape)}
+                a = np.frombuffer(a.tobytes(), np.uint8)
+            arrays[k] = a
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        body = buf.getvalue()
+        head = {
+            "meta": payload["meta"],
+            "tokens": [int(t) for t in payload["tokens"]],
+            "trace": payload.get("trace"),
+            "crc": resilience._crc32c_bytes(body),
+            "nbytes": len(body),
+        }
+        if exotic:
+            head["exotic"] = exotic
+        path = self.path(name)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(json.dumps(head, sort_keys=True).encode("utf-8"))
+            f.write(b"\n")
+            f.write(body)
+        os.replace(tmp, path)
+        failpoints.tear("fleet.migrate", path)
+        return name
+
+    def load(self, name: str) -> dict | None:
+        """Read + verify one post. Returns the payload dict (arrays
+        rehydrated), or None when the file is missing (already cleaned
+        up) OR corrupt — corrupt commits are quarantined once, and the
+        caller's contract is the same either way: fall back to
+        re-prefill from the tokens+config that travel with the request
+        (zero loss, round-19 stance)."""
+        import io
+
+        import numpy as np
+
+        path = self.path(name)
+        try:
+            with open(path, "rb") as f:
+                header = f.readline()
+                body = f.read()
+        except OSError:
+            return None
+        try:
+            head = json.loads(header)
+            if len(body) != int(head["nbytes"]) or (
+                resilience._crc32c_bytes(body) != head["crc"]
+            ):
+                raise ValueError("crc/size mismatch")
+            with np.load(io.BytesIO(body)) as z:
+                arrays = {k: z[k] for k in z.files}
+            for k, spec in (head.get("exotic") or {}).items():
+                arrays[k] = np.frombuffer(
+                    arrays[k].tobytes(), _np_dtype(spec["dtype"])
+                ).reshape(spec["shape"])
+        except (ValueError, KeyError, TypeError) as exc:
+            self._quarantine(name, path, f"{type(exc).__name__}")
+            return None
+        return {
+            "arrays": arrays,
+            "meta": head["meta"],
+            "tokens": head["tokens"],
+            "trace": head.get("trace"),
+        }
+
+    def remove(self, name: str) -> None:
+        try:
+            os.remove(self.path(name))
+        except OSError:
+            pass
+
+    def _quarantine(self, name: str, path: str, reason: str) -> None:
+        try:
+            os.remove(path)
+        except OSError:  # pragma: no cover
+            pass
+        self.corrupt_files += 1
+        if self.metrics is not None:
+            self.metrics.counter("mailbox_corrupt_files_total").inc()
+        j = self.journal if self.journal is not None else (
+            obs_journal.get_journal()
+        )
+        j.emit(
+            "mailbox_corrupt",
+            mailbox="migrate",
+            box="migrate",
+            file=name,
+            reason=reason,
+            action="quarantined",
+        )
+
+
 # ---------------------------------------------------------------------------
 # The router.
 # ---------------------------------------------------------------------------
@@ -339,6 +493,7 @@ class _FleetRequest:
         "rid", "trace", "tokens", "config", "deadline", "deadline_s",
         "t_submit", "replica", "attempts", "done", "cancelled", "failed",
         "shed", "priority", "out", "t_done", "t_routed",
+        "leg", "resume_post", "prefill_replica", "leg1_tokens",
     )
 
     def __init__(self, rid, trace, tokens, config, deadline, deadline_s,
@@ -360,6 +515,14 @@ class _FleetRequest:
         self.out: list[int] | None = None
         self.t_done: float | None = None
         self.t_routed: float | None = None  # last route, breaker timeout
+        # Disaggregated two-leg lifecycle (round 23): "single" in a
+        # homogeneous fleet (byte-identical round-21 path); a role fleet
+        # routes leg "prefill" first, then — after the prefill replica's
+        # migrated result — leg "decode" with the migration post.
+        self.leg = "single"
+        self.resume_post: str | None = None  # migration post filename
+        self.prefill_replica: str | None = None
+        self.leg1_tokens: list[int] | None = None
 
     @property
     def terminal(self) -> bool:
@@ -385,11 +548,19 @@ class ReplicaHandle:
         client,
         agent: ElasticAgent | None = None,
         health: HttpHealth | None = None,
+        role: str = "both",
     ):
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"unknown replica role {role!r}; prefill|decode|both"
+            )
         self.name = name
         self.client = client
         self.agent = agent
         self.health = health
+        # Round-23 disaggregation: which leg(s) this replica serves.
+        # "both" everywhere = the homogeneous fleet, bitwise round 21.
+        self.role = role
         self.state = "starting"
         self.attempts = 0  # restarts charged
         self.relaunch_at: float | None = None
@@ -409,6 +580,14 @@ class ReplicaHandle:
         self.breaker_failures = 0
         self.breaker_until = 0.0
         self.breaker_probe = None
+
+    @property
+    def can_prefill(self) -> bool:
+        return self.role in ("prefill", "both")
+
+    @property
+    def can_decode(self) -> bool:
+        return self.role in ("decode", "both")
 
     @property
     def routable(self) -> bool:
@@ -445,6 +624,9 @@ class ReplicaRouter:
         breaker_failures: int = 3,
         breaker_reset_s: float = 5.0,
         route_timeout_s: float | None = None,
+        migrate_dir: str | None = None,
+        prefix_block_tokens: int = 16,
+        migrate_threshold: int | None = None,
         probe_interval_s: float = 0.5,
         poll_interval: float = 0.05,
         journal=None,
@@ -528,6 +710,45 @@ class ReplicaRouter:
         self._next_rid = 0
         self._started = False
         self._draining = False
+        # Round-23 disaggregation: the two-leg lifecycle arms only when
+        # a role-specialized replica exists — an all-"both" fleet keeps
+        # the round-21 single-leg path (and its sticky affinity map)
+        # bitwise. In a role fleet the sticky map is PROMOTED to a
+        # fleet-wide radix-prefix index: routing sees which replica
+        # holds which warm prefix (beliefs registered at route time,
+        # dropped on death/relaunch/swap) before choosing the prefill
+        # leg.
+        self._two_leg = any(h.role != "both" for h in replicas)
+        # Length-threshold routing (the DistServe-style policy knob):
+        # prompts SHORTER than ``migrate_threshold`` tokens skip the
+        # two-leg path and serve whole on a decode-capable replica —
+        # the handoff only pays for itself when the prefill is long
+        # enough to stall a decode batch. None (default) sends every
+        # first leg through the prefill pool (the round-23 base path;
+        # an all-"both" fleet ignores the knob entirely).
+        self.migrate_threshold = (
+            None if migrate_threshold is None else int(migrate_threshold)
+        )
+        self._migrate = (
+            MigrationStore(migrate_dir, journal=self.journal,
+                           metrics=self.metrics)
+            if migrate_dir is not None
+            else None
+        )
+        self._prefix_index = None
+        if self._two_leg:
+            from distributed_tensorflow_tpu.serve_pool import (
+                FleetPrefixIndex,
+            )
+
+            self._prefix_index = FleetPrefixIndex(
+                block_size=int(prefix_block_tokens)
+            )
+            self.journal.emit(
+                "fleet_roles",
+                roles={h.name: h.role for h in replicas},
+                migrate_dir=migrate_dir,
+            )
         # The checkpoint directory the fleet currently serves when a
         # swap ever pointed it AWAY from the replicas' spawn-time
         # default; re-sent to every replica as it comes (back) up, so a
@@ -745,6 +966,10 @@ class ReplicaRouter:
             if checkpoint_dir is not None:
                 payload["checkpoint_dir"] = checkpoint_dir
             h.client.control(payload)
+            if self._prefix_index is not None:
+                # The swap flushes the replica's radix (stale-weights
+                # K/V): forget the fleet-level beliefs with it.
+                self._prefix_index.drop_replica(h.name)
         self.journal.emit(
             "weight_swap_requested",
             source=checkpoint_dir,
@@ -824,6 +1049,7 @@ class ReplicaRouter:
                 elif payload.get("cancelled"):
                     req.cancelled = True
                     req.t_done = self.clock()
+                    self._cleanup_post(req)
                     self.metrics.counter("fleet_cancelled_total").inc()
                     self.journal.emit(
                         "fleet_result",
@@ -837,6 +1063,7 @@ class ReplicaRouter:
                     # past its deadline / displaced under saturation).
                     req.shed = True
                     req.t_done = self.clock()
+                    self._cleanup_post(req)
                     self.metrics.counter("fleet_shed_total").inc()
                     self.journal.emit(
                         "fleet_result",
@@ -845,10 +1072,37 @@ class ReplicaRouter:
                         replica=h.name,
                         status="shed",
                     )
+                elif payload.get("migrated"):
+                    # Leg 1 (prefill + first token) finished: schedule
+                    # the decode leg under the SAME trace/rid. A failed
+                    # post (post=None — the fleet.migrate failpoint, a
+                    # full disk) degrades to re-prefill on the decode
+                    # replica: slower, never lost. Only the current
+                    # owner's report counts (stale-bounce rule above).
+                    if req.replica == h.name:
+                        req.leg = "decode"
+                        req.resume_post = payload.get("post")
+                        req.prefill_replica = h.name
+                        req.leg1_tokens = [
+                            int(t) for t in payload.get("tokens", [])
+                        ]
+                        req.replica = None
+                        self.metrics.counter("fleet_migrations_total").inc()
+                        self.journal.emit(
+                            "request_migrated",
+                            trace=trace,
+                            rid=req.rid,
+                            from_replica=h.name,
+                            post=req.resume_post,
+                            blocks=payload.get("blocks"),
+                            nbytes=payload.get("nbytes"),
+                        )
+                        self._requeue_front(req)
                 else:
                     req.out = [int(t) for t in payload.get("tokens", [])]
                     req.done = True
                     req.t_done = self.clock()
+                    self._cleanup_post(req)
                     if req.out and req.t_routed is not None:
                         # Route-to-result seconds per emitted token: the
                         # hopeless-shed predicate's evidence. Includes
@@ -873,6 +1127,14 @@ class ReplicaRouter:
                         latency_s=round(req.t_done - req.t_submit, 6),
                         reroutes=max(req.attempts - 1, 0),
                     )
+
+    def _cleanup_post(self, req: _FleetRequest) -> None:
+        """The router owns a migration post's lifetime: remove it once
+        its request is terminal (a decode-leg failover before then
+        re-imports the SAME post — that is why the importer never
+        deletes)."""
+        if req.resume_post is not None and self._migrate is not None:
+            self._migrate.remove(req.resume_post)
 
     def _rejected(self, h: ReplicaHandle, req, payload: dict) -> None:
         """A replica bounced the request. QueueFull is pure BACKPRESSURE:
@@ -905,6 +1167,7 @@ class ReplicaRouter:
                 f"routed {req.attempts} times (budget {self.max_reroutes})"
             )
             req.t_done = self.clock()
+            self._cleanup_post(req)
             self.metrics.counter("fleet_failed_total").inc()
             self.journal.emit(
                 "fleet_result",
@@ -988,6 +1251,10 @@ class ReplicaRouter:
             self._requeue_front(req)
         h.inflight.clear()
         h.breaker_reset()  # supervision owns the replica now
+        if self._prefix_index is not None:
+            # A dead replica's radix died with it: forget every warm-
+            # prefix belief so the prefill leg stops preferring a ghost.
+            self._prefix_index.drop_replica(h.name)
         h.attempts += 1
         self.metrics.counter("failovers_total").inc()
         lifecycle_event(
@@ -1069,6 +1336,7 @@ class ReplicaRouter:
     def _shed(self, req: _FleetRequest, now: float, *, reason: str) -> None:
         req.shed = True
         req.t_done = now
+        self._cleanup_post(req)
         self.metrics.counter("fleet_shed_total").inc()
         self.journal.emit(
             "request_shed",
@@ -1245,6 +1513,8 @@ class ReplicaRouter:
         routable = [h for h in self.replicas.values() if h.routable]
         if not routable:
             return None
+        if self._two_leg:
+            return self._pick_role(req, routable)
         key = self._affinity_key(req)
         if key is not None:
             sticky = self.replicas.get(self._affinity.get(key, ""), None)
@@ -1270,6 +1540,43 @@ class ReplicaRouter:
             while len(self._affinity) > self.affinity_cap:
                 self._affinity.pop(next(iter(self._affinity)))
         return pick
+
+    def _pick_role(
+        self, req: _FleetRequest, routable: list[ReplicaHandle]
+    ) -> ReplicaHandle | None:
+        """Role-aware pick for disaggregated fleets (round 23). The leg
+        decides the candidate pool (prefill-capable for the first leg,
+        decode-capable for the resumed one); when no capable replica is
+        routable, ANY routable replica serves the request whole — roles
+        are scheduling policy, every replica runs the full engine, so a
+        degraded fleet stays correct, just un-specialized. The prefill
+        leg prefers the replica the fleet-wide prefix index says holds
+        the deepest warm prefix, provided it is in the open pool. With
+        ``migrate_threshold`` set, a first leg whose prompt is shorter
+        than the threshold targets the DECODE pool instead — it serves
+        whole where it would decode anyway, skipping a handoff that
+        costs more than the prefill it would offload."""
+        short = (
+            req.leg != "decode"
+            and self.migrate_threshold is not None
+            and len(req.tokens) < self.migrate_threshold
+        )
+        want = (
+            (lambda h: h.can_decode)
+            if req.leg == "decode" or short
+            else (lambda h: h.can_prefill)
+        )
+        pool = [h for h in routable if want(h)] or routable
+        open_ = [h for h in pool if not self._saturated(h)]
+        if not open_:
+            return None  # capable pool saturated: hold at the router
+        if req.leg != "decode" and self._prefix_index is not None:
+            name, depth = self._prefix_index.lookup(req.tokens)
+            if depth > 0 and name is not None:
+                warm = self.replicas.get(name)
+                if warm is not None and warm in open_:
+                    return warm
+        return min(open_, key=lambda h: len(h.inflight))
 
     def _next_queued(self) -> tuple[int, int] | None:
         """(priority, index) of the next dequeue candidate: weighted-fair
@@ -1320,6 +1627,8 @@ class ReplicaRouter:
                 if not q:
                     del self._queues[prio]
                 continue
+            if self._two_leg and req.leg == "single":
+                req.leg = "prefill"  # first leg of a disaggregated request
             h = self._pick(req)
             if h is None:
                 return
@@ -1347,6 +1656,23 @@ class ReplicaRouter:
                 payload["priority"] = req.priority
             if req.deadline is not None:
                 payload["deadline_s"] = max(req.deadline - now, 0.0)
+            if req.leg == "prefill" and h.role == "prefill":
+                # Migrate only off a prefill-SPECIALIZED replica — a
+                # "both" (or fallback decode) target just serves the
+                # request whole; the handoff would be pure overhead.
+                payload["migrate"] = True
+            elif req.leg == "decode":
+                if req.resume_post is not None:
+                    payload["resume"] = req.resume_post
+                    payload["emitted"] = req.leg1_tokens or []
+                # resume_post None = the prefill leg's post failed or was
+                # quarantined: the decode replica re-prefills from the
+                # prompt (full re-serve, stream identical by parity).
+            if self._prefix_index is not None and req.leg != "decode":
+                # Optimistic: this replica is about to warm these prompt
+                # blocks. A died-before-prefill entry is self-healing —
+                # _fail drops the replica's entries wholesale.
+                self._prefix_index.insert(req.tokens, h.name)
             try:
                 h.client.submit(payload)
             except OSError as exc:
@@ -1371,6 +1697,9 @@ class ReplicaRouter:
                 )
                 return
             self.metrics.counter("routed_total").inc()
+            route_kw = {}
+            if req.leg != "single":
+                route_kw["leg"] = req.leg
             self.journal.emit(
                 "request_route",
                 trace=req.trace,
@@ -1378,6 +1707,7 @@ class ReplicaRouter:
                 replica=h.name,
                 attempt=req.attempts,
                 queue_wait_s=round(now - req.t_submit, 6),
+                **route_kw,
             )
 
 
@@ -1407,10 +1737,15 @@ def local_fleet(
     fleet_dir: str,
     *,
     replicas: int = 3,
-    slots: int = 4,
+    roles: list[str] | tuple[str, ...] | None = None,
+    slots: int | list[int] | tuple[int, ...] = 4,
     chunk: int = 8,
     queue_limit: int = 32,
     buckets: tuple[int, ...] | None = None,
+    paged: bool = False,
+    block_size: int = 16,
+    kv_blocks: int = 64,
+    kv_dtype: str = "bf16",
     poll_s: float = 0.005,
     warm: bool = True,
     env: dict | None = None,
@@ -1428,10 +1763,37 @@ def local_fleet(
     ``obs_report --fleet`` merges into one cross-replica timeline. The
     startup grace is generous by default: a cold jax import + restore on
     a loaded host must not read as death (CLAUDE.md's integration-test
-    lesson)."""
+    lesson). ``roles`` (one of ``prefill``/``decode``/``both`` per
+    replica) arms the round-23 disaggregated two-leg path: any non-both
+    role forces ``paged=True``, creates ``<fleet_dir>/migrate`` as the
+    shared migration store, and passes ``migrate_dir`` to the router.
+    ``slots`` may be a per-replica list — the role-tuning lever: decode
+    replicas pack many resident streams (decode is memory-bound, round
+    18), prefill replicas size to their batch-prefill width."""
     from distributed_tensorflow_tpu.observability.journal import EventJournal
 
     os.makedirs(fleet_dir, exist_ok=True)
+    slot_list = (
+        [int(s) for s in slots]
+        if isinstance(slots, (list, tuple))
+        else [int(slots)] * replicas
+    )
+    if len(slot_list) != replicas:
+        raise ValueError(
+            f"slots has {len(slot_list)} entries for {replicas} replicas"
+        )
+    if roles is not None:
+        if len(roles) != replicas:
+            raise ValueError(
+                f"roles has {len(roles)} entries for {replicas} replicas"
+            )
+        if any(r != "both" for r in roles):
+            paged = True  # a disaggregated fleet migrates paged KV
+    migrate_dir = None
+    if roles is not None and any(r != "both" for r in roles):
+        migrate_dir = os.path.join(fleet_dir, "migrate")
+        os.makedirs(migrate_dir, exist_ok=True)
+        router_kw.setdefault("migrate_dir", migrate_dir)
     run_id = f"fleet-{os.getpid()}"
     journal = EventJournal.in_dir(fleet_dir, run_id=run_id)
     handles = []
@@ -1450,11 +1812,20 @@ def local_fleet(
             "--replica", "--dir", rdir,
             "--checkpoint-dir", checkpoint_dir,
             "--model", json.dumps(model_kw),
-            "--slots", str(slots), "--chunk", str(chunk),
+            "--slots", str(slot_list[i]), "--chunk", str(chunk),
             "--queue-limit", str(queue_limit), "--poll-s", str(poll_s),
         ]
         if buckets:
             cmd += ["--buckets", ",".join(str(b) for b in buckets)]
+        if paged:
+            cmd += [
+                "--paged",
+                "--block-size", str(block_size),
+                "--kv-blocks", str(kv_blocks),
+                "--kv-dtype", kv_dtype,
+            ]
+        if migrate_dir is not None:
+            cmd += ["--migrate-dir", migrate_dir]
         if warm:
             cmd += ["--warm"]
 
@@ -1481,6 +1852,7 @@ def local_fleet(
                     grace_s=grace_s,
                     dead_after_s=dead_after_s,
                 ),
+                role=roles[i] if roles is not None else "both",
             )
         )
     return ReplicaRouter(
@@ -1563,6 +1935,14 @@ def run_replica(args) -> int:
         if args.buckets
         else None
     )
+    srv_kw: dict = {}
+    if args.paged:
+        srv_kw.update(
+            paged=True,
+            block_size=args.block_size,
+            kv_blocks=args.kv_blocks,
+            kv_dtype=args.kv_dtype,
+        )
     srv = TextServer.from_checkpoint(
         model,
         args.checkpoint_dir,
@@ -1570,8 +1950,14 @@ def run_replica(args) -> int:
         chunk=args.chunk,
         buckets=buckets,
         queue_limit=args.queue_limit or None,
+        **srv_kw,
     )
     box = MailboxClient(args.dir, metrics=srv.metrics)
+    store = (
+        MigrationStore(args.migrate_dir, metrics=srv.metrics)
+        if getattr(args, "migrate_dir", None)
+        else None
+    )
     # A fresh incarnation serves only newly routed work: anything in the
     # inbox predates this process and already failed over elsewhere.
     box.clear_inbox()
@@ -1589,12 +1975,18 @@ def run_replica(args) -> int:
                 [_np.arange(1, b + 1, dtype=_np.int32)],
                 GenerationConfig(max_new=2),
             )
+        if store is not None:
+            # Decode replicas must not pay the import-scatter compile
+            # on their first resumed request (see warm_import).
+            srv.warm_import()
     def _health():
         # Round-21 satellite: mailbox corruption is a health-visible
         # signal, not a "silent replica by design" (known_issues.md) —
         # router verdicts and dashboards see the quarantine count.
         doc = srv.health()
-        doc["mailbox_corrupt_files"] = box.corrupt_files
+        doc["mailbox_corrupt_files"] = box.corrupt_files + (
+            store.corrupt_files if store is not None else 0
+        )
         return doc
 
     exporter = MetricsExporter(srv.metrics, port=args.port, health_fn=_health)
@@ -1606,6 +1998,51 @@ def run_replica(args) -> int:
     def _flush_done(rids: dict) -> None:
         for rid in list(rids):
             if srv.done(rid):
+                export = srv.take_export(rid) if store is not None else None
+                if export is not None:
+                    # Prefill leg finished: post the KV payload on the
+                    # migration store, then hand the baton back to the
+                    # router. A failed post is NOT a failed request —
+                    # post=None tells the router the decode leg must
+                    # re-prefill (the fallback matrix's cheap row).
+                    trace = rids.pop(rid)
+                    t0 = time.perf_counter()
+                    nbytes = sum(
+                        a.nbytes for a in export["arrays"].values()
+                    )
+                    try:
+                        post = store.post(f"{trace}.npz", export)
+                    except OSError as exc:
+                        post = None
+                        obs_journal_mod.get_journal().emit(
+                            "kv_migration",
+                            phase="post_failed",
+                            trace=trace,
+                            error=f"{type(exc).__name__}: {exc}",
+                        )
+                    else:
+                        obs_journal_mod.get_journal().emit(
+                            "kv_migration",
+                            phase="post",
+                            trace=trace,
+                            file=post,
+                            blocks=int(export["meta"]["blocks"]),
+                            nbytes=int(nbytes),
+                            wall_ms=round(
+                                (time.perf_counter() - t0) * 1e3, 3
+                            ),
+                        )
+                    box.put_result(
+                        {
+                            "trace": trace,
+                            "migrated": True,
+                            "post": post,
+                            "tokens": [int(t) for t in export["tokens"]],
+                            "blocks": int(export["meta"]["blocks"]),
+                            "nbytes": int(nbytes),
+                        }
+                    )
+                    continue
                 trace = rids.pop(rid)
                 try:
                     toks = srv.result(rid)
@@ -1648,14 +2085,66 @@ def run_replica(args) -> int:
                     # router — a poison request must cost ITSELF, never
                     # the replica process (the router fails it terminally
                     # on the error_kind, so it cannot cascade either).
+                    sub_kw: dict = {}
+                    if payload.get("migrate") and store is not None:
+                        sub_kw["prefill_only"] = True
+                    post_name = payload.get("resume")
+                    if post_name is not None and store is not None:
+                        loaded = store.load(post_name)
+                        if loaded is None:
+                            # Missing or quarantined post: fall back to a
+                            # full re-prefill on THIS replica — the warm
+                            # radix stays, the stream stays identical.
+                            obs_journal_mod.get_journal().emit(
+                                "kv_migration",
+                                phase="fallback",
+                                trace=payload.get("trace"),
+                                file=post_name,
+                                reason="load_failed",
+                            )
+                        else:
+                            sub_kw["resume"] = {
+                                "arrays": loaded["arrays"],
+                                "meta": loaded["meta"],
+                            }
+                            sub_kw["emitted_tokens"] = payload.get(
+                                "emitted", loaded.get("tokens")
+                            )
                     try:
-                        rid = srv.submit(
-                            payload["tokens"],
-                            GenerationConfig(**(payload.get("config") or {})),
-                            deadline_s=payload.get("deadline_s"),
-                            priority=int(payload.get("priority", 0)),
-                            trace=payload.get("trace"),
-                        )
+                        try:
+                            rid = srv.submit(
+                                payload["tokens"],
+                                GenerationConfig(
+                                    **(payload.get("config") or {})
+                                ),
+                                deadline_s=payload.get("deadline_s"),
+                                priority=int(payload.get("priority", 0)),
+                                trace=payload.get("trace"),
+                                **sub_kw,
+                            )
+                        except ValueError:
+                            if "resume" not in sub_kw:
+                                raise
+                            # Geometry/dtype mismatch between the post and
+                            # THIS replica's cache (heterogeneous fleet,
+                            # mid-roll kv_dtype change): re-prefill here
+                            # rather than bounce the request.
+                            obs_journal_mod.get_journal().emit(
+                                "kv_migration",
+                                phase="fallback",
+                                trace=payload.get("trace"),
+                                file=post_name,
+                                reason="resume_rejected",
+                            )
+                            rid = srv.submit(
+                                payload["tokens"],
+                                GenerationConfig(
+                                    **(payload.get("config") or {})
+                                ),
+                                deadline_s=payload.get("deadline_s"),
+                                priority=int(payload.get("priority", 0)),
+                                trace=payload.get("trace"),
+                            )
                     except (
                         QueueFull, ValueError, TypeError, RuntimeError,
                     ) as exc:
@@ -1702,6 +2191,18 @@ def main(argv=None) -> int:
         help="/healthz port (0 = ephemeral, published to <dir>/port.json)",
     )
     ap.add_argument("--poll-s", type=float, default=0.005)
+    ap.add_argument(
+        "--paged", action="store_true",
+        help="serve from the paged KV pool (required for migration)",
+    )
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--kv-blocks", type=int, default=64)
+    ap.add_argument("--kv-dtype", default="bf16")
+    ap.add_argument(
+        "--migrate-dir", default=None,
+        help="shared migration-store directory (arms the prefill→decode "
+        "KV handoff; posts are CRC-enveloped npz files)",
+    )
     ap.add_argument(
         "--warm", action="store_true",
         help="compile every prefill bucket + the chunk executable before "

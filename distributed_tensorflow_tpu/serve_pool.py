@@ -31,6 +31,10 @@ jax-free (the lean-import convention — the device half lives in
 - :func:`lookup_draft` — prompt-lookup speculative drafts (n-gram
   continuation from the request's own context; no draft model), verified
   by one batched target pass in the engine's greedy-exact verify graph.
+- :class:`FleetPrefixIndex` (round 23) — the ROUTER's view of the same
+  radix identity: prompt-block chains → which replica holds that prefix
+  warm, so the disaggregated fleet can choose the prefill leg by warmth
+  (docs/serving.md §disaggregation).
 """
 
 from __future__ import annotations
@@ -347,6 +351,110 @@ class PrefixCache:
         if parent != -1:
             self._children[parent] -= 1
         self.allocator.release(bid)
+
+
+class FleetPrefixIndex:
+    """Fleet-wide radix over prompt prefixes → the replica believed to
+    hold that prefix WARM (round 23, the disaggregated-fleet routing
+    half of :class:`PrefixCache`): same hash-consed node identity
+    (parent node, that block's token content), but the payload per node
+    is a {replica: last-touch tick} map instead of a physical block id —
+    the router holds no blocks, it holds BELIEFS about where prefixes
+    live. This promotes the round-16 sticky ``affinity_tokens`` map
+    (exact fixed-length key, single owner) into true longest-prefix
+    matching with per-replica recency.
+
+    Fed from two sides: optimistically at ROUTE time (the routed
+    prefill replica is about to register the prompt in its own radix)
+    and authoritatively from replica journal events
+    (``admission``/``prefix_evict``/``weight_swap`` — see
+    ``ReplicaRouter._ingest_prefix_events``). Beliefs can go stale
+    either way; the router treats a lookup as a HINT (a miss on the
+    replica costs one re-prefill, never correctness), which is why this
+    stays jax-free and lock-free. ``drop_replica`` forgets everything a
+    dead/relaunched/swapped replica was believed to hold — its radix is
+    gone (relaunch) or flushed (weight swap), so the belief is provably
+    wrong."""
+
+    def __init__(self, block_size: int = 16, cap: int = 4096):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if cap < 1:
+            raise ValueError(f"cap must be >= 1, got {cap}")
+        self.block_size = block_size
+        self.cap = cap
+        self._nodes: dict = {}  # (parent key | None, block tokens) -> {replica: tick}
+        self._tick = 0
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def _keys(self, tokens, nmax: int | None = None):
+        bs = self.block_size
+        n = len(tokens) // bs
+        if nmax is not None:
+            n = min(n, nmax)
+        parent = None
+        for i in range(n):
+            key = (parent, tuple(int(t) for t in tokens[i * bs:(i + 1) * bs]))
+            yield key
+            parent = key
+
+    def insert(self, tokens, replica: str) -> int:
+        """Register every full block of ``tokens`` as warm on
+        ``replica``; returns the chain depth registered. LRU-capped on
+        total node count — oldest nodes fall off first (a belief cache,
+        not an allocator: forgetting is always safe)."""
+        self._tick += 1
+        depth = 0
+        for key in self._keys(tokens):
+            self._nodes.setdefault(key, {})[replica] = self._tick
+            depth += 1
+        while len(self._nodes) > self.cap:
+            oldest = min(
+                self._nodes, key=lambda k: max(self._nodes[k].values())
+            )
+            del self._nodes[oldest]
+        return depth
+
+    def lookup(self, tokens) -> tuple[str | None, int]:
+        """The replica believed to hold the LONGEST warm prefix of
+        ``tokens`` (full blocks only) and its depth in blocks. A replica
+        counts at depth d only if it is present on EVERY node of the
+        chain up to d (a warm prefix is a chain, not a set); ties break
+        to the most recently touched belief. ``(None, 0)`` = no belief."""
+        alive: dict = {}  # replica -> (depth, freshest tick)
+        on_chain: set | None = None
+        for depth, key in enumerate(self._keys(tokens), start=1):
+            node = self._nodes.get(key)
+            if not node:
+                break
+            here = set(node) if on_chain is None else on_chain & set(node)
+            if not here:
+                break
+            on_chain = here
+            for r in here:
+                alive[r] = (depth, node[r])
+        if not alive:
+            return None, 0
+        best = max(alive.items(), key=lambda kv: kv[1])
+        return best[0], best[1][0]
+
+    def drop_replica(self, replica: str) -> int:
+        """Forget every belief about ``replica`` (death, relaunch, or
+        weight swap — its radix no longer holds what we thought).
+        Returns the number of nodes the replica was dropped from."""
+        dropped = 0
+        empty = []
+        for key, node in self._nodes.items():
+            if replica in node:
+                del node[replica]
+                dropped += 1
+                if not node:
+                    empty.append(key)
+        for key in empty:
+            del self._nodes[key]
+        return dropped
 
 
 def lookup_draft(context, max_draft: int, ngram: int = 2):

@@ -319,19 +319,27 @@ def summarize(events: list[dict]) -> dict:
     ``fleet_result``/``request_shed``) alike. Returns::
 
         {"classes": {prio: {requests, done, shed, cancelled, failed,
-                            shed_rate, ttft_s: {p50, p95},
+                            migrated, shed_rate, ttft_s: {p50, p95},
                             latency_s: {p50, p95}}},
          "requests": N, "shed_rate": overall}
+
+    Router journals from a disaggregated fleet (round 23) additionally
+    yield top-level ``migrated`` and ``kv_migration_bytes_per_req``
+    (mean bytes over the ``request_migrated`` events).
     """
     sub: dict = {}
     first: dict = {}
     term: dict = {}
+    migr: dict = {}
     for ev in events:
         kind, rid = ev.get("kind"), ev.get("rid")
         if rid is None:
             continue
         if kind == "request_submit":
             sub[rid] = (ev.get("ts"), int(ev.get("priority", 0)))
+        elif kind == "request_migrated":
+            # Round 23 (disaggregated fleet): the prefill→decode handoff.
+            migr[rid] = ev.get("nbytes") or 0
         elif kind in _FIRST_SERVICE:
             first.setdefault(rid, ev.get("ts"))
         elif kind == "completion":
@@ -351,10 +359,12 @@ def summarize(events: list[dict]) -> dict:
             prio,
             {
                 "requests": 0, "done": 0, "shed": 0, "cancelled": 0,
-                "failed": 0, "_ttft": [], "_lat": [],
+                "failed": 0, "migrated": 0, "_ttft": [], "_lat": [],
             },
         )
         c["requests"] += 1
+        if rid in migr:
+            c["migrated"] += 1
         status, ts1 = term.get(rid, (None, None))
         if status == "done":
             c["done"] += 1
@@ -383,6 +393,7 @@ def summarize(events: list[dict]) -> dict:
             "shed": c["shed"],
             "cancelled": c["cancelled"],
             "failed": c["failed"],
+            "migrated": c["migrated"],
             "shed_rate": round(c["shed"] / max(c["requests"], 1), 4),
             "ttft_s": {"p50": pct(c["_ttft"], 0.5),
                        "p95": pct(c["_ttft"], 0.95)},
@@ -391,11 +402,17 @@ def summarize(events: list[dict]) -> dict:
         }
     total = sum(c["requests"] for c in out.values())
     shed = sum(c["shed"] for c in out.values())
-    return {
+    summary = {
         "classes": out,
         "requests": total,
         "shed_rate": round(shed / max(total, 1), 4),
     }
+    if migr:
+        summary["migrated"] = len(migr)
+        summary["kv_migration_bytes_per_req"] = round(
+            sum(migr.values()) / len(migr), 1
+        )
+    return summary
 
 
 def main(argv=None) -> int:

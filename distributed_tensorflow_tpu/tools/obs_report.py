@@ -608,8 +608,19 @@ def reconstruct_fleet_requests(merged: dict) -> list[dict]:
                 "done": False,
                 "cancelled": False,
                 "rejected": False,
+                "migrated": False,
+                "migration": None,
             }
         return recs[trace]
+
+    def migration(trace) -> dict:
+        r = rec(trace)
+        if r["migration"] is None:
+            r["migration"] = {
+                "from": None, "to": None, "blocks": None, "nbytes": None,
+                "post_ms": None, "import_ms": None, "fallback": None,
+            }
+        return r["migration"]
 
     for ev in merged["events"]:
         trace = ev.get("trace")
@@ -648,6 +659,25 @@ def reconstruct_fleet_requests(merged: dict) -> list[dict]:
                     r["ttft_s"] = round(
                         (ts - lat + ttft) - r["submit_ts"], 6
                     )
+        elif kind == "request_migrated" and src == driver:
+            # Round 23: the prefill→decode handoff, one trace across
+            # both legs — the join this function exists to render.
+            r = rec(trace)
+            r["migrated"] = True
+            m = migration(trace)
+            m["from"] = ev.get("from_replica")
+            m["blocks"] = ev.get("blocks")
+            m["nbytes"] = ev.get("nbytes")
+        elif kind == "kv_migration":
+            m = migration(trace)
+            ph = ev.get("phase")
+            if ph == "post":
+                m["post_ms"] = ev.get("wall_ms")
+            elif ph == "import":
+                m["to"] = src
+                m["import_ms"] = ev.get("wall_ms")
+            elif ph in ("fallback", "post_failed"):
+                m["fallback"] = ev.get("reason", ph)
         elif kind == "request_cancelled":
             rec(trace)["cancelled"] = True
         elif kind == "fleet_result" and ev.get("status") == "rejected":
@@ -673,7 +703,7 @@ def render_fleet_requests(records: list[dict]) -> str:
         if r["cancelled"]:
             status = "cancelled"
         elif r["done"]:
-            status = "done"
+            status = "done+migr" if r.get("migrated") else "done"
         elif r.get("rejected"):
             status = "rejected"
         else:
@@ -697,13 +727,35 @@ def render_fleet_requests(records: list[dict]) -> str:
         if not r["done"] and not r["cancelled"] and not r.get("rejected")
     ]
     failovers = sum(r["failovers"] for r in fleet)
+    migrated = [r for r in fleet if r.get("migrated")]
     tail = (
         f"{len(fleet)} requests: {len(done)} done, "
         f"{sum(r['cancelled'] for r in fleet)} cancelled, "
         f"{sum(bool(r.get('rejected')) for r in fleet)} rejected, "
         f"{len(lost)} in flight/lost; {failovers} failover(s)"
+        + (f"; {len(migrated)} migrated" if migrated else "")
         + (f" (+{local} replica-local)" if local else "")
     )
+    if migrated:
+        ms = [r["migration"] or {} for r in migrated]
+        bytes_ = [m["nbytes"] for m in ms if m.get("nbytes")]
+        blocks = [m["blocks"] for m in ms if m.get("blocks")]
+        fallbacks = sum(1 for m in ms if m.get("fallback"))
+        line = "kv migration:"
+        if blocks:
+            line += f" avg blocks {sum(blocks) / len(blocks):.1f}"
+        if bytes_:
+            line += f", avg {sum(bytes_) / len(bytes_) / 1024:.1f} KiB/req"
+        post = sorted(m["post_ms"] for m in ms if m.get("post_ms") is not None)
+        imp = sorted(
+            m["import_ms"] for m in ms if m.get("import_ms") is not None
+        )
+        if post:
+            line += f", post p50 {_percentile(post, 0.50):.2f} ms"
+        if imp:
+            line += f", import p50 {_percentile(imp, 0.50):.2f} ms"
+        line += f", {fallbacks} fallback(s)"
+        lines.append(line)
     pct = request_percentiles(
         [
             {"done": True, "ttft_s": r["ttft_s"], "latency_s": r["latency_s"]}
@@ -729,8 +781,9 @@ def render_gang(summary: dict) -> str:
         skew = summary["skew_s"].get(label, 0.0)
         starts = summary["worker_starts"].get(label, 0)
         prog = r.get("last_progress")
+        role = f" [{r['role']}]" if r.get("role") else ""
         lines.append(
-            f"  {label}: {r['events']} events over {r['wall_span_s']}s"
+            f"  {label}{role}: {r['events']} events over {r['wall_span_s']}s"
             + (f", skew {skew}s" if skew else "")
             + (f", {starts} incarnation(s)" if starts else "")
             + (

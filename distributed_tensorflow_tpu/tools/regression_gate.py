@@ -68,7 +68,7 @@ import sys
 LOWER_IS_BETTER_UNITS = (
     "ms", "s", "ms/token", "ms/dispatch", "requests", "bytes",
     "bytes/token", "us", "µs", "us/token", "µs/token",
-    "dispatches/token", "shed_rate",
+    "dispatches/token", "shed_rate", "bytes/req",
 )
 
 DEFAULT_TOLERANCE = 0.5

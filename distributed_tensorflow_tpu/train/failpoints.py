@@ -125,6 +125,12 @@ REGISTERED = {
         "serve_fleet._read_dir entry (take_inbox and poll_results both "
         "pass through it)"
     ),
+    "fleet.migrate": (
+        "serve_fleet.MigrationStore.post entry (+ tear of the committed "
+        "KV-migration npz envelope) — a torn post is quarantined once at "
+        "load and the request falls back to re-prefill on the decode "
+        "replica"
+    ),
     "journal.append": "observability EventJournal.emit, before the os.write",
     "journal.rotate": "observability EventJournal._rotate entry",
     "elastic.relaunch": "elastic.ElasticAgent.start entry (every spawn)",

@@ -1232,3 +1232,164 @@ def test_obs_report_per_class_rollup():
     assert "class p" not in obs_report.render_requests(
         obs_report.reconstruct_requests(plain)
     )
+
+
+# ---------------------------------------------------------------------------
+# Round 23: disaggregated fleet — migration join, role tags, bytes/req gate.
+# ---------------------------------------------------------------------------
+
+
+def test_gate_bytes_per_req_unit_fails_high():
+    # Round 23: kv_migration_bytes_per_req is a wire-payload series like
+    # round 17's bytes/token — the handoff payload creeping UP past the
+    # recorded band is the regression; a smaller payload must never trip.
+    mk = lambda vals, unit: [  # noqa: E731
+        (i, v, unit) for i, v in enumerate(vals)
+    ]
+    assert "bytes/req" in regression_gate.LOWER_IS_BETTER_UNITS
+    res = regression_gate.check_series(
+        {("serve_bench", "kv_migration_bytes_per_req"): mk(
+            [4096.0, 4200.0, 9000.0], "bytes/req"
+        )},
+        tolerance=0.5,
+    )
+    [f] = res["failures"]
+    assert f["direction"] == "above" and f["unit"] == "bytes/req"
+    assert not regression_gate.check_series(
+        {("serve_bench", "kv_migration_bytes_per_req"): mk(
+            [4096.0, 4200.0, 1024.0], "bytes/req"
+        )},
+        tolerance=0.5,
+    )["failures"]
+
+
+def _merged(events_by_src):
+    """A minimal aggregate.merge-shaped dict: events carry _src, router
+    journal is 'driver'."""
+    events = []
+    for src, evs in events_by_src.items():
+        for ev in evs:
+            events.append({**ev, "_src": src})
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return {"ranks": list(events_by_src), "events": events}
+
+
+def test_obs_report_fleet_migration_two_leg_join():
+    """Satellite 3: one trace, two legs — router submit, prefill-leg
+    admission on r0, migration, decode-leg admission + completion on r1 —
+    joins into ONE record with the migration detail and renders the
+    done+migr status plus the kv-migration summary line."""
+    merged = _merged({
+        "driver": [
+            {"kind": "request_submit", "ts": 0.0, "rid": 0, "trace": "tA",
+             "prompt_len": 4},
+            {"kind": "request_route", "ts": 0.1, "rid": 0, "trace": "tA",
+             "replica": "r0", "leg": "prefill"},
+            {"kind": "request_migrated", "ts": 0.5, "rid": 0, "trace": "tA",
+             "from_replica": "r0", "post": "tA.npz", "blocks": 3,
+             "nbytes": 6144},
+            {"kind": "request_route", "ts": 0.6, "rid": 0, "trace": "tA",
+             "replica": "r1", "leg": "decode"},
+        ],
+        "r0": [
+            {"kind": "admission", "ts": 0.2, "rid": 0, "trace": "tA"},
+            {"kind": "kv_migration", "ts": 0.45, "trace": "tA",
+             "phase": "post", "blocks": 3, "nbytes": 6144, "wall_ms": 1.5},
+        ],
+        "r1": [
+            {"kind": "admission", "ts": 0.7, "rid": 0, "trace": "tA"},
+            {"kind": "kv_migration", "ts": 0.75, "trace": "tA",
+             "phase": "import", "slot": 0, "blocks": 3, "wall_ms": 2.0},
+            {"kind": "completion", "ts": 1.0, "rid": 0, "trace": "tA",
+             "tokens": 8, "latency_s": 0.8, "ttft_s": 0.3},
+        ],
+    })
+    [r] = obs_report.reconstruct_fleet_requests(merged)
+    assert r["migrated"] is True
+    assert r["replicas"] == ["r0", "r1"]
+    assert r["completed_on"] == "r1" and r["done"]
+    m = r["migration"]
+    assert m["from"] == "r0" and m["to"] == "r1"
+    assert m["blocks"] == 3 and m["nbytes"] == 6144
+    assert m["post_ms"] == 1.5 and m["import_ms"] == 2.0
+    assert m["fallback"] is None
+    txt = obs_report.render_fleet_requests([r])
+    assert "done+migr" in txt
+    assert "1 migrated" in txt
+    assert "kv migration:" in txt
+    assert "avg blocks 3.0" in txt and "6.0 KiB/req" in txt
+    assert "post p50 1.50 ms" in txt and "import p50 2.00 ms" in txt
+    assert "0 fallback(s)" in txt
+
+
+def test_obs_report_fleet_migration_fallback_rendered():
+    merged = _merged({
+        "driver": [
+            {"kind": "request_submit", "ts": 0.0, "rid": 0, "trace": "tB",
+             "prompt_len": 4},
+            {"kind": "request_migrated", "ts": 0.5, "rid": 0, "trace": "tB",
+             "from_replica": "r0", "post": "tB.npz", "blocks": 2,
+             "nbytes": 2048},
+        ],
+        "r1": [
+            {"kind": "kv_migration", "ts": 0.7, "trace": "tB",
+             "phase": "fallback", "reason": "load_failed"},
+            {"kind": "completion", "ts": 1.0, "rid": 0, "trace": "tB",
+             "tokens": 8, "latency_s": 0.9, "ttft_s": 0.4},
+        ],
+    })
+    [r] = obs_report.reconstruct_fleet_requests(merged)
+    assert r["migration"]["fallback"] == "load_failed"
+    txt = obs_report.render_fleet_requests([r])
+    assert "1 fallback(s)" in txt
+
+
+def test_fleet_roles_event_renders_and_tags_summary():
+    from distributed_tensorflow_tpu.observability import aggregate, format as fmt
+
+    ev = {"kind": "fleet_roles", "ts": 0.0,
+          "roles": {"r0": "prefill", "r1": "decode"},
+          "migrate_dir": "/tmp/m"}
+    [line] = fmt.render("fleet_roles", ev)
+    assert "Fleet: roles" in line
+    assert "r0=prefill" in line and "r1=decode" in line
+    assert "fleet_roles" in aggregate.GANG_KINDS
+
+    [mig] = fmt.render(
+        "request_migrated",
+        {"kind": "request_migrated", "trace": "t", "from_replica": "r0",
+         "post": "t.npz", "blocks": 2, "nbytes": 4096},
+    )
+    assert mig.startswith("Migrate:") and "from=r0" in mig
+    [kv] = fmt.render(
+        "kv_migration",
+        {"kind": "kv_migration", "phase": "import", "trace": "t",
+         "slot": 1, "wall_ms": 2.5},
+    )
+    assert kv.startswith("KV-migration:") and "phase=import" in kv
+
+
+def test_load_gen_summarize_counts_migrations():
+    from distributed_tensorflow_tpu.tools import load_gen
+
+    events = [
+        {"kind": "request_submit", "ts": 0.0, "rid": 0, "priority": 1},
+        {"kind": "request_route", "ts": 0.1, "rid": 0},
+        {"kind": "request_migrated", "ts": 0.5, "rid": 0, "nbytes": 4096},
+        {"kind": "fleet_result", "ts": 1.0, "rid": 0, "status": "done"},
+        {"kind": "request_submit", "ts": 0.0, "rid": 1},
+        {"kind": "request_route", "ts": 0.1, "rid": 1},
+        {"kind": "request_migrated", "ts": 0.6, "rid": 1, "nbytes": 8192},
+        {"kind": "fleet_result", "ts": 1.2, "rid": 1, "status": "done"},
+    ]
+    s = load_gen.summarize(events)
+    assert s["migrated"] == 2
+    assert s["kv_migration_bytes_per_req"] == 6144.0
+    assert s["classes"][1]["migrated"] == 1
+    assert s["classes"][0]["migrated"] == 1
+    # No migrations => the keys stay absent (round-21 summaries unchanged).
+    plain = load_gen.summarize(events[:2] + [
+        {"kind": "fleet_result", "ts": 1.0, "rid": 0, "status": "done"},
+    ])
+    assert "migrated" not in plain
+    assert "kv_migration_bytes_per_req" not in plain

@@ -1,0 +1,395 @@
+"""Round 23 — disaggregated prefill/decode handoff (docs/serving.md
+§disaggregation): the KV-migration seams, bottom-up. (1) the block
+export/import primitives round-trip paged pool blocks BIT-exactly
+(storage dtype + per-row scale side tensors; sentinel = ``num_blocks``
+drops, never writes), (2) the ``MigrationStore`` wire format survives
+npz encode/decode byte-for-byte and quarantines torn posts once
+(round-19 CRC discipline, ``fleet.migrate`` failpoint), and (3) a
+two-``TextServer`` handoff — prefill + first token on server A,
+``take_export`` → post → load → ``submit(resume=...)`` on server B — is
+token-identical to one server serving the request whole, greedy AND
+seeded-sampled, bf16 AND quantized KV (the round-15 uniform rule is
+what makes this hold). Single-device, fast tier; compile-tail matrix
+rows are heavy-marked per the round-14 audit rule (NOT in
+conftest._CACHE_OPT_OUT_FIRST).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.models.gpt import (
+    GPTLM,
+    export_kv_blocks,
+    import_kv_blocks,
+)
+from distributed_tensorflow_tpu.serve import GenerationConfig, TextServer
+from distributed_tensorflow_tpu.serve_fleet import MigrationStore
+
+from test_serve import _prompts, tiny_model
+
+
+def _run(srv):
+    while srv.step():
+        pass
+
+
+def _serve_one(srv, prompt, cfg):
+    rid = srv.submit(prompt, cfg)
+    _run(srv)
+    return srv.result(rid)
+
+
+def _paged_server(m, p, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("chunk", 4)
+    kw.setdefault("buckets", (8, 24))
+    kw.setdefault("paged", True)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("kv_blocks", 24)
+    return TextServer(m, p, **kw)
+
+
+# ---------------------------------------------------------------------------
+# (1) Block export/import primitives.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+def test_export_import_round_trips_bitwise(kv_dtype):
+    """Exported pool blocks re-imported at fresh ids reproduce the EXACT
+    storage bytes — payload and scale side pools alike. The oracle is
+    raw-view equality: uint8 over the payload, f32 bits over scales."""
+    m = tiny_model()
+    src = m.empty_paged_cache(2, 8, block_size=4, kv_dtype=kv_dtype)
+    rng = np.random.default_rng(0)
+    k = rng.normal(size=src.k.shape).astype(np.float32)
+    v = rng.normal(size=src.v.shape).astype(np.float32)
+    src = src._replace(
+        k=jnp.asarray(k).astype(src.k.dtype),
+        v=jnp.asarray(v).astype(src.v.dtype),
+    )
+    if kv_dtype != "bf16":
+        sc = rng.uniform(0.5, 2.0, size=src.k_scale.shape).astype(np.float32)
+        src = src._replace(
+            k_scale=jnp.asarray(sc), v_scale=jnp.asarray(sc * 0.5)
+        )
+    ids = [5, 1, 3]
+    blocks = export_kv_blocks(src, ids)
+    dst = m.empty_paged_cache(2, 8, block_size=4, kv_dtype=kv_dtype)
+    dst = import_kv_blocks(dst, [0, 2, 6], blocks)
+    for src_i, dst_i in zip(ids, [0, 2, 6]):
+        np.testing.assert_array_equal(
+            np.asarray(src.k[:, src_i]).view(np.uint8),
+            np.asarray(dst.k[:, dst_i]).view(np.uint8),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(src.v[:, src_i]).view(np.uint8),
+            np.asarray(dst.v[:, dst_i]).view(np.uint8),
+        )
+        if kv_dtype != "bf16":
+            np.testing.assert_array_equal(
+                np.asarray(src.k_scale[:, src_i]),
+                np.asarray(dst.k_scale[:, dst_i]),
+            )
+            np.testing.assert_array_equal(
+                np.asarray(src.v_scale[:, src_i]),
+                np.asarray(dst.v_scale[:, dst_i]),
+            )
+
+
+def test_import_sentinel_drops_never_wraps():
+    """Sentinel id == num_blocks DROPS the payload row; -1 must be
+    REFUSED (JAX would wrap it onto the last real block — the round-11
+    silent-corruption rule)."""
+    m = tiny_model()
+    cache = m.empty_paged_cache(1, 4, block_size=4)
+    marker = cache._replace(
+        k=jnp.full_like(cache.k, 7.0), v=jnp.full_like(cache.v, 7.0)
+    )
+    blocks = export_kv_blocks(marker, [0, 1])
+    out = import_kv_blocks(cache, [2, 4], blocks)  # 4 == num_blocks: drop
+    assert bool(jnp.all(out.k[:, 2] == jnp.asarray(7.0, out.k.dtype)))
+    assert bool(jnp.all(out.k[:, 3] == 0))  # the last block is untouched
+    with pytest.raises(ValueError, match="sentinel"):
+        import_kv_blocks(cache, [2, -1], blocks)
+
+
+# ---------------------------------------------------------------------------
+# (2) MigrationStore wire format (jax-free seam).
+# ---------------------------------------------------------------------------
+
+
+def _payload(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "arrays": {
+            "k": rng.integers(-128, 128, (2, 3, 4, 2, 8)).astype(np.int8),
+            "v": rng.integers(0, 255, (2, 3, 4, 2, 8)).astype(np.uint8),
+            "k_scale": np.ldexp(  # pow2 scales: f32 round-trip oracle
+                1.0, rng.integers(-8, 8, (2, 3, 4, 2))
+            ).astype(np.float32),
+            "key": rng.integers(0, 2**32 - 1, (2,)).astype(np.uint32),
+        },
+        "meta": {"kv_dtype": "int8", "length": 11, "blocks": 3},
+        "tokens": [5, 9],
+        "trace": "t-abc",
+    }
+
+
+def test_migration_store_round_trips_bit_exact(tmp_path):
+    store = MigrationStore(str(tmp_path))
+    pay = _payload()
+    store.post("t-abc.npz", pay)
+    out = store.load("t-abc.npz")
+    assert out is not None and out["trace"] == "t-abc"
+    assert out["tokens"] == [5, 9] and out["meta"] == pay["meta"]
+    assert set(out["arrays"]) == set(pay["arrays"])
+    for name, a in pay["arrays"].items():
+        b = out["arrays"][name]
+        assert b.dtype == a.dtype and b.shape == a.shape
+        np.testing.assert_array_equal(
+            a.view(np.uint8), b.view(np.uint8)
+        )  # BIT-exact, not merely close
+    # The importer never deletes; remove() is the router's edge.
+    assert store.load("t-abc.npz") is not None
+    store.remove("t-abc.npz")
+    assert store.load("t-abc.npz") is None  # missing → None, not an error
+    assert store.corrupt_files == 0
+
+
+def test_migration_store_round_trips_ml_dtypes_bitwise(tmp_path):
+    """fp8/bfloat16 storage arrays do not survive np.savez natively
+    (they load back as opaque void) — the store ships them as uint8
+    views + a header dtype record and rebuilds exactly (round-17
+    mailbox discipline; what the fp8 handoff parity rides on)."""
+    import ml_dtypes
+
+    rng = np.random.default_rng(1)
+    f8 = rng.normal(size=(2, 3, 4)).astype(ml_dtypes.float8_e4m3fn)
+    b16 = rng.normal(size=(3, 5)).astype(ml_dtypes.bfloat16)
+    store = MigrationStore(str(tmp_path))
+    store.post(
+        "x.npz",
+        {
+            "arrays": {"k": f8, "v": b16},
+            "meta": {"kv_dtype": "fp8"},
+            "tokens": [1],
+            "trace": "t",
+        },
+    )
+    out = store.load("x.npz")
+    assert out["arrays"]["k"].dtype == f8.dtype
+    assert out["arrays"]["v"].dtype == b16.dtype
+    np.testing.assert_array_equal(
+        out["arrays"]["k"].view(np.uint8), f8.view(np.uint8)
+    )
+    np.testing.assert_array_equal(
+        out["arrays"]["v"].view(np.uint8), b16.view(np.uint8)
+    )
+
+
+def test_migration_store_quarantines_torn_post_once(tmp_path):
+    """A COMMITTED-but-torn post (the ``fleet.migrate`` torn failpoint)
+    fails CRC at load: removed, counted, None — and the second load is
+    the missing-file path, so a corrupt post is never re-read forever."""
+    from distributed_tensorflow_tpu.train import failpoints
+
+    store = MigrationStore(str(tmp_path))
+    failpoints.configure("fleet.migrate:torn@1")
+    try:
+        store.post("torn.npz", _payload())
+    finally:
+        failpoints.configure(None)
+    assert store.load("torn.npz") is None
+    assert store.corrupt_files == 1
+    assert store.load("torn.npz") is None  # quarantined: gone
+    assert store.corrupt_files == 1
+
+
+def test_migration_store_raise_failpoint_surfaces_oserror(tmp_path):
+    from distributed_tensorflow_tpu.train import failpoints
+
+    store = MigrationStore(str(tmp_path))
+    failpoints.configure("fleet.migrate:raise@1")
+    try:
+        with pytest.raises(OSError):
+            store.post("x.npz", _payload())
+    finally:
+        failpoints.configure(None)
+    assert store.load("x.npz") is None  # nothing committed
+
+
+# ---------------------------------------------------------------------------
+# (3) Two-server handoff parity (the tentpole's contract).
+# ---------------------------------------------------------------------------
+
+
+def _handoff(m, p, prompt, cfg, store, *, kv_dtype="bf16", name="h.npz"):
+    """Prefill + first token on A, migrate through ``store``, finish on
+    B; returns B's served stream."""
+    a = _paged_server(m, p, kv_dtype=kv_dtype)
+    rid = a.submit(prompt, cfg, prefill_only=True)
+    _run(a)
+    assert a.done(rid)
+    export = a.take_export(rid)
+    assert export is not None and len(export["tokens"]) == 1
+    assert a.metrics.counter("migrations_exported_total").value == 1
+    store.post(name, export)
+    loaded = store.load(name)
+    assert loaded is not None
+    b = _paged_server(m, p, kv_dtype=kv_dtype)
+    rid_b = b.submit(
+        prompt,
+        cfg,
+        resume={"arrays": loaded["arrays"], "meta": loaded["meta"]},
+        emitted_tokens=loaded["tokens"],
+    )
+    _run(b)
+    assert b.metrics.counter("migrations_imported_total").value == 1
+    return b.result(rid_b)
+
+
+CFGS = {
+    "greedy": GenerationConfig(max_new=7),
+    "sampled": GenerationConfig(
+        max_new=7, greedy=False, temperature=0.9, top_p=0.9, seed=3
+    ),
+}
+
+
+@pytest.mark.parametrize(
+    "kv_dtype,cfg_name",
+    [
+        ("bf16", "greedy"),
+        ("int8", "sampled"),
+        pytest.param("fp8", "greedy", marks=pytest.mark.heavy),
+        pytest.param("bf16", "sampled", marks=pytest.mark.heavy),
+    ],
+)
+def test_handoff_stream_token_identical(tmp_path, kv_dtype, cfg_name):
+    m = tiny_model()
+    p = m.init(0)
+    prompt = _prompts(m.vocab_size, [11])[0]
+    cfg = CFGS[cfg_name]
+    ref = _serve_one(_paged_server(m, p, kv_dtype=kv_dtype), prompt, cfg)
+    got = _handoff(
+        m, p, prompt, cfg, MigrationStore(str(tmp_path)), kv_dtype=kv_dtype
+    )
+    assert np.array_equal(got, ref)
+
+
+@pytest.mark.heavy
+def test_handoff_gqa_windowed_model(tmp_path):
+    """The model-shape corners ride the same contract: GQA KV widths and
+    a rolling-window model migrate like dense (paged keeps full history,
+    windowing is a mask — round 11)."""
+    m = tiny_model(num_kv_heads=2, window=16)
+    p = m.init(1)
+    prompt = _prompts(m.vocab_size, [13], seed=2)[0]
+    cfg = GenerationConfig(max_new=6)
+    ref = _serve_one(_paged_server(m, p), prompt, cfg)
+    got = _handoff(m, p, prompt, cfg, MigrationStore(str(tmp_path)))
+    assert np.array_equal(got, ref)
+
+
+def test_torn_post_falls_back_to_replica_reprefill(tmp_path):
+    """The fallback matrix's main row: a torn migration post loads as
+    None, and the decode replica serves the request WHOLE — same stream,
+    one quarantine, and the radix/pool state of the decode server is
+    exactly a normal admission's (nothing to unwind)."""
+    from distributed_tensorflow_tpu.train import failpoints
+
+    m = tiny_model()
+    p = m.init(0)
+    prompt = _prompts(m.vocab_size, [9])[0]
+    cfg = GenerationConfig(max_new=5)
+    ref = _serve_one(_paged_server(m, p), prompt, cfg)
+
+    a = _paged_server(m, p)
+    rid = a.submit(prompt, cfg, prefill_only=True)
+    _run(a)
+    export = a.take_export(rid)
+    store = MigrationStore(str(tmp_path))
+    failpoints.configure("fleet.migrate:torn@1")
+    try:
+        store.post("t.npz", export)
+    finally:
+        failpoints.configure(None)
+    assert store.load("t.npz") is None and store.corrupt_files == 1
+    b = _paged_server(m, p)  # resume=None → the plain-submit path
+    assert np.array_equal(_serve_one(b, prompt, cfg), ref)
+    assert b.metrics.counter("migrations_imported_total").value == 0
+
+
+# ---------------------------------------------------------------------------
+# Validation edges (PERMANENT rejections — the router fails these
+# terminally; they must be loud and typed).
+# ---------------------------------------------------------------------------
+
+
+def test_submit_resume_validation_rejects_mismatches(tmp_path):
+    m = tiny_model()
+    p = m.init(0)
+    prompt = _prompts(m.vocab_size, [11])[0]
+    cfg = GenerationConfig(max_new=4)
+    a = _paged_server(m, p)
+    rid = a.submit(prompt, cfg, prefill_only=True)
+    _run(a)
+    export = a.take_export(rid)
+
+    slab = TextServer(m, p, slots=2, chunk=4, buckets=(24,))
+    with pytest.raises(ValueError, match="paged"):
+        slab.submit(prompt, cfg, prefill_only=True)
+    with pytest.raises(ValueError, match="paged"):
+        slab.submit(prompt, cfg, resume=export)
+
+    b = _paged_server(m, p)
+    with pytest.raises(ValueError):
+        b.submit(prompt, cfg, prefill_only=True, resume=export)
+    wrong_dtype = dict(export, meta=dict(export["meta"], kv_dtype="int8"))
+    with pytest.raises(ValueError, match="kv_dtype"):
+        b.submit(
+            prompt, cfg, resume=wrong_dtype, emitted_tokens=export["tokens"]
+        )
+    q = _paged_server(m, p, kv_dtype="int8")  # geometry mismatch vs bf16 post
+    with pytest.raises(ValueError):
+        q.submit(prompt, cfg, resume=export, emitted_tokens=export["tokens"])
+    with pytest.raises(ValueError):  # emitted count must match meta
+        b.submit(prompt, cfg, resume=export, emitted_tokens=[])
+
+
+def test_result_of_migrated_request_points_at_take_export():
+    m = tiny_model()
+    p = m.init(0)
+    a = _paged_server(m, p)
+    rid = a.submit(
+        _prompts(m.vocab_size, [9])[0],
+        GenerationConfig(max_new=4),
+        prefill_only=True,
+    )
+    _run(a)
+    with pytest.raises(RuntimeError, match="take_export"):
+        a.result(rid)
+    assert a.take_export(rid) is not None
+    assert a.take_export(rid) is None  # consumed
+
+
+def test_prefill_only_request_finishing_at_first_token_completes():
+    """max_new=1 (or EOS on the first token) has nothing to migrate:
+    the request completes normally on the prefill replica and
+    take_export returns None — the router's single-leg degenerate."""
+    m = tiny_model()
+    p = m.init(0)
+    prompt = _prompts(m.vocab_size, [9])[0]
+    cfg = GenerationConfig(max_new=1)
+    ref = _serve_one(_paged_server(m, p), prompt, cfg)
+    a = _paged_server(m, p)
+    rid = a.submit(prompt, cfg, prefill_only=True)
+    _run(a)
+    assert a.take_export(rid) is None
+    assert np.array_equal(a.result(rid), ref)

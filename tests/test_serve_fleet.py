@@ -1489,3 +1489,284 @@ def test_ewma_discards_compile_bearing_first_dispatch():
     while srv.step():
         pass
     assert srv._tok_ewma is not None and srv._tok_ewma > 0
+
+
+# ---------------------------------------------------------------------------
+# Round 23: disaggregated roles + two-leg migration routing (fakes).
+# ---------------------------------------------------------------------------
+
+
+class RoleFakeReplica(FakeReplica):
+    """FakeReplica that honors the round-23 payload keys: a ``migrate``
+    submit returns a migrated result (first token + a post name, and —
+    when ``store_dir`` is set — a REAL file in the migration store so
+    the router's post-lifetime ownership is observable); a ``resume``
+    submit asserts the post travelled and completes with the full
+    stream. Streams are deterministic per prompt, so a handoff (or a
+    fallback re-prefill) completes identically wherever it lands."""
+
+    def __init__(self, vocab=97, ticks=1, store_dir=None):
+        super().__init__(vocab=vocab, ticks=ticks)
+        self.store_dir = store_dir
+
+    def poll_results(self):
+        out, self.ready = self.ready, []
+        if self.frozen:
+            return out
+        for trace in list(self.active):
+            payload, left = self.active[trace]
+            if left > 1:
+                self.active[trace][1] = left - 1
+                continue
+            del self.active[trace]
+            cfg = payload.get("config") or {}
+            max_new = int(cfg.get("max_new", 4))
+            full = self.stream(payload["tokens"], max_new, self.vocab)
+            if payload.get("migrate"):
+                post = f"{trace}.npz"
+                if self.store_dir is not None:
+                    with open(
+                        f"{self.store_dir}/{post}", "w", encoding="utf-8"
+                    ) as f:
+                        f.write("post")
+                out.append({
+                    "trace": trace, "migrated": True, "post": post,
+                    "tokens": full[:1], "blocks": 2, "nbytes": 1024,
+                })
+            else:
+                if payload.get("resume") is not None:
+                    assert payload["resume"] == f"{trace}.npz"
+                    assert payload.get("emitted") == full[:1]
+                out.append({"trace": trace, "tokens": full})
+        return out
+
+
+def make_role_router(roles, *, ticks=1, store_dir=None, **kw):
+    clock = FakeClock()
+    handles = []
+    for i, role in enumerate(roles):
+        handles.append(ReplicaHandle(
+            f"r{i}",
+            client=RoleFakeReplica(ticks=ticks, store_dir=store_dir),
+            agent=ElasticAgent(f"r{i}", lambda: FakeProc([None])),
+            health=FakeHealth(),
+            role=role,
+        ))
+    j = _RecordingJournal()
+    kw.setdefault("backoff", 1.0)
+    kw.setdefault("jitter", 0.0)
+    kw.setdefault("probe_interval_s", 0.0)
+    if store_dir is not None:
+        kw.setdefault("migrate_dir", str(store_dir))
+    router = ReplicaRouter(
+        handles, journal=j, print_fn=lambda *a: None,
+        clock=clock, sleep=clock.sleep, **kw,
+    )
+    return router, clock, j
+
+
+def test_replica_handle_role_validation():
+    with pytest.raises(ValueError, match="role"):
+        ReplicaHandle("r0", client=FakeReplica(), role="prefiller")
+    h = ReplicaHandle("r0", client=FakeReplica(), role="prefill")
+    assert h.can_prefill and not h.can_decode
+    b = ReplicaHandle("r1", client=FakeReplica())
+    assert b.role == "both" and b.can_prefill and b.can_decode
+
+
+def test_router_two_leg_role_routing_and_parity():
+    """The tentpole's routing half on fakes: every request runs leg 1 on
+    the prefill replica, migrates, and finishes on the decode replica —
+    with the same stream a homogeneous fleet serves."""
+    router, clock, j = make_role_router(["prefill", "decode"])
+    rids = [router.submit([1, 2, 3, 4], {"max_new": 4}) for _ in range(3)]
+    _drive(router, clock)
+    for rid in rids:
+        assert router.result(rid) == _expect([1, 2, 3, 4], 4)
+    routes = j.kinds("request_route")
+    assert [e.get("leg") for e in routes].count("prefill") == 3
+    assert [e.get("leg") for e in routes].count("decode") == 3
+    assert {e["replica"] for e in routes if e.get("leg") == "prefill"} == {"r0"}
+    assert {e["replica"] for e in routes if e.get("leg") == "decode"} == {"r1"}
+    assert len(j.kinds("request_migrated")) == 3
+    assert len(j.kinds("fleet_roles")) == 1
+    assert router.metrics.counter("fleet_migrations_total").value == 3
+
+
+def test_router_homogeneous_fleet_stays_single_leg():
+    """All-both fleets keep the round-21 path: no legs, no migrate keys
+    in submit payloads, no roles event — byte-identical journals."""
+    router, clock, j = make_router(2)
+    router.start()
+    rid = router.submit([5, 6], {"max_new": 4})
+    _drive(router, clock)
+    assert router.result(rid) == _expect([5, 6], 4)
+    assert not router._two_leg
+    for h in router.replicas.values():
+        for payload in h.client.submitted:
+            assert "migrate" not in payload and "resume" not in payload
+    assert all("leg" not in e for e in j.kinds("request_route"))
+    assert j.kinds("fleet_roles") == []
+
+
+def test_router_single_prefill_replica_serves_decode_leg_itself():
+    """Fallback matrix: no decode-capable replica routable → ANY
+    routable replica serves the leg (roles are policy, not capability).
+    A one-prefill-replica fleet completes both legs on itself."""
+    router, clock, j = make_role_router(["prefill"])
+    rid = router.submit([9, 9], {"max_new": 3})
+    _drive(router, clock)
+    assert router.result(rid) == _expect([9, 9], 3)
+    routes = j.kinds("request_route")
+    assert [e.get("leg") for e in routes] == ["prefill", "decode"]
+    assert {e["replica"] for e in routes} == {"r0"}
+
+
+def test_router_decode_leg_failover_reimports_same_post(tmp_path):
+    """Zero-loss across the handoff: the decode replica SIGKILLs
+    mid-stream AFTER migration — the request re-routes to the other
+    decode replica with the SAME post (the router had not removed it:
+    it owns post lifetime until terminal), and the post file is removed
+    once the request completes."""
+    router, clock, j = make_role_router(
+        ["prefill", "decode", "decode"], ticks=10, store_dir=tmp_path,
+        max_restarts=2,
+    )
+    router.start()
+    router.step()
+    rid = router.submit([4, 2], {"max_new": 4})
+    req = router._by_rid[rid]
+    # Leg 1 completes quickly on r0 (drive until the migrated result).
+    for _ in range(40):
+        router.step()
+        clock.sleep(0.05)
+        if req.leg == "decode" and req.replica is not None:
+            break
+    assert req.resume_post is not None
+    post_path = tmp_path / req.resume_post
+    assert post_path.exists()
+    holder = router.replicas[req.replica]
+    assert holder.role == "decode"
+    holder.client.frozen = True
+    holder.agent.handle.script = [-9]
+    router.step()  # rc lands: failover re-routes the DECODE leg
+    assert req.replica != holder.name and req.leg == "decode"
+    assert req.resume_post is not None  # still the same post
+    _drive(router, clock)
+    assert router.result(rid) == _expect([4, 2], 4)
+    resumes = [
+        p for h in router.replicas.values()
+        for p in h.client.submitted if p.get("resume")
+    ]
+    assert len(resumes) == 2  # both decode replicas got the SAME post
+    assert {p["resume"] for p in resumes} == {req.resume_post}
+    assert not post_path.exists()  # removed at terminal
+    assert router.stats()["failovers"] == 1
+
+
+def test_router_deadline_and_priority_travel_both_legs():
+    router, clock, j = make_role_router(["prefill", "decode"])
+    rid = router.submit([7, 7], {"max_new": 4}, priority=2, deadline_s=60.0)
+    _drive(router, clock)
+    assert router.result(rid) == _expect([7, 7], 4)
+    legs = [
+        p for h in router.replicas.values() for p in h.client.submitted
+    ]
+    assert len(legs) == 2
+    for p in legs:
+        assert p["priority"] == 2
+        assert 0 < p["deadline_s"] <= 60.0
+
+
+def test_router_prefix_index_steers_prefill_leg_to_warm_replica():
+    """The fleet-wide prefix index: a repeat prompt routes its prefill
+    leg to the replica that already warmed those blocks, even while that
+    replica is the more loaded one; a cold prompt balances to the idle
+    replica instead. Index granularity is FULL blocks, so the test runs
+    2-token blocks over 6-token prompts (depth 3)."""
+    router, clock, j = make_role_router(
+        ["prefill", "prefill", "decode"], prefix_block_tokens=2,
+    )
+    warm = [11, 12, 13, 14, 15, 16]
+    rid = router.submit(warm, {"max_new": 3})
+    _drive(router, clock)
+    first = next(
+        e["replica"] for e in j.kinds("request_route")
+        if e.get("leg") == "prefill"
+    )
+    # Warm repeat + cold prompt queued TOGETHER: the warm one sticks to
+    # `first` via the index (making it the loaded replica), the cold one
+    # load-balances to the other, idle, prefill replica.
+    rid2 = router.submit(warm, {"max_new": 3})
+    rid3 = router.submit([80, 81, 82, 83, 84, 85], {"max_new": 3})
+    _drive(router, clock)
+    legs = [
+        (e["rid"], e["replica"]) for e in j.kinds("request_route")
+        if e.get("leg") == "prefill"
+    ]
+    by_rid = dict(legs[1:])
+    assert by_rid[rid2] == first  # warm prefix stuck to the same replica
+    assert by_rid[rid3] != first  # cold prompt balanced to the idle one
+    assert router.result(rid2) == _expect(warm, 3)
+    assert router.result(rid3) == _expect([80, 81, 82, 83, 84, 85], 3)
+
+
+def test_router_prefix_index_drops_dead_replicas_entries():
+    router, clock, j = make_role_router(
+        ["prefill", "prefill", "decode"], max_restarts=1,
+        prefix_block_tokens=2,
+    )
+    router.start()
+    router.step()
+    rid = router.submit([3, 1, 4, 1, 5, 9], {"max_new": 3})
+    _drive(router, clock)
+    assert router.result(rid) == _expect([3, 1, 4, 1, 5, 9], 3)
+    warm = next(
+        e["replica"] for e in j.kinds("request_route")
+        if e.get("leg") == "prefill"
+    )
+    name, depth = router._prefix_index.lookup([3, 1, 4, 1, 5, 9])
+    assert name == warm and depth >= 1
+    h = router.replicas[warm]
+    h.client.frozen = True
+    h.agent.handle.script = [-9]
+    router.step()  # dead verdict → drop_replica
+    assert router._prefix_index.lookup([3, 1, 4, 1, 5, 9])[0] != warm
+
+
+def test_router_migrate_threshold_short_prompt_serves_whole_on_decode():
+    """Length-threshold routing (DistServe policy): with
+    ``migrate_threshold`` set, a prompt SHORTER than the threshold skips
+    the handoff — it routes to a decode-capable replica and serves
+    whole (no migration events, no post), while a long prompt still
+    runs the two-leg path through the prefill pool. Default None keeps
+    every first leg on the prefill pool (the other tests' behavior)."""
+    router, clock, j = make_role_router(
+        ["prefill", "decode"], migrate_threshold=4
+    )
+    short = router.submit([5, 6], {"max_new": 4})           # 2 < 4
+    long_ = router.submit([1, 2, 3, 4, 5], {"max_new": 4})  # 5 >= 4
+    _drive(router, clock)
+    assert router.result(short) == _expect([5, 6], 4)
+    assert router.result(long_) == _expect([1, 2, 3, 4, 5], 4)
+    assert [e["rid"] for e in j.kinds("request_migrated")] == [long_]
+    routes = {
+        (e["rid"], e.get("leg")): e["replica"]
+        for e in j.kinds("request_route")
+    }
+    assert routes[(short, "prefill")] == "r1"  # whole, on the decoder
+    assert routes[(long_, "prefill")] == "r0"
+    assert routes[(long_, "decode")] == "r1"
+
+
+def test_local_fleet_per_replica_slots_length_validated(tmp_path):
+    from distributed_tensorflow_tpu import serve_fleet
+
+    with pytest.raises(ValueError, match="slots has 2 entries"):
+        serve_fleet.local_fleet(
+            {},
+            str(tmp_path / "ckpt"),
+            str(tmp_path / "fleet"),
+            replicas=3,
+            slots=[2, 4],
+        )
